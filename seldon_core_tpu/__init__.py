@@ -1,0 +1,22 @@
+"""seldon_core_tpu — a TPU-native model-serving framework.
+
+Capability surface mirrors Seldon Core (reference: /root/reference, v0.4.0 era):
+declarative inference graphs (MODEL / ROUTER / COMBINER / TRANSFORMER /
+OUTPUT_TRANSFORMER nodes), a single component contract for heterogeneous model
+runtimes, REST + gRPC transports sharing one payload schema, in-band custom
+metrics, tracing, feedback-driven routing (A/B, bandits), prepackaged model
+servers, cloud-storage model fetching and a load-testing harness.
+
+Architecture differs deliberately: where the reference orchestrates one
+microservice per graph node over HTTP/gRPC (engine/src/main/java/io/seldon/
+engine/predictors/PredictiveUnitBean.java:113-193 — a network hop + JSON<->proto
+codec per node), this framework executes the whole predictor graph in one
+process per replica. Graph nodes are composable JAX/XLA-compiled functions,
+request tensors are staged as device buffers at ingress, and large models shard
+over a TPU slice via jax.sharding meshes (ICI/DCN collectives) instead of
+service replicas.
+"""
+
+from seldon_core_tpu.version import __version__
+
+__all__ = ["__version__"]

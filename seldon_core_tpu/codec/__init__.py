@@ -1,0 +1,4 @@
+from seldon_core_tpu.codec.response import construct_response
+from seldon_core_tpu.codec.staging import stage_to_device
+
+__all__ = ["construct_response", "stage_to_device"]

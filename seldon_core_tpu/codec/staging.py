@@ -1,0 +1,65 @@
+"""Device staging: move request payloads onto TPU as XLA buffers.
+
+The reference keeps tensors as numpy between every hop and re-serializes per
+node (`python/seldon_core/utils.py:147-278`). Here, ingress decodes once and
+stages the array on device; graph nodes that are JAX computations consume the
+device buffer directly. Shape bucketing keeps XLA from recompiling per request
+size: batch dims are padded up to the next bucket so a small, fixed set of
+compiled programs serves all traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def bucket_size(n: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
+    """Smallest bucket >= n; grows by doubling past the last bucket."""
+    for b in buckets:
+        if n <= b:
+            return b
+    b = buckets[-1]
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_batch(arr: np.ndarray, buckets: Sequence[int] = DEFAULT_BUCKETS) -> Tuple[np.ndarray, int]:
+    """Pad the leading (batch) dim up to its bucket. Returns (padded, true_n)."""
+    n = arr.shape[0] if arr.ndim else 1
+    target = bucket_size(n, buckets)
+    if target == n:
+        return arr, n
+    pad_width = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width), n
+
+
+def stage_to_device(
+    arr: np.ndarray,
+    dtype: Optional[np.dtype] = None,
+    device=None,
+    sharding=None,
+    pad: bool = False,
+    buckets: Sequence[int] = DEFAULT_BUCKETS,
+):
+    """Decode-once device staging: numpy -> jax.Array on TPU (or given sharding).
+
+    Returns (device_array, true_batch). With ``pad=True`` the leading dim is
+    bucketed so downstream jitted fns hit the compile cache.
+    """
+    import jax
+
+    true_n = arr.shape[0] if arr.ndim else 1
+    if pad:
+        arr, true_n = pad_batch(arr, buckets)
+    if dtype is not None:
+        arr = arr.astype(dtype, copy=False)
+    if sharding is not None:
+        return jax.device_put(arr, sharding), true_n
+    if device is not None:
+        return jax.device_put(arr, device), true_n
+    return jax.device_put(arr), true_n

@@ -1,0 +1,15 @@
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.components.metrics import (
+    create_counter,
+    create_gauge,
+    create_timer,
+    validate_metrics,
+)
+
+__all__ = [
+    "SeldonComponent",
+    "create_counter",
+    "create_gauge",
+    "create_timer",
+    "validate_metrics",
+]

@@ -1,0 +1,117 @@
+"""Built-in graph units.
+
+Parity with the reference engine's hardcoded implementations used for tests,
+benchmarks and spec defaults (`engine/src/main/java/io/seldon/engine/
+predictors/{SimpleModelUnit,SimpleRouterUnit,AverageCombinerUnit,
+RandomABTestUnit}.java`) — except here they are JAX functions, so a graph of
+built-ins fuses into a single XLA computation.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.components.metrics import create_counter, create_gauge, create_timer
+from seldon_core_tpu.contracts.graph import UnitImplementation
+
+
+class SimpleModel(SeldonComponent):
+    """Constant stub model: returns [[0.1, 0.9, 0.5]] per row and sample
+    metrics, echoes bytes/str payloads — the benchmark stub of
+    `engine/.../SimpleModelUnit.java:33-64`."""
+
+    values = (0.1, 0.9, 0.5)
+    classes = ("class0", "class1", "class2")
+
+    def predict(self, X, names: Sequence[str], meta: Optional[Dict] = None):
+        if isinstance(X, (bytes, bytearray, str)) or X is None:
+            return X
+        import jax.numpy as jnp
+
+        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        rows = X.shape[0] if X.ndim > 1 else 1
+        return self._fn(None, jnp.zeros((rows,), dtype=jnp.float32))
+
+    def jax_fn(self):
+        return self._fn, None
+
+    @staticmethod
+    def _fn(params: Any, x):
+        import jax.numpy as jnp
+
+        rows = x.shape[0] if x.ndim >= 1 else 1
+        out = jnp.tile(jnp.asarray(SimpleModel.values, dtype=jnp.float32), (rows, 1))
+        return out
+
+    def class_names(self) -> List[str]:
+        return list(self.classes)
+
+    def metrics(self):
+        return [
+            create_counter("mycounter", 1.0),
+            create_gauge("mygauge", 100.0),
+            create_timer("mytimer", 20.6),
+        ]
+
+
+class SimpleRouter(SeldonComponent):
+    """Always route to branch 0 (`engine/.../SimpleRouterUnit.java`)."""
+
+    def route(self, X, names: Sequence[str]) -> int:
+        return 0
+
+
+class RandomABTest(SeldonComponent):
+    """Uniform-random branch choice (`engine/.../RandomABTestUnit.java`)."""
+
+    def __init__(self, ratioA: float = 0.5, n_branches: int = 2, seed: Optional[int] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.ratio_a = float(ratioA)
+        self.n_branches = int(n_branches)
+        self._rng = random.Random(seed)
+
+    def route(self, X, names: Sequence[str]) -> int:
+        if self.n_branches == 2:
+            return 0 if self._rng.random() < self.ratio_a else 1
+        return self._rng.randrange(self.n_branches)
+
+
+class AverageCombiner(SeldonComponent):
+    """Element-wise mean of child outputs (`engine/.../AverageCombinerUnit.java`
+    + `PredictorUtils.java`), as a jitted stacked-mean."""
+
+    def aggregate(self, Xs: Sequence[np.ndarray], names: Sequence[Sequence[str]]):
+        if not Xs:
+            raise ValueError("AverageCombiner requires at least one input")
+        import jax.numpy as jnp
+
+        shapes = {np.asarray(x).shape for x in Xs}
+        if len(shapes) != 1:
+            raise ValueError(f"AverageCombiner inputs must share a shape, got {sorted(shapes)}")
+        stacked = jnp.stack([jnp.asarray(np.asarray(x, dtype=np.float64)) for x in Xs])
+        return self._fn(None, stacked)
+
+    def jax_fn(self):
+        return self._fn, None
+
+    @staticmethod
+    def _fn(params: Any, stacked):
+        return stacked.mean(axis=0)
+
+
+def make_builtin(implementation: UnitImplementation, parameters: Optional[Dict[str, Any]] = None) -> SeldonComponent:
+    """Instantiate a built-in unit from a graph spec implementation."""
+    parameters = parameters or {}
+    if implementation == UnitImplementation.SIMPLE_MODEL:
+        return SimpleModel()
+    if implementation == UnitImplementation.SIMPLE_ROUTER:
+        return SimpleRouter()
+    if implementation == UnitImplementation.RANDOM_ABTEST:
+        return RandomABTest(**parameters)
+    if implementation == UnitImplementation.AVERAGE_COMBINER:
+        return AverageCombiner()
+    raise ValueError(f"No in-process builtin for implementation {implementation}")

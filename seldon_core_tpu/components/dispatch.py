@@ -1,0 +1,161 @@
+"""Method dispatch: SeldonMessage in -> component call -> SeldonMessage out.
+
+Capability of the reference's `python/seldon_core/seldon_methods.py:11-229`,
+shared by REST, gRPC and the in-process graph engine (the reference runs this
+only inside each microservice; here it is also the node-invocation layer of
+the single-process engine). For each method: prefer the component's ``*_raw``
+low-level hook, else extract the payload, call the high-level method, and
+construct the response with the reference's encoding rules.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.codec.response import construct_response, response_meta
+from seldon_core_tpu.components.component import (
+    client_aggregate,
+    client_predict,
+    client_route,
+    client_send_feedback,
+    client_transform_input,
+    client_transform_output,
+    has_raw,
+)
+from seldon_core_tpu.contracts.payload import (
+    Feedback,
+    Meta,
+    SeldonError,
+    SeldonMessage,
+    SeldonMessageList,
+)
+
+
+def _coerce_raw(component: Any, result: Any, request: Optional[SeldonMessage], is_request: bool):
+    """Normalize a *_raw return into a SeldonMessage. If the raw hook is a
+    coroutine (e.g. a remote node), returns a coroutine the caller awaits."""
+    if inspect.isawaitable(result):
+        async def _await():
+            return _coerce_raw(component, await result, request, is_request)
+
+        return _await()
+    if isinstance(result, SeldonMessage):
+        return result
+    if isinstance(result, dict):
+        return SeldonMessage.from_dict(result)
+    return construct_response(component, is_request, request, result)
+
+
+def predict(component: Any, request: SeldonMessage) -> SeldonMessage:
+    if has_raw(component, "predict"):
+        return _coerce_raw(component, component.predict_raw(request), request, is_request=False)
+    payload = request.payload()
+    result = client_predict(component, payload, request.names, meta=request.meta.to_dict())
+    return construct_response(component, False, request, result)
+
+
+def transform_input(component: Any, request: SeldonMessage) -> SeldonMessage:
+    if has_raw(component, "transform_input"):
+        return _coerce_raw(component, component.transform_input_raw(request), request, is_request=True)
+    payload = request.payload()
+    result = client_transform_input(component, payload, request.names, meta=request.meta.to_dict())
+    return construct_response(component, True, request, result)
+
+
+def transform_output(component: Any, request: SeldonMessage) -> SeldonMessage:
+    if has_raw(component, "transform_output"):
+        return _coerce_raw(component, component.transform_output_raw(request), request, is_request=False)
+    payload = request.payload()
+    result = client_transform_output(component, payload, request.names, meta=request.meta.to_dict())
+    return construct_response(component, False, request, result)
+
+
+def route(component: Any, request: SeldonMessage) -> SeldonMessage:
+    """Returns a 1x1 ndarray-encoded branch index, as the reference does
+    (`seldon_methods.py:159-189`); the index must be an int >= -1."""
+    if has_raw(component, "route"):
+        raw = component.route_raw(request)
+        msg = _coerce_raw(component, raw, request, is_request=False)
+        if inspect.isawaitable(msg):
+            async def _await():
+                out = await msg
+                _validate_route_msg(out)
+                return out
+
+            return _await()
+        _validate_route_msg(msg)
+        return msg
+    payload = request.payload()
+    branch = client_route(component, payload, request.names)
+    if not isinstance(branch, int) or isinstance(branch, bool):
+        raise SeldonError("Routing response must be an integer")
+    if branch < -1:
+        raise SeldonError(f"Routing response invalid: {branch} (must be >= -1)")
+    msg = construct_response(component, False, request, np.array([[branch]]))
+    if msg.data is not None:
+        msg.data.encoding = "ndarray"
+        msg.data.raw_ndarray = [[branch]]
+    return msg
+
+
+def _validate_route_msg(msg: SeldonMessage) -> None:
+    arr = msg.payload()
+    if isinstance(arr, np.ndarray):
+        flat = arr.ravel()
+        if flat.size != 1 or int(flat[0]) < -1:
+            raise SeldonError(f"Routing response invalid: {flat.tolist()}")
+
+
+def extract_route(msg: SeldonMessage) -> int:
+    arr = msg.payload()
+    if isinstance(arr, np.ndarray):
+        flat = arr.ravel()
+        if flat.size == 1:
+            return int(flat[0])
+    raise SeldonError("Routing response must contain a single integer")
+
+
+def aggregate(component: Any, requests: SeldonMessageList) -> SeldonMessage:
+    if has_raw(component, "aggregate"):
+        return _coerce_raw(component, component.aggregate_raw(requests.messages), None, is_request=False)
+    arrays: List[np.ndarray] = []
+    names: List[Sequence[str]] = []
+    for m in requests.messages:
+        arrays.append(m.payload())
+        names.append(m.names)
+    result = client_aggregate(component, arrays, names)
+    first = requests.messages[0] if requests.messages else None
+    return construct_response(component, False, first, result)
+
+
+def send_feedback(component: Any, feedback: Feedback, unit_id: Optional[str] = None) -> SeldonMessage:
+    """Deliver feedback. ``unit_id`` selects this unit's routing decision from
+    the response meta (the reference reads env PREDICTIVE_UNIT_ID,
+    `seldon_methods.py:52-90`)."""
+    if has_raw(component, "send_feedback"):
+        raw = component.send_feedback_raw(feedback)
+        if raw is None:
+            return SeldonMessage(meta=response_meta(component, None))
+        return _coerce_raw(component, raw, feedback.request, is_request=False)
+    # fall through to the high-level path below
+
+    features: Optional[np.ndarray] = None
+    feature_names: Sequence[str] = []
+    if feedback.request is not None:
+        features = feedback.request.payload()
+        feature_names = feedback.request.names
+    truth = feedback.truth.payload() if feedback.truth is not None else None
+
+    routing: Optional[int] = None
+    uid = unit_id if unit_id is not None else os.environ.get("PREDICTIVE_UNIT_ID", "")
+    if feedback.response is not None and uid:
+        routing = feedback.response.meta.routing.get(uid)
+
+    result = client_send_feedback(component, features, feature_names, feedback.reward, truth, routing)
+    if result is None:
+        return SeldonMessage(meta=response_meta(component, None))
+    return construct_response(component, False, feedback.request, result)

@@ -1,0 +1,53 @@
+"""In-band custom metric helpers.
+
+Components return a list of metric dicts from ``metrics()``; they flow through
+the response ``meta.metrics`` and are registered by the engine — the
+reference's distinctive metrics-in-the-payload design
+(`python/seldon_core/metrics.py:8-89`, `proto/prediction.proto:48-58`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+COUNTER = "COUNTER"
+GAUGE = "GAUGE"
+TIMER = "TIMER"
+_TYPES = (COUNTER, GAUGE, TIMER)
+
+
+def create_counter(key: str, value: float, tags: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    return _metric(key, COUNTER, value, tags)
+
+
+def create_gauge(key: str, value: float, tags: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    return _metric(key, GAUGE, value, tags)
+
+
+def create_timer(key: str, value: float, tags: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    return _metric(key, TIMER, value, tags)
+
+
+def _metric(key: str, mtype: str, value: float, tags: Optional[Dict[str, str]]) -> Dict[str, Any]:
+    d: Dict[str, Any] = {"key": key, "type": mtype, "value": value}
+    if tags:
+        d["tags"] = tags
+    return d
+
+
+def validate_metrics(metrics: Any) -> bool:
+    """Schema check mirroring the reference (`python/seldon_core/metrics.py:60-89`):
+    a list of {key: str, type: COUNTER|GAUGE|TIMER, value: number}."""
+    if not isinstance(metrics, (list, tuple)):
+        return False
+    for m in metrics:
+        if not isinstance(m, dict):
+            return False
+        if not isinstance(m.get("key"), str):
+            return False
+        if m.get("type") not in _TYPES:
+            return False
+        v = m.get("value")
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return False
+    return True

@@ -1,0 +1,45 @@
+"""Wire contracts: payload schema, graph spec, typed unit parameters.
+
+Mirrors the capability of the reference's `proto/prediction.proto` and
+`proto/seldon_deployment.proto` without porting code: payloads are lightweight
+Python dataclasses with a JSON codec that is wire-compatible with the
+reference's proto-JSON, and the graph spec parses SeldonDeployment-shaped
+dicts (CRD-compatible).
+"""
+
+from seldon_core_tpu.contracts.payload import (
+    DefaultData,
+    Feedback,
+    Meta,
+    Metric,
+    SeldonMessage,
+    SeldonMessageList,
+    Status,
+)
+from seldon_core_tpu.contracts.graph import (
+    PredictiveUnit,
+    PredictorSpec,
+    SeldonDeploymentSpec,
+    UnitImplementation,
+    UnitMethod,
+    UnitType,
+)
+from seldon_core_tpu.contracts.parameters import Parameter, parse_parameters
+
+__all__ = [
+    "DefaultData",
+    "Feedback",
+    "Meta",
+    "Metric",
+    "Parameter",
+    "PredictiveUnit",
+    "PredictorSpec",
+    "SeldonDeploymentSpec",
+    "SeldonMessage",
+    "SeldonMessageList",
+    "Status",
+    "UnitImplementation",
+    "UnitMethod",
+    "UnitType",
+    "parse_parameters",
+]

@@ -1,0 +1,3 @@
+from seldon_core_tpu.models.registry import get_model, register_model
+
+__all__ = ["get_model", "register_model"]

@@ -1,0 +1,32 @@
+"""MLP classifier — the minimal end-to-end model family (SURVEY.md §7 stage 4:
+"a Flax MLP served REST+gRPC"). bfloat16 matmuls by default so XLA tiles them
+onto the MXU."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.registry import register_model
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 128)
+    num_classes: int = 3
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for f in self.features:
+            x = nn.Dense(f, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return nn.softmax(x.astype(jnp.float32))
+
+
+@register_model("mlp")
+def make_mlp(features: Sequence[int] = (128, 128), num_classes: int = 3, dtype: str = "bfloat16"):
+    return MLP(features=tuple(features), num_classes=num_classes, dtype=jnp.dtype(dtype))

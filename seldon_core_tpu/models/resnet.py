@@ -1,0 +1,93 @@
+"""ResNet family (v1.5 bottleneck) in Flax — the BASELINE.json north-star
+model (ResNet-50 on v5e). Designed for the MXU: NHWC layout, bfloat16 compute,
+f32 batch-norm statistics, no data-dependent control flow, so XLA fuses the
+conv+BN+relu chains."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.registry import register_model
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides, name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=nn.relu,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+@register_model("resnet50")
+def make_resnet50(num_classes: int = 1000, dtype: str = "bfloat16"):
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=jnp.dtype(dtype))
+
+
+@register_model("resnet18")
+def make_resnet18(num_classes: int = 1000, dtype: str = "bfloat16"):
+    # 18-layer variant uses the same bottleneck stack shrunk to (2,2,2,2);
+    # kept bottleneck (not basic-block) for MXU-friendly 1x1 convs.
+    return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes, dtype=jnp.dtype(dtype))
+
+
+@register_model("resnet101")
+def make_resnet101(num_classes: int = 1000, dtype: str = "bfloat16"):
+    return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes, dtype=jnp.dtype(dtype))

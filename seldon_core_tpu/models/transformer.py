@@ -1,0 +1,294 @@
+"""Decoder-only transformer (Llama-family) in Flax, sharding-aware.
+
+Serves the BASELINE.json stretch config (Llama-2-7B on a v5e-8 pod). Written
+TPU-first:
+
+- all weights carry flax *logical* partitioning names; the parallel module
+  maps them onto a device mesh (tp over 'model', dp over 'data', sequence
+  parallel over 'seq') — XLA/GSPMD inserts the collectives over ICI.
+- GQA attention, rotary embeddings, RMSNorm, SwiGLU — bfloat16 on the MXU.
+- decode path uses a static-shape KV cache (scatter at position index), so
+  jit compiles one program per bucketed cache length.
+- optional mixture-of-experts FFN (expert-parallel 'expert' axis) for EP.
+
+No reference counterpart: the reference (a serving platform) has no model code
+at all; this is the native model family the TPU build adds (SURVEY.md §5
+"Long-context / sequence parallelism: absent — design from scratch").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import partitioning as nn_partitioning
+
+from seldon_core_tpu.models.registry import register_model
+
+param_with_axes = nn_partitioning.param_with_axes
+with_sharding_constraint = nn_partitioning.with_sharding_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # MoE: 0 = dense FFN; otherwise number of experts with top-2 routing.
+    n_experts: int = 0
+    n_experts_per_token: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (norm * weight).astype(x.dtype)
+
+
+def rotary_embedding(positions: jnp.ndarray, head_dim: int, theta: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for the given absolute positions: [..., seq, head_dim/2]."""
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., seq, hd/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: [batch, seq, heads, head_dim]; cos/sin: [batch, seq, head_dim/2]."""
+    cos = cos[:, :, None, :]  # broadcast over heads
+    sin = sin[:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    dim: int
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        w = param_with_axes("weight", nn.initializers.ones_init(), (self.dim,), jnp.float32, axes=("embed",))
+        return rms_norm(x, w, self.eps)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+                 cache_index: Optional[jnp.ndarray] = None):
+        """x: [b, s, d]. With cache=(k_cache, v_cache) of [b, max_len, kvh, hd]
+        and cache_index (scalar write offset), runs incremental decode and
+        returns (out, (new_k_cache, new_v_cache)); else full causal attention
+        and returns (out, (k, v))."""
+        cfg = self.cfg
+        b, s, _ = x.shape
+        hd = cfg.head_dim
+
+        wq = param_with_axes(
+            "wq", nn.initializers.lecun_normal(), (cfg.dim, cfg.n_heads * hd), jnp.float32,
+            axes=("embed", "heads"),
+        )
+        wk = param_with_axes(
+            "wk", nn.initializers.lecun_normal(), (cfg.dim, cfg.n_kv_heads * hd), jnp.float32,
+            axes=("embed", "kv_heads"),
+        )
+        wv = param_with_axes(
+            "wv", nn.initializers.lecun_normal(), (cfg.dim, cfg.n_kv_heads * hd), jnp.float32,
+            axes=("embed", "kv_heads"),
+        )
+        wo = param_with_axes(
+            "wo", nn.initializers.lecun_normal(), (cfg.n_heads * hd, cfg.dim), jnp.float32,
+            axes=("heads", "embed"),
+        )
+
+        dt = cfg.dtype
+        q = (x @ wq.astype(dt)).reshape(b, s, cfg.n_heads, hd)
+        k = (x @ wk.astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+        v = (x @ wv.astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+
+        cos, sin = rotary_embedding(positions, hd, cfg.rope_theta)
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+
+        if cache is not None:
+            k_cache, v_cache = cache
+            idx = jnp.asarray(cache_index, dtype=jnp.int32)
+            k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, idx, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, idx, 0, 0))
+            k_all, v_all = k_cache, v_cache
+            kv_len = k_cache.shape[1]
+            new_cache = (k_cache, v_cache)
+        else:
+            k_all, v_all = k, v
+            kv_len = s
+            new_cache = (k, v)
+        # Cache slots are laid out by absolute position, so one predicate covers
+        # causality and the unfilled suffix: key position <= query position.
+        kv_pos = jnp.arange(kv_len)
+        mask = kv_pos[None, None, :] <= positions[:, :, None]  # [b, s, kv]
+
+        # GQA: repeat kv heads up to n_heads
+        if cfg.n_kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k_all = jnp.repeat(k_all, rep, axis=2)
+            v_all = jnp.repeat(v_all, rep, axis=2)
+
+        scale = hd**-0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_all.astype(dt)) * scale
+        logits = logits.astype(jnp.float32)
+        logits = jnp.where(mask[:, None, :, :], logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all.astype(dt))
+        out = out.reshape(b, s, cfg.n_heads * hd)
+        out = out @ wo.astype(dt)
+        return out, new_cache
+
+
+class DenseFFN(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        w1 = param_with_axes("w1", nn.initializers.lecun_normal(), (cfg.dim, cfg.ffn_dim), jnp.float32,
+                             axes=("embed", "mlp"))
+        w2 = param_with_axes("w2", nn.initializers.lecun_normal(), (cfg.ffn_dim, cfg.dim), jnp.float32,
+                             axes=("mlp", "embed"))
+        w3 = param_with_axes("w3", nn.initializers.lecun_normal(), (cfg.dim, cfg.ffn_dim), jnp.float32,
+                             axes=("embed", "mlp"))
+        dt = cfg.dtype
+        return (jax.nn.silu(x @ w1.astype(dt)) * (x @ w3.astype(dt))) @ w2.astype(dt)
+
+
+class MoEFFN(nn.Module):
+    """Top-k token-choice MoE with an 'expert' partition axis (EP). Dense
+    einsum formulation — every expert computes every token, weighted by the
+    router — which is XLA-friendly at small expert counts and shards cleanly
+    over the expert axis."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        e = cfg.n_experts
+        dt = cfg.dtype
+        router = param_with_axes("router", nn.initializers.lecun_normal(), (cfg.dim, e), jnp.float32,
+                                 axes=("embed", "expert"))
+        w1 = param_with_axes("w1", nn.initializers.lecun_normal(), (e, cfg.dim, cfg.ffn_dim), jnp.float32,
+                             axes=("expert", "embed", "mlp"))
+        w2 = param_with_axes("w2", nn.initializers.lecun_normal(), (e, cfg.ffn_dim, cfg.dim), jnp.float32,
+                             axes=("expert", "mlp", "embed"))
+        w3 = param_with_axes("w3", nn.initializers.lecun_normal(), (e, cfg.dim, cfg.ffn_dim), jnp.float32,
+                             axes=("expert", "embed", "mlp"))
+
+        gate_logits = (x.astype(jnp.float32) @ router)  # [b, s, e]
+        k = min(cfg.n_experts_per_token, e)
+        topv, topi = jax.lax.top_k(gate_logits, k)
+        gates = jax.nn.softmax(topv, axis=-1)  # [b, s, k]
+        # dense weights [b, s, e]: scatter top-k gates
+        dense_gates = jnp.zeros_like(gate_logits).at[
+            jnp.arange(x.shape[0])[:, None, None],
+            jnp.arange(x.shape[1])[None, :, None],
+            topi,
+        ].set(gates)
+        h = jax.nn.silu(jnp.einsum("bsd,edf->bsef", x, w1.astype(dt))) * jnp.einsum(
+            "bsd,edf->bsef", x, w3.astype(dt)
+        )
+        y = jnp.einsum("bsef,efd->bsed", h, w2.astype(dt))
+        return jnp.einsum("bsed,bse->bsd", y, dense_gates.astype(dt))
+
+
+class TransformerBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache=None, cache_index=None):
+        cfg = self.cfg
+        h, new_cache = Attention(cfg, name="attention")(
+            RMSNorm(cfg.dim, cfg.norm_eps, name="attention_norm")(x), positions, cache, cache_index
+        )
+        x = x + h
+        ffn_in = RMSNorm(cfg.dim, cfg.norm_eps, name="ffn_norm")(x)
+        if cfg.n_experts > 0:
+            f = MoEFFN(cfg, name="moe")(ffn_in)
+        else:
+            f = DenseFFN(cfg, name="ffn")(ffn_in)
+        return x + f, new_cache
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, caches=None, cache_index=None):
+        """tokens: [b, s] int32. Returns (logits [b, s, vocab], new_caches)."""
+        cfg = self.cfg
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        emb = param_with_axes(
+            "tok_embeddings", nn.initializers.normal(stddev=0.02), (cfg.vocab_size, cfg.dim),
+            jnp.float32, axes=("vocab", "embed"),
+        )
+        x = emb.astype(cfg.dtype)[tokens]
+        x = with_sharding_constraint(x, ("batch", "seq", "embed"))
+        new_caches = []
+        for i in range(cfg.n_layers):
+            layer_cache = caches[i] if caches is not None else None
+            x, nc = TransformerBlock(cfg, name=f"layer_{i}")(x, positions, layer_cache, cache_index)
+            new_caches.append(nc)
+        x = RMSNorm(cfg.dim, cfg.norm_eps, name="norm")(x)
+        logits = x.astype(jnp.float32) @ emb.T
+        return logits, new_caches
+
+
+def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int):
+    """Static-shape KV caches: one (k, v) pair per layer, [b, max_len, kvh, hd]."""
+    shape = (batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return [
+        (jnp.zeros(shape, dtype=cfg.dtype), jnp.zeros(shape, dtype=cfg.dtype))
+        for _ in range(cfg.n_layers)
+    ]
+
+
+@register_model("transformer")
+def make_transformer(**kwargs):
+    dtype = kwargs.pop("dtype", "bfloat16")
+    cfg = TransformerConfig(dtype=jnp.dtype(dtype), **kwargs)
+    return Transformer(cfg)
+
+
+@register_model("llama2-7b")
+def make_llama2_7b(dtype: str = "bfloat16"):
+    cfg = TransformerConfig(
+        vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=32,
+        ffn_dim=11008, max_seq_len=4096, dtype=jnp.dtype(dtype),
+    )
+    return Transformer(cfg)
+
+
+@register_model("llama-tiny")
+def make_llama_tiny(dtype: str = "float32", n_experts: int = 0):
+    """Small config for tests and the multi-chip dry run."""
+    cfg = TransformerConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=128, dtype=jnp.dtype(dtype), n_experts=n_experts,
+    )
+    return Transformer(cfg)

@@ -1,0 +1,8 @@
+from seldon_core_tpu.parallel.mesh import make_mesh
+from seldon_core_tpu.parallel.sharding import (
+    DEFAULT_LOGICAL_RULES,
+    shard_apply,
+    shard_params,
+)
+
+__all__ = ["DEFAULT_LOGICAL_RULES", "make_mesh", "shard_apply", "shard_params"]

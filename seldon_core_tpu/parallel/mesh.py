@@ -1,0 +1,49 @@
+"""Device meshes: the scaling substrate.
+
+The reference scales by Kubernetes replicas + HPA and pays the pod network for
+every hop (SURVEY.md §2 parallelism note). Here scaling is a
+``jax.sharding.Mesh`` over TPU chips: data-parallel replica serving ('data'),
+GSPMD tensor parallelism ('model'), sequence parallelism for long context
+('seq'), expert parallelism ('expert') and pipeline stages ('pipe'). XLA lowers
+the resulting collectives onto ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+def make_mesh(
+    axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+):
+    """Build a Mesh with the given {axis_name: size}. Sizes of -1 are inferred
+    from the device count (at most one -1). Axis order is preserved; ICI-heavy
+    axes ('model', 'seq') should come last so neighboring devices serve them."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = dict(axes)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {unknown}")
+    known = math.prod(v for v in sizes.values() if v != -1)
+    if unknown:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by fixed axes product {known}")
+        sizes[unknown[0]] = n // known
+    total = math.prod(sizes.values())
+    if total != n:
+        raise ValueError(f"Mesh axes {sizes} need {total} devices, have {n}")
+    mesh_devices = np.array(devices).reshape(*sizes.values())
+    return Mesh(mesh_devices, tuple(sizes.keys()))
+
+
+def serving_mesh(model_parallel: int = 1, devices: Optional[Sequence] = None):
+    """Standard serving mesh: ('data', 'model') with tp innermost for ICI."""
+    return make_mesh({"data": -1, "model": model_parallel}, devices)

@@ -1,0 +1,158 @@
+"""Parameter + activation sharding via GSPMD.
+
+Models in seldon_core_tpu.models carry flax *logical* axis names on their
+params (param_with_axes). This module maps logical names onto mesh axes with a
+rule table and jits the apply function with NamedShardings, letting XLA insert
+all_gather/reduce_scatter/psum over ICI — the TPU-native replacement for the
+reference's replica-per-pod scaling (SURVEY.md §2 parallelism note).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+# logical axis -> mesh axis (None = replicated). Megatron-style layout:
+# hidden/ffn/head dims shard over 'model'; batch over 'data'; sequence over
+# 'seq' (long-context); experts over 'expert'.
+DEFAULT_LOGICAL_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
+    ("batch", "data"),
+    ("seq", None),
+    ("embed", None),
+    ("heads", "model"),
+    ("kv_heads", "model"),
+    ("mlp", "model"),
+    ("vocab", "model"),
+    ("expert", "expert"),
+)
+
+
+def _rules_for_mesh(mesh, rules) -> list:
+    """Drop rules whose mesh axis doesn't exist on this mesh."""
+    available = set(mesh.axis_names)
+    out = []
+    for logical, physical in rules:
+        out.append((logical, physical if physical in available else None))
+    return out
+
+
+def logical_axis_tree(module, example_input):
+    """Abstract-init the module to recover the logical PartitionSpec tree for
+    its params (the 'params_axes' collection), without allocating memory."""
+    import jax
+    from flax.linen import partitioning as nn_partitioning
+
+    def _init():
+        return module.init(jax.random.PRNGKey(0), example_input)
+
+    abstract = jax.eval_shape(_init)
+    if "params_axes" not in abstract:
+        return None
+    return nn_partitioning.get_axis_names(abstract["params_axes"])
+
+
+def shard_params(params: Any, mesh, logical_specs: Any, rules=DEFAULT_LOGICAL_RULES):
+    """device_put the param pytree with NamedShardings from logical specs.
+    Params without a spec (or when logical_specs is None) are replicated."""
+    import jax
+    from flax.linen import partitioning as nn_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rules = _rules_for_mesh(mesh, rules)
+    replicated = NamedSharding(mesh, P())
+
+    if logical_specs is None:
+        return jax.device_put(params, replicated)
+
+    def to_sharding(spec):
+        mesh_spec = nn_partitioning.logical_to_mesh_axes(spec, rules=rules)
+        return NamedSharding(mesh, P(*mesh_spec))
+
+    flat_p, treedef_p = jax.tree.flatten(params)
+    specs_for_params = _align_specs(params, logical_specs)
+    flat_s, _ = jax.tree.flatten(specs_for_params, is_leaf=lambda x: x is None or _is_spec(x))
+    if len(flat_s) != len(flat_p):
+        logger.warning("param/spec tree mismatch (%d vs %d); replicating params", len(flat_p), len(flat_s))
+        return jax.device_put(params, replicated)
+    out = [
+        jax.device_put(p, to_sharding(s) if s is not None else replicated)
+        for p, s in zip(flat_p, flat_s)
+    ]
+    return jax.tree.unflatten(treedef_p, out)
+
+
+def _is_spec(x) -> bool:
+    from jax.sharding import PartitionSpec
+
+    return isinstance(x, (tuple, PartitionSpec))
+
+
+def _align_specs(params: Any, logical_specs: Any):
+    """The params tree may contain collections (params/batch_stats) while the
+    axes tree covers only 'params'. Walk params and pull matching specs, None
+    where absent."""
+    import jax
+
+    spec_map = {}
+
+    def record(path, leaf):
+        spec_map[tuple(str(k) for k in path)] = leaf
+
+    jax.tree_util.tree_map_with_path(record, logical_specs, is_leaf=_is_spec)
+
+    def lookup(path, leaf):
+        key = tuple(str(k) for k in path)
+        # try suffix match: params tree has a leading collection key
+        if key in spec_map:
+            return spec_map[key]
+        if len(key) > 1 and key[1:] in spec_map:
+            return spec_map[key[1:]]
+        return None
+
+    return jax.tree_util.tree_map_with_path(lookup, params)
+
+
+def shard_apply(
+    apply_fn: Callable,
+    module,
+    params: Any,
+    mesh,
+    rules=None,
+    example_input=None,
+    batch_axis: str = "data",
+):
+    """Return (jitted_apply, sharded_params) for mesh execution.
+
+    - params shard per the module's logical axis names (replicated fallback);
+    - inputs/outputs shard their leading batch dim over ``batch_axis``;
+    - the mesh is installed as context so flax sharding constraints resolve.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rules = tuple(rules) if rules is not None else DEFAULT_LOGICAL_RULES
+
+    logical_specs = None
+    if example_input is not None:
+        try:
+            logical_specs = logical_axis_tree(module, example_input)
+        except Exception as e:
+            logger.warning("could not derive logical axes (%s); replicating params", e)
+    sharded_params = shard_params(params, mesh, logical_specs, rules)
+
+    batch_sharding = NamedSharding(mesh, P(batch_axis))
+    replicated = NamedSharding(mesh, P())
+
+    jitted = jax.jit(
+        apply_fn,
+        in_shardings=(None, batch_sharding),
+        out_shardings=batch_sharding,
+    )
+
+    def run(p, x):
+        with mesh:
+            return jitted(p, x)
+
+    return run, sharded_params

@@ -1,0 +1,3 @@
+from seldon_core_tpu.runtime.engine import GraphEngine, PredictorState
+
+__all__ = ["GraphEngine", "PredictorState"]

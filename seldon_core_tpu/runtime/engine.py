@@ -1,0 +1,404 @@
+"""In-process inference-graph engine.
+
+Implements the reference orchestrator's graph semantics
+(`engine/src/main/java/io/seldon/engine/predictors/PredictiveUnitBean.java:81-237`):
+
+    per node: transformInput -> route (-1 = all children) -> children ->
+              aggregate -> transformOutput
+    meta: merge tags, accumulate metrics, record routing + requestPath
+    feedback: deliver to node, then replay only down the routed branch
+
+with two deliberate architecture changes:
+
+1. **One process, zero hops.** The reference pays a network round-trip and an
+   ndarray<->proto codec per node (`service/InternalPredictionService.java:
+   354-443`). Here every in-process node is a direct call; only nodes with an
+   explicit ``endpoint`` go over the network (runtime.remote).
+2. **Whole-graph XLA fusion.** Router-free subgraphs whose components expose
+   ``jax_fn()`` are composed into a single jitted function at build time, so a
+   MODEL->COMBINER fan-out executes as one fused XLA program on TPU rather
+   than N async futures (`PredictiveUnitBean.java:167-177`'s thread pool).
+
+The engine also builds graph state ONCE at startup — the reference rebuilds it
+per request (`service/PredictionService.java:113`), which SURVEY.md flags as a
+hot-path cost to avoid.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from seldon_core_tpu.components import dispatch
+from seldon_core_tpu.components.builtin import make_builtin
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.graph import (
+    PredictiveUnit,
+    PredictorSpec,
+    UnitImplementation,
+    UnitMethod,
+    UnitType,
+)
+from seldon_core_tpu.contracts.payload import (
+    Feedback,
+    Meta,
+    SeldonError,
+    SeldonMessage,
+    SeldonMessageList,
+)
+
+logger = logging.getLogger(__name__)
+
+ComponentFactory = Callable[[PredictiveUnit], SeldonComponent]
+
+
+def make_puid() -> str:
+    """Request id: 26 base32-ish chars, the entropy class of the reference's
+    SecureRandom 130-bit id (`service/PredictionService.java:77-83`)."""
+    return secrets.token_hex(16)
+
+
+@dataclass
+class UnitState:
+    """Built (static) state for one graph node: resolved component + children.
+
+    Equivalent of `engine/.../PredictiveUnitState.java:37-125`, constructed
+    once at engine build, never per request.
+    """
+
+    name: str
+    unit: PredictiveUnit
+    component: Optional[SeldonComponent]
+    children: List["UnitState"] = field(default_factory=list)
+    image: str = ""
+    # Set when this node's entire subtree fused into one jitted callable.
+    fused_fn: Optional[Callable[[Any], Any]] = None
+
+    @property
+    def methods(self) -> List[UnitMethod]:
+        return self.unit.resolved_methods()
+
+    def has_method(self, m: UnitMethod) -> bool:
+        return m in self.methods
+
+
+class PredictorState:
+    """Immutable built graph for one predictor."""
+
+    def __init__(self, spec: PredictorSpec, root: UnitState):
+        self.spec = spec
+        self.root = root
+
+    def walk(self):
+        stack = [self.root]
+        while stack:
+            s = stack.pop()
+            yield s
+            stack.extend(s.children)
+
+    def unit_by_name(self, name: str) -> Optional[UnitState]:
+        for s in self.walk():
+            if s.name == name:
+                return s
+        return None
+
+
+class GraphEngine:
+    """Builds and executes a predictor graph.
+
+    components: name -> live SeldonComponent for in-process user nodes.
+    factory: fallback resolver for units this engine cannot resolve itself
+             (used by servers/ to wire prepackaged servers from modelUri).
+    """
+
+    def __init__(
+        self,
+        spec: PredictorSpec,
+        components: Optional[Dict[str, SeldonComponent]] = None,
+        factory: Optional[ComponentFactory] = None,
+        fuse: bool = True,
+        remote_client: Optional[Any] = None,
+    ):
+        self.spec = spec
+        self._components = dict(components or {})
+        self._factory = factory
+        self._fuse = fuse
+        self._remote_client = remote_client
+        self.state = self._build(spec)
+        if fuse:
+            self._try_fuse(self.state.root)
+
+    # ------------------------------------------------------------------
+    # Build
+    # ------------------------------------------------------------------
+    def _build(self, spec: PredictorSpec) -> PredictorState:
+        root = self._build_unit(spec.graph)
+        return PredictorState(spec, root)
+
+    def _build_unit(self, unit: PredictiveUnit) -> UnitState:
+        component = self._resolve(unit)
+        image = type(component).__name__ if component is not None else (
+            f"{unit.endpoint.service_host}:{unit.endpoint.service_port}" if unit.endpoint else ""
+        )
+        state = UnitState(
+            name=unit.name,
+            unit=unit,
+            component=component,
+            children=[self._build_unit(c) for c in unit.children],
+            image=image,
+        )
+        return state
+
+    def _resolve(self, unit: PredictiveUnit) -> Optional[SeldonComponent]:
+        if unit.name in self._components:
+            comp = self._components[unit.name]
+        elif unit.implementation is not None and unit.implementation not in (
+            UnitImplementation.UNKNOWN_IMPLEMENTATION,
+        ):
+            comp = self._make_implementation(unit)
+        elif unit.endpoint is not None and unit.endpoint.service_host:
+            from seldon_core_tpu.runtime.remote import RemoteComponent
+
+            comp = RemoteComponent(unit.endpoint, client=self._remote_client)
+        elif self._factory is not None:
+            comp = self._factory(unit)
+        else:
+            raise SeldonError(
+                f"Cannot resolve component for unit {unit.name!r}: no registered component, "
+                f"implementation, or endpoint",
+                reason="BAD_GRAPH",
+                status_code=500,
+            )
+        if comp is not None and hasattr(comp, "load"):
+            comp.load()
+        return comp
+
+    def _make_implementation(self, unit: PredictiveUnit) -> SeldonComponent:
+        impl = unit.implementation
+        params = unit.parameters_dict()
+        try:
+            return make_builtin(impl, params)
+        except ValueError:
+            pass
+        from seldon_core_tpu.servers import make_prepackaged_server
+
+        return make_prepackaged_server(impl, unit.model_uri, params)
+
+    # ------------------------------------------------------------------
+    # Whole-graph XLA fusion
+    # ------------------------------------------------------------------
+    def _try_fuse(self, state: UnitState) -> Optional[Callable[[Any], Any]]:
+        """Bottom-up: if this node and all children are pure jax fns (and no
+        routing decision is needed), produce one jitted callable for the
+        subtree. Falls back silently; correctness never depends on fusion."""
+        child_fns = [self._try_fuse(c) for c in state.children]
+
+        fusible = (
+            state.component is not None
+            and not state.has_method(UnitMethod.ROUTE)
+            and all(f is not None for f in child_fns)
+        )
+        if not fusible:
+            return None
+        pair = state.component.jax_fn() if hasattr(state.component, "jax_fn") else None
+        if pair is None:
+            return None
+        fn, params = pair
+
+        is_combiner = state.has_method(UnitMethod.AGGREGATE)
+        if state.children and not is_combiner and len(state.children) > 1:
+            return None  # multiple children need a combiner to merge
+
+        import jax
+        import jax.numpy as jnp
+
+        children = list(child_fns)
+
+        if not state.children:
+            def subtree(x, _fn=fn, _p=params):
+                return _fn(_p, x)
+        elif is_combiner:
+            def subtree(x, _fn=fn, _p=params, _children=children):
+                outs = [c(x) for c in _children]
+                return _fn(_p, jnp.stack(outs))
+        else:
+            # transformer/model with a single child: this node transforms the
+            # input, the child consumes it.
+            child = children[0]
+
+            def subtree(x, _fn=fn, _p=params, _child=child):
+                return _child(_fn(_p, x))
+
+        state.fused_fn = jax.jit(subtree)
+        logger.info("fused subtree at unit %s into one XLA computation", state.name)
+        return subtree
+
+    # ------------------------------------------------------------------
+    # Predict
+    # ------------------------------------------------------------------
+    async def predict(self, request: SeldonMessage) -> SeldonMessage:
+        if not request.meta.puid:
+            request.meta.puid = make_puid()
+        puid = request.meta.puid
+        response = await self._get_output(self.state.root, request)
+        response.meta.puid = puid
+        return response
+
+    def predict_sync(self, request: SeldonMessage) -> SeldonMessage:
+        return asyncio.run(self.predict(request))
+
+    async def _get_output(self, state: UnitState, message: SeldonMessage) -> SeldonMessage:
+        # Fused fast path: the whole subtree is one XLA call.
+        if state.fused_fn is not None and message.which == "data" and message.data is not None:
+            arr = message.data.to_numpy()
+            out = state.fused_fn(np.asarray(arr, dtype=np.float32) if arr.dtype != np.float32 else arr)
+            resp = dispatch.construct_response(state.component, False, message, out)
+            self._merge_meta(resp, message.meta)
+            self._record_path(resp, state)
+            return resp
+
+        # 1. transformInput (for MODEL this is predict — the reference maps
+        #    MODEL.transformInput to the predict method,
+        #    `PredictorConfigBean.java:30-107`).
+        if state.has_method(UnitMethod.TRANSFORM_INPUT):
+            if state.unit.type == UnitType.MODEL:
+                transformed = await self._call(dispatch.predict, state, message)
+            else:
+                transformed = await self._call(dispatch.transform_input, state, message)
+            self._merge_meta(transformed, message.meta)
+        else:
+            transformed = message
+
+        # 2. route
+        branch = -1
+        if state.has_method(UnitMethod.ROUTE) and state.children:
+            route_msg = await self._call(dispatch.route, state, transformed)
+            branch = dispatch.extract_route(route_msg)
+            if branch >= len(state.children):
+                raise SeldonError(
+                    f"Router {state.name} returned branch {branch} but unit has "
+                    f"{len(state.children)} children",
+                    status_code=500,
+                    reason="BAD_ROUTING",
+                )
+            transformed.meta.routing[state.name] = branch
+            self._merge_meta(transformed, route_msg.meta, routing_only_tags=True)
+
+        # 3. children
+        if state.children:
+            if branch == -1:
+                child_outputs = await asyncio.gather(
+                    *[self._get_output(c, transformed) for c in state.children]
+                )
+            else:
+                child_outputs = [await self._get_output(state.children[branch], transformed)]
+        else:
+            child_outputs = []
+
+        # 4. aggregate / merge
+        if state.has_method(UnitMethod.AGGREGATE):
+            if not child_outputs:
+                child_outputs = [transformed]
+            merged = await self._call(
+                dispatch.aggregate, state, SeldonMessageList(messages=list(child_outputs))
+            )
+            for co in child_outputs:
+                self._merge_meta(merged, co.meta)
+        elif len(child_outputs) == 1:
+            merged = child_outputs[0]
+        elif len(child_outputs) > 1:
+            raise SeldonError(
+                f"Unit {state.name} has {len(child_outputs)} child outputs but no "
+                f"COMBINER to aggregate them",
+                status_code=500,
+                reason="BAD_GRAPH",
+            )
+        else:
+            merged = transformed
+
+        # 5. transformOutput
+        if state.has_method(UnitMethod.TRANSFORM_OUTPUT):
+            out = await self._call(dispatch.transform_output, state, merged)
+            self._merge_meta(out, merged.meta)
+        else:
+            out = merged
+
+        self._record_path(out, state)
+        return out
+
+    async def _call(self, fn: Callable, state: UnitState, message: Any) -> SeldonMessage:
+        comp = state.component
+        if comp is None:
+            raise SeldonError(f"Unit {state.name} has no component", status_code=500)
+        if getattr(comp, "is_async", False):
+            return await fn(comp, message)
+        result = fn(comp, message)
+        if inspect.isawaitable(result):
+            return await result
+        return result
+
+    @staticmethod
+    def _merge_meta(target: SeldonMessage, source: Meta, routing_only_tags: bool = False) -> None:
+        """Merge request/previous meta into a node response, per the reference's
+        mergeMeta (`PredictiveUnitBean.java:350-366`): tags union (response
+        wins), routing/requestPath union, metrics append."""
+        merged_tags = dict(source.tags)
+        merged_tags.update(target.meta.tags)
+        target.meta.tags = merged_tags
+        for k, v in source.routing.items():
+            target.meta.routing.setdefault(k, v)
+        for k, v in source.request_path.items():
+            target.meta.request_path.setdefault(k, v)
+        if not routing_only_tags:
+            existing = {id(m) for m in target.meta.metrics}
+            for m in source.metrics:
+                if id(m) not in existing:
+                    target.meta.metrics.append(m)
+        if source.puid and not target.meta.puid:
+            target.meta.puid = source.puid
+
+    @staticmethod
+    def _record_path(msg: SeldonMessage, state: UnitState) -> None:
+        msg.meta.request_path[state.name] = state.image
+
+    # ------------------------------------------------------------------
+    # Feedback
+    # ------------------------------------------------------------------
+    async def send_feedback(self, feedback: Feedback) -> SeldonMessage:
+        return await self._feedback(self.state.root, feedback)
+
+    async def _feedback(self, state: UnitState, feedback: Feedback) -> SeldonMessage:
+        # Deliver to this unit if it handles feedback.
+        if state.has_method(UnitMethod.SEND_FEEDBACK) and state.component is not None:
+            comp = state.component
+            if getattr(comp, "is_async", False):
+                await dispatch.send_feedback(comp, feedback, unit_id=state.name)
+            else:
+                result = dispatch.send_feedback(comp, feedback, unit_id=state.name)
+                if inspect.isawaitable(result):
+                    await result
+
+        # Replay down the routed branch only (`PredictiveUnitBean.java:210-218`).
+        if state.children:
+            routing = {}
+            if feedback.response is not None:
+                routing = feedback.response.meta.routing
+            branch = routing.get(state.name, -1)
+            if branch == -1:
+                await asyncio.gather(*[self._feedback(c, feedback) for c in state.children])
+            elif 0 <= branch < len(state.children):
+                await self._feedback(state.children[branch], feedback)
+            else:
+                raise SeldonError(
+                    f"Feedback routing for {state.name} names branch {branch} outside "
+                    f"{len(state.children)} children",
+                    reason="BAD_ROUTING",
+                )
+        return SeldonMessage()

@@ -1,0 +1,127 @@
+"""Remote graph nodes: async client for components living in other processes.
+
+The reference talks to every node this way (`engine/src/main/java/io/seldon/
+engine/service/InternalPredictionService.java:186-443`: per-node REST/gRPC with
+3 retries, timeouts from annotations). Here remote hops are the *exception* —
+only units with an explicit endpoint — but the semantics match: same routes,
+same payload schema, retry-with-backoff, per-call deadline.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Optional, Sequence
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.graph import Endpoint, EndpointType
+from seldon_core_tpu.contracts.payload import (
+    Feedback,
+    SeldonError,
+    SeldonMessage,
+    SeldonMessageList,
+)
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_RETRIES = 3  # reference default (`InternalPredictionService.java:84`)
+DEFAULT_TIMEOUT_S = 5.0
+
+
+class RemoteComponent(SeldonComponent):
+    """A graph node reached over the network; implements the *_raw contract so
+    dispatch passes full messages through untouched."""
+
+    is_async = True
+
+    def __init__(
+        self,
+        endpoint: Endpoint,
+        client: Optional[Any] = None,
+        retries: int = DEFAULT_RETRIES,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+    ):
+        super().__init__()
+        self.endpoint = endpoint
+        self.retries = retries
+        self.timeout_s = timeout_s
+        self._client = client
+        self._session = None
+
+    def load(self) -> None:
+        pass
+
+    # -- transport ------------------------------------------------------
+    async def _rest_call(self, path: str, payload: dict) -> dict:
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        url = f"http://{self.endpoint.service_host}:{self.endpoint.service_port}{path}"
+        last_err: Optional[Exception] = None
+        for attempt in range(self.retries):
+            try:
+                async with self._session.post(
+                    url,
+                    json=payload,
+                    timeout=aiohttp.ClientTimeout(total=self.timeout_s),
+                ) as resp:
+                    body = await resp.text()
+                    if resp.status != 200:
+                        raise SeldonError(
+                            f"Remote node {url} returned {resp.status}: {body[:500]}",
+                            status_code=resp.status,
+                            reason="REMOTE_NODE_ERROR",
+                        )
+                    return json.loads(body)
+            except (aiohttp.ClientError, asyncio.TimeoutError, json.JSONDecodeError) as e:
+                last_err = e
+                if attempt + 1 < self.retries:
+                    await asyncio.sleep(0.05 * (2**attempt))
+        raise SeldonError(
+            f"Remote node {url} unreachable after {self.retries} attempts: {last_err}",
+            status_code=503,
+            reason="REMOTE_NODE_UNAVAILABLE",
+        )
+
+    async def _grpc_call(self, method: str, request_msg: Any) -> SeldonMessage:
+        from seldon_core_tpu.transport.grpc_client import unary_call
+
+        return await unary_call(
+            f"{self.endpoint.service_host}:{self.endpoint.service_port}",
+            method,
+            request_msg,
+            timeout_s=self.timeout_s,
+        )
+
+    async def _call(self, rest_path: str, grpc_method: str, msg: Any) -> SeldonMessage:
+        if self.endpoint.type == EndpointType.GRPC.value:
+            return await self._grpc_call(grpc_method, msg)
+        out = await self._rest_call(rest_path, msg.to_dict())
+        return SeldonMessage.from_dict(out)
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    # -- component contract (raw passthrough) ---------------------------
+    async def predict_raw(self, msg: SeldonMessage) -> SeldonMessage:
+        return await self._call("/predict", "Predict", msg)
+
+    async def transform_input_raw(self, msg: SeldonMessage) -> SeldonMessage:
+        return await self._call("/transform-input", "TransformInput", msg)
+
+    async def transform_output_raw(self, msg: SeldonMessage) -> SeldonMessage:
+        return await self._call("/transform-output", "TransformOutput", msg)
+
+    async def route_raw(self, msg: SeldonMessage) -> SeldonMessage:
+        return await self._call("/route", "Route", msg)
+
+    async def aggregate_raw(self, msgs: Sequence[SeldonMessage]) -> SeldonMessage:
+        lst = SeldonMessageList(messages=list(msgs))
+        return await self._call("/aggregate", "Aggregate", lst)
+
+    async def send_feedback_raw(self, feedback: Feedback) -> SeldonMessage:
+        return await self._call("/send-feedback", "SendFeedback", feedback)

@@ -1,0 +1,45 @@
+"""Prepackaged model servers.
+
+Parity with `servers/{sklearnserver,xgboostserver,mlflowserver,tfserving}` in
+the reference, selected from the graph spec by ``implementation`` + ``modelUri``
+(`proto/seldon_deployment.proto:102-113,130`). The native addition is
+JAX_SERVER (seldon_core_tpu.servers.jaxserver): Flax/orbax checkpoints served
+jit-compiled on TPU — the role TF-Serving/TensorRT play for the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.graph import UnitImplementation
+from seldon_core_tpu.contracts.payload import SeldonError
+
+
+def make_prepackaged_server(
+    implementation: UnitImplementation, model_uri: str, parameters: Optional[Dict[str, Any]] = None
+) -> SeldonComponent:
+    parameters = parameters or {}
+    if implementation == UnitImplementation.JAX_SERVER:
+        from seldon_core_tpu.servers.jaxserver import JAXServer
+
+        return JAXServer(model_uri=model_uri, **parameters)
+    if implementation == UnitImplementation.SKLEARN_SERVER:
+        from seldon_core_tpu.servers.sklearnserver import SKLearnServer
+
+        return SKLearnServer(model_uri=model_uri, **parameters)
+    if implementation == UnitImplementation.XGBOOST_SERVER:
+        from seldon_core_tpu.servers.xgboostserver import XGBoostServer
+
+        return XGBoostServer(model_uri=model_uri, **parameters)
+    if implementation == UnitImplementation.MLFLOW_SERVER:
+        from seldon_core_tpu.servers.mlflowserver import MLFlowServer
+
+        return MLFlowServer(model_uri=model_uri, **parameters)
+    if implementation == UnitImplementation.TENSORFLOW_SERVER:
+        from seldon_core_tpu.servers.tfproxy import TFServingProxy
+
+        return TFServingProxy(model_uri=model_uri, **parameters)
+    raise SeldonError(
+        f"No prepackaged server for implementation {implementation}", reason="BAD_GRAPH"
+    )

@@ -1,0 +1,192 @@
+"""JAX_SERVER: the native TPU prepackaged server.
+
+This is the component that replaces the reference's delegation to external
+native inference servers (`integrations/tfserving/TfServingProxy.py:20-125`,
+`integrations/nvidia-inference-server/TRTProxy.py:31-81`): instead of proxying
+to a C++ process over HTTP, the XLA-compiled model runs in-process on TPU.
+
+Checkpoint layout at ``modelUri``:
+    config.json   {"model": "<registry name>", "kwargs": {...},
+                   "input_shape": [...], "input_dtype": "float32",
+                   "batch_buckets": [1, 8, 64], "apply_kwargs": {...}}
+    params/       orbax checkpoint of the param pytree (preferred), or
+    params.msgpack  flax serialized params.
+
+Serving path: request ndarray -> device staging with batch bucketing
+(codec.staging) -> jitted apply (one compiled program per bucket) -> slice
+back to the true batch. Optionally shards params + activations over a device
+mesh via parallel.sharding for models larger than one chip.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu import storage
+from seldon_core_tpu.codec.staging import DEFAULT_BUCKETS, pad_batch
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.payload import SeldonError
+
+logger = logging.getLogger(__name__)
+
+
+class JAXServer(SeldonComponent):
+    def __init__(
+        self,
+        model_uri: str = "",
+        model: Optional[str] = None,
+        mesh: Optional[Any] = None,
+        param_sharding_rules: Optional[Any] = None,
+        batch_buckets: Optional[Sequence[int]] = None,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        self.model_uri = model_uri
+        self.model_name = model
+        self.mesh = mesh
+        self.param_sharding_rules = param_sharding_rules
+        self.batch_buckets = tuple(batch_buckets) if batch_buckets else DEFAULT_BUCKETS
+        self.ready = False
+        self._apply = None
+        self._params = None
+        self._config: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    def load(self) -> None:
+        if self.ready:
+            return
+        import jax
+        import flax
+
+        path = storage.download(self.model_uri)
+        cfg_path = os.path.join(path, "config.json")
+        if not os.path.exists(cfg_path):
+            raise SeldonError(f"JAXServer checkpoint missing config.json at {path}", status_code=500)
+        with open(cfg_path) as f:
+            self._config = json.load(f)
+
+        from seldon_core_tpu.models import get_model
+
+        name = self.model_name or self._config["model"]
+        module = get_model(name, **self._config.get("kwargs", {}))
+        self._module = module
+
+        params = self._load_params(path)
+        apply_kwargs = self._config.get("apply_kwargs", {})
+
+        def apply_fn(params, x):
+            out = module.apply(params, x, **apply_kwargs)
+            if isinstance(out, tuple):
+                out = out[0]
+            return out
+
+        if self.mesh is not None:
+            from seldon_core_tpu.parallel.sharding import shard_apply
+
+            self._apply, params = shard_apply(
+                apply_fn, module, params, self.mesh, rules=self.param_sharding_rules
+            )
+        else:
+            self._apply = jax.jit(apply_fn)
+        self._params = params
+        self.ready = True
+        logger.info("JAXServer loaded model %s from %s", name, path)
+
+    def _load_params(self, path: str):
+        import jax
+
+        orbax_dir = os.path.join(path, "params")
+        msgpack_file = os.path.join(path, "params.msgpack")
+        if os.path.isdir(orbax_dir):
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.StandardCheckpointer()
+            params = ckptr.restore(os.path.abspath(orbax_dir))
+            return params
+        if os.path.exists(msgpack_file):
+            import flax.serialization
+
+            from seldon_core_tpu.models import get_model
+
+            # Build an abstract target so deserialization restores exact dtypes.
+            module = self._module
+            shape = self._config.get("input_shape")
+            dtype = self._config.get("input_dtype", "float32")
+            if shape is None:
+                raise SeldonError("config.json needs input_shape to restore msgpack params", status_code=500)
+            example = jax.ShapeDtypeStruct((1, *shape), jax.numpy.dtype(dtype))
+            target = jax.eval_shape(lambda: module.init(jax.random.PRNGKey(0), jax.numpy.zeros(example.shape, example.dtype)))
+            target = jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), target)
+            with open(msgpack_file, "rb") as f:
+                return flax.serialization.from_bytes(target, f.read())
+        raise SeldonError(f"No params found under {path} (expected params/ or params.msgpack)", status_code=500)
+
+    # ------------------------------------------------------------------
+    def predict(self, X: np.ndarray, names: Sequence[str], meta: Optional[Dict] = None):
+        if not self.ready:
+            self.load()
+        arr = np.asarray(X)
+        dtype = np.dtype(self._config.get("input_dtype", "float32"))
+        if arr.dtype != dtype:
+            arr = arr.astype(dtype)
+        padded, true_n = pad_batch(arr, self.batch_buckets)
+        out = self._apply(self._params, padded)
+        return np.asarray(out)[:true_n]
+
+    def jax_fn(self):
+        if not self.ready:
+            self.load()
+        apply = self._apply
+
+        def fn(params, x):
+            return apply(params, x)
+
+        return fn, self._params
+
+    def class_names(self):
+        return self._config.get("class_names")
+
+
+def export_checkpoint(
+    out_dir: str,
+    model: str,
+    params: Any,
+    kwargs: Optional[Dict[str, Any]] = None,
+    input_shape: Optional[Sequence[int]] = None,
+    input_dtype: str = "float32",
+    apply_kwargs: Optional[Dict[str, Any]] = None,
+    class_names: Optional[Sequence[str]] = None,
+    use_orbax: bool = True,
+) -> str:
+    """Write a JAXServer-servable checkpoint directory."""
+    os.makedirs(out_dir, exist_ok=True)
+    cfg = {
+        "model": model,
+        "kwargs": kwargs or {},
+        "input_dtype": input_dtype,
+    }
+    if input_shape is not None:
+        cfg["input_shape"] = list(input_shape)
+    if apply_kwargs:
+        cfg["apply_kwargs"] = apply_kwargs
+    if class_names:
+        cfg["class_names"] = list(class_names)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    if use_orbax:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.abspath(os.path.join(out_dir, "params")), params)
+        ckptr.wait_until_finished()
+    else:
+        import flax.serialization
+
+        with open(os.path.join(out_dir, "params.msgpack"), "wb") as f:
+            f.write(flax.serialization.to_bytes(params))
+    return out_dir

@@ -1,0 +1,44 @@
+"""MLflow prepackaged server (parity: `servers/mlflowserver/mlflowserver/
+MLFlowServer.py:15-48`): loads a pyfunc model dir, predicts on a DataFrame.
+mlflow is not installed in this image; load() raises a clear error.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu import storage
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.payload import SeldonError
+
+
+class MLFlowServer(SeldonComponent):
+    def __init__(self, model_uri: str = "", **kwargs):
+        super().__init__(**kwargs)
+        self.model_uri = model_uri
+        self.ready = False
+        self._model = None
+
+    def load(self) -> None:
+        if self.ready:
+            return
+        try:
+            import mlflow.pyfunc
+        except ImportError as e:
+            raise SeldonError(
+                "MLFLOW_SERVER requires the mlflow package, which is not installed",
+                status_code=500,
+            ) from e
+        path = storage.download(self.model_uri)
+        self._model = mlflow.pyfunc.load_model(path)
+        self.ready = True
+
+    def predict(self, X: np.ndarray, names: Sequence[str], meta: Optional[Dict] = None):
+        if not self.ready:
+            self.load()
+        import pandas as pd
+
+        df = pd.DataFrame(np.asarray(X), columns=list(names) if names else None)
+        return np.asarray(self._model.predict(df))

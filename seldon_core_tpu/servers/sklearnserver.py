@@ -1,0 +1,52 @@
+"""sklearn prepackaged server.
+
+Parity with `servers/sklearnserver/sklearnserver/SKLearnServer.py:15-44`:
+loads `model.joblib` from modelUri via storage, predicts with predict_proba
+(default) or predict.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu import storage
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.payload import SeldonError
+
+logger = logging.getLogger(__name__)
+
+JOBLIB_FILE = "model.joblib"
+
+
+class SKLearnServer(SeldonComponent):
+    def __init__(self, model_uri: str = "", method: str = "predict_proba", **kwargs):
+        super().__init__(**kwargs)
+        self.model_uri = model_uri
+        self.method = method
+        self.ready = False
+        self._model = None
+
+    def load(self) -> None:
+        if self.ready:
+            return
+        import joblib
+
+        path = storage.download(self.model_uri)
+        if os.path.isdir(path):
+            path = os.path.join(path, JOBLIB_FILE)
+        if not os.path.exists(path):
+            raise SeldonError(f"sklearn model file not found: {path}", status_code=500)
+        self._model = joblib.load(path)
+        self.ready = True
+        logger.info("loaded sklearn model from %s", path)
+
+    def predict(self, X: np.ndarray, names: Sequence[str], meta: Optional[Dict] = None):
+        if not self.ready:
+            self.load()
+        if self.method == "predict_proba":
+            return self._model.predict_proba(X)
+        return self._model.predict(X)

@@ -1,0 +1,47 @@
+"""TENSORFLOW_SERVER proxy (parity: `integrations/tfserving/TfServingProxy.py:
+20-125`): forwards predict calls to an external TF-Serving REST endpoint
+({"instances": ...} -> /v1/models/<name>:predict). In the TPU build this path
+exists for heterogeneous graphs; native models should use JAX_SERVER instead.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.payload import SeldonError
+
+
+class TFServingProxy(SeldonComponent):
+    def __init__(
+        self,
+        model_uri: str = "",
+        rest_endpoint: str = "http://localhost:8501",
+        model_name: str = "model",
+        signature_name: str = "serving_default",
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.rest_endpoint = rest_endpoint.rstrip("/")
+        self.model_name = model_name
+        self.signature_name = signature_name
+
+    def predict(self, X: np.ndarray, names: Sequence[str], meta: Optional[Dict] = None):
+        import requests
+
+        url = f"{self.rest_endpoint}/v1/models/{self.model_name}:predict"
+        body = {"signature_name": self.signature_name, "instances": np.asarray(X).tolist()}
+        resp = requests.post(url, json=body, timeout=30)
+        if resp.status_code != 200:
+            raise SeldonError(
+                f"TF-Serving returned {resp.status_code}: {resp.text[:500]}",
+                status_code=502,
+                reason="UPSTREAM_ERROR",
+            )
+        payload = resp.json()
+        if "predictions" not in payload:
+            raise SeldonError(f"TF-Serving response missing predictions: {json.dumps(payload)[:500]}")
+        return np.asarray(payload["predictions"])

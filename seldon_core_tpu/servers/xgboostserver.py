@@ -1,0 +1,48 @@
+"""XGBoost prepackaged server (parity: `servers/xgboostserver/xgboostserver/
+XGBoostServer.py:10-26`). xgboost is not installed in this image; the class
+degrades with a clear error at load() so graph specs referencing it still parse.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu import storage
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.payload import SeldonError
+
+BOOSTER_FILE = "model.bst"
+
+
+class XGBoostServer(SeldonComponent):
+    def __init__(self, model_uri: str = "", **kwargs):
+        super().__init__(**kwargs)
+        self.model_uri = model_uri
+        self.ready = False
+        self._booster = None
+
+    def load(self) -> None:
+        if self.ready:
+            return
+        try:
+            import xgboost as xgb
+        except ImportError as e:
+            raise SeldonError(
+                "XGBOOST_SERVER requires the xgboost package, which is not installed",
+                status_code=500,
+            ) from e
+        path = storage.download(self.model_uri)
+        if os.path.isdir(path):
+            path = os.path.join(path, BOOSTER_FILE)
+        self._booster = xgb.Booster(model_file=path)
+        self._xgb = xgb
+        self.ready = True
+
+    def predict(self, X: np.ndarray, names: Sequence[str], meta: Optional[Dict] = None):
+        if not self.ready:
+            self.load()
+        dmat = self._xgb.DMatrix(X)
+        return self._booster.predict(dmat)

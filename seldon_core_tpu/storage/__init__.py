@@ -1,0 +1,122 @@
+"""Model-artifact fetching from object stores.
+
+Capability of the reference's `python/seldon_core/storage.py:36-160` (gs://,
+s3://, azure, file://, local). In this environment only local/file paths can
+be exercised; cloud schemes are implemented behind lazy imports and raise a
+clear error when the SDK is absent.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Optional
+from urllib.parse import urlparse
+
+
+class StorageError(RuntimeError):
+    pass
+
+
+def download(uri: str, out_dir: Optional[str] = None) -> str:
+    """Fetch a model artifact directory/file to local disk, returning the path."""
+    parsed = urlparse(uri)
+    scheme = parsed.scheme
+    if scheme in ("", "file"):
+        return _local(parsed.path if scheme == "file" else uri, out_dir)
+    if scheme == "gs":
+        return _gcs(parsed, out_dir)
+    if scheme == "s3":
+        return _s3(parsed, out_dir)
+    if scheme in ("http", "https"):
+        return _http(uri, out_dir)
+    raise StorageError(f"Unsupported model URI scheme {scheme!r} in {uri!r}")
+
+
+def _local(path: str, out_dir: Optional[str]) -> str:
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise StorageError(f"Local model path does not exist: {path}")
+    if out_dir is None:
+        return path
+    os.makedirs(out_dir, exist_ok=True)
+    if os.path.isdir(path):
+        dst = os.path.join(out_dir, os.path.basename(path.rstrip("/")))
+        if not os.path.exists(dst):
+            shutil.copytree(path, dst)
+        return dst
+    dst = os.path.join(out_dir, os.path.basename(path))
+    shutil.copy2(path, dst)
+    return dst
+
+
+def _workdir(out_dir: Optional[str]) -> str:
+    if out_dir is None:
+        out_dir = tempfile.mkdtemp(prefix="seldon-tpu-model-")
+    os.makedirs(out_dir, exist_ok=True)
+    return out_dir
+
+
+def _gcs(parsed, out_dir: Optional[str]) -> str:
+    try:
+        from google.cloud import storage as gcs  # type: ignore
+    except ImportError as e:
+        raise StorageError(
+            "gs:// model URIs require google-cloud-storage, which is not installed"
+        ) from e
+    out_dir = _workdir(out_dir)
+    try:
+        client = gcs.Client()
+    except Exception:
+        client = gcs.Client.create_anonymous_client()
+    bucket = client.bucket(parsed.netloc)
+    prefix = parsed.path.lstrip("/")
+    count = 0
+    for blob in bucket.list_blobs(prefix=prefix):
+        rel = os.path.relpath(blob.name, prefix) if blob.name != prefix else os.path.basename(blob.name)
+        dst = os.path.join(out_dir, rel)
+        os.makedirs(os.path.dirname(dst) or out_dir, exist_ok=True)
+        blob.download_to_filename(dst)
+        count += 1
+    if count == 0:
+        raise StorageError(f"No objects found at gs://{parsed.netloc}/{prefix}")
+    return out_dir
+
+
+def _s3(parsed, out_dir: Optional[str]) -> str:
+    try:
+        import boto3  # type: ignore
+    except ImportError as e:
+        raise StorageError("s3:// model URIs require boto3, which is not installed") from e
+    out_dir = _workdir(out_dir)
+    s3 = boto3.client(
+        "s3",
+        endpoint_url=os.environ.get("S3_ENDPOINT") or None,
+        aws_access_key_id=os.environ.get("AWS_ACCESS_KEY_ID"),
+        aws_secret_access_key=os.environ.get("AWS_SECRET_ACCESS_KEY"),
+    )
+    prefix = parsed.path.lstrip("/")
+    resp = s3.list_objects_v2(Bucket=parsed.netloc, Prefix=prefix)
+    contents = resp.get("Contents", [])
+    if not contents:
+        raise StorageError(f"No objects found at s3://{parsed.netloc}/{prefix}")
+    for obj in contents:
+        rel = os.path.relpath(obj["Key"], prefix) if obj["Key"] != prefix else os.path.basename(obj["Key"])
+        dst = os.path.join(out_dir, rel)
+        os.makedirs(os.path.dirname(dst) or out_dir, exist_ok=True)
+        s3.download_file(parsed.netloc, obj["Key"], dst)
+    return out_dir
+
+
+def _http(uri: str, out_dir: Optional[str]) -> str:
+    import requests
+
+    out_dir = _workdir(out_dir)
+    dst = os.path.join(out_dir, os.path.basename(urlparse(uri).path) or "model")
+    with requests.get(uri, stream=True, timeout=60) as r:
+        r.raise_for_status()
+        with open(dst, "wb") as f:
+            for chunk in r.iter_content(1 << 20):
+                f.write(chunk)
+    return dst

@@ -1,0 +1,169 @@
+"""Dispatch-layer tests, modeled on the reference's microservice test strategy
+(python/tests/test_model_microservice.py: inline fake user components with
+behavior switches)."""
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.components import dispatch
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.components.metrics import create_counter
+from seldon_core_tpu.contracts.payload import (
+    Feedback,
+    SeldonError,
+    SeldonMessage,
+    SeldonMessageList,
+)
+
+
+class UserObject(SeldonComponent):
+    def __init__(self, metrics_ok=True, ret_nparray=False, ret_meta=False):
+        self.metrics_ok = metrics_ok
+        self.ret_nparray = ret_nparray
+        self.nparray = np.array([1, 2, 3])
+        self.ret_meta = ret_meta
+        self.received_feedback = None
+
+    def predict(self, X, features_names, meta=None):
+        if self.ret_meta:
+            self.inc_meta = meta
+        if self.ret_nparray:
+            return self.nparray
+        return X
+
+    def send_feedback(self, features, feature_names, reward, truth, routing=None):
+        self.received_feedback = (features, reward, truth, routing)
+
+    def tags(self):
+        return {"mytag": 1}
+
+    def metrics(self):
+        if self.metrics_ok:
+            return [create_counter("mycounter", 1)]
+        return [{"type": "BAD", "key": "bad", "value": 1}]
+
+
+def msg_tensor(values, shape):
+    return SeldonMessage.from_dict({"data": {"tensor": {"shape": shape, "values": values}}})
+
+
+def test_predict_echo_tensor():
+    out = dispatch.predict(UserObject(), msg_tensor([1.0, 2.0], [1, 2]))
+    d = out.to_dict()
+    assert d["data"]["tensor"] == {"shape": [1, 2], "values": [1.0, 2.0]}
+    assert d["meta"]["tags"] == {"mytag": 1}
+    assert d["meta"]["metrics"][0]["key"] == "mycounter"
+
+
+def test_predict_returns_ndarray_encoding_follows_request():
+    out = dispatch.predict(UserObject(ret_nparray=True), SeldonMessage.from_dict({"data": {"ndarray": [1]}}))
+    assert "ndarray" in out.to_dict()["data"]
+
+
+def test_predict_bad_metrics_raises():
+    with pytest.raises(SeldonError):
+        dispatch.predict(UserObject(metrics_ok=False), msg_tensor([1.0], [1, 1]))
+
+
+def test_predict_str_data():
+    class EchoStr(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            assert X == "hello"
+            return X.upper()
+
+    out = dispatch.predict(EchoStr(), SeldonMessage.from_dict({"strData": "hello"}))
+    assert out.to_dict()["strData"] == "HELLO"
+
+
+def test_predict_bin_data():
+    import base64
+
+    class EchoBin(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return bytes(X) + b"!"
+
+    raw = base64.b64encode(b"xyz").decode()
+    out = dispatch.predict(EchoBin(), SeldonMessage.from_dict({"binData": raw}))
+    assert base64.b64decode(out.to_dict()["binData"]) == b"xyz!"
+
+
+def test_predict_raw_preferred():
+    class RawModel(SeldonComponent):
+        def predict_raw(self, msg):
+            return {"data": {"ndarray": [9]}, "meta": {"tags": {"raw": True}}}
+
+        def predict(self, X, names, meta=None):
+            raise AssertionError("high-level predict must not be called")
+
+    out = dispatch.predict(RawModel(), msg_tensor([1.0], [1, 1]))
+    assert out.to_dict()["data"]["ndarray"] == [9]
+
+
+def test_route_returns_branch_ndarray():
+    class R(SeldonComponent):
+        def route(self, X, names):
+            return 1
+
+    out = dispatch.route(R(), msg_tensor([1.0], [1, 1]))
+    assert dispatch.extract_route(out) == 1
+    assert out.to_dict()["data"]["ndarray"] == [[1]]
+
+
+def test_route_non_int_raises():
+    class R(SeldonComponent):
+        def route(self, X, names):
+            return 0.5
+
+    with pytest.raises(SeldonError):
+        dispatch.route(R(), msg_tensor([1.0], [1, 1]))
+
+
+def test_route_below_minus_one_raises():
+    class R(SeldonComponent):
+        def route(self, X, names):
+            return -2
+
+    with pytest.raises(SeldonError):
+        dispatch.route(R(), msg_tensor([1.0], [1, 1]))
+
+
+def test_aggregate_mean():
+    class Agg(SeldonComponent):
+        def aggregate(self, Xs, names):
+            return (np.asarray(Xs[0]) + np.asarray(Xs[1])) / 2.0
+
+    lst = SeldonMessageList(messages=[msg_tensor([1.0, 2.0], [1, 2]), msg_tensor([3.0, 4.0], [1, 2])])
+    out = dispatch.aggregate(Agg(), lst)
+    assert out.to_dict()["data"]["tensor"]["values"] == [2.0, 3.0]
+
+
+def test_send_feedback_routing_extraction():
+    user = UserObject()
+    fb = Feedback.from_dict(
+        {
+            "request": {"data": {"ndarray": [[1.0, 2.0]]}},
+            "response": {"data": {"ndarray": [[0.9]]}, "meta": {"routing": {"myunit": 1}}},
+            "reward": 0.5,
+        }
+    )
+    dispatch.send_feedback(user, fb, unit_id="myunit")
+    features, reward, truth, routing = user.received_feedback
+    assert reward == 0.5
+    assert routing == 1
+    np.testing.assert_array_equal(features, [[1.0, 2.0]])
+
+
+def test_puid_propagated():
+    msg = msg_tensor([1.0], [1, 1])
+    msg.meta.puid = "pp1"
+    out = dispatch.predict(UserObject(), msg)
+    assert out.meta.puid == "pp1"
+
+
+def test_class_names_default():
+    class TwoD(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.ones((1, 3))
+
+    out = dispatch.predict(TwoD(), msg_tensor([1.0], [1, 1]))
+    assert out.to_dict()["data"]["names"] == ["t:0", "t:1", "t:2"]

@@ -1,0 +1,128 @@
+"""Payload schema wire-compat tests (reference: python/tests/test_utils.py
+shapes and proto/prediction.proto JSON forms)."""
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.contracts.payload import (
+    Feedback,
+    Meta,
+    Metric,
+    SeldonError,
+    SeldonMessage,
+    SeldonMessageList,
+    Status,
+)
+
+
+def test_tensor_roundtrip():
+    d = {"data": {"names": ["a", "b"], "tensor": {"shape": [2, 2], "values": [1.0, 2.0, 3.0, 4.0]}}}
+    msg = SeldonMessage.from_dict(d)
+    assert msg.which == "data"
+    arr = msg.payload()
+    assert arr.shape == (2, 2)
+    np.testing.assert_array_equal(arr, [[1.0, 2.0], [3.0, 4.0]])
+    out = msg.to_dict()
+    assert out["data"]["tensor"] == {"shape": [2, 2], "values": [1.0, 2.0, 3.0, 4.0]}
+    assert out["data"]["names"] == ["a", "b"]
+
+
+def test_ndarray_roundtrip():
+    d = {"data": {"ndarray": [[1, 2], [3, 4]]}}
+    msg = SeldonMessage.from_dict(d)
+    arr = msg.payload()
+    assert arr.shape == (2, 2)
+    assert msg.to_dict()["data"]["ndarray"] == [[1, 2], [3, 4]]
+
+
+def test_ndarray_strings():
+    d = {"data": {"ndarray": [["a", "b"], ["c", "d"]]}}
+    msg = SeldonMessage.from_dict(d)
+    assert msg.to_dict()["data"]["ndarray"] == [["a", "b"], ["c", "d"]]
+
+
+def test_bin_data_roundtrip():
+    import base64
+
+    payload = b"\x00\x01binary"
+    d = {"binData": base64.b64encode(payload).decode()}
+    msg = SeldonMessage.from_dict(d)
+    assert msg.payload() == payload
+    assert msg.to_dict()["binData"] == base64.b64encode(payload).decode()
+
+
+def test_str_data_roundtrip():
+    msg = SeldonMessage.from_dict({"strData": "hello"})
+    assert msg.payload() == "hello"
+    assert msg.to_dict()["strData"] == "hello"
+
+
+def test_json_data_roundtrip():
+    payload = {"nested": [1, 2, {"x": True}]}
+    msg = SeldonMessage.from_dict({"jsonData": payload})
+    assert msg.payload() == payload
+    assert msg.to_dict()["jsonData"] == payload
+
+
+def test_meta_roundtrip():
+    d = {
+        "meta": {
+            "puid": "abc123",
+            "tags": {"t": 1},
+            "routing": {"router": 1},
+            "requestPath": {"model": "img:1"},
+            "metrics": [{"key": "c", "type": "COUNTER", "value": 2.0}],
+        },
+        "data": {"ndarray": [1]},
+    }
+    msg = SeldonMessage.from_dict(d)
+    assert msg.meta.puid == "abc123"
+    assert msg.meta.routing == {"router": 1}
+    assert msg.meta.metrics[0].key == "c"
+    out = msg.to_dict()["meta"]
+    assert out["requestPath"] == {"model": "img:1"}
+    assert out["metrics"][0]["type"] == "COUNTER"
+
+
+def test_tensor_shape_mismatch_raises():
+    with pytest.raises(SeldonError):
+        SeldonMessage.from_dict({"data": {"tensor": {"shape": [3, 3], "values": [1.0, 2.0]}}})
+
+
+def test_tftensor_rejected_cleanly():
+    with pytest.raises(SeldonError, match="tensorflow"):
+        SeldonMessage.from_dict({"data": {"tftensor": {}}})
+
+
+def test_empty_data_raises():
+    with pytest.raises(SeldonError):
+        SeldonMessage.from_dict({"data": {}})
+
+
+def test_feedback_roundtrip():
+    fb = Feedback.from_dict(
+        {
+            "request": {"data": {"ndarray": [[1.0]]}},
+            "response": {"data": {"ndarray": [[0.9]]}, "meta": {"routing": {"eg-router": 1}}},
+            "reward": 1.0,
+        }
+    )
+    assert fb.reward == 1.0
+    assert fb.response.meta.routing == {"eg-router": 1}
+    out = fb.to_dict()
+    assert out["reward"] == 1.0
+    assert out["response"]["meta"]["routing"] == {"eg-router": 1}
+
+
+def test_message_list_roundtrip():
+    lst = SeldonMessageList.from_dict(
+        {"seldonMessages": [{"data": {"ndarray": [1]}}, {"strData": "x"}]}
+    )
+    assert len(lst.messages) == 2
+    assert lst.to_dict()["seldonMessages"][1]["strData"] == "x"
+
+
+def test_status():
+    s = Status.from_dict({"code": 400, "info": "bad", "status": "FAILURE"})
+    assert s.code == 400
+    assert s.to_dict()["status"] == "FAILURE"
