@@ -1,0 +1,3 @@
+from seldon_core_tpu.metrics.registry import MetricsRegistry
+
+__all__ = ["MetricsRegistry"]

@@ -1,0 +1,111 @@
+"""Prometheus metrics with the reference's tag scheme.
+
+Mirrors the engine's micrometer setup (`engine/src/main/java/io/seldon/engine/
+metrics/SeldonRestTemplateExchangeTagsProvider.java:40-119`: deployment/
+predictor/model tags on every series) and its registration of user metrics
+carried in-band in ``meta.metrics`` (`PredictiveUnitBean.java:314-340`), plus
+the feedback/reward counters (`:309-312`). Exposed at /metrics and /prometheus
+(`ENGINE_PROMETHEUS_PATH` in the reference operator).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+from seldon_core_tpu.contracts.payload import Feedback, SeldonMessage
+
+LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
+
+class MetricsRegistry:
+    def __init__(self, deployment: str = "", predictor: str = ""):
+        self.deployment = deployment or os.environ.get("DEPLOYMENT_NAME", "")
+        self.predictor = predictor or os.environ.get("PREDICTOR_ID", "")
+        self.registry = CollectorRegistry()
+        base = ["deployment_name", "predictor_name"]
+        self._api = Counter(
+            "seldon_api_executor_server_requests_total",
+            "API requests by method and code",
+            base + ["method", "code"],
+            registry=self.registry,
+        )
+        self._latency = Histogram(
+            "seldon_api_executor_server_requests_seconds",
+            "API latency",
+            base + ["method"],
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self._feedback = Counter(
+            "seldon_api_model_feedback_total",
+            "Feedback events",
+            base,
+            registry=self.registry,
+        )
+        self._feedback_reward = Counter(
+            "seldon_api_model_feedback_reward_total",
+            "Cumulative feedback reward",
+            base,
+            registry=self.registry,
+        )
+        self._custom_counters: Dict[str, Counter] = {}
+        self._custom_gauges: Dict[str, Gauge] = {}
+        self._custom_timers: Dict[str, Histogram] = {}
+
+    # ------------------------------------------------------------------
+    def _base(self) -> Dict[str, str]:
+        return {"deployment_name": self.deployment, "predictor_name": self.predictor}
+
+    def observe_api_call(self, method: str, code: str, seconds: float) -> None:
+        self._api.labels(**self._base(), method=method, code=code).inc()
+        self._latency.labels(**self._base(), method=method).observe(seconds)
+
+    def observe_prediction(self, engine: Any, response: SeldonMessage, seconds: float) -> None:
+        self.observe_api_call("predictions", "200", seconds)
+        self.register_custom(response)
+
+    def observe_feedback(self, feedback: Feedback) -> None:
+        self._feedback.labels(**self._base()).inc()
+        if feedback.reward:
+            self._feedback_reward.labels(**self._base()).inc(abs(feedback.reward))
+
+    # ------------------------------------------------------------------
+    def register_custom(self, response: SeldonMessage) -> None:
+        """Register COUNTER/GAUGE/TIMER metrics carried in response meta."""
+        for m in response.meta.metrics:
+            tags = dict(sorted(m.tags.items()))
+            key = m.key + "|" + ",".join(f"{k}={v}" for k in tags for v in [tags[k]])
+            label_names = list(tags)
+            if m.type == "COUNTER":
+                c = self._custom_counters.get(key)
+                if c is None:
+                    c = Counter(m.key, "custom counter", label_names, registry=self.registry)
+                    self._custom_counters[key] = c
+                (c.labels(**tags) if tags else c).inc(m.value)
+            elif m.type == "GAUGE":
+                g = self._custom_gauges.get(key)
+                if g is None:
+                    g = Gauge(m.key, "custom gauge", label_names, registry=self.registry)
+                    self._custom_gauges[key] = g
+                (g.labels(**tags) if tags else g).set(m.value)
+            elif m.type == "TIMER":
+                h = self._custom_timers.get(key)
+                if h is None:
+                    h = Histogram(m.key, "custom timer", label_names, registry=self.registry)
+                    self._custom_timers[key] = h
+                # reference timers arrive in milliseconds (`metrics.py` docs)
+                (h.labels(**tags) if tags else h).observe(m.value / 1000.0)
+
+    def expose(self) -> bytes:
+        return generate_latest(self.registry)

@@ -44,6 +44,8 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # Llama-2 uses an untied lm_head; tie only for small/test configs.
+    tie_embeddings: bool = False
     # MoE: 0 = dense FFN; otherwise number of experts with top-2 routing.
     n_experts: int = 0
     n_experts_per_token: int = 2
@@ -255,7 +257,14 @@ class Transformer(nn.Module):
             x, nc = TransformerBlock(cfg, name=f"layer_{i}")(x, positions, layer_cache, cache_index)
             new_caches.append(nc)
         x = RMSNorm(cfg.dim, cfg.norm_eps, name="norm")(x)
-        logits = x.astype(jnp.float32) @ emb.T
+        if cfg.tie_embeddings:
+            logits = x.astype(jnp.float32) @ emb.T
+        else:
+            lm_head = param_with_axes(
+                "lm_head", nn.initializers.normal(stddev=0.02), (cfg.dim, cfg.vocab_size),
+                jnp.float32, axes=("embed", "vocab"),
+            )
+            logits = x.astype(jnp.float32) @ lm_head
         return logits, new_caches
 
 
@@ -290,5 +299,6 @@ def make_llama_tiny(dtype: str = "float32", n_experts: int = 0):
     cfg = TransformerConfig(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
         ffn_dim=128, max_seq_len=128, dtype=jnp.dtype(dtype), n_experts=n_experts,
+        tie_embeddings=True,
     )
     return Transformer(cfg)

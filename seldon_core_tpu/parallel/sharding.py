@@ -45,7 +45,10 @@ def logical_axis_tree(module, example_input):
     from flax.linen import partitioning as nn_partitioning
 
     def _init():
-        return module.init(jax.random.PRNGKey(0), example_input)
+        x = example_input
+        if isinstance(x, jax.ShapeDtypeStruct):
+            x = jax.numpy.zeros(x.shape, x.dtype)
+        return module.init(jax.random.PRNGKey(0), x)
 
     abstract = jax.eval_shape(_init)
     if "params_axes" not in abstract:

@@ -80,6 +80,10 @@ class UnitState:
     image: str = ""
     # Set when this node's entire subtree fused into one jitted callable.
     fused_fn: Optional[Callable[[Any], Any]] = None
+    # All units covered by fused_fn, and the component whose class_names/
+    # encoding rules own the final payload (the last node in unfused flow).
+    fused_units: List["UnitState"] = field(default_factory=list)
+    fused_owner: Optional[SeldonComponent] = None
 
     @property
     def methods(self) -> List[UnitMethod]:
@@ -194,16 +198,17 @@ class GraphEngine:
     # ------------------------------------------------------------------
     # Whole-graph XLA fusion
     # ------------------------------------------------------------------
-    def _try_fuse(self, state: UnitState) -> Optional[Callable[[Any], Any]]:
+    def _try_fuse(self, state: UnitState):
         """Bottom-up: if this node and all children are pure jax fns (and no
         routing decision is needed), produce one jitted callable for the
-        subtree. Falls back silently; correctness never depends on fusion."""
-        child_fns = [self._try_fuse(c) for c in state.children]
+        subtree. Returns (fn, covered_units, owner) or None. Falls back
+        silently; correctness never depends on fusion."""
+        child_results = [self._try_fuse(c) for c in state.children]
 
         fusible = (
             state.component is not None
             and not state.has_method(UnitMethod.ROUTE)
-            and all(f is not None for f in child_fns)
+            and all(r is not None for r in child_results)
         )
         if not fusible:
             return None
@@ -213,32 +218,46 @@ class GraphEngine:
         fn, params = pair
 
         is_combiner = state.has_method(UnitMethod.AGGREGATE)
+        if is_combiner and not state.children:
+            # A leaf combiner aggregates a singleton list of the request (the
+            # unfused path's behavior); fusing fn(x) directly would instead
+            # reduce over the batch dim. Leave it to the host path.
+            return None
         if state.children and not is_combiner and len(state.children) > 1:
             return None  # multiple children need a combiner to merge
 
         import jax
         import jax.numpy as jnp
 
-        children = list(child_fns)
-
         if not state.children:
+            covered = [state]
+            owner = state.component
+
             def subtree(x, _fn=fn, _p=params):
                 return _fn(_p, x)
         elif is_combiner:
+            children = [r[0] for r in child_results]
+            covered = [state] + [u for r in child_results for u in r[1]]
+            owner = state.component  # combiner constructs the merged response
+
             def subtree(x, _fn=fn, _p=params, _children=children):
                 outs = [c(x) for c in _children]
                 return _fn(_p, jnp.stack(outs))
         else:
             # transformer/model with a single child: this node transforms the
-            # input, the child consumes it.
-            child = children[0]
+            # input, the child consumes it and owns the response.
+            child, child_units, child_owner = child_results[0]
+            covered = [state] + child_units
+            owner = child_owner
 
             def subtree(x, _fn=fn, _p=params, _child=child):
                 return _child(_fn(_p, x))
 
         state.fused_fn = jax.jit(subtree)
+        state.fused_units = covered
+        state.fused_owner = owner
         logger.info("fused subtree at unit %s into one XLA computation", state.name)
-        return subtree
+        return subtree, covered, owner
 
     # ------------------------------------------------------------------
     # Predict
@@ -255,13 +274,21 @@ class GraphEngine:
         return asyncio.run(self.predict(request))
 
     async def _get_output(self, state: UnitState, message: SeldonMessage) -> SeldonMessage:
-        # Fused fast path: the whole subtree is one XLA call.
+        # Fused fast path: the whole subtree is one XLA call. Meta parity with
+        # the unfused flow: every covered unit contributes its requestPath
+        # entry and tags/metrics; the flow-final component owns the payload
+        # encoding and class_names.
         if state.fused_fn is not None and message.which == "data" and message.data is not None:
             arr = message.data.to_numpy()
             out = state.fused_fn(np.asarray(arr, dtype=np.float32) if arr.dtype != np.float32 else arr)
-            resp = dispatch.construct_response(state.component, False, message, out)
+            resp = dispatch.construct_response(state.fused_owner or state.component, False, message, out)
             self._merge_meta(resp, message.meta)
-            self._record_path(resp, state)
+            from seldon_core_tpu.codec.response import response_meta
+
+            for unit in state.fused_units:
+                if unit.component is not state.fused_owner:
+                    self._merge_meta(resp, response_meta(unit.component, None))
+                self._record_path(resp, unit)
             return resp
 
         # 1. transformInput (for MODEL this is predict — the reference maps

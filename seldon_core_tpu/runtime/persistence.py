@@ -1,0 +1,128 @@
+"""Component state persistence.
+
+Capability of the reference's Redis pickle persistence for stateful routers
+(`python/seldon_core/persistence.py:21-85`: periodic pickle of the live user
+object under key ``persistence_{DEPLOYMENT}_{PREDICTOR}_{UNIT}``, restore on
+boot). Backend is pluggable: file-backed by default (works everywhere), Redis
+when a server + client library are available.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import threading
+import time
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PERIOD_S = 60.0  # reference default (`persistence.py:68-85`)
+
+
+def state_key(env: Optional[dict] = None) -> str:
+    env = env if env is not None else dict(os.environ)
+    return "persistence_{}_{}_{}".format(
+        env.get("DEPLOYMENT_NAME", "dep"),
+        env.get("PREDICTOR_ID", "pred"),
+        env.get("PREDICTIVE_UNIT_ID", "unit"),
+    )
+
+
+class StateStore:
+    def save(self, key: str, obj: Any) -> None:
+        raise NotImplementedError
+
+    def restore(self, key: str) -> Optional[Any]:
+        raise NotImplementedError
+
+
+class FileStateStore(StateStore):
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or os.environ.get("PERSISTENCE_DIR", "/tmp/seldon-tpu-state")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key + ".pkl")
+
+    def save(self, key: str, obj: Any) -> None:
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f)
+        os.replace(tmp, self._path(key))
+
+    def restore(self, key: str) -> Optional[Any]:
+        path = self._path(key)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+
+class RedisStateStore(StateStore):
+    def __init__(self, host: Optional[str] = None, port: int = 6379):
+        try:
+            import redis
+        except ImportError as e:
+            raise RuntimeError("RedisStateStore requires the redis package") from e
+        self._client = redis.StrictRedis(
+            host=host or os.environ.get("REDIS_SERVICE_HOST", "localhost"), port=port
+        )
+
+    def save(self, key: str, obj: Any) -> None:
+        self._client.set(key, pickle.dumps(obj))
+
+    def restore(self, key: str) -> Optional[Any]:
+        raw = self._client.get(key)
+        return pickle.loads(raw) if raw else None
+
+
+def make_store() -> StateStore:
+    if os.environ.get("REDIS_SERVICE_HOST"):
+        try:
+            return RedisStateStore()
+        except RuntimeError:
+            logger.warning("REDIS_SERVICE_HOST set but redis client unavailable; using file store")
+    return FileStateStore()
+
+
+def restore_component(component_class, key: Optional[str] = None, store: Optional[StateStore] = None):
+    """Restore a live component of the given class, or None. Class mismatch
+    discards stale state (same guard as `persistence.py:34-41`)."""
+    store = store or make_store()
+    key = key or state_key()
+    obj = store.restore(key)
+    if obj is None:
+        return None
+    if type(obj).__name__ != component_class.__name__:
+        logger.warning("persisted state is a %s, expected %s; ignoring", type(obj).__name__, component_class.__name__)
+        return None
+    return obj
+
+
+class PersistenceThread(threading.Thread):
+    """Periodically snapshots the live component (daemon thread)."""
+
+    def __init__(self, component: Any, key: Optional[str] = None, store: Optional[StateStore] = None,
+                 period_s: float = DEFAULT_PERIOD_S):
+        super().__init__(daemon=True, name="seldon-persistence")
+        self.component = component
+        self.key = key or state_key()
+        self.store = store or make_store()
+        self.period_s = period_s
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self.snapshot()
+
+    def snapshot(self) -> None:
+        try:
+            self.store.save(self.key, self.component)
+        except Exception:
+            logger.exception("persistence snapshot failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.snapshot()

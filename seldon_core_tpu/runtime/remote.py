@@ -47,22 +47,38 @@ class RemoteComponent(SeldonComponent):
         self.retries = retries
         self.timeout_s = timeout_s
         self._client = client
-        self._session = None
+        # ClientSessions bind to the event loop they were created on; engines
+        # may be driven from several short-lived loops (predict_sync), so keep
+        # one session per live loop.
+        self._sessions: dict = {}
 
     def load(self) -> None:
         pass
 
     # -- transport ------------------------------------------------------
+    def _get_session(self):
+        import aiohttp
+
+        loop = asyncio.get_running_loop()
+        session = self._sessions.get(id(loop))
+        if session is None or session.closed or loop.is_closed():
+            # drop sessions whose loops are gone
+            self._sessions = {
+                k: s for k, s in self._sessions.items() if not s.closed and k != id(loop)
+            }
+            session = aiohttp.ClientSession()
+            self._sessions[id(loop)] = session
+        return session
+
     async def _rest_call(self, path: str, payload: dict) -> dict:
         import aiohttp
 
-        if self._session is None:
-            self._session = aiohttp.ClientSession()
+        session = self._get_session()
         url = f"http://{self.endpoint.service_host}:{self.endpoint.service_port}{path}"
         last_err: Optional[Exception] = None
         for attempt in range(self.retries):
             try:
-                async with self._session.post(
+                async with session.post(
                     url,
                     json=payload,
                     timeout=aiohttp.ClientTimeout(total=self.timeout_s),
@@ -102,9 +118,13 @@ class RemoteComponent(SeldonComponent):
         return SeldonMessage.from_dict(out)
 
     async def close(self) -> None:
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
+        for session in list(self._sessions.values()):
+            if not session.closed:
+                try:
+                    await session.close()
+                except RuntimeError:
+                    pass  # session's loop already gone
+        self._sessions.clear()
 
     # -- component contract (raw passthrough) ---------------------------
     async def predict_raw(self, msg: SeldonMessage) -> SeldonMessage:

@@ -88,8 +88,15 @@ class JAXServer(SeldonComponent):
         if self.mesh is not None:
             from seldon_core_tpu.parallel.sharding import shard_apply
 
+            example_input = None
+            shape = self._config.get("input_shape")
+            if shape is not None:
+                example_input = jax.ShapeDtypeStruct(
+                    (1, *shape), jax.numpy.dtype(self._config.get("input_dtype", "float32"))
+                )
             self._apply, params = shard_apply(
-                apply_fn, module, params, self.mesh, rules=self.param_sharding_rules
+                apply_fn, module, params, self.mesh,
+                rules=self.param_sharding_rules, example_input=example_input,
             )
         else:
             self._apply = jax.jit(apply_fn)

@@ -97,15 +97,17 @@ def _s3(parsed, out_dir: Optional[str]) -> str:
         aws_secret_access_key=os.environ.get("AWS_SECRET_ACCESS_KEY"),
     )
     prefix = parsed.path.lstrip("/")
-    resp = s3.list_objects_v2(Bucket=parsed.netloc, Prefix=prefix)
-    contents = resp.get("Contents", [])
-    if not contents:
+    count = 0
+    paginator = s3.get_paginator("list_objects_v2")
+    for page in paginator.paginate(Bucket=parsed.netloc, Prefix=prefix):
+        for obj in page.get("Contents", []):
+            rel = os.path.relpath(obj["Key"], prefix) if obj["Key"] != prefix else os.path.basename(obj["Key"])
+            dst = os.path.join(out_dir, rel)
+            os.makedirs(os.path.dirname(dst) or out_dir, exist_ok=True)
+            s3.download_file(parsed.netloc, obj["Key"], dst)
+            count += 1
+    if count == 0:
         raise StorageError(f"No objects found at s3://{parsed.netloc}/{prefix}")
-    for obj in contents:
-        rel = os.path.relpath(obj["Key"], prefix) if obj["Key"] != prefix else os.path.basename(obj["Key"])
-        dst = os.path.join(out_dir, rel)
-        os.makedirs(os.path.dirname(dst) or out_dir, exist_ok=True)
-        s3.download_file(parsed.netloc, obj["Key"], dst)
     return out_dir
 
 

@@ -1,0 +1,138 @@
+"""Distributed tracing.
+
+The reference uses Jaeger/OpenTracing end-to-end, enabled by env TRACING=1
+(`engine/.../tracing/TracingProvider.java:25-52`, `python/seldon_core/
+microservice.py:116-151`). The opentelemetry SDK is not installed in this
+image, so this module ships a small native tracer with the same span topology
+(server span -> per-node child spans) and W3C traceparent propagation;
+``export`` hooks let deployments forward finished spans to a collector.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import logging
+import os
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("seldon.tracing")
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "seldon_current_span", default=None
+)
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start: float = field(default_factory=time.time)
+    end: Optional[float] = None
+    tags: Dict[str, Any] = field(default_factory=dict)
+
+    def finish(self) -> None:
+        self.end = time.time()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "startUs": int(self.start * 1e6),
+            "durationUs": int(((self.end or time.time()) - self.start) * 1e6),
+            "tags": self.tags,
+        }
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+class Tracer:
+    def __init__(self, service_name: str = "seldon-tpu", enabled: bool = False, max_buffer: int = 4096):
+        self.service_name = service_name
+        self.enabled = enabled
+        self._buffer: List[Span] = []
+        self._lock = threading.Lock()
+        self._max_buffer = max_buffer
+        self.exporter = None  # callable(List[Span]) or None
+
+    @contextlib.contextmanager
+    def span(self, name: str, traceparent: Optional[str] = None, **tags: Any):
+        if not self.enabled:
+            yield None
+            return
+        parent = _current_span.get()
+        if traceparent and parent is None:
+            trace_id, parent_id = _parse_traceparent(traceparent)
+        elif parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            trace_id, parent_id = secrets.token_hex(16), None
+        s = Span(name=name, trace_id=trace_id, span_id=secrets.token_hex(8), parent_id=parent_id, tags=dict(tags))
+        token = _current_span.set(s)
+        try:
+            yield s
+        finally:
+            s.finish()
+            _current_span.reset(token)
+            self._record(s)
+
+    def _record(self, s: Span) -> None:
+        with self._lock:
+            self._buffer.append(s)
+            if len(self._buffer) >= self._max_buffer:
+                self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            spans, self._buffer = self._buffer, []
+        if not spans:
+            return
+        if self.exporter is not None:
+            try:
+                self.exporter(spans)
+            except Exception:
+                logger.exception("trace export failed")
+        elif os.environ.get("TRACING_LOG", ""):
+            for s in spans:
+                logger.info("span %s", json.dumps(s.to_dict()))
+
+    def drain(self) -> List[Span]:
+        with self._lock:
+            spans, self._buffer = self._buffer, []
+        return spans
+
+
+def _parse_traceparent(header: str):
+    try:
+        parts = header.split("-")
+        return parts[1], parts[2]
+    except (IndexError, AttributeError):
+        return secrets.token_hex(16), None
+
+
+_tracer: Optional[Tracer] = None
+
+
+def get_tracer() -> Tracer:
+    global _tracer
+    if _tracer is None:
+        _tracer = Tracer(
+            service_name=os.environ.get("JAEGER_SERVICE_NAME", "seldon-tpu"),
+            enabled=os.environ.get("TRACING", "0") == "1",
+        )
+    return _tracer
+
+
+def set_tracer(tracer: Tracer) -> None:
+    global _tracer
+    _tracer = tracer

@@ -1,0 +1,161 @@
+"""CLI entrypoints.
+
+``python -m seldon_core_tpu.transport.cli microservice <Interface> [REST|GRPC]``
+mirrors the reference wrapper CLI (`python/seldon_core/microservice.py:177-322`):
+import the user class, typed params from PREDICTIVE_UNIT_PARAMETERS, optional
+state restore (--persistence), annotations file, log level, tracing, then serve.
+
+``... engine`` boots a whole predictor graph from ENGINE_PREDICTOR (base64
+JSON spec), the role of the reference's JVM engine bootstrap
+(`engine/.../EnginePredictor.java:58-108`) — but serving the graph in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import logging
+import os
+import sys
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+ANNOTATIONS_FILE = "/etc/podinfo/annotations"
+
+
+def load_annotations(path: str = ANNOTATIONS_FILE) -> Dict[str, str]:
+    """k8s downward-API annotations file: `key="value"` lines
+    (`python/seldon_core/microservice.py:90-113`)."""
+    annotations: Dict[str, str] = {}
+    if not os.path.exists(path):
+        return annotations
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or "=" not in line:
+                continue
+            key, _, value = line.partition("=")
+            annotations[key.strip()] = value.strip().strip('"')
+    return annotations
+
+
+def setup_logging() -> None:
+    level = os.environ.get("SELDON_LOG_LEVEL", "INFO").upper()
+    logging.basicConfig(
+        level=getattr(logging, level, logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+
+def import_interface(name: str):
+    """Import `Name` from module `Name`, or `pkg.mod.Class` dotted form."""
+    sys.path.insert(0, os.getcwd())
+    if "." in name:
+        module_name, _, class_name = name.rpartition(".")
+    else:
+        module_name = class_name = name
+    module = importlib.import_module(module_name)
+    return getattr(module, class_name)
+
+
+def build_component(interface_name: str, persistence: bool = False):
+    from seldon_core_tpu.contracts.parameters import parse_parameters
+    from seldon_core_tpu.runtime.persistence import PersistenceThread, restore_component
+
+    klass = import_interface(interface_name)
+    parameters = parse_parameters()
+    component = None
+    thread = None
+    if persistence:
+        component = restore_component(klass)
+    if component is None:
+        component = klass(**parameters)
+    if hasattr(component, "load"):
+        component.load()
+    if persistence:
+        thread = PersistenceThread(component)
+        thread.start()
+    return component, thread
+
+
+def run_microservice(args: argparse.Namespace) -> None:
+    setup_logging()
+    component, _ = build_component(args.interface_name, persistence=args.persistence)
+    port = args.port or int(os.environ.get("PREDICTIVE_UNIT_SERVICE_PORT", "5000"))
+    unit_id = os.environ.get("PREDICTIVE_UNIT_ID", "")
+    api = (args.api or os.environ.get("API_TYPE", "REST")).upper()
+    logger.info("serving %s as %s on port %d", args.interface_name, api, port)
+    if api == "REST":
+        from seldon_core_tpu.transport.rest import make_component_app, serve
+
+        serve(make_component_app(component, unit_id=unit_id), host=args.host, port=port)
+    elif api == "GRPC":
+        from seldon_core_tpu.transport.grpc_server import serve_component
+
+        serve_component(component, host=args.host, port=port, unit_id=unit_id)
+    else:
+        raise SystemExit(f"Unknown API type {api} (use REST or GRPC)")
+
+
+def run_engine(args: argparse.Namespace) -> None:
+    setup_logging()
+    from seldon_core_tpu.contracts.graph import load_predictor_spec_from_env
+    from seldon_core_tpu.metrics.registry import MetricsRegistry
+    from seldon_core_tpu.runtime.engine import GraphEngine
+    from seldon_core_tpu.transport.rest import make_engine_app, serve
+
+    spec = None
+    if args.spec:
+        from seldon_core_tpu.contracts.graph import PredictorSpec
+
+        with open(args.spec) as f:
+            spec = PredictorSpec.from_dict(json.load(f))
+    else:
+        spec = load_predictor_spec_from_env()
+    if spec is None:
+        # Default single SIMPLE_MODEL spec, as the reference engine does when
+        # unconfigured (`EnginePredictor.java:122-141`).
+        from seldon_core_tpu.contracts.graph import PredictorSpec
+
+        spec = PredictorSpec.from_dict(
+            {"name": "default", "graph": {"name": "simple", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
+        )
+    engine = GraphEngine(spec)
+    metrics = MetricsRegistry(predictor=spec.name)
+    port = args.port or int(os.environ.get("ENGINE_SERVER_PORT", "8000"))
+    logger.info("engine serving predictor %r on port %d", spec.name, port)
+    if (args.api or "REST").upper() == "GRPC":
+        from seldon_core_tpu.transport.grpc_server import serve_engine
+
+        serve_engine(engine, host=args.host, port=port, metrics=metrics)
+    else:
+        serve(make_engine_app(engine, metrics=metrics), host=args.host, port=port)
+
+
+def main(argv: Optional[list] = None) -> None:
+    parser = argparse.ArgumentParser(prog="seldon-core-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    ms = sub.add_parser("microservice", help="serve one component")
+    ms.add_argument("interface_name")
+    ms.add_argument("api", nargs="?", default=None, help="REST or GRPC")
+    ms.add_argument("--port", type=int, default=None)
+    ms.add_argument("--host", default="0.0.0.0")
+    ms.add_argument("--persistence", action="store_true")
+    ms.set_defaults(func=run_microservice)
+
+    eng = sub.add_parser("engine", help="serve a predictor graph in-process")
+    eng.add_argument("--spec", default=None, help="path to PredictorSpec JSON")
+    eng.add_argument("--api", default="REST")
+    eng.add_argument("--port", type=int, default=None)
+    eng.add_argument("--host", default="0.0.0.0")
+    eng.set_defaults(func=run_engine)
+
+    args = parser.parse_args(argv)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""gRPC client: channel cache + typed unary calls.
+
+Role of the reference's `engine/.../grpc/GrpcChannelHandler.java` (channel
+cache) and the stub calls in `InternalPredictionService.java:261-283`; also
+backs the SDK's gRPC paths.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import grpc
+
+from seldon_core_tpu.contracts.payload import Feedback, SeldonMessage, SeldonMessageList
+from seldon_core_tpu.transport import proto_convert as pc
+from seldon_core_tpu.transport.proto import prediction_pb2 as pb
+
+_channels: Dict[Tuple[str, tuple], grpc.Channel] = {}
+_lock = threading.Lock()
+
+# method -> (service owning it for the Generic path, request serializer, from-dataclass)
+_METHODS = {
+    "Predict": ("Model", pb.SeldonMessage),
+    "TransformInput": ("Generic", pb.SeldonMessage),
+    "TransformOutput": ("Generic", pb.SeldonMessage),
+    "Route": ("Router", pb.SeldonMessage),
+    "Aggregate": ("Combiner", pb.SeldonMessageList),
+    "SendFeedback": ("Model", pb.Feedback),
+}
+
+
+def get_channel(target: str, options: Optional[list] = None) -> grpc.Channel:
+    key = (target, tuple(options or ()))
+    with _lock:
+        ch = _channels.get(key)
+        if ch is None:
+            ch = grpc.insecure_channel(target, options=options)
+            _channels[key] = ch
+        return ch
+
+
+def _to_proto(msg: Any):
+    if isinstance(msg, SeldonMessage):
+        return pc.message_to_proto(msg)
+    if isinstance(msg, SeldonMessageList):
+        return pc.list_to_proto(msg)
+    if isinstance(msg, Feedback):
+        return pc.feedback_to_proto(msg)
+    return msg  # already a proto
+
+
+def call_sync(
+    target: str,
+    method: str,
+    msg: Any,
+    service: Optional[str] = None,
+    timeout_s: float = 5.0,
+    options: Optional[list] = None,
+) -> SeldonMessage:
+    if method not in _METHODS:
+        raise ValueError(f"Unknown gRPC method {method}")
+    default_service, _req_cls = _METHODS[method]
+    service = service or default_service
+    channel = get_channel(target, options)
+    rpc = channel.unary_unary(
+        f"/seldon.protos.{service}/{method}",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=pb.SeldonMessage.FromString,
+    )
+    out = rpc(_to_proto(msg), timeout=timeout_s)
+    return pc.message_from_proto(out)
+
+
+async def unary_call(
+    target: str, method: str, msg: Any, service: Optional[str] = None, timeout_s: float = 5.0
+) -> SeldonMessage:
+    """Async wrapper used by RemoteComponent (runs the blocking stub in a thread)."""
+    return await asyncio.to_thread(call_sync, target, method, msg, service, timeout_s)

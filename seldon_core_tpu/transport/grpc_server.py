@@ -1,0 +1,216 @@
+"""gRPC transport.
+
+Exposes the same seven services as the reference (`proto/prediction.proto:
+94-128`: Generic, Model, Router, Transformer, OutputTransformer, Combiner,
+Seldon). grpc_tools is unavailable in this image, so the servicer glue the
+generator would emit is written directly with ``grpc.method_handlers_generic_
+handler`` — identical wire behavior, no generated *_pb2_grpc module.
+
+- ``serve_component``: one component, microservice role
+  (`python/seldon_core/wrapper.py:103-146`).
+- ``serve_engine``: whole predictor graph, engine role
+  (`engine/.../grpc/SeldonGrpcServer.java:34-143`).
+
+Max message size honors the reference annotation
+``seldon.io/grpc-max-message-size``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from concurrent import futures
+from typing import Any, Callable, Dict, Optional
+
+import grpc
+
+from seldon_core_tpu.components import dispatch
+from seldon_core_tpu.contracts.payload import SeldonError
+from seldon_core_tpu.metrics.registry import MetricsRegistry
+from seldon_core_tpu.tracing import get_tracer
+from seldon_core_tpu.transport import proto_convert as pc
+from seldon_core_tpu.transport.proto import prediction_pb2 as pb
+
+logger = logging.getLogger(__name__)
+
+ANNOTATION_GRPC_MAX_MSG_SIZE = "seldon.io/grpc-max-message-size"
+DEFAULT_MAX_MSG_BYTES = 4 * 1024 * 1024
+
+_SERVICE_PACKAGE = "seldon.protos"
+
+
+def _abort(context: grpc.ServicerContext, e: Exception):
+    if isinstance(e, SeldonError):
+        code = grpc.StatusCode.INVALID_ARGUMENT if e.status_code < 500 else grpc.StatusCode.INTERNAL
+        context.abort(code, e.message)
+    logger.exception("grpc handler error")
+    context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+
+def _component_methods(component: Any, unit_id: str) -> Dict[str, Dict[str, Callable]]:
+    """method table: service -> rpc name -> (deserializer applied by handler)."""
+
+    def wrap(fn, req_from, method_name):
+        def handler(request, context):
+            tracer = get_tracer()
+            try:
+                with tracer.span("grpc:" + method_name):
+                    result = fn(component, req_from(request))
+                    if asyncio.iscoroutine(result):
+                        result = asyncio.run(result)
+                return pc.message_to_proto(result)
+            except Exception as e:  # noqa: BLE001
+                _abort(context, e)
+
+        return handler
+
+    def fb(comp, f):
+        return dispatch.send_feedback(comp, f, unit_id=unit_id or None)
+
+    predict = wrap(dispatch.predict, pc.message_from_proto, "predict")
+    tin = wrap(dispatch.transform_input, pc.message_from_proto, "transform_input")
+    tout = wrap(dispatch.transform_output, pc.message_from_proto, "transform_output")
+    route = wrap(dispatch.route, pc.message_from_proto, "route")
+    aggregate = wrap(dispatch.aggregate, pc.list_from_proto, "aggregate")
+    feedback = wrap(fb, pc.feedback_from_proto, "send_feedback")
+
+    return {
+        "Model": {"Predict": (predict, pb.SeldonMessage), "SendFeedback": (feedback, pb.Feedback)},
+        "Generic": {
+            "TransformInput": (tin, pb.SeldonMessage),
+            "TransformOutput": (tout, pb.SeldonMessage),
+            "Route": (route, pb.SeldonMessage),
+            "Aggregate": (aggregate, pb.SeldonMessageList),
+            "SendFeedback": (feedback, pb.Feedback),
+        },
+        "Router": {"Route": (route, pb.SeldonMessage), "SendFeedback": (feedback, pb.Feedback)},
+        "Transformer": {"TransformInput": (tin, pb.SeldonMessage)},
+        "OutputTransformer": {"TransformOutput": (tout, pb.SeldonMessage)},
+        "Combiner": {"Aggregate": (aggregate, pb.SeldonMessageList)},
+    }
+
+
+def _generic_handlers(method_table: Dict[str, Dict[str, tuple]]):
+    handlers = []
+    for service, methods in method_table.items():
+        rpc_handlers = {}
+        for rpc_name, (fn, req_cls) in methods.items():
+            rpc_handlers[rpc_name] = grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+        handlers.append(
+            grpc.method_handlers_generic_handler(f"{_SERVICE_PACKAGE}.{service}", rpc_handlers)
+        )
+    return handlers
+
+
+def _server_options(annotations: Optional[Dict[str, str]]) -> list:
+    max_size = DEFAULT_MAX_MSG_BYTES
+    if annotations and ANNOTATION_GRPC_MAX_MSG_SIZE in annotations:
+        max_size = int(annotations[ANNOTATION_GRPC_MAX_MSG_SIZE])
+    return [
+        ("grpc.max_send_message_length", max_size),
+        ("grpc.max_receive_message_length", max_size),
+    ]
+
+
+def make_component_server(
+    component: Any,
+    port: Optional[int] = 5000,
+    host: str = "0.0.0.0",
+    unit_id: str = "",
+    annotations: Optional[Dict[str, str]] = None,
+    max_workers: int = 8,
+) -> grpc.Server:
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers), options=_server_options(annotations)
+    )
+    for h in _generic_handlers(_component_methods(component, unit_id)):
+        server.add_generic_rpc_handlers((h,))
+    if port is not None:
+        server.add_insecure_port(f"{host}:{port}")
+    return server
+
+
+def make_engine_server(
+    engine: Any,
+    port: Optional[int] = 5001,
+    host: str = "0.0.0.0",
+    metrics: Optional[MetricsRegistry] = None,
+    annotations: Optional[Dict[str, str]] = None,
+    max_workers: int = 8,
+    loop: Optional[asyncio.AbstractEventLoop] = None,
+) -> grpc.Server:
+    """Seldon external service over the in-process graph engine. The engine is
+    async; handlers submit onto the engine's event loop (or a private one)."""
+    metrics = metrics or MetricsRegistry()
+    own_loop = loop
+    if own_loop is None:
+        own_loop = asyncio.new_event_loop()
+        import threading
+
+        t = threading.Thread(target=own_loop.run_forever, daemon=True, name="seldon-grpc-engine-loop")
+        t.start()
+
+    def run_coro(coro):
+        return asyncio.run_coroutine_threadsafe(coro, own_loop).result()
+
+    def predict(request, context):
+        import time
+
+        t0 = time.perf_counter()
+        try:
+            msg = pc.message_from_proto(request)
+            out = run_coro(engine.predict(msg))
+            metrics.observe_prediction(engine, out, time.perf_counter() - t0)
+            return pc.message_to_proto(out)
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def send_feedback(request, context):
+        try:
+            fb = pc.feedback_from_proto(request)
+            out = run_coro(engine.send_feedback(fb))
+            metrics.observe_feedback(fb)
+            return pc.message_to_proto(out)
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers), options=_server_options(annotations)
+    )
+    handler = grpc.method_handlers_generic_handler(
+        f"{_SERVICE_PACKAGE}.Seldon",
+        {
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                predict,
+                request_deserializer=pb.SeldonMessage.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+            "SendFeedback": grpc.unary_unary_rpc_method_handler(
+                send_feedback,
+                request_deserializer=pb.Feedback.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+        },
+    )
+    server.add_generic_rpc_handlers((handler,))
+    if port is not None:
+        server.add_insecure_port(f"{host}:{port}")
+    return server
+
+
+def serve_component(component: Any, host: str = "0.0.0.0", port: int = 5000, unit_id: str = "") -> None:
+    server = make_component_server(component, port=port, host=host, unit_id=unit_id)
+    server.start()
+    logger.info("gRPC component server on %s:%d", host, port)
+    server.wait_for_termination()
+
+
+def serve_engine(engine: Any, host: str = "0.0.0.0", port: int = 5001, metrics=None) -> None:
+    server = make_engine_server(engine, port=port, host=host, metrics=metrics)
+    server.start()
+    logger.info("gRPC engine server on %s:%d", host, port)
+    server.wait_for_termination()

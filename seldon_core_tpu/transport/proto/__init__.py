@@ -1,0 +1,9 @@
+import os
+import sys
+
+# Generated code does a top-level `import prediction_pb2`-style resolution of
+# google.protobuf only; make this package importable both as a package module
+# and for regeneration via `protoc --python_out=seldon_core_tpu/transport/proto`.
+sys.path.insert(0, os.path.dirname(__file__))
+
+from seldon_core_tpu.transport.proto import prediction_pb2  # noqa: E402,F401

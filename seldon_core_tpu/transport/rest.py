@@ -1,0 +1,259 @@
+"""REST transport (aiohttp).
+
+Two route families, matching the reference:
+
+- **Microservice routes** (`python/seldon_core/wrapper.py:37-94`): /predict,
+  /transform-input, /transform-output, /route, /aggregate, /send-feedback,
+  plus GET /seldon.json (OpenAPI) and /health. Serves ONE component.
+- **Engine routes** (`engine/.../api/rest/RestClientController.java:76-245`):
+  /api/v0.1/predictions, /api/v0.1/feedback, /ready, /live, /pause, /unpause,
+  /ping, /metrics (Prometheus). Serves a whole predictor GRAPH via the
+  in-process engine — the reference needs a separate JVM pod for this; here it
+  is the same process, so a single-model deployment is one process total.
+
+Request parsing accepts raw JSON bodies, form field ``json=``, and multipart
+(binData/strData parts) like the reference (`python/seldon_core/flask_utils.py:
+6-65`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Callable, Optional
+
+from aiohttp import web
+
+from seldon_core_tpu.components import dispatch
+from seldon_core_tpu.contracts.payload import (
+    Feedback,
+    SeldonError,
+    SeldonMessage,
+    SeldonMessageList,
+)
+from seldon_core_tpu.metrics.registry import MetricsRegistry
+from seldon_core_tpu.tracing import get_tracer
+
+logger = logging.getLogger(__name__)
+
+
+async def parse_request(request: web.Request) -> dict:
+    """JSON body, ?json= query param, form json= field, or multipart parts."""
+    ctype = request.content_type or ""
+    if ctype.startswith("multipart/"):
+        data = await request.post()
+        out: dict = {}
+        for key, value in data.items():
+            if hasattr(value, "file"):
+                raw = value.file.read()
+                if key == "binData":
+                    import base64
+
+                    out[key] = base64.b64encode(raw).decode()
+                elif key == "strData":
+                    out[key] = raw.decode()
+                else:
+                    out[key] = json.loads(raw)
+            else:
+                out[key] = json.loads(value) if key not in ("strData",) else value
+        return out
+    body = await request.text()
+    if ctype == "application/x-www-form-urlencoded" and body:
+        from urllib.parse import parse_qs
+
+        qs = parse_qs(body)
+        if "json" in qs:
+            return json.loads(qs["json"][0])
+        # fall through: clients (curl -d) often send raw JSON under the
+        # default form content type
+    if body:
+        try:
+            return json.loads(body)
+        except json.JSONDecodeError as e:
+            raise SeldonError(f"Invalid JSON body: {e}")
+    if "json" in request.query:
+        return json.loads(request.query["json"])
+    raise SeldonError("Empty request body")
+
+
+def error_response(e: Exception) -> web.Response:
+    if isinstance(e, SeldonError):
+        status = e.to_status()
+        code = e.status_code
+    else:
+        logger.exception("unhandled error")
+        from seldon_core_tpu.contracts.payload import Status
+
+        status = Status(code=500, info=str(e), reason="INTERNAL_ERROR", status="FAILURE")
+        code = 500
+    return web.json_response({"status": status.to_dict()}, status=code)
+
+
+def _json(msg: SeldonMessage) -> web.Response:
+    return web.json_response(msg.to_dict())
+
+
+# ---------------------------------------------------------------------------
+# Microservice app: one component
+# ---------------------------------------------------------------------------
+
+def make_component_app(
+    component: Any,
+    unit_id: str = "",
+    metrics: Optional[MetricsRegistry] = None,
+) -> web.Application:
+    app = web.Application(client_max_size=1 << 30)
+    metrics = metrics or MetricsRegistry()
+    tracer = get_tracer()
+
+    def handler(fn: Callable, parser: Callable, method_name: str):
+        async def handle(request: web.Request) -> web.Response:
+            t0 = time.perf_counter()
+            try:
+                payload = parser(await parse_request(request))
+                with tracer.span(method_name):
+                    result = fn(component, payload)
+                    if asyncio.iscoroutine(result):
+                        result = await result
+                metrics.observe_api_call(method_name, "200", time.perf_counter() - t0)
+                return _json(result)
+            except Exception as e:
+                code = str(getattr(e, "status_code", 500))
+                metrics.observe_api_call(method_name, code, time.perf_counter() - t0)
+                return error_response(e)
+
+        return handle
+
+    msg = SeldonMessage.from_dict
+    lst = SeldonMessageList.from_dict
+    fbk = Feedback.from_dict
+
+    def fb_with_unit(comp, f):
+        return dispatch.send_feedback(comp, f, unit_id=unit_id or None)
+
+    for path, fn, parser, name in [
+        ("/predict", dispatch.predict, msg, "predict"),
+        ("/api/v0.1/predictions", dispatch.predict, msg, "predict"),
+        ("/transform-input", dispatch.transform_input, msg, "transform_input"),
+        ("/transform-output", dispatch.transform_output, msg, "transform_output"),
+        ("/route", dispatch.route, msg, "route"),
+        ("/aggregate", dispatch.aggregate, lst, "aggregate"),
+        ("/send-feedback", fb_with_unit, fbk, "send_feedback"),
+        ("/api/v0.1/feedback", fb_with_unit, fbk, "send_feedback"),
+    ]:
+        h = handler(fn, parser, name)
+        app.router.add_post(path, h)
+        app.router.add_get(path, h)
+
+    async def health(request):
+        return web.json_response({"status": "ok"})
+
+    async def openapi(request):
+        from seldon_core_tpu.transport.openapi import wrapper_spec
+
+        return web.json_response(wrapper_spec())
+
+    async def prom(request):
+        return web.Response(body=metrics.expose(), content_type="text/plain")
+
+    app.router.add_get("/health/status", health)
+    app.router.add_get("/ready", health)
+    app.router.add_get("/live", health)
+    app.router.add_get("/seldon.json", openapi)
+    app.router.add_get("/metrics", prom)
+    app.router.add_get("/prometheus", prom)
+    return app
+
+
+# ---------------------------------------------------------------------------
+# Engine app: whole predictor graph in-process
+# ---------------------------------------------------------------------------
+
+def make_engine_app(engine: Any, metrics: Optional[MetricsRegistry] = None) -> web.Application:
+    """engine: seldon_core_tpu.runtime.engine.GraphEngine (or compatible,
+    e.g. the batched engine wrapper)."""
+    app = web.Application(client_max_size=1 << 30)
+    metrics = metrics or MetricsRegistry()
+    tracer = get_tracer()
+    state = {"paused": False, "ready": True}
+    app[web.AppKey("state", dict)] = state
+
+    async def predictions(request: web.Request) -> web.Response:
+        if state["paused"]:
+            return web.json_response(
+                {"status": {"code": 503, "info": "paused", "status": "FAILURE"}}, status=503
+            )
+        t0 = time.perf_counter()
+        try:
+            body = await parse_request(request)
+            msg = SeldonMessage.from_dict(body)
+            with tracer.span("predictions"):
+                out = await engine.predict(msg)
+            metrics.observe_prediction(engine, out, time.perf_counter() - t0)
+            return _json(out)
+        except Exception as e:
+            metrics.observe_api_call("predictions", str(getattr(e, "status_code", 500)), time.perf_counter() - t0)
+            return error_response(e)
+
+    async def feedback(request: web.Request) -> web.Response:
+        t0 = time.perf_counter()
+        try:
+            body = await parse_request(request)
+            fb = Feedback.from_dict(body)
+            with tracer.span("feedback"):
+                out = await engine.send_feedback(fb)
+            metrics.observe_feedback(fb)
+            metrics.observe_api_call("feedback", "200", time.perf_counter() - t0)
+            return _json(out)
+        except Exception as e:
+            metrics.observe_api_call("feedback", str(getattr(e, "status_code", 500)), time.perf_counter() - t0)
+            return error_response(e)
+
+    async def ready(request):
+        if state["ready"] and not state["paused"]:
+            return web.Response(text="ready")
+        return web.Response(status=503, text="not ready")
+
+    async def live(request):
+        return web.Response(text="live")
+
+    async def ping(request):
+        return web.Response(text="pong")
+
+    async def pause(request):
+        state["paused"] = True
+        return web.Response(text="paused")
+
+    async def unpause(request):
+        state["paused"] = False
+        return web.Response(text="unpaused")
+
+    async def prom(request):
+        return web.Response(body=metrics.expose(), content_type="text/plain")
+
+    async def openapi(request):
+        from seldon_core_tpu.transport.openapi import engine_spec
+
+        return web.json_response(engine_spec())
+
+    app.router.add_post("/api/v0.1/predictions", predictions)
+    app.router.add_post("/predict", predictions)
+    app.router.add_post("/api/v0.1/feedback", feedback)
+    app.router.add_post("/send-feedback", feedback)
+    app.router.add_get("/ready", ready)
+    app.router.add_get("/live", live)
+    app.router.add_get("/ping", ping)
+    app.router.add_post("/pause", pause)
+    app.router.add_post("/unpause", unpause)
+    app.router.add_get("/pause", pause)
+    app.router.add_get("/unpause", unpause)
+    app.router.add_get("/metrics", prom)
+    app.router.add_get("/prometheus", prom)
+    app.router.add_get("/seldon.json", openapi)
+    return app
+
+
+def serve(app: web.Application, host: str = "0.0.0.0", port: int = 5000) -> None:
+    web.run_app(app, host=host, port=port, print=None)

@@ -1,21 +1,26 @@
 """Test config: run JAX on a virtual 8-device CPU mesh so parallelism tests
 exercise real shardings without TPU hardware (the driver separately dry-runs
-the multi-chip path; bench.py uses the real chip)."""
+the multi-chip path; bench.py uses the real chip).
+
+Note: the axon TPU plugin in this image ignores the JAX_PLATFORMS env var, so
+the cpu override must go through jax.config.update after import."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def eight_devices():
-    import jax
-
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs

@@ -220,10 +220,49 @@ def test_fused_graph_matches_unfused():
     fused = GraphEngine(spec(graph), fuse=True)
     unfused = GraphEngine(spec(graph), fuse=False)
     assert fused.state.root.fused_fn is not None
-    msg = tensor_msg([1.0, 2.0], [1, 2])
-    out_f = run(fused.predict(msg)).to_dict()["data"]["tensor"]["values"]
-    out_u = run(unfused.predict(tensor_msg([1.0, 2.0], [1, 2]))).to_dict()["data"]["tensor"]["values"]
-    assert out_f == pytest.approx(out_u)
+    out_f = run(fused.predict(tensor_msg([1.0, 2.0], [1, 2]))).to_dict()
+    out_u = run(unfused.predict(tensor_msg([1.0, 2.0], [1, 2]))).to_dict()
+    assert out_f["data"]["tensor"]["values"] == pytest.approx(out_u["data"]["tensor"]["values"])
+    # meta parity: fused responses carry the same requestPath and in-band
+    # metrics as the unfused flow
+    assert set(out_f["meta"]["requestPath"]) == set(out_u["meta"]["requestPath"]) == {"combiner", "m1", "m2"}
+    fused_keys = sorted(m["key"] for m in out_f["meta"].get("metrics", []))
+    unfused_keys = sorted(m["key"] for m in out_u["meta"].get("metrics", []))
+    assert fused_keys == unfused_keys
+
+
+def test_leaf_combiner_not_fused_and_identity():
+    # A childless AVERAGE_COMBINER aggregates the singleton [request]; fusing
+    # it would instead mean over the batch dim. Must match unfused semantics.
+    graph = {"name": "c", "type": "COMBINER", "implementation": "AVERAGE_COMBINER"}
+    fused = GraphEngine(spec(graph), fuse=True)
+    assert fused.state.root.fused_fn is None
+    out = run(fused.predict(tensor_msg([1.0, 2.0, 3.0, 4.0], [2, 2]))).to_dict()
+    assert out["data"]["tensor"] == {"shape": [2, 2], "values": [1.0, 2.0, 3.0, 4.0]}
+
+
+def test_fused_chain_class_names_from_leaf():
+    # transformer -> SIMPLE_MODEL chain: the leaf model owns class_names even
+    # when the chain fuses into one XLA call.
+    class JitDouble(SeldonComponent):
+        def transform_input(self, X, names, meta=None):
+            return np.asarray(X) * 2
+
+        def jax_fn(self):
+            import jax.numpy as jnp
+
+            return (lambda p, x: x * 2), None
+
+    graph = {
+        "name": "t",
+        "type": "TRANSFORMER",
+        "children": [{"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}],
+    }
+    engine = GraphEngine(spec(graph), components={"t": JitDouble()})
+    assert engine.state.root.fused_fn is not None
+    out = run(engine.predict(tensor_msg([1.0], [1, 1]))).to_dict()
+    assert out["data"]["names"] == ["class0", "class1", "class2"]
+    assert set(out["meta"]["requestPath"]) == {"t", "m"}
 
 
 def test_tags_merge_across_nodes():

@@ -1,0 +1,182 @@
+"""REST transport tests: in-process aiohttp test client, no network — the
+strategy of the reference's python/tests (Flask test_client)."""
+
+import asyncio
+import base64
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.graph import PredictorSpec
+from seldon_core_tpu.metrics.registry import MetricsRegistry
+from seldon_core_tpu.runtime.engine import GraphEngine
+from seldon_core_tpu.transport.rest import make_component_app, make_engine_app
+
+
+def call(app, path, json_body=None, method="POST", data=None, params=None, as_text=False):
+    async def go():
+        async with TestClient(TestServer(app)) as client:
+            fn = client.post if method == "POST" else client.get
+            resp = await fn(path, json=json_body, data=data, params=params)
+            body = await (resp.text() if as_text else resp.json())
+            return resp.status, body
+
+    return asyncio.run(go())
+
+
+class Echo(SeldonComponent):
+    def predict(self, X, names, meta=None):
+        return X
+
+    def tags(self):
+        return {"echo": True}
+
+
+def test_component_predict_roundtrip():
+    app = make_component_app(Echo())
+    status, body = call(app, "/predict", {"data": {"tensor": {"shape": [1, 2], "values": [1.0, 2.0]}}})
+    assert status == 200
+    assert body["data"]["tensor"] == {"shape": [1, 2], "values": [1.0, 2.0]}
+    assert body["meta"]["tags"] == {"echo": True}
+
+
+def test_component_predict_form_encoded():
+    app = make_component_app(Echo())
+    status, body = call(
+        app, "/predict", data={"json": '{"data": {"ndarray": [[5]]}}'}
+    )
+    assert status == 200
+    assert body["data"]["ndarray"] == [[5]]
+
+
+def test_component_predict_query_param():
+    app = make_component_app(Echo())
+    status, body = call(app, "/predict", method="GET", params={"json": '{"data": {"ndarray": [[7]]}}'})
+    assert status == 200
+    assert body["data"]["ndarray"] == [[7]]
+
+
+def test_component_bad_json_is_400_with_status():
+    app = make_component_app(Echo())
+    status, body = call(app, "/predict", data=b"not json{")
+    assert status == 400
+    assert body["status"]["status"] == "FAILURE"
+
+
+def test_component_error_maps_to_status_payload():
+    class Boom(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            raise RuntimeError("exploded")
+
+    app = make_component_app(Boom())
+    status, body = call(app, "/predict", {"data": {"ndarray": [1]}})
+    assert status == 500
+    assert "exploded" in body["status"]["info"]
+
+
+def test_openapi_served():
+    app = make_component_app(Echo())
+    status, body = call(app, "/seldon.json", method="GET")
+    assert status == 200
+    assert "/predict" in body["paths"]
+
+
+def test_engine_predictions_and_health():
+    spec = PredictorSpec.from_dict(
+        {"name": "p", "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
+    )
+    engine = GraphEngine(spec)
+
+    async def go():
+        app = make_engine_app(engine)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/api/v0.1/predictions", json={"data": {"ndarray": [[1.0]]}})
+            assert r.status == 200
+            body = await r.json()
+            assert np.asarray(body["data"]["ndarray"]).ravel().tolist() == pytest.approx([0.1, 0.9, 0.5])
+            assert body["meta"]["puid"]
+            r = await client.get("/ready")
+            assert r.status == 200
+            r = await client.get("/ping")
+            assert await r.text() == "pong"
+
+    asyncio.run(go())
+
+
+def test_engine_pause_drains():
+    spec = PredictorSpec.from_dict(
+        {"name": "p", "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
+    )
+    engine = GraphEngine(spec)
+    app = make_engine_app(engine)
+
+    async def go():
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/pause")
+            assert r.status == 200
+            r = await client.post("/api/v0.1/predictions", json={"data": {"ndarray": [[1.0]]}})
+            assert r.status == 503
+            r = await client.get("/ready")
+            assert r.status == 503
+            r = await client.post("/unpause")
+            assert r.status == 200
+            r = await client.post("/api/v0.1/predictions", json={"data": {"ndarray": [[1.0]]}})
+            assert r.status == 200
+
+    asyncio.run(go())
+
+
+def test_engine_feedback_and_metrics_exposition():
+    spec = PredictorSpec.from_dict(
+        {"name": "p", "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
+    )
+    engine = GraphEngine(spec)
+    metrics = MetricsRegistry(deployment="dep1", predictor="p")
+    app = make_engine_app(engine, metrics=metrics)
+
+    async def go():
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/api/v0.1/predictions", json={"data": {"ndarray": [[1.0]]}})
+            assert r.status == 200
+            r = await client.post(
+                "/api/v0.1/feedback",
+                json={
+                    "request": {"data": {"ndarray": [[1.0]]}},
+                    "response": {"data": {"ndarray": [[1.0]]}},
+                    "reward": 1.0,
+                },
+            )
+            assert r.status == 200
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "seldon_api_model_feedback_total" in text
+            assert "seldon_api_executor_server_requests_seconds" in text
+            # in-band custom metrics from SimpleModel registered engine-side
+            assert "mycounter" in text
+            assert "mygauge" in text
+
+    asyncio.run(go())
+
+
+def test_multipart_bin_data():
+    class BinEcho(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            assert isinstance(X, bytes)
+            return X
+
+    app = make_component_app(BinEcho())
+
+    async def go():
+        import aiohttp
+
+        form = aiohttp.FormData()
+        form.add_field("binData", b"\x01\x02payload", content_type="application/octet-stream")
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/predict", data=form)
+            assert r.status == 200
+            body = await r.json()
+            assert base64.b64decode(body["binData"]) == b"\x01\x02payload"
+
+    asyncio.run(go())
