@@ -1,0 +1,18 @@
+"""Analytics graph components: bandit routers and outlier-detection
+transformers (capability of the reference's `components/routers/` and
+`components/outlier-detection/` trees, rebuilt JAX-native)."""
+
+from seldon_core_tpu.analytics.routers import EpsilonGreedy, ThompsonSampling
+from seldon_core_tpu.analytics.outliers import (
+    MahalanobisOutlierDetector,
+    IsolationForestOutlierDetector,
+    VAEOutlierDetector,
+)
+
+__all__ = [
+    "EpsilonGreedy",
+    "ThompsonSampling",
+    "MahalanobisOutlierDetector",
+    "IsolationForestOutlierDetector",
+    "VAEOutlierDetector",
+]

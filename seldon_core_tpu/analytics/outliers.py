@@ -1,0 +1,332 @@
+"""Outlier-detection TRANSFORMER components.
+
+Capability parity with the reference's `components/outlier-detection/` tree
+(`vae/{CoreVAE.py,OutlierVAE.py}`, `mahalanobis/CoreMahalanobis.py`,
+`isolation-forest/CoreIsolationForest.py`): each detector sits in the graph as
+a TRANSFORMER that passes features through unchanged while tagging outlier
+scores/flags into ``meta.tags`` and emitting gauge metrics — so the model node
+downstream still receives the original features and dashboards see the scores.
+
+TPU-first: the Mahalanobis update/score and the VAE train/score paths are
+jitted JAX (the reference uses numpy resp. Keras); isolation forest wraps
+sklearn (CPU, like the reference) behind the same component surface.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.components.metrics import create_gauge
+
+logger = logging.getLogger(__name__)
+
+
+class _OutlierTransformer(SeldonComponent):
+    """Shared surface: score a batch in transform_input, keep features
+    unchanged, expose scores via tags()/metrics()."""
+
+    def __init__(self, threshold: float = 0.0, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.threshold = float(threshold)
+        self._last_scores: Optional[np.ndarray] = None
+        # RLock: transform_input holds it while calling score(), which locks
+        # again in subclasses that update running state (Mahalanobis).
+        self._lock = threading.RLock()
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.RLock()
+
+    def score(self, X: np.ndarray) -> np.ndarray:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def transform_input(self, X, names: Sequence[str], meta: Optional[Dict] = None):
+        arr = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        with self._lock:
+            self._last_scores = np.asarray(self.score(arr), dtype=np.float64)
+        return X
+
+    def tags(self) -> Dict[str, Any]:
+        if self._last_scores is None:
+            return {}
+        flags = self._last_scores > self.threshold
+        return {
+            "outlier_score": [float(s) for s in self._last_scores],
+            "is_outlier": [int(f) for f in flags],
+        }
+
+    def metrics(self) -> List[Dict[str, Any]]:
+        if self._last_scores is None:
+            return []
+        return [
+            create_gauge("outlier_score_max", float(np.max(self._last_scores))),
+            create_gauge("n_outliers", float(np.sum(self._last_scores > self.threshold))),
+        ]
+
+
+class MahalanobisOutlierDetector(_OutlierTransformer):
+    """Online Mahalanobis distance (`mahalanobis/CoreMahalanobis.py:191`):
+    scores each batch against the running mean/covariance *before* folding the
+    batch into the statistics, with an effective-sample clip ``n_clip`` so the
+    estimator tracks drift. The score+update is one jitted JAX function.
+    """
+
+    def __init__(
+        self,
+        threshold: float = 3.0,
+        n_components: int = 0,
+        n_clip: int = 1000,
+        reg_eps: float = 1e-6,
+        **kwargs: Any,
+    ):
+        super().__init__(threshold=threshold, **kwargs)
+        self.n_components = int(n_components)
+        self.n_clip = int(n_clip)
+        self.reg_eps = float(reg_eps)
+        self._state: Optional[Tuple[Any, Any, Any]] = None  # (mean, cov, n)
+        self._step = None
+
+    def _build(self, d: int):
+        import jax
+        import jax.numpy as jnp
+
+        reg_eps = self.reg_eps
+        n_clip = float(self.n_clip)
+
+        def step(state, X):
+            mean, cov, n = state
+            Xc = X - mean
+            prec = jnp.linalg.inv(cov + reg_eps * jnp.eye(d))
+            scores = jnp.sqrt(jnp.maximum(jnp.einsum("bi,ij,bj->b", Xc, prec, Xc), 0.0))
+
+            # fold the batch into the running statistics (clipped n so the
+            # estimator keeps adapting)
+            b = X.shape[0]
+            batch_mean = jnp.mean(X, axis=0)
+            delta = batch_mean - mean
+            n_new = n + b
+            new_mean = mean + delta * (b / n_new)
+            Xb = X - batch_mean
+            batch_cov = (Xb.T @ Xb) / jnp.maximum(b, 1)
+            w_old = n / n_new
+            w_b = b / n_new
+            new_cov = w_old * cov + w_b * batch_cov + w_old * w_b * jnp.outer(delta, delta)
+            n_new = jnp.minimum(n_new, n_clip)
+            return scores, (new_mean, new_cov, n_new)
+
+        return jax.jit(step)
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        if self.n_components and X.shape[1] > self.n_components:
+            # cheap spectral projection instead of the reference's sklearn PCA
+            X = X[:, : self.n_components]
+        d = X.shape[1]
+        with self._lock:
+            if self._state is None:
+                self._state = (
+                    jnp.zeros((d,), jnp.float32),
+                    jnp.eye(d, dtype=jnp.float32),
+                    jnp.asarray(0.0, jnp.float32),
+                )
+                self._step = self._build(d)
+            scores, self._state = self._step(self._state, jnp.asarray(X, dtype=jnp.float32))
+        return np.asarray(scores)
+
+    # jax buffers don't pickle portably; persist as numpy.
+    def __getstate__(self):
+        state = super().__getstate__()
+        state.pop("_step", None)
+        if state.get("_state") is not None:
+            state["_state"] = tuple(np.asarray(s) for s in state["_state"])
+        return state
+
+    def __setstate__(self, state):
+        super().__setstate__(state)
+        self._step = None
+        if self._state is not None:
+            import jax.numpy as jnp
+
+            self._state = tuple(jnp.asarray(s) for s in self._state)
+            self._step = self._build(int(self._state[0].shape[0]))
+
+
+class IsolationForestOutlierDetector(_OutlierTransformer):
+    """sklearn isolation forest (`isolation-forest/CoreIsolationForest.py:116`):
+    fit offline on clean data (or load a joblib artifact from ``model_uri``),
+    score = -decision_function so higher means more anomalous."""
+
+    def __init__(
+        self,
+        threshold: float = 0.0,
+        model_uri: str = "",
+        n_estimators: int = 100,
+        contamination: float = 0.01,
+        seed: int = 0,
+        **kwargs: Any,
+    ):
+        super().__init__(threshold=threshold, **kwargs)
+        self.model_uri = model_uri
+        self.n_estimators = int(n_estimators)
+        self.contamination = float(contamination)
+        self.seed = int(seed)
+        self._clf = None
+
+    def load(self) -> None:
+        if self._clf is not None or not self.model_uri:
+            return
+        import joblib
+
+        from seldon_core_tpu import storage
+
+        path = storage.download(self.model_uri)
+        import os
+
+        candidate = os.path.join(path, "model.joblib")
+        self._clf = joblib.load(candidate if os.path.exists(candidate) else path)
+
+    def fit(self, X: np.ndarray) -> "IsolationForestOutlierDetector":
+        from sklearn.ensemble import IsolationForest
+
+        self._clf = IsolationForest(
+            n_estimators=self.n_estimators,
+            contamination=self.contamination,
+            random_state=self.seed,
+        ).fit(np.asarray(X))
+        return self
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        if self._clf is None:
+            self.load()
+        if self._clf is None:
+            raise RuntimeError("IsolationForestOutlierDetector needs fit() or model_uri")
+        return -self._clf.decision_function(np.asarray(X))
+
+
+class VAEOutlierDetector(_OutlierTransformer):
+    """Variational autoencoder reconstruction-error detector
+    (`vae/{CoreVAE.py:181,OutlierVAE.py:118}`), rebuilt as a Flax MLP VAE with
+    a jitted optax train loop; score = per-sample reconstruction MSE (the
+    reference thresholds Keras reconstruction loss the same way)."""
+
+    def __init__(
+        self,
+        threshold: float = 0.1,
+        latent_dim: int = 2,
+        hidden_dim: int = 64,
+        seed: int = 0,
+        **kwargs: Any,
+    ):
+        super().__init__(threshold=threshold, **kwargs)
+        self.latent_dim = int(latent_dim)
+        self.hidden_dim = int(hidden_dim)
+        self.seed = int(seed)
+        self._params = None
+        self._d: Optional[int] = None
+        self._score_fn = None
+
+    def _module(self, d: int):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        latent, hidden = self.latent_dim, self.hidden_dim
+
+        class VAE(nn.Module):
+            @nn.compact
+            def __call__(self, x, rng):
+                import jax
+
+                h = nn.relu(nn.Dense(hidden)(x))
+                mu = nn.Dense(latent)(h)
+                logvar = nn.Dense(latent)(h)
+                eps = jax.random.normal(rng, mu.shape)
+                z = mu + jnp.exp(0.5 * logvar) * eps
+                h2 = nn.relu(nn.Dense(hidden)(z))
+                recon = nn.Dense(d)(h2)
+                return recon, mu, logvar
+
+        return VAE()
+
+    def fit(self, X: np.ndarray, epochs: int = 200, lr: float = 1e-3, kl_weight: float = 1e-3):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        self._d = X.shape[1]
+        module = self._module(self._d)
+        key = jax.random.PRNGKey(self.seed)
+        params = module.init(key, jnp.asarray(X[:1]), key)
+
+        tx = optax.adam(lr)
+        opt_state = tx.init(params)
+
+        def loss_fn(params, x, rng):
+            recon, mu, logvar = module.apply(params, x, rng)
+            mse = jnp.mean(jnp.sum((recon - x) ** 2, axis=-1))
+            kl = -0.5 * jnp.mean(jnp.sum(1 + logvar - mu**2 - jnp.exp(logvar), axis=-1))
+            return mse + kl_weight * kl
+
+        @jax.jit
+        def train_step(params, opt_state, x, rng):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, rng)
+            updates, opt_state = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        xs = jnp.asarray(X)
+        for i in range(epochs):
+            key, sub = jax.random.split(key)
+            params, opt_state, loss = train_step(params, opt_state, xs, sub)
+        self._params = params
+        self._build_score(module)
+        logger.info("VAE fit done: final loss %.5f", float(loss))
+        return self
+
+    def _build_score(self, module=None):
+        import jax
+        import jax.numpy as jnp
+
+        module = module or self._module(self._d)
+
+        @jax.jit
+        def score_fn(params, x):
+            # deterministic pass: eps drawn with a fixed key, mean path
+            recon, mu, logvar = module.apply(params, x, jax.random.PRNGKey(0))
+            return jnp.mean((recon - x) ** 2, axis=-1)
+
+        self._score_fn = score_fn
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        if self._params is None:
+            raise RuntimeError("VAEOutlierDetector needs fit() before scoring")
+        if self._score_fn is None:
+            self._build_score()
+        import jax.numpy as jnp
+
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        return np.asarray(self._score_fn(self._params, jnp.asarray(X)))
+
+    def __getstate__(self):
+        import jax
+
+        state = super().__getstate__()
+        state.pop("_score_fn", None)
+        if state.get("_params") is not None:
+            state["_params"] = jax.tree.map(np.asarray, state["_params"])
+        return state
+
+    def __setstate__(self, state):
+        super().__setstate__(state)
+        self._score_fn = None

@@ -1,0 +1,159 @@
+"""Multi-armed-bandit ROUTER components.
+
+Capability parity with the reference's analytics routers
+(`components/routers/epsilon-greedy/EpsilonGreedy.py:9-136` and
+`components/routers/thompson-sampling/ThompsonSampling.py`): stateful graph
+nodes that choose a child branch per request and learn from the feedback
+replay path (`Feedback.reward` routed back down the branch that served the
+original request — SURVEY.md §3.5).
+
+State is plain numpy so instances pickle cleanly through
+``runtime.persistence`` (the reference persists bandit posteriors to Redis;
+here the StateStore does the same job). Engine-side the per-branch reward
+counters also surface as Prometheus metrics via ``metrics()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.components.metrics import create_counter, create_gauge
+
+
+class _BanditRouter(SeldonComponent):
+    """Shared bookkeeping: per-branch pull counts and reward sums, a lock
+    (feedback and route arrive concurrently), and metrics/tags exposure."""
+
+    def __init__(self, n_branches: int = 2, seed: Optional[int] = None, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.n_branches = int(n_branches)
+        if self.n_branches < 1:
+            raise ValueError(f"n_branches must be >= 1, got {n_branches}")
+        self.pulls = np.zeros(self.n_branches, dtype=np.int64)
+        self.reward_sum = np.zeros(self.n_branches, dtype=np.float64)
+        self.fail_sum = np.zeros(self.n_branches, dtype=np.float64)
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self._last_branch: Optional[int] = None
+
+    # pickling: locks are not picklable; rebuild on restore.
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    def send_feedback(
+        self,
+        features: np.ndarray,
+        feature_names: Sequence[str],
+        reward: float,
+        truth: Optional[np.ndarray],
+        routing: Optional[int] = None,
+    ) -> None:
+        if routing is None or not (0 <= int(routing) < self.n_branches):
+            return
+        branch = int(routing)
+        reward = float(reward)
+        with self._lock:
+            self.pulls[branch] += 1
+            # Rewards are interpreted as success fractions in [0, 1], the
+            # reference's convention for its bandit case study.
+            r = min(max(reward, 0.0), 1.0)
+            self.reward_sum[branch] += r
+            self.fail_sum[branch] += 1.0 - r
+
+    def branch_means(self) -> np.ndarray:
+        with self._lock:
+            return self.reward_sum / np.maximum(self.pulls, 1)
+
+    def tags(self) -> Dict[str, Any]:
+        return {
+            "bandit": type(self).__name__,
+            "branch_means": [round(float(m), 6) for m in self.branch_means()],
+        }
+
+    def metrics(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            # consume the route marker so the counter ticks once per route,
+            # not once per metrics collection (feedback also collects)
+            branch, self._last_branch = self._last_branch, None
+        if branch is not None:
+            out.append(create_counter(f"bandit_route_branch_{branch}", 1.0))
+        for i, m in enumerate(self.branch_means()):
+            out.append(create_gauge(f"bandit_branch_{i}_mean_reward", float(m)))
+        return out
+
+
+class EpsilonGreedy(_BanditRouter):
+    """ε-greedy: with probability ``epsilon`` explore a uniform random branch,
+    otherwise exploit the branch with the highest mean reward
+    (`EpsilonGreedy.py:9-136`)."""
+
+    def __init__(
+        self,
+        n_branches: int = 2,
+        epsilon: float = 0.1,
+        seed: Optional[int] = None,
+        best_branch: int = 0,
+        **kwargs: Any,
+    ):
+        super().__init__(n_branches=n_branches, seed=seed, **kwargs)
+        if not 0.0 <= float(epsilon) <= 1.0:
+            raise ValueError(f"epsilon must be in [0,1], got {epsilon}")
+        self.epsilon = float(epsilon)
+        # starting exploit choice before any feedback (reference's
+        # `best_branch` init param)
+        if not 0 <= int(best_branch) < self.n_branches:
+            raise ValueError(f"best_branch {best_branch} out of range for {self.n_branches} branches")
+        self.best_branch = int(best_branch)
+
+    def route(self, X: np.ndarray, names: Sequence[str]) -> int:
+        with self._lock:
+            if self._rng.random() < self.epsilon:
+                branch = int(self._rng.integers(self.n_branches))
+            elif self.pulls.sum() == 0:
+                branch = self.best_branch
+            else:
+                means = self.reward_sum / np.maximum(self.pulls, 1)
+                branch = int(np.argmax(means))
+            self._last_branch = branch
+            return branch
+
+
+class ThompsonSampling(_BanditRouter):
+    """Thompson sampling with Beta posteriors per branch
+    (`ThompsonSampling.py`): route samples θ_i ~ Beta(α_i, β_i) and picks
+    argmax; feedback adds reward/failure mass to the routed branch's
+    posterior."""
+
+    def __init__(
+        self,
+        n_branches: int = 2,
+        alpha: float = 1.0,
+        beta: float = 1.0,
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ):
+        super().__init__(n_branches=n_branches, seed=seed, **kwargs)
+        if alpha <= 0 or beta <= 0:
+            raise ValueError("alpha and beta priors must be positive")
+        self.alpha0 = float(alpha)
+        self.beta0 = float(beta)
+
+    def route(self, X: np.ndarray, names: Sequence[str]) -> int:
+        with self._lock:
+            a = self.alpha0 + self.reward_sum
+            b = self.beta0 + self.fail_sum
+            theta = self._rng.beta(a, b)
+            branch = int(np.argmax(theta))
+            self._last_branch = branch
+            return branch

@@ -1,0 +1,20 @@
+"""Client SDK + contract-based test tooling (capability of the reference's
+`python/seldon_core/{seldon_client.py,microservice_tester.py,api_tester.py,
+serving_test_gen.py}`)."""
+
+from seldon_core_tpu.client.client import ClientResponse, SeldonClient
+from seldon_core_tpu.client.contract import (
+    generate_batch,
+    load_contract,
+    unfold_contract,
+    validate_response,
+)
+
+__all__ = [
+    "SeldonClient",
+    "ClientResponse",
+    "generate_batch",
+    "load_contract",
+    "unfold_contract",
+    "validate_response",
+]

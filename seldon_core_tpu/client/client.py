@@ -1,0 +1,178 @@
+"""SeldonClient: one client for every serving path.
+
+Capability of the reference's `python/seldon_core/seldon_client.py:104+`
+(microservice-direct and gateway paths, REST + gRPC, all graph methods) minus
+the legacy OAuth APIFE. Two endpoint kinds:
+
+- ``engine``: the external API of a predictor (`/api/v0.1/predictions`,
+  `/api/v0.1/feedback`; gRPC service ``Seldon``) — what a deployed graph
+  exposes behind the gateway.
+- ``microservice``: a single component's internal API (`/predict`,
+  `/transform-input`, ...; gRPC services Model/Router/Transformer/Combiner) —
+  what the engine calls per node.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from seldon_core_tpu.contracts.payload import (
+    Feedback,
+    SeldonMessage,
+    SeldonMessageList,
+)
+
+
+@dataclasses.dataclass
+class ClientResponse:
+    success: bool
+    msg: Optional[SeldonMessage]
+    raw: Optional[Dict[str, Any]]
+    error: Optional[str] = None
+
+    @property
+    def data(self) -> Optional[np.ndarray]:
+        if self.msg is None or self.msg.data is None:
+            return None
+        return self.msg.data.to_numpy()
+
+
+def _to_message(payload: Any, bin_data=None, str_data=None, json_data=None) -> SeldonMessage:
+    if isinstance(payload, SeldonMessage):
+        return payload
+    if bin_data is not None:
+        return SeldonMessage.from_bytes(bytes(bin_data))
+    if str_data is not None:
+        return SeldonMessage.from_str(str_data)
+    if json_data is not None:
+        return SeldonMessage.from_json_data(json_data)
+    if payload is None:
+        payload = np.array([[1.0]])
+    return SeldonMessage.from_array(np.asarray(payload))
+
+
+class SeldonClient:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        transport: str = "rest",
+        endpoint_kind: str = "engine",
+        timeout_s: float = 10.0,
+        names: Optional[Sequence[str]] = None,
+    ):
+        if transport not in ("rest", "grpc"):
+            raise ValueError(f"transport must be rest|grpc, got {transport}")
+        if endpoint_kind not in ("engine", "microservice"):
+            raise ValueError(f"endpoint_kind must be engine|microservice, got {endpoint_kind}")
+        self.host = host
+        self.port = int(port)
+        self.transport = transport
+        self.endpoint_kind = endpoint_kind
+        self.timeout_s = float(timeout_s)
+        self.names = list(names or [])
+
+    # ------------------------------------------------------------- REST
+    def _rest_url(self, path: str) -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def _rest_call(self, path: str, body: Dict[str, Any]) -> ClientResponse:
+        import requests
+
+        try:
+            r = requests.post(self._rest_url(path), json=body, timeout=self.timeout_s)
+            raw = r.json()
+        except Exception as e:  # connection/JSON errors
+            return ClientResponse(False, None, None, error=str(e))
+        if r.status_code != 200:
+            return ClientResponse(False, None, raw, error=json.dumps(raw))
+        return ClientResponse(True, SeldonMessage.from_dict(raw), raw)
+
+    # ------------------------------------------------------------- gRPC
+    def _grpc_call(self, method: str, msg: Any, service: str) -> ClientResponse:
+        from seldon_core_tpu.transport import grpc_client
+
+        try:
+            out = grpc_client.call_sync(
+                f"{self.host}:{self.port}", method, msg, service=service, timeout_s=self.timeout_s
+            )
+        except Exception as e:
+            return ClientResponse(False, None, None, error=str(e))
+        return ClientResponse(True, out, out.to_dict())
+
+    # ------------------------------------------------------------ methods
+    def predict(
+        self,
+        data: Any = None,
+        names: Optional[Sequence[str]] = None,
+        bin_data=None,
+        str_data=None,
+        json_data=None,
+    ) -> ClientResponse:
+        msg = _to_message(data, bin_data, str_data, json_data)
+        if (names or self.names) and msg.data is not None:
+            msg.data.names = list(names or self.names)
+        if self.transport == "rest":
+            path = "/api/v0.1/predictions" if self.endpoint_kind == "engine" else "/predict"
+            return self._rest_call(path, msg.to_dict())
+        service = "Seldon" if self.endpoint_kind == "engine" else "Model"
+        return self._grpc_call("Predict", msg, service)
+
+    def feedback(
+        self,
+        request: Optional[Union[SeldonMessage, Dict]] = None,
+        response: Optional[Union[SeldonMessage, Dict]] = None,
+        reward: float = 0.0,
+        truth: Any = None,
+    ) -> ClientResponse:
+        fb = Feedback(
+            request=_as_msg(request),
+            response=_as_msg(response),
+            reward=float(reward),
+            truth=SeldonMessage.from_array(np.asarray(truth)) if truth is not None else None,
+        )
+        if self.transport == "rest":
+            path = "/api/v0.1/feedback" if self.endpoint_kind == "engine" else "/send-feedback"
+            return self._rest_call(path, fb.to_dict())
+        service = "Seldon" if self.endpoint_kind == "engine" else "Model"
+        return self._grpc_call("SendFeedback", fb, service)
+
+    # microservice-only graph methods
+    def transform_input(self, data: Any, names: Optional[Sequence[str]] = None) -> ClientResponse:
+        return self._unit_call("TransformInput", "/transform-input", data, names, "Transformer")
+
+    def transform_output(self, data: Any, names: Optional[Sequence[str]] = None) -> ClientResponse:
+        return self._unit_call(
+            "TransformOutput", "/transform-output", data, names, "OutputTransformer"
+        )
+
+    def route(self, data: Any, names: Optional[Sequence[str]] = None) -> ClientResponse:
+        return self._unit_call("Route", "/route", data, names, "Router")
+
+    def aggregate(self, datas: Sequence[Any]) -> ClientResponse:
+        msgs = SeldonMessageList(messages=[_to_message(d) for d in datas])
+        if self.transport == "rest":
+            return self._rest_call("/aggregate", msgs.to_dict())
+        return self._grpc_call("Aggregate", msgs, "Combiner")
+
+    def _unit_call(self, method, path, data, names, service) -> ClientResponse:
+        if self.endpoint_kind != "microservice":
+            raise ValueError(f"{method} is a microservice-level call")
+        msg = _to_message(data)
+        if (names or self.names) and msg.data is not None:
+            msg.data.names = list(names or self.names)
+        if self.transport == "rest":
+            return self._rest_call(path, msg.to_dict())
+        return self._grpc_call(method, msg, service)
+
+
+def _as_msg(x: Optional[Union[SeldonMessage, Dict]]) -> Optional[SeldonMessage]:
+    if x is None:
+        return None
+    if isinstance(x, SeldonMessage):
+        return x
+    return SeldonMessage.from_dict(x)

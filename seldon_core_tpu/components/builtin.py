@@ -114,4 +114,15 @@ def make_builtin(implementation: UnitImplementation, parameters: Optional[Dict[s
         return RandomABTest(**parameters)
     if implementation == UnitImplementation.AVERAGE_COMBINER:
         return AverageCombiner()
+    analytics = {
+        UnitImplementation.EPSILON_GREEDY: "EpsilonGreedy",
+        UnitImplementation.THOMPSON_SAMPLING: "ThompsonSampling",
+        UnitImplementation.MAHALANOBIS_OD: "MahalanobisOutlierDetector",
+        UnitImplementation.ISOLATION_FOREST_OD: "IsolationForestOutlierDetector",
+        UnitImplementation.VAE_OD: "VAEOutlierDetector",
+    }
+    if implementation in analytics:
+        import seldon_core_tpu.analytics as _analytics
+
+        return getattr(_analytics, analytics[implementation])(**parameters)
     raise ValueError(f"No in-process builtin for implementation {implementation}")

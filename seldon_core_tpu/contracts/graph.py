@@ -53,6 +53,14 @@ class UnitImplementation(str, Enum):
     TENSORFLOW_SERVER = "TENSORFLOW_SERVER"
     MLFLOW_SERVER = "MLFLOW_SERVER"
     JAX_SERVER = "JAX_SERVER"
+    # Analytics units the reference ships as standalone container images
+    # (`components/routers/`, `components/outlier-detection/`); here they are
+    # in-process implementations selectable straight from the graph spec.
+    EPSILON_GREEDY = "EPSILON_GREEDY"
+    THOMPSON_SAMPLING = "THOMPSON_SAMPLING"
+    MAHALANOBIS_OD = "MAHALANOBIS_OD"
+    ISOLATION_FOREST_OD = "ISOLATION_FOREST_OD"
+    VAE_OD = "VAE_OD"
 
 
 class UnitMethod(str, Enum):

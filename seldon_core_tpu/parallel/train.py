@@ -17,6 +17,7 @@ mesh.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import flax.struct
@@ -25,7 +26,9 @@ import jax.numpy as jnp
 import optax
 from flax.linen import partitioning as nn_partitioning
 
-from seldon_core_tpu.parallel.sharding import _rules_for_mesh, shard_params
+from seldon_core_tpu.parallel.sharding import _rules_for_mesh
+
+logger = logging.getLogger(__name__)
 
 # Training rule table: unlike serving (DEFAULT_LOGICAL_RULES, where 'seq' is
 # replicated because requests are short), training shards activations along
@@ -58,15 +61,41 @@ def init_train_state(
 ) -> TrainState:
     """Initialise params sharded per the module's flax logical axis names and
     an optimizer state that inherits the param shardings (sharding
-    propagation through a jitted ``tx.init``)."""
+    propagation through a jitted ``tx.init``).
+
+    Params never materialise unsharded: logical specs come from
+    ``jax.eval_shape`` over init, and the real init is jitted with
+    ``out_shardings`` so each device only ever allocates its shard — required
+    for models whose full parameter tree exceeds one device's HBM."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     rules = tuple(_rules_for_mesh(mesh, rules))
+    key = jax.random.PRNGKey(seed)
+
+    def init_params(key):
+        return module.init(key, example_tokens)["params"]
+
+    replicated = NamedSharding(mesh, P())
     with mesh, nn_partitioning.axis_rules(rules):
-        variables = module.init(jax.random.PRNGKey(seed), example_tokens)
-    logical = None
-    if "params_axes" in variables:
-        logical = nn_partitioning.get_axis_names(variables["params_axes"])
-    params = shard_params(variables["params"], mesh, logical, rules)
-    with mesh:
+        abstract = jax.eval_shape(lambda k: module.init(k, example_tokens), key)
+        out_shardings: Any = replicated
+        if "params_axes" in abstract:
+            import flax.core
+
+            # get_axis_names returns a FrozenDict; params is a plain dict
+            logical = flax.core.unfreeze(nn_partitioning.get_axis_names(abstract["params_axes"]))
+            is_spec = lambda x: isinstance(x, (tuple, P))  # noqa: E731
+            spec_tree = jax.tree.map(
+                lambda s: NamedSharding(mesh, P(*nn_partitioning.logical_to_mesh_axes(s, rules=list(rules)))),
+                logical,
+                is_leaf=is_spec,
+            )
+            params_struct = jax.tree.structure(abstract["params"])
+            if jax.tree.structure(spec_tree) == params_struct:
+                out_shardings = spec_tree
+            else:
+                logger.warning("params/axes tree mismatch; initialising replicated")
+        params = jax.jit(init_params, out_shardings=out_shardings)(key)
         opt_state = jax.jit(tx.init)(params)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 

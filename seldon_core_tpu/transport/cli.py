@@ -153,6 +153,20 @@ def main(argv: Optional[list] = None) -> None:
     eng.add_argument("--host", default="0.0.0.0")
     eng.set_defaults(func=run_engine)
 
+    from seldon_core_tpu.client.testers import add_tester_args, tester_main
+
+    tester = sub.add_parser(
+        "tester", help="contract-fuzz a microservice (seldon-core-tester equivalent)"
+    )
+    add_tester_args(tester, endpoint_kind="microservice")
+    tester.set_defaults(func=tester_main)
+
+    api_tester = sub.add_parser(
+        "api-tester", help="contract-fuzz an engine/gateway (seldon-core-api-tester equivalent)"
+    )
+    add_tester_args(api_tester, endpoint_kind="engine")
+    api_tester.set_defaults(func=tester_main)
+
     args = parser.parse_args(argv)
     args.func(args)
 

@@ -1,0 +1,194 @@
+"""Bandit routers + outlier detectors — the reference tests these per
+component (`components/routers/epsilon-greedy/test_EpsilonGreedy.py`,
+outlier-detection test suites); here additionally through the in-process
+graph engine (routing meta + feedback replay)."""
+
+import asyncio
+import pickle
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.analytics import (
+    EpsilonGreedy,
+    IsolationForestOutlierDetector,
+    MahalanobisOutlierDetector,
+    ThompsonSampling,
+    VAEOutlierDetector,
+)
+from seldon_core_tpu.contracts.graph import PredictorSpec
+from seldon_core_tpu.contracts.payload import Feedback, SeldonMessage
+from seldon_core_tpu.runtime.engine import GraphEngine
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def msg(values, shape):
+    return SeldonMessage.from_dict({"data": {"tensor": {"shape": shape, "values": values}}})
+
+
+X = np.array([[1.0, 2.0]])
+
+
+# ---------------------------------------------------------------- routers
+def test_epsilon_greedy_exploits_best_branch():
+    r = EpsilonGreedy(n_branches=3, epsilon=0.0, seed=0)
+    for _ in range(5):
+        r.send_feedback(X, [], 1.0, None, routing=2)
+        r.send_feedback(X, [], 0.0, None, routing=0)
+    assert r.route(X, []) == 2
+    assert r.branch_means()[2] == pytest.approx(1.0)
+
+
+def test_epsilon_greedy_explores():
+    r = EpsilonGreedy(n_branches=2, epsilon=1.0, seed=0)
+    routes = {r.route(X, []) for _ in range(50)}
+    assert routes == {0, 1}
+
+
+def test_epsilon_greedy_rejects_bad_params():
+    with pytest.raises(ValueError):
+        EpsilonGreedy(n_branches=0)
+    with pytest.raises(ValueError):
+        EpsilonGreedy(epsilon=1.5)
+
+
+def test_thompson_sampling_converges():
+    r = ThompsonSampling(n_branches=2, seed=1)
+    for _ in range(200):
+        r.send_feedback(X, [], 0.9, None, routing=1)
+        r.send_feedback(X, [], 0.1, None, routing=0)
+    routes = [r.route(X, []) for _ in range(100)]
+    assert np.mean(routes) > 0.9  # overwhelmingly prefers the good branch
+
+
+def test_router_ignores_out_of_range_routing():
+    r = ThompsonSampling(n_branches=2)
+    r.send_feedback(X, [], 1.0, None, routing=None)
+    r.send_feedback(X, [], 1.0, None, routing=7)
+    assert r.pulls.sum() == 0
+
+
+def test_router_pickle_roundtrip():
+    r = EpsilonGreedy(n_branches=2, epsilon=0.0, seed=0)
+    for _ in range(3):
+        r.send_feedback(X, [], 1.0, None, routing=1)
+    r2 = pickle.loads(pickle.dumps(r))
+    assert r2.route(X, []) == 1
+    assert list(r2.pulls) == list(r.pulls)
+
+
+def test_bandit_graph_end_to_end():
+    graph = {
+        "name": "eg",
+        "type": "ROUTER",
+        "implementation": "EPSILON_GREEDY",
+        "parameters": [
+            {"name": "n_branches", "value": "2", "type": "INT"},
+            {"name": "epsilon", "value": "0.0", "type": "FLOAT"},
+            {"name": "best_branch", "value": "0", "type": "INT"},
+        ],
+        "children": [
+            {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+            {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+    engine = GraphEngine(PredictorSpec.from_dict({"name": "p", "graph": graph}))
+    out = run(engine.predict(msg([1.0], [1, 1]))).to_dict()
+    assert out["meta"]["routing"]["eg"] == 0
+
+    # feed rewards for branch 1 through the engine's feedback replay path
+    for _ in range(5):
+        fb = Feedback.from_dict(
+            {
+                "request": {"data": {"ndarray": [[1.0]]}},
+                "response": {"meta": {"routing": {"eg": 1}}},
+                "reward": 1.0,
+            }
+        )
+        run(engine.send_feedback(fb))
+    out2 = run(engine.predict(msg([1.0], [1, 1]))).to_dict()
+    assert out2["meta"]["routing"]["eg"] == 1  # learned the rewarded branch
+    # router surfaces its posterior in-band
+    tag = out2["meta"]["tags"]["branch_means"]
+    assert tag[1] == pytest.approx(1.0)
+
+
+# ------------------------------------------------------------- outliers
+def test_mahalanobis_scores_outliers_higher():
+    rng = np.random.default_rng(0)
+    det = MahalanobisOutlierDetector(threshold=3.0, n_clip=10000)
+    for _ in range(20):
+        det.score(rng.normal(size=(64, 4)))
+    inlier = det.score(rng.normal(size=(8, 4)))
+    outlier = det.score(np.full((1, 4), 10.0))
+    assert outlier[0] > inlier.max() * 2
+    assert outlier[0] > det.threshold
+
+
+def test_mahalanobis_transform_tags():
+    rng = np.random.default_rng(1)
+    det = MahalanobisOutlierDetector(threshold=3.0)
+    for _ in range(10):
+        det.score(rng.normal(size=(64, 3)))
+    batch = np.vstack([rng.normal(size=(2, 3)), np.full((1, 3), 25.0)])
+    out = det.transform_input(batch, ["a", "b", "c"])
+    assert np.array_equal(out, batch)  # features pass through unchanged
+    tags = det.tags()
+    assert tags["is_outlier"] == [0, 0, 1]
+    metric_keys = {m["key"] for m in det.metrics()}
+    assert {"outlier_score_max", "n_outliers"} <= metric_keys
+
+
+def test_mahalanobis_pickle_roundtrip():
+    rng = np.random.default_rng(2)
+    det = MahalanobisOutlierDetector()
+    det.score(rng.normal(size=(32, 3)))
+    det2 = pickle.loads(pickle.dumps(det))
+    a = det.score(np.ones((2, 3)))
+    b = det2.score(np.ones((2, 3)))
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_isolation_forest():
+    rng = np.random.default_rng(3)
+    train = rng.normal(size=(256, 2))
+    det = IsolationForestOutlierDetector(threshold=0.0, n_estimators=50).fit(train)
+    inlier = det.score(rng.normal(size=(8, 2)))
+    outlier = det.score(np.full((1, 2), 8.0))
+    assert outlier[0] > inlier.mean()
+    assert outlier[0] > 0.0
+
+
+def test_vae_outlier_detector():
+    rng = np.random.default_rng(4)
+    train = rng.normal(size=(256, 4)).astype(np.float32)
+    det = VAEOutlierDetector(latent_dim=2, hidden_dim=32, seed=0)
+    det.fit(train, epochs=150)
+    inlier = det.score(rng.normal(size=(16, 4)))
+    outlier = det.score(np.full((1, 4), 6.0))
+    assert outlier[0] > inlier.mean() * 3
+    det2 = pickle.loads(pickle.dumps(det))
+    np.testing.assert_allclose(det2.score(train[:4]), det.score(train[:4]), rtol=1e-4)
+
+
+def test_outlier_graph_transformer():
+    """Outlier TRANSFORMER in front of a model: scores land in meta.tags."""
+    rng = np.random.default_rng(5)
+    det = MahalanobisOutlierDetector(threshold=3.0)
+    for _ in range(10):
+        det.score(rng.normal(size=(64, 2)))
+    graph = {
+        "name": "od",
+        "type": "TRANSFORMER",
+        "children": [{"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}],
+    }
+    engine = GraphEngine(
+        PredictorSpec.from_dict({"name": "p", "graph": graph}), components={"od": det}
+    )
+    out = run(engine.predict(msg([30.0, 30.0], [1, 2]))).to_dict()
+    assert out["meta"]["tags"]["is_outlier"] == [1]
+    keys = {m["key"] for m in out["meta"]["metrics"]}
+    assert "outlier_score_max" in keys

@@ -1,0 +1,205 @@
+"""Client SDK + contract tester round trips against live in-process servers
+(reference strategy: python/tests/test_seldon_client.py +
+test_microservice_tester.py, here with real ephemeral-port servers)."""
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.client import (
+    SeldonClient,
+    generate_batch,
+    unfold_contract,
+    validate_response,
+)
+from seldon_core_tpu.client.contract import contract_from_dataframe, feature_names
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.graph import PredictorSpec
+from seldon_core_tpu.runtime.engine import GraphEngine
+from seldon_core_tpu.transport.grpc_server import make_component_server, make_engine_server
+from seldon_core_tpu.transport.rest import make_engine_app
+
+CONTRACT = {
+    "features": [
+        {"name": "x", "ftype": "continuous", "dtype": "FLOAT", "range": [0, 1], "shape": [2]},
+        {"name": "k", "ftype": "continuous", "dtype": "INT", "range": [0, 10]},
+    ],
+    "targets": [
+        {"name": "p", "ftype": "continuous", "range": [0, 1], "shape": [3]},
+    ],
+}
+
+
+# ---------------------------------------------------------------- contract
+def test_unfold_contract_expands_shapes():
+    c = unfold_contract(CONTRACT)
+    assert [f["name"] for f in c["features"]] == ["x:0", "x:1", "k"]
+    assert [t["name"] for t in c["targets"]] == ["p:0", "p:1", "p:2"]
+
+
+def test_generate_batch_respects_ranges():
+    batch = generate_batch(CONTRACT, 50, seed=0)
+    assert batch.shape == (50, 3)
+    assert np.all(batch[:, :2] >= 0) and np.all(batch[:, :2] <= 1)
+    assert np.all(batch[:, 2] == np.floor(batch[:, 2]))
+
+
+def test_generate_batch_categorical():
+    c = {"features": [{"name": "c", "ftype": "categorical", "values": ["a", "b"]}]}
+    batch = generate_batch(c, 20, seed=1)
+    assert set(batch.ravel()) <= {"a", "b"}
+
+
+def test_validate_response():
+    ok = validate_response(CONTRACT, np.array([[0.1, 0.9, 0.5]]))
+    assert ok == []
+    bad = validate_response(CONTRACT, np.array([[0.1, 1.9, 0.5]]))
+    assert any("above range" in p for p in bad)
+    wrong_cols = validate_response(CONTRACT, np.array([[0.1, 0.9]]))
+    assert "expected 3 target columns" in wrong_cols[0]
+
+
+def test_contract_from_dataframe():
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [0.5, 1.5, 2.5], "b": ["x", "y", "x"]})
+    c = contract_from_dataframe(df)
+    by_name = {f["name"]: f for f in c["features"]}
+    assert by_name["a"]["ftype"] == "continuous"
+    assert by_name["a"]["range"] == [0.5, 2.5]
+    assert by_name["b"]["ftype"] == "categorical"
+    assert by_name["b"]["values"] == ["x", "y"]
+    batch = generate_batch(c, 5, seed=0)
+    assert batch.shape == (5, 2)
+
+
+# ------------------------------------------------------------- live servers
+SPEC = {
+    "name": "p",
+    "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+}
+
+
+@pytest.fixture(scope="module")
+def rest_engine():
+    """Real aiohttp engine server on an ephemeral port, in a thread."""
+    from aiohttp import web
+
+    engine = GraphEngine(PredictorSpec.from_dict(SPEC))
+    app = make_engine_app(engine)
+    loop = asyncio.new_event_loop()
+    port_holder = {}
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port_holder["port"] = runner.addresses[0][1]
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    yield port_holder["port"]
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture(scope="module")
+def grpc_engine():
+    engine = GraphEngine(PredictorSpec.from_dict(SPEC))
+    server = make_engine_server(engine, port=None)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    yield port
+    server.stop(None)
+
+
+def test_rest_client_predict(rest_engine):
+    client = SeldonClient(port=rest_engine, transport="rest", endpoint_kind="engine")
+    resp = client.predict(np.array([[1.0, 2.0]]))
+    assert resp.success, resp.error
+    assert resp.data.ravel() == pytest.approx([0.1, 0.9, 0.5])
+    assert resp.raw["meta"]["requestPath"] == {"m": "SimpleModel"}
+
+
+def test_rest_client_feedback(rest_engine):
+    client = SeldonClient(port=rest_engine, transport="rest", endpoint_kind="engine")
+    resp = client.feedback(
+        request={"data": {"ndarray": [[1.0]]}},
+        response={"meta": {"routing": {}}},
+        reward=1.0,
+    )
+    assert resp.success, resp.error
+
+
+def test_rest_client_connection_error_is_graceful():
+    client = SeldonClient(port=1, transport="rest", timeout_s=0.5)
+    resp = client.predict(np.array([[1.0]]))
+    assert not resp.success
+    assert resp.error
+
+
+def test_grpc_client_predict(grpc_engine):
+    client = SeldonClient(port=grpc_engine, transport="grpc", endpoint_kind="engine")
+    resp = client.predict(np.array([[1.0, 2.0]]))
+    assert resp.success, resp.error
+    assert resp.data.ravel() == pytest.approx([0.1, 0.9, 0.5])
+
+
+def test_grpc_microservice_methods():
+    class Unit(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.asarray(X) * 2
+
+        def route(self, X, names):
+            return 1
+
+        def aggregate(self, Xs, names):
+            return np.mean([np.asarray(x) for x in Xs], axis=0)
+
+    server = make_component_server(Unit(), port=None)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        client = SeldonClient(port=port, transport="grpc", endpoint_kind="microservice")
+        assert client.predict(np.array([[2.0]])).data.ravel() == pytest.approx([4.0])
+        assert client.route(np.array([[1.0]])).data.ravel() == pytest.approx([1])
+        agg = client.aggregate([np.array([[2.0]]), np.array([[4.0]])])
+        assert agg.data.ravel() == pytest.approx([3.0])
+    finally:
+        server.stop(None)
+
+
+def test_contract_tester_against_engine(rest_engine, tmp_path):
+    from seldon_core_tpu.client.testers import run_contract_test
+
+    contract = {
+        "features": [
+            {"name": "x", "ftype": "continuous", "dtype": "FLOAT", "range": [0, 1], "shape": [2]}
+        ],
+        "targets": [
+            {"name": "p", "ftype": "continuous", "range": [0, 1], "shape": [3]}
+        ],
+    }
+    path = tmp_path / "contract.json"
+    path.write_text(json.dumps(contract))
+    failures = run_contract_test(
+        str(path), "127.0.0.1", rest_engine, n_requests=3, batch_size=2,
+        endpoint_kind="engine", seed=0,
+    )
+    assert failures == 0
+
+
+def test_feature_names_helper():
+    assert feature_names(CONTRACT) == ["x:0", "x:1", "k"]
