@@ -31,6 +31,11 @@ from seldon_core_tpu.models.registry import register_model
 param_with_axes = nn_partitioning.param_with_axes
 with_sharding_constraint = nn_partitioning.with_sharding_constraint
 
+# Sentinel position for empty/padded cache slots and padded prompt tokens:
+# larger than any real position, so causal masks (key_pos <= query_pos)
+# exclude them; small enough that rotary angles stay finite.
+PAD_POS = 1 << 28
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -49,6 +54,13 @@ class TransformerConfig:
     # MoE: 0 = dense FFN; otherwise number of experts with top-2 routing.
     n_experts: int = 0
     n_experts_per_token: int = 2
+    # "full" = dense attention (GSPMD gathers KV when seq-sharded);
+    # "ring" = sequence-parallel ring attention over mesh axis 'seq'
+    # (ops.ring_attention) for long-context cache-less forward/training.
+    # Any call that passes a KV cache (prefill/decode serving) uses the dense
+    # path regardless — ring needs seq-sharded KV, caches are slot-indexed.
+    attention_impl: str = "full"
+    mesh: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -94,10 +106,14 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x, positions, cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
                  cache_index: Optional[jnp.ndarray] = None):
-        """x: [b, s, d]. With cache=(k_cache, v_cache) of [b, max_len, kvh, hd]
-        and cache_index (scalar write offset), runs incremental decode and
-        returns (out, (new_k_cache, new_v_cache)); else full causal attention
-        and returns (out, (k, v))."""
+        """x: [b, s, d]. With cache=(k_cache, v_cache, pos_cache) of
+        [b, max_len, kvh, hd] / [b, max_len], runs incremental decode and
+        returns (out, new_cache). cache_index is the write offset: a scalar
+        (same slot for the whole batch — prefill) or a [b] vector (per-sequence
+        slots — continuous batching decode, s must be 1). pos_cache holds each
+        slot's absolute position (PAD_POS when empty), so causal masking is
+        exact under right-padding: empty/pad slots are never attended.
+        Without a cache: full causal attention, returns (out, (k, v))."""
         cfg = self.cfg
         b, s, _ = x.shape
         hd = cfg.head_dim
@@ -129,21 +145,29 @@ class Attention(nn.Module):
         k = apply_rotary(k, cos, sin)
 
         if cache is not None:
-            k_cache, v_cache = cache
+            k_cache, v_cache, pos_cache = cache
             idx = jnp.asarray(cache_index, dtype=jnp.int32)
-            k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, idx, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, idx, 0, 0))
+            if idx.ndim == 0:
+                k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, idx, 0, 0))
+                v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, idx, 0, 0))
+                pos_cache = jax.lax.dynamic_update_slice(
+                    pos_cache, positions.astype(pos_cache.dtype), (0, idx)
+                )
+            else:
+                # per-sequence write offsets (continuous batching): s == 1
+                bidx = jnp.arange(b)
+                k_cache = k_cache.at[bidx, idx].set(k[:, 0].astype(k_cache.dtype))
+                v_cache = v_cache.at[bidx, idx].set(v[:, 0].astype(v_cache.dtype))
+                pos_cache = pos_cache.at[bidx, idx].set(positions[:, 0].astype(pos_cache.dtype))
             k_all, v_all = k_cache, v_cache
-            kv_len = k_cache.shape[1]
-            new_cache = (k_cache, v_cache)
+            # pos_cache marks empty slots with PAD_POS, so one predicate covers
+            # causality, the unfilled suffix, and right-padding garbage.
+            mask = pos_cache[:, None, :] <= positions[:, :, None]  # [b, s, kv]
+            new_cache = (k_cache, v_cache, pos_cache)
         else:
             k_all, v_all = k, v
-            kv_len = s
+            mask = positions[:, None, :] <= positions[:, :, None]  # [b, s, kv]
             new_cache = (k, v)
-        # Cache slots are laid out by absolute position, so one predicate covers
-        # causality and the unfilled suffix: key position <= query position.
-        kv_pos = jnp.arange(kv_len)
-        mask = kv_pos[None, None, :] <= positions[:, :, None]  # [b, s, kv]
 
         # GQA: repeat kv heads up to n_heads
         if cfg.n_kv_heads != cfg.n_heads:
@@ -151,12 +175,19 @@ class Attention(nn.Module):
             k_all = jnp.repeat(k_all, rep, axis=2)
             v_all = jnp.repeat(v_all, rep, axis=2)
 
-        scale = hd**-0.5
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_all.astype(dt)) * scale
-        logits = logits.astype(jnp.float32)
-        logits = jnp.where(mask[:, None, :, :], logits, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(logits, axis=-1).astype(dt)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all.astype(dt))
+        if cache is None and cfg.attention_impl == "ring":
+            from seldon_core_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(
+                q, k_all.astype(dt), v_all.astype(dt), positions, positions, mesh=cfg.mesh
+            )
+        else:
+            scale = hd**-0.5
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_all.astype(dt)) * scale
+            logits = logits.astype(jnp.float32)
+            logits = jnp.where(mask[:, None, :, :], logits, jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all.astype(dt))
         out = out.reshape(b, s, cfg.n_heads * hd)
         out = out @ wo.astype(dt)
         return out, new_cache
@@ -269,10 +300,16 @@ class Transformer(nn.Module):
 
 
 def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int):
-    """Static-shape KV caches: one (k, v) pair per layer, [b, max_len, kvh, hd]."""
+    """Static-shape KV caches: one (k, v, pos) triple per layer —
+    [b, max_len, kvh, hd] buffers plus a [b, max_len] position map whose empty
+    slots hold PAD_POS (never attended)."""
     shape = (batch, max_len, cfg.n_kv_heads, cfg.head_dim)
     return [
-        (jnp.zeros(shape, dtype=cfg.dtype), jnp.zeros(shape, dtype=cfg.dtype))
+        (
+            jnp.zeros(shape, dtype=cfg.dtype),
+            jnp.zeros(shape, dtype=cfg.dtype),
+            jnp.full((batch, max_len), PAD_POS, dtype=jnp.int32),
+        )
         for _ in range(cfg.n_layers)
     ]
 
@@ -294,11 +331,11 @@ def make_llama2_7b(dtype: str = "bfloat16"):
 
 
 @register_model("llama-tiny")
-def make_llama_tiny(dtype: str = "float32", n_experts: int = 0):
+def make_llama_tiny(dtype: str = "float32", **kwargs):
     """Small config for tests and the multi-chip dry run."""
     cfg = TransformerConfig(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
-        ffn_dim=128, max_seq_len=128, dtype=jnp.dtype(dtype), n_experts=n_experts,
-        tie_embeddings=True,
+        ffn_dim=128, max_seq_len=128, dtype=jnp.dtype(dtype),
+        tie_embeddings=True, **kwargs,
     )
     return Transformer(cfg)

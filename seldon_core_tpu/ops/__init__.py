@@ -1,0 +1,4 @@
+"""TPU-native ops: ring attention (sequence-parallel long context), sampling,
+and pallas kernels. No reference counterpart — the reference is a serving
+platform with no model/kernel code (SURVEY.md §5 'Long-context: absent,
+design from scratch')."""

@@ -1,0 +1,121 @@
+"""Ring attention: exact attention over sequence-sharded Q/K/V.
+
+Long-context sequence parallelism for the transformer family: Q, K, V live
+sharded along the sequence axis of a device mesh; each device computes
+attention of its local query block against one K/V block at a time while the
+K/V blocks rotate around the ring via ``ppermute`` (one ICI hop per step, so
+communication overlaps compute and no device ever holds the full sequence).
+Softmax is accumulated online flash-style (running max/denominator), so the
+result is exact, not approximate.
+
+The reference has no analogue (SURVEY.md §5: long-context/sequence
+parallelism "absent — design from scratch"); the design follows the public
+ring-attention recipe (blockwise attention + rotating KV; see PAPERS.md).
+
+Layout convention: q/k/v are [batch, seq, heads, head_dim]; positions are
+[batch, seq] absolute indices (needed for causal masking across blocks —
+after sharding, a device only knows global causality through positions).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = jnp.finfo(jnp.float32).min
+
+
+def _block_attention(q, k_blk, v_blk, q_pos, kv_pos, m, l, acc, scale, causal):
+    """One online-softmax accumulation step of local q against one K/V block.
+
+    q: [b, sq, h, d]; k_blk/v_blk: [b, sk, h, d]; q_pos: [b, sq];
+    kv_pos: [b, sk]; m, l: [b, h, sq] running max / denominator;
+    acc: [b, sq, h, d] running numerator.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    if causal:
+        mask = kv_pos[:, None, None, :] <= q_pos[:, None, :, None]
+        logits = jnp.where(mask, logits, NEG_INF)
+
+    blk_max = jnp.max(logits, axis=-1)  # [b, h, sq]
+    m_new = jnp.maximum(m, blk_max)
+    # Fully-masked-so-far rows keep m == NEG_INF; exp guards avoid inf-inf.
+    p = jnp.exp(logits - m_new[..., None])
+    p = jnp.where(logits <= NEG_INF, 0.0, p)
+    corr = jnp.where(m <= NEG_INF, 0.0, jnp.exp(m - m_new))
+
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+    )
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_local(q, k, v, q_pos, kv_pos, axis_name: Optional[str], causal: bool):
+    """Per-device body: rotate K/V around `axis_name` accumulating attention.
+    With axis_name=None this degenerates to single-block (full) attention."""
+    b, sq, h, d = q.shape
+    scale = d**-0.5
+    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    acc = jnp.zeros((b, sq, h, d), jnp.float32)
+
+    if axis_name is None:
+        m, l, acc = _block_attention(q, k, v, q_pos, kv_pos, m, l, acc, scale, causal)
+    else:
+        n = jax.lax.axis_size(axis_name)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def step(i, carry):
+            k_blk, v_blk, kvp, m, l, acc = carry
+            m, l, acc = _block_attention(q, k_blk, v_blk, q_pos, kvp, m, l, acc, scale, causal)
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            kvp = jax.lax.ppermute(kvp, axis_name, perm)
+            return k_blk, v_blk, kvp, m, l, acc
+
+        _, _, _, m, l, acc = jax.lax.fori_loop(0, n, step, (k, v, kv_pos, m, l, acc))
+
+    denom = jnp.maximum(l, jnp.finfo(jnp.float32).tiny).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    q_positions,
+    kv_positions,
+    mesh=None,
+    seq_axis: str = "seq",
+    batch_axis: Optional[str] = "data",
+    head_axis: Optional[str] = "model",
+    causal: bool = True,
+):
+    """Exact attention over seq-sharded q/k/v on ``mesh``.
+
+    All of q/k/v must carry the same number of heads (callers repeat GQA KV
+    heads first) and the same per-device sequence shard. Without a mesh (or
+    when the mesh lacks ``seq_axis``) this is plain full attention — callers
+    can use one code path everywhere.
+    """
+    if mesh is None or seq_axis not in getattr(mesh, "axis_names", ()):
+        return _ring_attention_local(q, k, v, q_positions, kv_positions, None, causal)
+
+    ba = batch_axis if batch_axis in mesh.axis_names else None
+    ha = head_axis if head_axis in mesh.axis_names else None
+    qkv_spec = P(ba, seq_axis, ha, None)
+    pos_spec = P(ba, seq_axis)
+
+    fn = jax.shard_map(
+        partial(_ring_attention_local, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec, pos_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, q_positions, kv_positions)

@@ -1,0 +1,223 @@
+"""Continuous batching for LLM decode.
+
+The engine-side request batcher of the BASELINE.json north star ("the
+orchestrator's gRPC request batcher shards inference-graph traffic across a
+v5e slice"), specialised for autoregressive decode: requests join and leave a
+fixed pool of cache slots *between decode steps*, so one compiled decode
+program serves overlapping requests at arbitrary arrival times — no
+head-of-line blocking on the longest generation, no recompilation.
+
+Design (all shapes static):
+- one slot-batched KV cache [S, max_len, ...] lives on device;
+- admission: a single-prompt prefill (compiled per length bucket) produces a
+  1-sequence cache which is written into a free slot (jitted insert);
+- every step runs ONE jitted decode over all S slots with per-slot cache
+  offsets (models/transformer.py vector ``cache_index``); inactive slots
+  compute garbage into their own slot, which the next insert overwrites;
+- completion: EOS or per-request max_new_tokens frees the slot.
+
+The transformer's position-tracked cache (PAD_POS masking) is what makes the
+mixed-occupancy batch exact: each slot only attends to its own written
+positions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.servers.llmserver import LLMServer, _bucket
+
+logger = logging.getLogger(__name__)
+
+
+class _Slot:
+    __slots__ = ("future", "tokens", "true_len", "n_new", "max_new", "active")
+
+    def __init__(self):
+        self.active = False
+        self.future: Optional[asyncio.Future] = None
+        self.tokens: List[int] = []
+        self.true_len = 0
+        self.n_new = 0
+        self.max_new = 0
+
+
+class ContinuousBatcher:
+    def __init__(
+        self,
+        server: LLMServer,
+        max_slots: int = 4,
+        max_len: Optional[int] = None,
+        len_buckets: Optional[Sequence[int]] = None,
+    ):
+        server.load()
+        self.server = server
+        self.S = int(max_slots)
+        cfg = server._cfg
+        self.max_len = int(max_len or (cfg.max_seq_len + server.max_new_tokens))
+        self.len_buckets = tuple(len_buckets or server.len_buckets)
+        self.eos_id = server.eos_id
+        self._slots = [_Slot() for _ in range(self.S)]
+        from collections import deque
+
+        self._pending: Any = deque()  # FIFO, peek-without-pop on full slots
+        self._wakeup = asyncio.Event()
+        self._closed = False
+        self._task: Optional[asyncio.Task] = None
+        self._build()
+        # host mirrors of per-slot decode state
+        self._last_tok = np.zeros((self.S,), np.int32)
+        self._next_pos = np.zeros((self.S,), np.int32)
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.transformer import init_kv_caches
+
+        server, cfg = self.server, self.server._cfg
+        module = server._module
+        self._caches = jax.jit(lambda: init_kv_caches(cfg, self.S, self.max_len))()
+
+        @jax.jit
+        def insert(big, small, slot):
+            return jax.tree.map(lambda b, s: b.at[slot].set(s[0]), big, small)
+
+        self._insert = insert
+
+        @jax.jit
+        def decode_step(params, caches, last_tok, next_pos):
+            logits, caches = module.apply(
+                params,
+                last_tok[:, None],
+                positions=next_pos[:, None],
+                caches=caches,
+                cache_index=next_pos,
+            )
+            return caches, jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+
+        self._decode_step = decode_step
+
+    # ------------------------------------------------------------------
+    async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None) -> List[int]:
+        """prompt: str or token sequence. Resolves to generated token ids."""
+        if self._closed:
+            raise RuntimeError("batcher closed")
+        if isinstance(prompt, str):
+            ids = self.server._tokenizer.encode(prompt)
+        else:
+            ids = [int(t) for t in np.asarray(prompt).ravel()]
+        if not ids:
+            raise ValueError("empty prompt")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending.append((ids, int(max_new_tokens or self.server.max_new_tokens), fut))
+        self._ensure_running()
+        self._wakeup.set()
+        return await fut
+
+    def _ensure_running(self):
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def close(self):
+        self._closed = True
+        self._wakeup.set()
+        if self._task is not None:
+            await self._task
+
+    # ------------------------------------------------------------------
+    def _admit(self, ids: List[int], max_new: int, fut: asyncio.Future) -> bool:
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.transformer import PAD_POS
+
+        free = next((i for i, s in enumerate(self._slots) if not s.active), None)
+        if free is None:
+            return False
+        # same truncation rule as LLMServer.generate: never beyond the model's
+        # trained context, and leave room for at least one generated token
+        plen = min(
+            _bucket(len(ids), self.len_buckets),
+            self.server._cfg.max_seq_len,
+            self.max_len - 1,
+        )
+        ids = ids[-plen:]
+        L = len(ids)
+        tokens = np.zeros((1, plen), np.int32)
+        positions = np.full((1, plen), PAD_POS, np.int32)
+        tokens[0, :L] = ids
+        positions[0, :L] = np.arange(L)
+
+        prefill = self.server._get_prefill(1, plen, self.max_len)
+        logits, cache1 = prefill(self.server._params, jnp.asarray(tokens), jnp.asarray(positions))
+        self._caches = self._insert(self._caches, cache1, free)
+        first = int(np.asarray(logits[0, L - 1]).argmax())
+
+        slot = self._slots[free]
+        slot.active = True
+        slot.future = fut
+        slot.true_len = L
+        slot.max_new = max_new
+        slot.n_new = 1
+        slot.tokens = [first]
+        self._last_tok[free] = first
+        self._next_pos[free] = L
+        if first == self.eos_id or max_new <= 1:
+            self._finish(free)
+        return True
+
+    def _finish(self, i: int):
+        slot = self._slots[i]
+        toks = slot.tokens
+        if self.eos_id in toks:
+            toks = toks[: toks.index(self.eos_id)]
+        if slot.future is not None and not slot.future.done():
+            slot.future.set_result(toks)
+        slot.active = False
+        slot.future = None
+
+    def _step(self):
+        import jax.numpy as jnp
+
+        self._caches, nxt = self._decode_step(
+            self.server._params,
+            self._caches,
+            jnp.asarray(self._last_tok),
+            jnp.asarray(self._next_pos),
+        )
+        nxt = np.asarray(nxt).astype(np.int32)
+        for i, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            tok = int(nxt[i])
+            slot.tokens.append(tok)
+            slot.n_new += 1
+            self._last_tok[i] = tok
+            self._next_pos[i] += 1
+            if tok == self.eos_id or slot.n_new >= slot.max_new or int(self._next_pos[i]) >= self.max_len:
+                self._finish(i)
+
+    async def _run(self):
+        while True:
+            # admit as many pending requests as there are free slots (FIFO);
+            # device work runs in a worker thread so the event loop (and any
+            # co-hosted HTTP handlers) stays responsive during prefill/decode
+            while self._pending and any(not s.active for s in self._slots):
+                ids, max_new, fut = self._pending.popleft()
+                await asyncio.to_thread(self._admit, ids, max_new, fut)
+            if any(s.active for s in self._slots):
+                await asyncio.to_thread(self._step)
+                continue
+            if self._closed:
+                return
+            self._wakeup.clear()
+            try:
+                await asyncio.wait_for(self._wakeup.wait(), timeout=0.5)
+            except asyncio.TimeoutError:
+                if self._closed:
+                    return

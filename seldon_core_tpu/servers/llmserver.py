@@ -1,0 +1,413 @@
+"""LLM_SERVER: autoregressive text-generation prepackaged server.
+
+The BASELINE.json stretch config ("Llama-2-7B Flax jaxserver on v5e-8 pod").
+No reference counterpart — the reference's prepackaged servers are
+request/response classifiers (`servers/sklearnserver/...`); LLM serving is the
+TPU build's native extension, designed around XLA static shapes:
+
+- prompts are bucketed to (batch_bucket, len_bucket) so there is ONE compiled
+  prefill program per bucket pair and ONE decode program per batch bucket;
+- prefill writes the prompt into the position-tracked KV cache in one pass
+  (padded slots carry PAD_POS and are never attended — models/transformer.py);
+- decode is a single ``lax.scan`` over steps: per-sequence cache offsets,
+  greedy or temperature/top-k sampling, EOS masking inside the scan — no
+  per-token Python dispatch;
+- tensor parallelism: pass a mesh and the params shard per the model's
+  logical axes (parallel.sharding), with activations following under GSPMD.
+
+``attention_impl='ring'`` (ops.ring_attention) applies to the cache-less
+forward/training path; the cached prefill/decode path used here always runs
+dense attention (single-query blocks; GSPMD shards KV over the mesh).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.payload import SeldonError
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_LEN_BUCKETS = (32, 128, 512, 2048)
+DEFAULT_BATCH_BUCKETS = (1, 4, 8)
+
+
+class ByteTokenizer:
+    """UTF-8 byte fallback tokenizer (ids 0..255): always available, exercises
+    the full serving path without a vocab artifact. eos_id defaults to 0."""
+
+    vocab_size = 256
+
+    def __init__(self, eos_id: int = 0):
+        self.eos_id = eos_id
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        ids = [int(i) for i in ids if 0 <= int(i) < 256 and int(i) != self.eos_id]
+        return bytes(ids).decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """transformers tokenizer adapter (gated import; offline-friendly only if
+    the vocab files are local)."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+        self.eos_id = self._tok.eos_token_id or 0
+        self.vocab_size = self._tok.vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode([int(i) for i in ids])
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class LLMServer(SeldonComponent):
+    """Serves a registered transformer-family model for text generation.
+
+    Parameters (graph-spec ``parameters`` or constructor kwargs):
+      model_uri: jaxserver-style checkpoint dir (config.json + params) — or
+      model + init_random=True for a randomly-initialised model (tests/bench)
+      max_new_tokens, temperature, top_k, eos_id, tokenizer ("bytes" or an HF
+      name), len_buckets, batch_buckets, mesh (object, programmatic only).
+    """
+
+    def __init__(
+        self,
+        model_uri: str = "",
+        model: Optional[str] = None,
+        model_kwargs: Optional[Dict[str, Any]] = None,
+        init_random: bool = False,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        top_k: int = 40,
+        eos_id: Optional[int] = None,
+        tokenizer: str = "bytes",
+        len_buckets: Optional[Sequence[int]] = None,
+        batch_buckets: Optional[Sequence[int]] = None,
+        mesh: Optional[Any] = None,
+        seed: int = 0,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        self.model_uri = model_uri
+        self.model_name = model
+        self.model_kwargs = dict(model_kwargs or {})
+        self.init_random = bool(init_random)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.tokenizer_name = tokenizer
+        self.len_buckets = tuple(len_buckets or DEFAULT_LEN_BUCKETS)
+        self.batch_buckets = tuple(batch_buckets or DEFAULT_BATCH_BUCKETS)
+        self.mesh = mesh
+        self.seed = int(seed)
+        self.ready = False
+        self._eos_override = eos_id
+        self._prefill_cache: Dict[Tuple[int, int], Any] = {}
+        self._decode_cache: Dict[Tuple[int, int], Any] = {}
+        self._request_count = 0
+
+    # ------------------------------------------------------------------
+    def load(self) -> None:
+        if self.ready:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models import get_model
+
+        cfg_kwargs = dict(self.model_kwargs)
+        name = self.model_name
+        params = None
+        if self.model_uri:
+            from seldon_core_tpu import storage
+
+            path = storage.download(self.model_uri)
+            with open(os.path.join(path, "config.json")) as f:
+                file_cfg = json.load(f)
+            name = name or file_cfg["model"]
+            cfg_kwargs = {**file_cfg.get("kwargs", {}), **cfg_kwargs}
+            params = self._load_params(path, name, cfg_kwargs)
+        if name is None:
+            raise SeldonError("LLMServer needs model_uri or model=<registry name>", status_code=500)
+
+        self._module = get_model(name, **cfg_kwargs)
+        self._cfg = self._module.cfg
+
+        if params is None:
+            if not self.init_random:
+                raise SeldonError(
+                    "No checkpoint: pass model_uri or init_random=True", status_code=500
+                )
+            params = jax.jit(self._module.init)(
+                jax.random.PRNGKey(self.seed), jnp.zeros((1, 8), jnp.int32)
+            )
+
+        if self.mesh is not None:
+            from seldon_core_tpu.parallel.sharding import logical_axis_tree, shard_params
+
+            logical = logical_axis_tree(self._module, jax.ShapeDtypeStruct((1, 8), jnp.int32))
+            params = shard_params(params, self.mesh, logical)
+        self._params = params
+
+        if self.tokenizer_name == "bytes":
+            self._tokenizer = ByteTokenizer()
+        else:
+            self._tokenizer = HFTokenizer(self.tokenizer_name)
+        self.eos_id = self._eos_override if self._eos_override is not None else self._tokenizer.eos_id
+        self.ready = True
+        logger.info("LLMServer loaded %s (vocab=%d)", name, self._cfg.vocab_size)
+
+    def _load_params(self, path: str, name: str, cfg_kwargs: Dict[str, Any]):
+        orbax_dir = os.path.join(path, "params")
+        if os.path.isdir(orbax_dir):
+            import orbax.checkpoint as ocp
+
+            return ocp.StandardCheckpointer().restore(os.path.abspath(orbax_dir))
+        msgpack = os.path.join(path, "params.msgpack")
+        if os.path.exists(msgpack):
+            import flax.serialization
+            import jax
+            import jax.numpy as jnp
+
+            from seldon_core_tpu.models import get_model
+
+            module = get_model(name, **cfg_kwargs)
+            target = jax.eval_shape(
+                lambda: module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+            )
+            target = jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), target)
+            with open(msgpack, "rb") as f:
+                return flax.serialization.from_bytes(target, f.read())
+        raise SeldonError(f"No params under {path}", status_code=500)
+
+    # ------------------------------------------------------------------
+    # Compiled stages
+    # ------------------------------------------------------------------
+    def _get_prefill(self, b: int, plen: int, max_len: int):
+        key = (b, plen, max_len)
+        fn = self._prefill_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+
+        from seldon_core_tpu.models.transformer import init_kv_caches
+
+        module, cfg = self._module, self._cfg
+
+        @jax.jit
+        def prefill(params, tokens, positions):
+            caches = init_kv_caches(cfg, tokens.shape[0], max_len)
+            logits, caches = module.apply(
+                params, tokens, positions=positions, caches=caches, cache_index=0
+            )
+            return logits, caches
+
+        self._prefill_cache[key] = prefill
+        return prefill
+
+    def _get_decode(self, b: int, max_len: int):
+        key = (b, max_len)
+        fn = self._decode_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        module = self._module
+        eos_id = self.eos_id
+        top_k = self.top_k
+
+        @partial(jax.jit, static_argnames=("n_steps",))
+        def decode(params, caches, last_tok, true_len, n_steps, rng, temperature):
+            """last_tok [b], true_len [b]; returns tokens [b, n_steps]."""
+
+            def sample(logits, key):
+                greedy = jnp.argmax(logits, axis=-1)
+                k = min(top_k, logits.shape[-1])
+                topv, topi = jax.lax.top_k(logits, k)
+                draw = jax.random.categorical(key, topv / jnp.maximum(temperature, 1e-6))
+                sampled = jnp.take_along_axis(topi, draw[:, None], axis=-1)[:, 0]
+                return jnp.where(temperature <= 0.0, greedy, sampled)
+
+            def step(carry, _):
+                caches, tok, offset, done, key = carry
+                positions = (true_len + offset)[:, None]
+                cache_index = true_len + offset
+                logits, caches = module.apply(
+                    params, tok[:, None], positions=positions, caches=caches,
+                    cache_index=cache_index,
+                )
+                key, sub = jax.random.split(key)
+                nxt = sample(logits[:, -1].astype(jnp.float32), sub)
+                nxt = jnp.where(done, eos_id, nxt)
+                done = done | (nxt == eos_id)
+                return (caches, nxt, offset + 1, done, key), nxt
+
+            done0 = jnp.zeros_like(last_tok, dtype=bool)
+            (_, _, _, _, _), toks = jax.lax.scan(
+                step, (caches, last_tok, jnp.zeros_like(true_len), done0, rng), None,
+                length=n_steps,
+            )
+            return toks.T  # [b, n_steps]
+
+        self._decode_cache[key] = decode
+        return decode
+
+    # ------------------------------------------------------------------
+    def generate(
+        self,
+        prompts: Sequence[Any],
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        seed: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """prompts: list of strings or of int token lists/arrays."""
+        if not self.ready:
+            self.load()
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.transformer import PAD_POS
+
+        max_new = int(max_new_tokens or self.max_new_tokens)
+        temp = self.temperature if temperature is None else float(temperature)
+
+        token_lists: List[List[int]] = []
+        text_mode = []
+        for p in prompts:
+            if isinstance(p, str):
+                token_lists.append(self._tokenizer.encode(p))
+                text_mode.append(True)
+            else:
+                token_lists.append([int(t) for t in np.asarray(p).ravel()])
+                text_mode.append(False)
+        if not token_lists:
+            raise SeldonError("generate() needs at least one prompt")
+        if any(len(t) == 0 for t in token_lists):
+            raise SeldonError("empty prompt")
+
+        n = len(token_lists)
+        max_batch = self.batch_buckets[-1]
+        if n > max_batch:
+            # split oversized batches and merge (one compiled program per bucket)
+            out_tokens, out_texts = [], []
+            for i in range(0, n, max_batch):
+                part = self.generate(
+                    prompts[i : i + max_batch], max_new_tokens=max_new,
+                    temperature=temp, seed=seed,
+                )
+                out_tokens.extend(part["tokens"])
+                out_texts.extend(part["texts"])
+            return {"tokens": out_tokens, "texts": out_texts}
+        nb = _bucket(n, self.batch_buckets)
+        plen = _bucket(max(len(t) for t in token_lists), self.len_buckets)
+        plen = min(plen, self._cfg.max_seq_len)
+        token_lists = [t[-plen:] for t in token_lists]  # clip overlong prompts
+        max_len = min(plen + max_new, self._cfg.max_seq_len + max_new)
+
+        tokens = np.zeros((nb, plen), np.int32)
+        positions = np.full((nb, plen), PAD_POS, np.int32)
+        true_len = np.ones((nb,), np.int32)  # dummy rows decode from slot 1
+        last_tok = np.zeros((nb,), np.int32)
+        for i, toks in enumerate(token_lists):
+            L = len(toks)
+            tokens[i, :L] = toks
+            positions[i, :L] = np.arange(L)
+            true_len[i] = L
+            last_tok[i] = toks[-1]
+
+        prefill = self._get_prefill(nb, plen, max_len)
+        decode = self._get_decode(nb, max_len)
+
+        logits, caches = prefill(self._params, jnp.asarray(tokens), jnp.asarray(positions))
+        # next-token logits live at each sequence's last real slot
+        first_logits = np.asarray(logits[jnp.arange(nb), jnp.asarray(true_len) - 1]).astype(np.float32)
+        # explicit seed => reproducible; otherwise vary per request
+        rng = jax.random.PRNGKey(
+            int(seed) if seed is not None else self.seed + self._request_count
+        )
+        self._request_count += 1
+
+        if temp <= 0.0:
+            first_tok = first_logits.argmax(-1).astype(np.int32)
+        else:
+            k = min(self.top_k, first_logits.shape[-1])
+            rng, sub = jax.random.split(rng)
+            topv = np.sort(first_logits, axis=-1)[:, -k:]
+            topi = np.argsort(first_logits, axis=-1)[:, -k:]
+            draw = np.asarray(jax.random.categorical(sub, jnp.asarray(topv) / max(temp, 1e-6)))
+            first_tok = topi[np.arange(nb), draw].astype(np.int32)
+
+        out_tokens = [first_tok[:, None]]
+        if max_new > 1:
+            toks = decode(
+                self._params, caches, jnp.asarray(first_tok), jnp.asarray(true_len),
+                max_new - 1, rng, jnp.asarray(temp, jnp.float32),
+            )
+            out_tokens.append(np.asarray(toks))
+        all_toks = np.concatenate(out_tokens, axis=1)[:n]  # drop batch padding
+
+        results_tokens: List[List[int]] = []
+        results_text: List[Optional[str]] = []
+        for i in range(n):
+            seq = all_toks[i].tolist()
+            if self.eos_id in seq:
+                seq = seq[: seq.index(self.eos_id)]
+            results_tokens.append(seq)
+            results_text.append(self._tokenizer.decode(seq) if text_mode[i] else None)
+        return {"tokens": results_tokens, "texts": results_text}
+
+    # ------------------------------------------------------------------
+    # SeldonComponent surface
+    # ------------------------------------------------------------------
+    def predict(self, X, names: Sequence[str], meta: Optional[Dict] = None):
+        if isinstance(X, (bytes, bytearray)):
+            X = X.decode("utf-8")
+        if isinstance(X, str):
+            out = self.generate([X])
+            return out["texts"][0]
+        if isinstance(X, dict):
+            prompts = X.get("prompts") or X.get("prompt")
+            if prompts is None:
+                raise SeldonError("jsonData needs 'prompts'")
+            if isinstance(prompts, str):
+                prompts = [prompts]
+            out = self.generate(
+                prompts,
+                max_new_tokens=X.get("max_new_tokens"),
+                temperature=X.get("temperature"),
+                seed=X.get("seed"),
+            )
+            return {"texts": out["texts"], "tokens": out["tokens"]}
+        arr = np.atleast_2d(np.asarray(X, dtype=np.int64))
+        prompts = [row[row >= 0] for row in arr]  # -1 right-padding
+        out = self.generate(prompts)
+        width = max(len(t) for t in out["tokens"])
+        padded = np.full((len(prompts), width), -1, np.int64)
+        for i, t in enumerate(out["tokens"]):
+            padded[i, : len(t)] = t
+        return padded
+
+    def tags(self) -> Dict[str, Any]:
+        return {"llm_requests": self._request_count}

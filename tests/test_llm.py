@@ -1,0 +1,189 @@
+"""LLM serving correctness: ring attention parity, KV-cache decode vs full
+recompute (including ragged batches under right-padding), and the LLMServer
+component surface."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models import get_model
+from seldon_core_tpu.ops.ring_attention import ring_attention
+from seldon_core_tpu.parallel.mesh import make_mesh
+from seldon_core_tpu.servers.llmserver import ByteTokenizer, LLMServer, _bucket
+
+
+# ------------------------------------------------------------ ring attention
+def full_attention(q, k, v, pos, causal=True):
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * d**-0.5
+    if causal:
+        mask = pos[:, None, None, :] <= pos[:, None, :, None]
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    b, s, h, d = 2, 16, 4, 8
+    mk = lambda: jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    return mk(), mk(), mk(), pos
+
+
+def test_ring_attention_matches_full(eight_devices, qkv):
+    q, k, v, pos = qkv
+    mesh = make_mesh({"data": 2, "seq": 4}, eight_devices)
+    ref = full_attention(q, k, v, pos)
+    out = jax.jit(lambda *a: ring_attention(*a, mesh=mesh))(q, k, v, pos, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_attention_noncausal(eight_devices, qkv):
+    q, k, v, pos = qkv
+    mesh = make_mesh({"seq": 8}, eight_devices)
+    ref = full_attention(q, k, v, pos, causal=False)
+    out = ring_attention(q, k, v, pos, pos, mesh=mesh, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_attention_gradients(eight_devices, qkv):
+    q, k, v, pos = qkv
+    mesh = make_mesh({"data": 2, "seq": 4}, eight_devices)
+    g_ref = jax.grad(lambda q: full_attention(q, k, v, pos).sum())(q)
+    g_ring = jax.grad(lambda q: ring_attention(q, k, v, pos, pos, mesh=mesh).sum())(q)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=1e-4)
+
+
+def test_ring_attention_no_mesh_fallback(qkv):
+    q, k, v, pos = qkv
+    ref = full_attention(q, k, v, pos)
+    out = ring_attention(q, k, v, pos, pos, mesh=None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_transformer_ring_matches_full(eight_devices):
+    """Same params, attention_impl full vs ring on a seq-sharded mesh."""
+    mesh = make_mesh({"data": 2, "seq": 2, "model": 2}, eight_devices)
+    full = get_model("llama-tiny")
+    ring = get_model("llama-tiny", attention_impl="ring", mesh=mesh)
+    tokens = jnp.asarray(np.random.default_rng(1).integers(0, 255, (2, 16)), jnp.int32)
+    variables = full.init(jax.random.PRNGKey(0), tokens)
+    ref, _ = full.apply(variables, tokens)
+    with mesh:
+        out, _ = jax.jit(lambda v, t: ring.apply(v, t))(variables, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+# ------------------------------------------------------------- LLM server
+@pytest.fixture(scope="module")
+def server():
+    s = LLMServer(
+        model="llama-tiny",
+        init_random=True,
+        max_new_tokens=8,
+        len_buckets=(16, 32),
+        batch_buckets=(1, 4),
+        seed=7,
+    )
+    s.load()
+    return s
+
+
+def naive_greedy(server, prompt_ids, n_new):
+    """Reference decode: full forward (no cache) + argmax, one token a time."""
+    toks = list(prompt_ids)
+    for _ in range(n_new):
+        t = jnp.asarray(np.asarray(toks)[None, :], jnp.int32)
+        logits, _ = server._module.apply(server._params, t)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        if nxt == server.eos_id:
+            break
+        toks.append(nxt)
+    return toks[len(prompt_ids):] + ([server.eos_id] if len(toks) - len(prompt_ids) < n_new else [])
+
+
+def test_greedy_decode_matches_full_recompute(server):
+    prompt = [5, 9, 17, 33, 2]
+    out = server.generate([prompt], max_new_tokens=6)["tokens"][0]
+    ref = naive_greedy(server, prompt, 6)
+    ref = [t for t in ref if t != server.eos_id][: len(out)]
+    assert out == ref or out == ref[: len(out)], (out, ref)
+
+
+def test_ragged_batch_matches_single(server):
+    """Right-padded ragged batch must reproduce each prompt's solo decode —
+    the correctness property of PAD_POS masking."""
+    p1, p2 = [5, 9, 17], [40, 3, 22, 8, 11, 60, 2]
+    solo1 = server.generate([p1], max_new_tokens=5)["tokens"][0]
+    solo2 = server.generate([p2], max_new_tokens=5)["tokens"][0]
+    both = server.generate([p1, p2], max_new_tokens=5)["tokens"]
+    assert both[0] == solo1
+    assert both[1] == solo2
+
+
+def test_generate_text_roundtrip(server):
+    out = server.generate(["hello"], max_new_tokens=4)
+    assert isinstance(out["texts"][0], str)
+    assert len(out["tokens"][0]) <= 4
+
+
+def test_sampling_is_seeded(server):
+    a = server.generate(["abc"], max_new_tokens=6, temperature=0.9, seed=3)["tokens"]
+    b = server.generate(["abc"], max_new_tokens=6, temperature=0.9, seed=3)["tokens"]
+    c = server.generate(["abc"], max_new_tokens=6, temperature=0.9, seed=4)["tokens"]
+    assert a == b
+    assert a != c or len(a[0]) <= 1  # different seed, very likely different path
+
+
+def test_predict_json_payload(server):
+    out = server.predict({"prompts": ["hi", "yo"], "max_new_tokens": 3}, [])
+    assert len(out["texts"]) == 2
+    assert all(len(t) <= 3 for t in out["tokens"])
+
+
+def test_predict_str_payload(server):
+    out = server.predict("hello world", [])
+    assert isinstance(out, str)
+
+
+def test_predict_token_array_payload(server):
+    arr = np.array([[5, 9, 17, -1, -1], [4, 2, 8, 20, 7]], dtype=np.int64)
+    out = server.predict(arr, [])
+    assert out.shape[0] == 2
+    assert out.dtype == np.int64
+
+
+def test_batch_larger_than_biggest_bucket(server):
+    """More prompts than the largest batch bucket: split + merge, same result
+    as solo generation."""
+    prompts = [[i + 1, i + 2, i + 3] for i in range(6)]  # batch_buckets max is 4
+    out = server.generate(prompts, max_new_tokens=3)["tokens"]
+    assert len(out) == 6
+    for p, o in zip(prompts, out):
+        assert o == server.generate([p], max_new_tokens=3)["tokens"][0]
+
+
+def test_growing_max_new_tokens_recompiles_prefill(server):
+    """Regression: prefill cache keyed without max_len reused undersized KV
+    caches, silently truncating attention for longer generations."""
+    prompt = [9, 4, 7]
+    short = server.generate([prompt], max_new_tokens=2)["tokens"][0]
+    long = server.generate([prompt], max_new_tokens=12)["tokens"][0]
+    assert long[: len(short)] == short  # greedy prefix property
+    ref = naive_greedy(server, prompt, 12)
+    ref = [t for t in ref if t != server.eos_id][: len(long)]
+    assert long == ref or long == ref[: len(long)]
+
+
+def test_bucket_helper():
+    assert _bucket(3, (4, 8)) == 4
+    assert _bucket(9, (4, 8)) == 8  # clamps to the largest bucket
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    assert tok.decode(tok.encode("héllo")) == "héllo"
