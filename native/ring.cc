@@ -1,0 +1,204 @@
+// Shared-memory MPMC ring buffer for request/tensor staging.
+//
+// Role: the native data-plane piece of the TPU serving runtime. The reference
+// delegates its native performance path to external C++ servers
+// (integrations/tfserving, nvidia-inference-server — SURVEY.md §2 native-code
+// note); here the native component is in-repo: transport worker processes
+// (REST/gRPC frontends) stage decoded tensor payloads into a shared-memory
+// ring, and the single device-owning engine process drains them in batches —
+// no pickling, no socket hop, one memcpy each way.
+//
+// Design: Vyukov bounded MPMC queue. Each cell carries an atomic sequence
+// number; producers claim cells with fetch_add on enqueue_pos, consumers with
+// fetch_add on dequeue_pos. Lock-free, FIFO per producer, safe across
+// processes (std::atomic<uint64_t> on x86-64/aarch64 over shared mmap).
+//
+// Layout in the mapped file:
+//   [Header][Cell 0][Cell 1]...[Cell capacity-1]
+//   Cell = { atomic<uint64> seq; uint32 len; uint8 data[slot_size]; }
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x53454c52494e4731ull;  // "SELRING1"
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;   // power of two
+  uint64_t slot_size;  // payload bytes per cell
+  uint64_t cell_stride;
+  alignas(64) std::atomic<uint64_t> enqueue_pos;
+  alignas(64) std::atomic<uint64_t> dequeue_pos;
+};
+
+struct CellHeader {
+  std::atomic<uint64_t> seq;
+  uint32_t len;
+  // payload follows
+};
+
+struct Ring {
+  Header* header;
+  uint8_t* cells;
+  size_t map_len;
+};
+
+inline CellHeader* cell_at(const Ring* r, uint64_t idx) {
+  return reinterpret_cast<CellHeader*>(
+      r->cells + (idx & (r->header->capacity - 1)) * r->header->cell_stride);
+}
+
+inline uint8_t* cell_data(CellHeader* c) {
+  return reinterpret_cast<uint8_t*>(c) + sizeof(CellHeader);
+}
+
+size_t total_size(uint64_t capacity, uint64_t cell_stride) {
+  return sizeof(Header) + capacity * cell_stride;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (or overwrite) a ring file. capacity must be a power of two.
+// Returns an opaque handle or nullptr.
+void* scr_create(const char* path, uint64_t capacity, uint64_t slot_size) {
+  if (capacity == 0 || (capacity & (capacity - 1)) != 0) return nullptr;
+  uint64_t stride = sizeof(CellHeader) + slot_size;
+  stride = (stride + 63) & ~63ull;  // 64B-align cells
+  size_t len = total_size(capacity, stride);
+
+  int fd = ::open(path, O_RDWR | O_CREAT | O_TRUNC, 0600);
+  if (fd < 0) return nullptr;
+  if (::ftruncate(fd, static_cast<off_t>(len)) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+
+  auto* h = static_cast<Header*>(mem);
+  h->capacity = capacity;
+  h->slot_size = slot_size;
+  h->cell_stride = stride;
+  h->enqueue_pos.store(0, std::memory_order_relaxed);
+  h->dequeue_pos.store(0, std::memory_order_relaxed);
+
+  auto* ring = new Ring{h, static_cast<uint8_t*>(mem) + sizeof(Header), len};
+  for (uint64_t i = 0; i < capacity; ++i) {
+    cell_at(ring, i)->seq.store(i, std::memory_order_relaxed);
+    cell_at(ring, i)->len = 0;
+  }
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  h->magic = kMagic;
+  return ring;
+}
+
+// Attach to an existing ring file. Returns nullptr on mismatch.
+void* scr_attach(const char* path) {
+  int fd = ::open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(sizeof(Header))) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ | PROT_WRITE,
+                     MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* h = static_cast<Header*>(mem);
+  if (h->magic != kMagic ||
+      static_cast<size_t>(st.st_size) < total_size(h->capacity, h->cell_stride)) {
+    ::munmap(mem, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  return new Ring{h, static_cast<uint8_t*>(mem) + sizeof(Header),
+                  static_cast<size_t>(st.st_size)};
+}
+
+void scr_detach(void* handle) {
+  auto* r = static_cast<Ring*>(handle);
+  if (!r) return;
+  ::munmap(r->header, r->map_len);
+  delete r;
+}
+
+uint64_t scr_capacity(void* handle) { return static_cast<Ring*>(handle)->header->capacity; }
+uint64_t scr_slot_size(void* handle) { return static_cast<Ring*>(handle)->header->slot_size; }
+
+// Approximate occupancy (racy by nature; exact when quiescent).
+uint64_t scr_size(void* handle) {
+  auto* h = static_cast<Ring*>(handle)->header;
+  uint64_t e = h->enqueue_pos.load(std::memory_order_acquire);
+  uint64_t d = h->dequeue_pos.load(std::memory_order_acquire);
+  return e > d ? e - d : 0;
+}
+
+// 0 = ok, -1 = full, -2 = payload too large.
+int scr_push(void* handle, const void* data, uint32_t len) {
+  auto* r = static_cast<Ring*>(handle);
+  Header* h = r->header;
+  if (len > h->slot_size) return -2;
+
+  uint64_t pos = h->enqueue_pos.load(std::memory_order_relaxed);
+  CellHeader* cell;
+  for (;;) {
+    cell = cell_at(r, pos);
+    uint64_t seq = cell->seq.load(std::memory_order_acquire);
+    intptr_t dif = static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos);
+    if (dif == 0) {
+      if (h->enqueue_pos.compare_exchange_weak(pos, pos + 1, std::memory_order_relaxed))
+        break;
+    } else if (dif < 0) {
+      return -1;  // full
+    } else {
+      pos = h->enqueue_pos.load(std::memory_order_relaxed);
+    }
+  }
+  cell->len = len;
+  std::memcpy(cell_data(cell), data, len);
+  cell->seq.store(pos + 1, std::memory_order_release);
+  return 0;
+}
+
+// Returns payload length (>=0) or -1 = empty, -3 = out buffer too small
+// (item left in place).
+int scr_pop(void* handle, void* out, uint32_t out_cap) {
+  auto* r = static_cast<Ring*>(handle);
+  Header* h = r->header;
+
+  uint64_t pos = h->dequeue_pos.load(std::memory_order_relaxed);
+  CellHeader* cell;
+  for (;;) {
+    cell = cell_at(r, pos);
+    uint64_t seq = cell->seq.load(std::memory_order_acquire);
+    intptr_t dif =
+        static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos + 1);
+    if (dif == 0) {
+      if (cell->len > out_cap) return -3;
+      if (h->dequeue_pos.compare_exchange_weak(pos, pos + 1, std::memory_order_relaxed))
+        break;
+    } else if (dif < 0) {
+      return -1;  // empty
+    } else {
+      pos = h->dequeue_pos.load(std::memory_order_relaxed);
+    }
+  }
+  uint32_t len = cell->len;
+  std::memcpy(out, cell_data(cell), len);
+  cell->seq.store(pos + h->capacity, std::memory_order_release);
+  return static_cast<int>(len);
+}
+
+}  // extern "C"

@@ -169,19 +169,19 @@ class Attention(nn.Module):
             mask = positions[:, None, :] <= positions[:, :, None]  # [b, s, kv]
             new_cache = (k, v)
 
-        # GQA: repeat kv heads up to n_heads
-        if cfg.n_kv_heads != cfg.n_heads:
-            rep = cfg.n_heads // cfg.n_kv_heads
-            k_all = jnp.repeat(k_all, rep, axis=2)
-            v_all = jnp.repeat(v_all, rep, axis=2)
-
         if cache is None and cfg.attention_impl == "ring":
             from seldon_core_tpu.ops.ring_attention import ring_attention
 
+            # ring is GQA-aware: unrepeated KV rides the ring
             out = ring_attention(
                 q, k_all.astype(dt), v_all.astype(dt), positions, positions, mesh=cfg.mesh
             )
         else:
+            # GQA: repeat kv heads up to n_heads for the dense einsum
+            if cfg.n_kv_heads != cfg.n_heads:
+                rep = cfg.n_heads // cfg.n_kv_heads
+                k_all = jnp.repeat(k_all, rep, axis=2)
+                v_all = jnp.repeat(v_all, rep, axis=2)
             scale = hd**-0.5
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_all.astype(dt)) * scale
             logits = logits.astype(jnp.float32)

@@ -1,0 +1,11 @@
+"""Native runtime components (C++ via ctypes).
+
+The reference keeps its native performance path in external C++ servers
+(SURVEY.md §2 native-code note); this package keeps it in-repo. The library
+builds on demand with the baked-in toolchain (g++) and callers get a clear
+error if the toolchain is missing.
+"""
+
+from seldon_core_tpu.native.staging import SharedRing, build_native, native_available
+
+__all__ = ["SharedRing", "build_native", "native_available"]

@@ -1,0 +1,155 @@
+"""ctypes binding for the shared-memory staging ring (native/ring.cc).
+
+``SharedRing`` is the IPC data plane between transport worker processes and
+the device-owning engine process: lock-free MPMC, payloads are raw bytes (the
+codec's packed tensors), one memcpy per side. The .so builds lazily via make
+with the baked-in g++ (pybind11 is unavailable in this environment; ctypes
+keeps the binding dependency-free).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libseldon_staging.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def build_native(force: bool = False) -> str:
+    """Build the native library if needed; returns the .so path."""
+    if os.path.exists(_SO_PATH) and not force:
+        src_mtime = os.path.getmtime(os.path.join(_NATIVE_DIR, "ring.cc"))
+        if os.path.getmtime(_SO_PATH) >= src_mtime:
+            return _SO_PATH
+    subprocess.run(["make", "-C", _NATIVE_DIR], check=True, capture_output=True)
+    return _SO_PATH
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = build_native()
+        lib = ctypes.CDLL(path)
+        lib.scr_create.restype = ctypes.c_void_p
+        lib.scr_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+        lib.scr_attach.restype = ctypes.c_void_p
+        lib.scr_attach.argtypes = [ctypes.c_char_p]
+        lib.scr_detach.argtypes = [ctypes.c_void_p]
+        lib.scr_capacity.restype = ctypes.c_uint64
+        lib.scr_capacity.argtypes = [ctypes.c_void_p]
+        lib.scr_slot_size.restype = ctypes.c_uint64
+        lib.scr_slot_size.argtypes = [ctypes.c_void_p]
+        lib.scr_size.restype = ctypes.c_uint64
+        lib.scr_size.argtypes = [ctypes.c_void_p]
+        lib.scr_push.restype = ctypes.c_int
+        lib.scr_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        lib.scr_pop.restype = ctypes.c_int
+        lib.scr_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception as e:  # toolchain missing
+        logger.warning("native staging unavailable: %s", e)
+        return False
+
+
+class RingFull(RuntimeError):
+    pass
+
+
+class PayloadTooLarge(ValueError):
+    pass
+
+
+class SharedRing:
+    """MPMC shared-memory byte queue over a mapped file.
+
+    create=True initialises the file (the engine side does this); workers
+    attach to the same path. Capacity must be a power of two.
+    """
+
+    def __init__(self, path: str, capacity: int = 1024, slot_size: int = 1 << 20,
+                 create: bool = False):
+        self._lib = _load()
+        self.path = path
+        if create:
+            self._h = self._lib.scr_create(path.encode(), capacity, slot_size)
+        else:
+            self._h = self._lib.scr_attach(path.encode())
+        if not self._h:
+            raise RuntimeError(f"could not {'create' if create else 'attach'} ring at {path}")
+        self.capacity = int(self._lib.scr_capacity(self._h))
+        self.slot_size = int(self._lib.scr_slot_size(self._h))
+        self._popbuf = ctypes.create_string_buffer(self.slot_size)
+
+    # ------------------------------------------------------------------
+    def push(self, payload: bytes) -> bool:
+        """True on success, False when full; raises PayloadTooLarge."""
+        rc = self._lib.scr_push(self._h, payload, len(payload))
+        if rc == 0:
+            return True
+        if rc == -1:
+            return False
+        raise PayloadTooLarge(f"{len(payload)} bytes > slot_size {self.slot_size}")
+
+    def push_wait(self, payload: bytes, timeout_s: float = 1.0, spin_s: float = 0.0002) -> None:
+        deadline = time.monotonic() + timeout_s
+        while not self.push(payload):
+            if time.monotonic() > deadline:
+                raise RingFull(f"ring {self.path} full for {timeout_s}s")
+            time.sleep(spin_s)
+
+    def pop(self) -> Optional[bytes]:
+        """One payload or None when empty."""
+        rc = self._lib.scr_pop(self._h, self._popbuf, self.slot_size)
+        if rc == -1:
+            return None
+        if rc < 0:
+            raise RuntimeError(f"ring pop error {rc}")
+        return self._popbuf.raw[:rc]
+
+    def pop_batch(self, max_items: int, wait_s: float = 0.0, spin_s: float = 0.0002):
+        """Drain up to max_items; optionally wait up to wait_s for the first."""
+        out = []
+        deadline = time.monotonic() + wait_s
+        while len(out) < max_items:
+            item = self.pop()
+            if item is None:
+                if out or time.monotonic() > deadline:
+                    break
+                time.sleep(spin_s)
+                continue
+            out.append(item)
+        return out
+
+    def __len__(self) -> int:
+        return int(self._lib.scr_size(self._h))
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.scr_detach(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -32,16 +32,17 @@ NEG_INF = jnp.finfo(jnp.float32).min
 def _block_attention(q, k_blk, v_blk, q_pos, kv_pos, m, l, acc, scale, causal):
     """One online-softmax accumulation step of local q against one K/V block.
 
-    q: [b, sq, h, d]; k_blk/v_blk: [b, sk, h, d]; q_pos: [b, sq];
-    kv_pos: [b, sk]; m, l: [b, h, sq] running max / denominator;
-    acc: [b, sq, h, d] running numerator.
+    GQA-aware: q is [b, sq, hk, g, d] (query heads grouped per KV head, so
+    only the *unrepeated* KV rotates the ring); k_blk/v_blk: [b, sk, hk, d];
+    q_pos: [b, sq]; kv_pos: [b, sk]; m, l: [b, hk, g, sq] running max /
+    denominator; acc: [b, sq, hk, g, d] running numerator.
     """
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", q, k_blk).astype(jnp.float32) * scale
     if causal:
-        mask = kv_pos[:, None, None, :] <= q_pos[:, None, :, None]
+        mask = kv_pos[:, None, None, None, :] <= q_pos[:, None, None, :, None]
         logits = jnp.where(mask, logits, NEG_INF)
 
-    blk_max = jnp.max(logits, axis=-1)  # [b, h, sq]
+    blk_max = jnp.max(logits, axis=-1)  # [b, hk, g, sq]
     m_new = jnp.maximum(m, blk_max)
     # Fully-masked-so-far rows keep m == NEG_INF; exp guards avoid inf-inf.
     p = jnp.exp(logits - m_new[..., None])
@@ -49,20 +50,25 @@ def _block_attention(q, k_blk, v_blk, q_pos, kv_pos, m, l, acc, scale, causal):
     corr = jnp.where(m <= NEG_INF, 0.0, jnp.exp(m - m_new))
 
     l_new = l * corr + jnp.sum(p, axis=-1)
-    acc_new = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+    acc_new = acc * corr.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
+        "bhgqk,bkhd->bqhgd", p, v_blk.astype(jnp.float32)
     )
     return m_new, l_new, acc_new
 
 
 def _ring_attention_local(q, k, v, q_pos, kv_pos, axis_name: Optional[str], causal: bool):
     """Per-device body: rotate K/V around `axis_name` accumulating attention.
-    With axis_name=None this degenerates to single-block (full) attention."""
+    With axis_name=None this degenerates to single-block (full) attention.
+    q: [b, sq, h, d]; k/v: [b, sk, hk, d] with h % hk == 0 (GQA) — only the
+    unrepeated KV travels the ring."""
     b, sq, h, d = q.shape
+    hk = k.shape[2]
+    g = h // hk
+    q = q.reshape(b, sq, hk, g, d)
     scale = d**-0.5
-    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, h, sq), jnp.float32)
-    acc = jnp.zeros((b, sq, h, d), jnp.float32)
+    m = jnp.full((b, hk, g, sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, hk, g, sq), jnp.float32)
+    acc = jnp.zeros((b, sq, hk, g, d), jnp.float32)
 
     if axis_name is None:
         m, l, acc = _block_attention(q, k, v, q_pos, kv_pos, m, l, acc, scale, causal)
@@ -80,8 +86,8 @@ def _ring_attention_local(q, k, v, q_pos, kv_pos, axis_name: Optional[str], caus
 
         _, _, _, m, l, acc = jax.lax.fori_loop(0, n, step, (k, v, kv_pos, m, l, acc))
 
-    denom = jnp.maximum(l, jnp.finfo(jnp.float32).tiny).transpose(0, 2, 1)[..., None]
-    return (acc / denom).astype(q.dtype)
+    denom = jnp.maximum(l, jnp.finfo(jnp.float32).tiny).transpose(0, 3, 1, 2)[..., None]
+    return (acc / denom).reshape(b, sq, h, d).astype(q.dtype)
 
 
 def ring_attention(
@@ -98,10 +104,10 @@ def ring_attention(
 ):
     """Exact attention over seq-sharded q/k/v on ``mesh``.
 
-    All of q/k/v must carry the same number of heads (callers repeat GQA KV
-    heads first) and the same per-device sequence shard. Without a mesh (or
-    when the mesh lacks ``seq_axis``) this is plain full attention — callers
-    can use one code path everywhere.
+    GQA-aware: k/v may carry fewer heads than q (h % hk == 0) and are rotated
+    *unrepeated*, so ring ICI traffic and per-device KV memory stay at the
+    grouped size. Without a mesh (or when the mesh lacks ``seq_axis``) this is
+    plain full attention — callers can use one code path everywhere.
     """
     if mesh is None or seq_axis not in getattr(mesh, "axis_names", ()):
         return _ring_attention_local(q, k, v, q_positions, kv_positions, None, causal)

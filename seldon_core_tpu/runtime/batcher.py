@@ -90,8 +90,10 @@ class ContinuousBatcher:
 
         self._insert = insert
 
+        top_k = server.top_k
+
         @jax.jit
-        def decode_step(params, caches, last_tok, next_pos):
+        def decode_step(params, caches, last_tok, next_pos, key, temperature):
             logits, caches = module.apply(
                 params,
                 last_tok[:, None],
@@ -99,9 +101,17 @@ class ContinuousBatcher:
                 caches=caches,
                 cache_index=next_pos,
             )
-            return caches, jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+            lg = logits[:, -1].astype(jnp.float32)
+            greedy = jnp.argmax(lg, axis=-1)
+            k = min(top_k, lg.shape[-1])
+            topv, topi = jax.lax.top_k(lg, k)
+            draw = jax.random.categorical(key, topv / jnp.maximum(temperature, 1e-6))
+            sampled = jnp.take_along_axis(topi, draw[:, None], axis=-1)[:, 0]
+            return caches, jnp.where(temperature <= 0.0, greedy, sampled)
 
         self._decode_step = decode_step
+        self._rng = jax.random.PRNGKey(server.seed)
+        self._temp = jnp.asarray(server.temperature, jnp.float32)
 
     # ------------------------------------------------------------------
     async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None) -> List[int]:
@@ -114,7 +124,8 @@ class ContinuousBatcher:
             ids = [int(t) for t in np.asarray(prompt).ravel()]
         if not ids:
             raise ValueError("empty prompt")
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._loop = asyncio.get_running_loop()
+        fut: asyncio.Future = self._loop.create_future()
         self._pending.append((ids, int(max_new_tokens or self.server.max_new_tokens), fut))
         self._ensure_running()
         self._wakeup.set()
@@ -123,6 +134,20 @@ class ContinuousBatcher:
     def _ensure_running(self):
         if self._task is None or self._task.done():
             self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def _resolve(self, fut: asyncio.Future, result=None, exc: Optional[BaseException] = None):
+        """Thread-safe future completion: _finish runs inside asyncio.to_thread,
+        and Future.set_result must happen on the loop thread."""
+
+        def do():
+            if fut.done():
+                return
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+
+        self._loop.call_soon_threadsafe(do)
 
     async def close(self):
         self._closed = True
@@ -156,7 +181,18 @@ class ContinuousBatcher:
         prefill = self.server._get_prefill(1, plen, self.max_len)
         logits, cache1 = prefill(self.server._params, jnp.asarray(tokens), jnp.asarray(positions))
         self._caches = self._insert(self._caches, cache1, free)
-        first = int(np.asarray(logits[0, L - 1]).argmax())
+        first_logits = np.asarray(logits[0, L - 1]).astype(np.float32)
+        if float(self._temp) <= 0.0:
+            first = int(first_logits.argmax())
+        else:
+            import jax
+
+            self._rng, sub = jax.random.split(self._rng)
+            k = min(self.server.top_k, first_logits.shape[-1])
+            topi = np.argsort(first_logits)[-k:]
+            draw = int(np.asarray(jax.random.categorical(
+                sub, jnp.asarray(first_logits[topi]) / max(float(self._temp), 1e-6))))
+            first = int(topi[draw])
 
         slot = self._slots[free]
         slot.active = True
@@ -176,19 +212,23 @@ class ContinuousBatcher:
         toks = slot.tokens
         if self.eos_id in toks:
             toks = toks[: toks.index(self.eos_id)]
-        if slot.future is not None and not slot.future.done():
-            slot.future.set_result(toks)
+        if slot.future is not None:
+            self._resolve(slot.future, result=toks)
         slot.active = False
         slot.future = None
 
     def _step(self):
+        import jax
         import jax.numpy as jnp
 
+        self._rng, sub = jax.random.split(self._rng)
         self._caches, nxt = self._decode_step(
             self.server._params,
             self._caches,
             jnp.asarray(self._last_tok),
             jnp.asarray(self._next_pos),
+            sub,
+            self._temp,
         )
         nxt = np.asarray(nxt).astype(np.int32)
         for i, slot in enumerate(self._slots):
@@ -203,21 +243,38 @@ class ContinuousBatcher:
                 self._finish(i)
 
     async def _run(self):
-        while True:
-            # admit as many pending requests as there are free slots (FIFO);
-            # device work runs in a worker thread so the event loop (and any
-            # co-hosted HTTP handlers) stays responsive during prefill/decode
-            while self._pending and any(not s.active for s in self._slots):
-                ids, max_new, fut = self._pending.popleft()
-                await asyncio.to_thread(self._admit, ids, max_new, fut)
-            if any(s.active for s in self._slots):
-                await asyncio.to_thread(self._step)
-                continue
-            if self._closed:
-                return
-            self._wakeup.clear()
-            try:
-                await asyncio.wait_for(self._wakeup.wait(), timeout=0.5)
-            except asyncio.TimeoutError:
+        try:
+            while True:
+                # admit as many pending requests as there are free slots
+                # (FIFO, peek-then-pop so a failed admit keeps the request);
+                # device work runs in a worker thread so the event loop (and
+                # co-hosted HTTP handlers) stays responsive during decode
+                while self._pending:
+                    ids, max_new, fut = self._pending[0]
+                    if not await asyncio.to_thread(self._admit, ids, max_new, fut):
+                        break  # no free slot — decode until one frees up
+                    self._pending.popleft()
+                if any(s.active for s in self._slots):
+                    await asyncio.to_thread(self._step)
+                    continue
                 if self._closed:
                     return
+                self._wakeup.clear()
+                try:
+                    await asyncio.wait_for(self._wakeup.wait(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    if self._closed:
+                        return
+        except BaseException as e:
+            # device/compile failure: fail every in-flight and queued request
+            # instead of leaving their futures hanging
+            logger.exception("batcher loop died: %s", e)
+            for slot in self._slots:
+                if slot.active and slot.future is not None:
+                    self._resolve(slot.future, exc=e)
+                    slot.active = False
+                    slot.future = None
+            while self._pending:
+                _, _, fut = self._pending.popleft()
+                self._resolve(fut, exc=e)
+            raise

@@ -64,7 +64,9 @@ class HFTokenizer:
         from transformers import AutoTokenizer
 
         self._tok = AutoTokenizer.from_pretrained(name_or_path)
-        self.eos_id = self._tok.eos_token_id or 0
+        # id 0 is usually a real token; with no EOS defined, use -1 so the
+        # decode loop's EOS check never fires (generates to max_new_tokens)
+        self.eos_id = self._tok.eos_token_id if self._tok.eos_token_id is not None else -1
         self.vocab_size = self._tok.vocab_size
 
     def encode(self, text: str) -> List[int]:
@@ -75,10 +77,14 @@ class HFTokenizer:
 
 
 def _bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n; beyond the largest bucket, round up to a multiple
+    of it (bounded compile count) instead of silently truncating the prompt —
+    the model's max_seq_len is the only hard cap (applied by callers)."""
     for b in buckets:
         if n <= b:
             return b
-    return buckets[-1]
+    top = buckets[-1]
+    return ((n + top - 1) // top) * top
 
 
 class LLMServer(SeldonComponent):
@@ -321,9 +327,11 @@ class LLMServer(SeldonComponent):
                 out_texts.extend(part["texts"])
             return {"tokens": out_tokens, "texts": out_texts}
         nb = _bucket(n, self.batch_buckets)
-        plen = _bucket(max(len(t) for t in token_lists), self.len_buckets)
-        plen = min(plen, self._cfg.max_seq_len)
-        token_lists = [t[-plen:] for t in token_lists]  # clip overlong prompts
+        longest = max(len(t) for t in token_lists)
+        plen = min(_bucket(longest, self.len_buckets), self._cfg.max_seq_len)
+        if longest > plen:
+            logger.warning("prompt of %d tokens truncated to max_seq_len %d", longest, plen)
+        token_lists = [t[-plen:] for t in token_lists]  # keep the prompt tail
         max_len = min(plen + max_new, self._cfg.max_seq_len + max_new)
 
         tokens = np.zeros((nb, plen), np.int32)

@@ -126,10 +126,23 @@ def run_engine(args: argparse.Namespace) -> None:
     metrics = MetricsRegistry(predictor=spec.name)
     port = args.port or int(os.environ.get("ENGINE_SERVER_PORT", "8000"))
     logger.info("engine serving predictor %r on port %d", spec.name, port)
-    if (args.api or "REST").upper() == "GRPC":
+    api = (args.api or "REST").upper()
+    if api == "GRPC":
         from seldon_core_tpu.transport.grpc_server import serve_engine
 
         serve_engine(engine, host=args.host, port=port, metrics=metrics)
+    elif api == "IPC":
+        # native shared-memory data plane: N frontend processes attach as
+        # IPCClient workers, this process owns the device (transport/ipc.py)
+        import asyncio
+
+        from seldon_core_tpu.transport.ipc import IPCEngineServer
+
+        if not args.ipc_base:
+            raise SystemExit("--api IPC needs --ipc-base <path>")
+        server = IPCEngineServer(engine, args.ipc_base, n_workers=args.ipc_workers)
+        logger.info("engine serving over IPC at %s (%d workers)", args.ipc_base, args.ipc_workers)
+        asyncio.run(server.serve_forever())
     else:
         serve(make_engine_app(engine, metrics=metrics), host=args.host, port=port)
 
@@ -151,6 +164,8 @@ def main(argv: Optional[list] = None) -> None:
     eng.add_argument("--api", default="REST")
     eng.add_argument("--port", type=int, default=None)
     eng.add_argument("--host", default="0.0.0.0")
+    eng.add_argument("--ipc-base", default=None, help="ring path base for --api IPC")
+    eng.add_argument("--ipc-workers", type=int, default=4)
     eng.set_defaults(func=run_engine)
 
     from seldon_core_tpu.client.testers import add_tester_args, tester_main

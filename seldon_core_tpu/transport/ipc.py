@@ -1,0 +1,173 @@
+"""IPC serving: multi-process frontends over the native staging ring.
+
+The reference scales its Python wrapper with gunicorn workers, each paying
+full JSON->proto->ndarray codec plus a socket hop to the engine pod
+(SURVEY.md §3.1). Here transport workers (REST/gRPC frontends, or any client
+process) stage requests into the shared-memory ring (native/ring.cc) and the
+single device-owning engine process drains them in batches — the TPU-native
+layout, since exactly one process should own the TPU chip while N CPU-bound
+frontends decode payloads.
+
+Frame format (bytes, little-endian):
+    u16 worker_id | u32 request_id | u8 kind | JSON payload
+kind: 0 = predict(SeldonMessage), 1 = feedback(Feedback).
+Responses travel back on a per-worker ring as
+    u32 request_id | u8 status | JSON payload   (status 0 = ok, 1 = error)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import struct
+import time
+from typing import Any, Dict, Optional
+
+from seldon_core_tpu.contracts.payload import Feedback, SeldonError, SeldonMessage
+from seldon_core_tpu.native import SharedRing
+
+logger = logging.getLogger(__name__)
+
+_REQ_HEADER = struct.Struct("<HIB")
+_RESP_HEADER = struct.Struct("<IB")
+
+KIND_PREDICT = 0
+KIND_FEEDBACK = 1
+
+
+def request_ring_path(base: str) -> str:
+    return base + ".req"
+
+
+def response_ring_path(base: str, worker_id: int) -> str:
+    return f"{base}.resp.{worker_id}"
+
+
+class IPCEngineServer:
+    """Drains the request ring into the in-process GraphEngine."""
+
+    def __init__(
+        self,
+        engine: Any,
+        base_path: str,
+        n_workers: int,
+        capacity: int = 1024,
+        slot_size: int = 1 << 20,
+        batch: int = 64,
+    ):
+        self.engine = engine
+        self.base_path = base_path
+        self.batch = batch
+        self.req_ring = SharedRing(
+            request_ring_path(base_path), capacity=capacity, slot_size=slot_size, create=True
+        )
+        self.resp_rings = {
+            w: SharedRing(
+                response_ring_path(base_path, w), capacity=capacity, slot_size=slot_size,
+                create=True,
+            )
+            for w in range(n_workers)
+        }
+        self._stop = False
+
+    async def serve_forever(self, poll_wait_s: float = 0.05) -> None:
+        while not self._stop:
+            frames = await asyncio.to_thread(self.req_ring.pop_batch, self.batch, poll_wait_s)
+            if not frames:
+                continue
+            await asyncio.gather(*[self._handle(f) for f in frames])
+
+    def stop(self) -> None:
+        self._stop = True
+
+    async def _handle(self, frame: bytes) -> None:
+        worker_id, req_id, kind = _REQ_HEADER.unpack_from(frame)
+        try:
+            payload = json.loads(frame[_REQ_HEADER.size:])
+            if kind == KIND_PREDICT:
+                out = await self.engine.predict(SeldonMessage.from_dict(payload))
+            elif kind == KIND_FEEDBACK:
+                out = await self.engine.send_feedback(Feedback.from_dict(payload))
+            else:
+                raise SeldonError(f"unknown IPC kind {kind}")
+            body = json.dumps(out.to_dict()).encode()
+            status = 0
+        except Exception as e:
+            body = json.dumps(
+                {"status": {"info": str(e), "reason": getattr(e, "reason", "ENGINE_ERROR"),
+                            "status": 1}}
+            ).encode()
+            status = 1
+        ring = self.resp_rings.get(worker_id)
+        if ring is None:
+            logger.error("response for unknown worker %d dropped", worker_id)
+            return
+        await asyncio.to_thread(
+            ring.push_wait, _RESP_HEADER.pack(req_id, status) + body, 5.0
+        )
+
+
+class IPCClient:
+    """Worker-side handle: send a request frame, wait for the matching
+    response (out-of-order safe — responses for other requests from this
+    worker are parked)."""
+
+    def __init__(self, base_path: str, worker_id: int, timeout_s: float = 30.0):
+        self.worker_id = int(worker_id)
+        self.timeout_s = timeout_s
+        self.req_ring = SharedRing(request_ring_path(base_path), create=False)
+        self.resp_ring = SharedRing(response_ring_path(base_path, worker_id), create=False)
+        self._next_id = 0
+        self._parked: Dict[int, bytes] = {}
+
+    def _call(self, kind: int, payload: Dict[str, Any]) -> Dict[str, Any]:
+        req_id = self._next_id
+        self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+        frame = _REQ_HEADER.pack(self.worker_id, req_id, kind) + json.dumps(payload).encode()
+        self.req_ring.push_wait(frame, timeout_s=self.timeout_s)
+
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            if req_id in self._parked:
+                raw = self._parked.pop(req_id)
+            else:
+                raw = self.resp_ring.pop()
+                if raw is None:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(f"IPC response {req_id} timed out")
+                    time.sleep(0.0002)
+                    continue
+            rid, status = _RESP_HEADER.unpack_from(raw)
+            body = json.loads(raw[_RESP_HEADER.size:])
+            if rid != req_id:
+                self._parked[rid] = raw
+                continue
+            if status != 0:
+                raise SeldonError(
+                    body.get("status", {}).get("info", "IPC engine error"),
+                    reason=body.get("status", {}).get("reason", "ENGINE_ERROR"),
+                    status_code=500,
+                )
+            return body
+
+    def predict(self, message: SeldonMessage) -> SeldonMessage:
+        return SeldonMessage.from_dict(self._call(KIND_PREDICT, message.to_dict()))
+
+    def send_feedback(self, feedback: Feedback) -> SeldonMessage:
+        return SeldonMessage.from_dict(self._call(KIND_FEEDBACK, feedback.to_dict()))
+
+    def close(self) -> None:
+        self.req_ring.close()
+        self.resp_ring.close()
+
+
+def cleanup_rings(base_path: str, n_workers: int) -> None:
+    for p in [request_ring_path(base_path)] + [
+        response_ring_path(base_path, w) for w in range(n_workers)
+    ]:
+        try:
+            os.unlink(p)
+        except OSError:
+            pass

@@ -86,6 +86,27 @@ def test_batcher_string_prompt(server):
     assert isinstance(out, list) and len(out) <= 3
 
 
+def test_batcher_honors_sampling_config():
+    """A temperature-configured server must sample through the batcher too
+    (regression: batcher was silently greedy-only)."""
+    import jax
+
+    s1 = LLMServer(model="llama-tiny", init_random=True, temperature=0.9,
+                   len_buckets=(8,), seed=21)
+    s1.load()
+
+    async def run_batch(seed):
+        b = ContinuousBatcher(s1, max_slots=1, max_len=32, len_buckets=(8,))
+        b._rng = jax.random.PRNGKey(seed)
+        out = await b.submit([3, 5], max_new_tokens=8)
+        await b.close()
+        return out
+
+    a = asyncio.run(run_batch(0))
+    outs = {tuple(asyncio.run(run_batch(s))) for s in range(1, 5)}
+    assert len(outs | {tuple(a)}) > 1  # different rng seeds -> different samples
+
+
 def test_batcher_rejects_after_close(server):
     async def go():
         batcher = ContinuousBatcher(server, max_slots=1, max_len=32, len_buckets=(8,))

@@ -58,6 +58,21 @@ def test_ring_attention_gradients(eight_devices, qkv):
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=1e-4)
 
 
+def test_ring_attention_gqa_unrepeated_kv(eight_devices):
+    """KV with fewer heads than Q rides the ring unrepeated; result matches
+    dense attention over repeated KV."""
+    rng = np.random.default_rng(2)
+    b, s, h, hk, d = 2, 16, 4, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    ref = full_attention(q, jnp.repeat(k, h // hk, 2), jnp.repeat(v, h // hk, 2), pos)
+    mesh = make_mesh({"data": 2, "seq": 4}, eight_devices)
+    out = ring_attention(q, k, v, pos, pos, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_ring_attention_no_mesh_fallback(qkv):
     q, k, v, pos = qkv
     ref = full_attention(q, k, v, pos)
@@ -181,7 +196,8 @@ def test_growing_max_new_tokens_recompiles_prefill(server):
 
 def test_bucket_helper():
     assert _bucket(3, (4, 8)) == 4
-    assert _bucket(9, (4, 8)) == 8  # clamps to the largest bucket
+    assert _bucket(9, (4, 8)) == 16  # beyond largest: round up to multiple of it
+    assert _bucket(17, (4, 8)) == 24
 
 
 def test_byte_tokenizer_roundtrip():
