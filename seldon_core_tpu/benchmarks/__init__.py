@@ -1,0 +1,2 @@
+"""Load-generation harness (capability of the reference's locust-based
+`util/loadtester/` + loadtesting helm chart)."""

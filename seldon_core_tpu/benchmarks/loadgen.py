@@ -1,0 +1,186 @@
+"""Async load generator for REST and gRPC serving endpoints.
+
+Capability of the reference's distributed locust drivers
+(`util/loadtester/scripts/predict_rest_locust.py:17-80`,
+`predict_grpc_locust.py`): N concurrent clients fire predict requests
+(optionally contract-fuzzed payloads), collect latencies, and report
+throughput + percentiles. One process with asyncio concurrency replaces the
+locust master/slave pair for single-host runs; scale out by running multiple
+processes (the helm chart's slave count).
+
+Used by benchmarks and the `loadtest` CLI subcommand; prints a single JSON
+report compatible with BENCH tooling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+def percentile_stats(latencies_s) -> Dict[str, float]:
+    lat = np.asarray(sorted(latencies_s))
+    if lat.size == 0:
+        return {}
+    pct = lambda p: float(np.percentile(lat, p) * 1000.0)  # noqa: E731
+    return {
+        "p50_ms": round(pct(50), 3),
+        "p90_ms": round(pct(90), 3),
+        "p95_ms": round(pct(95), 3),
+        "p99_ms": round(pct(99), 3),
+        "mean_ms": round(float(lat.mean() * 1000.0), 3),
+        "max_ms": round(float(lat.max() * 1000.0), 3),
+    }
+
+
+async def run_rest_load(
+    url: str,
+    payload_fn: Callable[[], Dict[str, Any]],
+    clients: int = 16,
+    duration_s: float = 10.0,
+    warmup_s: float = 1.0,
+) -> Dict[str, Any]:
+    """Closed-loop: each client fires its next request when the previous one
+    answers (the locust model)."""
+    import aiohttp
+
+    latencies: list = []
+    errors = [0]
+    stop_at = [0.0]
+
+    async def client(session):
+        while time.perf_counter() < stop_at[0]:
+            t0 = time.perf_counter()
+            try:
+                async with session.post(url, json=payload_fn()) as resp:
+                    await resp.read()
+                    ok = resp.status == 200
+            except Exception:
+                ok = False
+            dt = time.perf_counter() - t0
+            if ok:
+                latencies.append((t0, dt))
+            else:
+                errors[0] += 1
+
+    conn = aiohttp.TCPConnector(limit=clients * 2)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        # warmup (excluded from stats)
+        stop_at[0] = time.perf_counter() + warmup_s
+        await asyncio.gather(*[client(session) for _ in range(min(4, clients))])
+        latencies.clear()
+        errors[0] = 0
+        start = time.perf_counter()
+        stop_at[0] = start + duration_s
+        await asyncio.gather(*[client(session) for _ in range(clients)])
+        elapsed = time.perf_counter() - start
+
+    lat_only = [d for (_, d) in latencies]
+    return {
+        "transport": "rest",
+        "clients": clients,
+        "duration_s": round(elapsed, 3),
+        "requests": len(lat_only),
+        "errors": errors[0],
+        "rps": round(len(lat_only) / elapsed, 2) if elapsed > 0 else 0.0,
+        **percentile_stats(lat_only),
+    }
+
+
+def run_grpc_load(
+    target: str,
+    payload_fn: Callable[[], Any],
+    clients: int = 8,
+    duration_s: float = 10.0,
+    warmup_s: float = 1.0,
+    service: str = "Seldon",
+) -> Dict[str, Any]:
+    """Thread-based closed loop over blocking gRPC stubs."""
+    import threading
+
+    from seldon_core_tpu.transport import grpc_client
+
+    latencies: list = []
+    errors = [0]
+    lock = threading.Lock()
+    stop_at = [time.perf_counter() + warmup_s]
+
+    def worker(collect: bool):
+        while time.perf_counter() < stop_at[0]:
+            t0 = time.perf_counter()
+            try:
+                grpc_client.call_sync(target, "Predict", payload_fn(), service=service)
+                ok = True
+            except Exception:
+                ok = False
+            dt = time.perf_counter() - t0
+            with lock:
+                if not collect:
+                    continue
+                if ok:
+                    latencies.append(dt)
+                else:
+                    errors[0] += 1
+
+    warm = [threading.Thread(target=worker, args=(False,)) for _ in range(min(4, clients))]
+    for t in warm:
+        t.start()
+    for t in warm:
+        t.join()
+
+    start = time.perf_counter()
+    stop_at[0] = start + duration_s
+    threads = [threading.Thread(target=worker, args=(True,)) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - start
+
+    return {
+        "transport": "grpc",
+        "clients": clients,
+        "duration_s": round(elapsed, 3),
+        "requests": len(latencies),
+        "errors": errors[0],
+        "rps": round(len(latencies) / elapsed, 2) if elapsed > 0 else 0.0,
+        **percentile_stats(latencies),
+    }
+
+
+def default_payload_fn(contract_path: Optional[str] = None, batch: int = 1):
+    """Random contract-conforming payloads, or a fixed 1x2 tensor."""
+    if contract_path:
+        from seldon_core_tpu.client.contract import generate_batch, load_contract
+
+        contract = load_contract(contract_path)
+
+        def fn():
+            arr = generate_batch(contract, batch)
+            return {"data": {"ndarray": arr.tolist()}}
+
+        return fn
+    fixed = {"data": {"tensor": {"shape": [batch, 2], "values": [1.0, 2.0] * batch}}}
+    return lambda: fixed
+
+
+def main(args) -> None:
+    payload_fn = default_payload_fn(args.contract, args.batch)
+    if args.grpc:
+        from seldon_core_tpu.contracts.payload import SeldonMessage
+
+        json_fn = payload_fn
+        msg_fn = lambda: SeldonMessage.from_dict(json_fn())  # noqa: E731
+        report = run_grpc_load(
+            f"{args.host}:{args.port}", msg_fn, clients=args.clients, duration_s=args.duration
+        )
+    else:
+        url = f"http://{args.host}:{args.port}/api/v0.1/predictions"
+        report = asyncio.run(
+            run_rest_load(url, payload_fn, clients=args.clients, duration_s=args.duration)
+        )
+    print(json.dumps(report))

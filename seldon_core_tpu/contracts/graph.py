@@ -206,6 +206,9 @@ class PredictorSpec:
     shadow: bool = False
     component_specs: List[Dict[str, Any]] = field(default_factory=list)
     svc_orch_spec: Dict[str, Any] = field(default_factory=dict)
+    # `SeldonHpaSpec` (proto/seldon_deployment.proto:72-76):
+    # {minReplicas, maxReplicas, metrics: [...]}
+    hpa_spec: Dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -225,6 +228,8 @@ class PredictorSpec:
             d["componentSpecs"] = self.component_specs
         if self.svc_orch_spec:
             d["svcOrchSpec"] = self.svc_orch_spec
+        if self.hpa_spec:
+            d["hpaSpec"] = self.hpa_spec
         return d
 
     @classmethod
@@ -241,6 +246,7 @@ class PredictorSpec:
             shadow=bool(d.get("shadow", False)),
             component_specs=list(d.get("componentSpecs", []) or []),
             svc_orch_spec=dict(d.get("svcOrchSpec", {}) or {}),
+            hpa_spec=dict(d.get("hpaSpec", {}) or {}),
         )
 
 

@@ -1,0 +1,8 @@
+"""Control plane: defaulting/validation and manifest rendering for
+SeldonDeployment-compatible CRs (capability of the reference's external Go
+operator + webhooks — SURVEY.md §2.8, §3.4)."""
+
+from seldon_core_tpu.controlplane.validate import default_deployment, validate_deployment
+from seldon_core_tpu.controlplane.render import render_manifests
+
+__all__ = ["default_deployment", "validate_deployment", "render_manifests"]

@@ -1,0 +1,2 @@
+"""Observability services: request logger (capability of the reference's
+`seldon-request-logger/app/app.py`)."""

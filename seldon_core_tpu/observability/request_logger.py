@@ -1,0 +1,96 @@
+"""Request logger service.
+
+Capability of the reference's `seldon-request-logger/app/app.py:15-60`: a
+small HTTP service that receives the engine's CloudEvents-style
+request/response pairs (`CE-Type: seldon.message.pair` headers —
+`engine/.../PredictionService.java:162-191`) and flattens each batch element
+into one JSON line on stdout for the fluentd/Elastic pipeline.
+
+The engine side posts pairs when ``REQUEST_LOGGER_URL`` is set
+(transport/rest.py), mirroring the reference's `log.messages.externally`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+
+def _rows(data: Optional[Dict[str, Any]]) -> List[Any]:
+    """Per-element rows from a SeldonMessage dict: one row per batch entry of
+    ndarray/tensor data, else the scalar payload."""
+    if not data:
+        return [None]
+    d = data.get("data", {})
+    if "ndarray" in d:
+        arr = d["ndarray"]
+        return list(arr) if isinstance(arr, list) else [arr]
+    if "tensor" in d:
+        shape = d["tensor"].get("shape", [])
+        values = d["tensor"].get("values", [])
+        if len(shape) == 2 and shape[0] * shape[1] == len(values):
+            n = shape[0]
+            w = shape[1]
+            return [values[i * w : (i + 1) * w] for i in range(n)]
+        return [values]
+    for key in ("strData", "binData", "jsonData"):
+        if key in data:
+            return [data[key]]
+    return [None]
+
+
+def flatten_pair(body: Dict[str, Any], ce_headers: Dict[str, str]) -> List[Dict[str, Any]]:
+    """One log record per request row, paired positionally with response rows
+    (the reference's per-element flattening)."""
+    request = body.get("request", {})
+    response = body.get("response", {})
+    puid = (
+        request.get("meta", {}).get("puid")
+        or response.get("meta", {}).get("puid")
+        or ce_headers.get("ce-requestid", "")
+    )
+    req_rows = _rows(request)
+    resp_rows = _rows(response)
+    n = max(len(req_rows), len(resp_rows))
+    out = []
+    for i in range(n):
+        out.append(
+            {
+                "request.id": puid,
+                "request.elem": i,
+                "request.data": req_rows[i] if i < len(req_rows) else None,
+                "response.data": resp_rows[i] if i < len(resp_rows) else None,
+                "ce-type": ce_headers.get("ce-type", ""),
+                "ce-source": ce_headers.get("ce-source", ""),
+                "sdep": ce_headers.get("ce-sdep", ""),
+            }
+        )
+    return out
+
+
+def make_logger_app(out=None) -> web.Application:
+    out = out or sys.stdout
+    app = web.Application(client_max_size=1 << 26)
+
+    async def handle(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "bad json"}, status=400)
+        ce = {k.lower(): v for k, v in request.headers.items() if k.lower().startswith("ce-")}
+        for record in flatten_pair(body, ce):
+            out.write(json.dumps(record) + "\n")
+        out.flush()
+        return web.json_response({"status": "ok"})
+
+    async def health(request):
+        return web.json_response({"status": "ok"})
+
+    app.router.add_post("/", handle)
+    app.router.add_post("/api/log", handle)
+    app.router.add_get("/ready", health)
+    app.router.add_get("/live", health)
+    return app

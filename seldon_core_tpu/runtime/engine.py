@@ -253,10 +253,17 @@ class GraphEngine:
             def subtree(x, _fn=fn, _p=params, _child=child):
                 return _child(_fn(_p, x))
 
-        state.fused_fn = jax.jit(subtree)
-        state.fused_units = covered
-        state.fused_owner = owner
-        logger.info("fused subtree at unit %s into one XLA computation", state.name)
+        # Only install a fused executor for MULTI-node subtrees: fusing a lone
+        # leaf adds a per-request jit dispatch (and, on this harness, a device
+        # round trip) without merging anything — components run their own
+        # compiled path (e.g. JAXServer) or host path (stubs) when unfused.
+        # The (fn, covered, owner) return still flows upward so a parent can
+        # fuse this leaf into a larger program.
+        if len(covered) >= 2:
+            state.fused_fn = jax.jit(subtree)
+            state.fused_units = covered
+            state.fused_owner = owner
+            logger.info("fused %d-unit subtree at %s into one XLA computation", len(covered), state.name)
         return subtree, covered, owner
 
     # ------------------------------------------------------------------

@@ -147,6 +147,43 @@ def run_engine(args: argparse.Namespace) -> None:
         serve(make_engine_app(engine, metrics=metrics), host=args.host, port=port)
 
 
+def run_render(args: argparse.Namespace) -> None:
+    import yaml
+
+    from seldon_core_tpu.contracts.graph import SeldonDeploymentSpec
+    from seldon_core_tpu.controlplane import render_manifests
+    from seldon_core_tpu.controlplane.render import DEFAULT_ENGINE_IMAGE
+
+    with open(args.file) as f:
+        raw = yaml.safe_load(f)
+    sdep = SeldonDeploymentSpec.from_dict(raw)
+    manifests = render_manifests(
+        sdep,
+        namespace=args.namespace,
+        engine_image=args.engine_image or DEFAULT_ENGINE_IMAGE,
+        tpu_chips=args.tpu_chips,
+        tpu_topology=args.tpu_topology,
+    )
+    if args.format == "json":
+        print(json.dumps(manifests, indent=2))
+    else:
+        print(yaml.safe_dump_all(manifests, sort_keys=False))
+
+
+def run_request_logger(args: argparse.Namespace) -> None:
+    setup_logging()
+    from seldon_core_tpu.observability.request_logger import make_logger_app
+    from seldon_core_tpu.transport.rest import serve
+
+    serve(make_logger_app(), host=args.host, port=args.port)
+
+
+def run_loadtest(args: argparse.Namespace) -> None:
+    from seldon_core_tpu.benchmarks import loadgen
+
+    loadgen.main(args)
+
+
 def main(argv: Optional[list] = None) -> None:
     parser = argparse.ArgumentParser(prog="seldon-core-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -181,6 +218,30 @@ def main(argv: Optional[list] = None) -> None:
     )
     add_tester_args(api_tester, endpoint_kind="engine")
     api_tester.set_defaults(func=tester_main)
+
+    render = sub.add_parser("render", help="SeldonDeployment CR -> k8s manifests (operator logic)")
+    render.add_argument("file", help="CR or spec JSON/YAML file")
+    render.add_argument("--namespace", default="default")
+    render.add_argument("--engine-image", default=None)
+    render.add_argument("--tpu-chips", type=int, default=1)
+    render.add_argument("--tpu-topology", default=None)
+    render.add_argument("--format", default="yaml", choices=["yaml", "json"])
+    render.set_defaults(func=run_render)
+
+    rl = sub.add_parser("request-logger", help="CloudEvents message-pair logger service")
+    rl.add_argument("--port", type=int, default=2222)
+    rl.add_argument("--host", default="0.0.0.0")
+    rl.set_defaults(func=run_request_logger)
+
+    lt = sub.add_parser("loadtest", help="async load generator (locust equivalent)")
+    lt.add_argument("host")
+    lt.add_argument("port", type=int)
+    lt.add_argument("--clients", type=int, default=16)
+    lt.add_argument("--duration", type=float, default=10.0)
+    lt.add_argument("--batch", type=int, default=1)
+    lt.add_argument("--contract", default=None)
+    lt.add_argument("--grpc", action="store_true")
+    lt.set_defaults(func=run_loadtest)
 
     args = parser.parse_args(argv)
     args.func(args)

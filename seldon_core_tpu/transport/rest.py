@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Any, Callable, Optional
 
@@ -180,6 +181,49 @@ def make_engine_app(engine: Any, metrics: Optional[MetricsRegistry] = None) -> w
     state = {"paused": False, "ready": True}
     app[web.AppKey("state", dict)] = state
 
+    # request/response pair logging — the reference's stdout logging
+    # (log.requests/log.responses, PredictionService.java:62-76,122-128) and
+    # CloudEvents POST to the request logger (:162-191)
+    log_requests = os.environ.get("SELDON_LOG_REQUESTS", "") == "1"
+    log_responses = os.environ.get("SELDON_LOG_RESPONSES", "") == "1"
+    logger_url = os.environ.get("REQUEST_LOGGER_URL", "")
+    # strong refs so fire-and-forget log tasks can't be GC'd mid-flight
+    log_tasks: set = set()
+    logger_session: list = [None]  # lazily-created shared ClientSession
+
+    async def _log_pair(req_dict, resp_dict):
+        if log_requests:
+            print(json.dumps({"request": req_dict}), flush=True)
+        if log_responses:
+            print(json.dumps({"response": resp_dict}), flush=True)
+        if logger_url:
+            try:
+                import aiohttp
+
+                if logger_session[0] is None or logger_session[0].closed:
+                    logger_session[0] = aiohttp.ClientSession(
+                        timeout=aiohttp.ClientTimeout(total=2)
+                    )
+                headers = {
+                    "CE-Type": "seldon.message.pair",
+                    "CE-Source": "seldon-engine-tpu",
+                    "CE-SDep": os.environ.get("DEPLOYMENT_NAME", ""),
+                    "CE-RequestId": (resp_dict.get("meta") or {}).get("puid", ""),
+                }
+                async with logger_session[0].post(
+                    logger_url,
+                    json={"request": req_dict, "response": resp_dict},
+                    headers=headers,
+                ) as resp:
+                    await resp.read()
+            except Exception as e:  # logging must never fail the request
+                logging.getLogger(__name__).warning("request-logger post failed: %s", e)
+
+    def _spawn_log(req_dict, resp_dict):
+        task = asyncio.ensure_future(_log_pair(req_dict, resp_dict))
+        log_tasks.add(task)
+        task.add_done_callback(log_tasks.discard)
+
     async def predictions(request: web.Request) -> web.Response:
         if state["paused"]:
             return web.json_response(
@@ -192,6 +236,8 @@ def make_engine_app(engine: Any, metrics: Optional[MetricsRegistry] = None) -> w
             with tracer.span("predictions"):
                 out = await engine.predict(msg)
             metrics.observe_prediction(engine, out, time.perf_counter() - t0)
+            if log_requests or log_responses or logger_url:
+                _spawn_log(body, out.to_dict())
             return _json(out)
         except Exception as e:
             metrics.observe_api_call("predictions", str(getattr(e, "status_code", 500)), time.perf_counter() - t0)

@@ -1,0 +1,152 @@
+"""Control-plane logic: defaulting, bad-graph rejection (reference parity:
+testing/scripts/test_bad_graphs.py), manifest rendering with engine injection
+(reference parity: operator reconcile, SURVEY.md §3.4)."""
+
+import base64
+import json
+
+import pytest
+
+from seldon_core_tpu.contracts.graph import SeldonDeploymentSpec
+from seldon_core_tpu.contracts.payload import SeldonError
+from seldon_core_tpu.controlplane import (
+    default_deployment,
+    render_manifests,
+    validate_deployment,
+)
+from seldon_core_tpu.controlplane.validate import require_valid
+
+
+def sdep(predictors):
+    return SeldonDeploymentSpec.from_dict({"name": "mydep", "predictors": predictors})
+
+
+SIMPLE = {"name": "p", "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
+
+
+# ------------------------------------------------------------- validation
+def test_valid_simple_deployment():
+    assert validate_deployment(default_deployment(sdep([SIMPLE]))) == []
+
+
+def test_defaulting_fills_name_replicas_traffic():
+    s = sdep([{"graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}])
+    s.predictors[0].name = ""
+    s.predictors[0].replicas = 0
+    s = default_deployment(s)
+    assert s.predictors[0].name == "predictor-0"
+    assert s.predictors[0].replicas == 1
+    assert s.predictors[0].traffic == 100
+
+
+def test_router_without_children_rejected():
+    bad = {"name": "p", "graph": {"name": "r", "type": "ROUTER", "implementation": "SIMPLE_ROUTER"}}
+    problems = validate_deployment(sdep([bad]))
+    assert any("ROUTER" in p and "child" in p for p in problems)
+
+
+def test_duplicate_unit_names_rejected():
+    bad = {
+        "name": "p",
+        "graph": {
+            "name": "x", "type": "TRANSFORMER",
+            "children": [{"name": "x", "type": "MODEL", "implementation": "SIMPLE_MODEL"}],
+        },
+    }
+    problems = validate_deployment(sdep([bad]))
+    assert any("duplicate unit name" in p for p in problems)
+
+
+def test_server_without_modeluri_rejected():
+    bad = {"name": "p", "graph": {"name": "m", "type": "MODEL", "implementation": "SKLEARN_SERVER"}}
+    problems = validate_deployment(sdep([bad]))
+    assert any("requires modelUri" in p for p in problems)
+
+
+def test_traffic_must_sum_to_100():
+    a = dict(SIMPLE, name="a", traffic=50)
+    b = dict(SIMPLE, name="b", traffic=30)
+    problems = validate_deployment(sdep([a, b]))
+    assert any("sum to 80" in p for p in problems)
+
+
+def test_bad_dns_name_rejected():
+    s = sdep([SIMPLE])
+    s.name = "Bad_Name"
+    problems = validate_deployment(s)
+    assert any("DNS label" in p for p in problems)
+
+
+def test_hpa_validation():
+    p = dict(SIMPLE, hpaSpec={"minReplicas": 5, "maxReplicas": 2})
+    problems = validate_deployment(sdep([p]))
+    assert any("minReplicas" in x for x in problems)
+
+
+def test_require_valid_raises():
+    bad = {"name": "p", "graph": {"name": "r", "type": "COMBINER"}}
+    with pytest.raises(SeldonError, match="COMBINER"):
+        require_valid(sdep([bad]))
+
+
+# ------------------------------------------------------------- rendering
+def test_render_injects_engine_with_spec_env():
+    manifests = render_manifests(sdep([SIMPLE]), namespace="ns1", tpu_chips=4)
+    dep = next(m for m in manifests if m["kind"] == "Deployment")
+    svc = next(m for m in manifests if m["kind"] == "Service")
+    assert dep["metadata"]["name"] == "mydep-p"
+    containers = dep["spec"]["template"]["spec"]["containers"]
+    engine = containers[0]
+    assert engine["name"] == "seldon-engine-tpu"
+    env = {e["name"]: e.get("value") for e in engine["env"]}
+    decoded = json.loads(base64.b64decode(env["ENGINE_PREDICTOR"]))
+    assert decoded["graph"]["implementation"] == "SIMPLE_MODEL"
+    assert engine["resources"]["limits"]["google.com/tpu"] == 4
+    assert engine["lifecycle"]["preStop"]["httpGet"]["path"] == "/pause"
+    assert svc["spec"]["selector"]["app"] == "mydep-p"
+    # prometheus scrape annotations present (analytics chart contract)
+    ann = dep["spec"]["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+
+
+def test_render_traffic_split_virtualservice():
+    a = dict(SIMPLE, name="a", traffic=90)
+    b = dict(SIMPLE, name="b", traffic=10)
+    manifests = render_manifests(sdep([a, b]), namespace="ns")
+    vs = next(m for m in manifests if m["kind"] == "VirtualService")
+    weights = {r["destination"]["host"]: r["weight"] for r in vs["spec"]["http"][0]["route"]}
+    assert weights["mydep-a.ns.svc.cluster.local"] == 90
+    assert weights["mydep-b.ns.svc.cluster.local"] == 10
+
+
+def test_render_shadow_mirror():
+    a = dict(SIMPLE, name="a", traffic=100)
+    b = dict(SIMPLE, name="b", shadow=True)
+    manifests = render_manifests(sdep([a, b]))
+    vs = next(m for m in manifests if m["kind"] == "VirtualService")
+    assert "mydep-b" in vs["spec"]["http"][0]["mirror"]["host"]
+
+
+def test_render_hpa():
+    p = dict(SIMPLE, hpaSpec={"minReplicas": 2, "maxReplicas": 6})
+    manifests = render_manifests(sdep([p]))
+    hpa = next(m for m in manifests if m["kind"] == "HorizontalPodAutoscaler")
+    assert hpa["spec"]["minReplicas"] == 2
+    assert hpa["spec"]["maxReplicas"] == 6
+
+
+def test_render_component_spec_containers_merged():
+    p = dict(
+        SIMPLE,
+        componentSpecs=[{"spec": {"containers": [{"name": "sidecar", "image": "busybox"}]}}],
+    )
+    manifests = render_manifests(sdep([p]))
+    dep = next(m for m in manifests if m["kind"] == "Deployment")
+    names = [c["name"] for c in dep["spec"]["template"]["spec"]["containers"]]
+    assert names == ["seldon-engine-tpu", "sidecar"]
+
+
+def test_render_rejects_invalid():
+    bad = {"name": "p", "graph": {"name": "r", "type": "ROUTER"}}
+    with pytest.raises(SeldonError):
+        render_manifests(sdep([bad]))
