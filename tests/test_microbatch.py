@@ -1,0 +1,150 @@
+"""Cross-request micro-batching: exactness per caller, coalescing into one
+engine call, routing-graph rejection, shape grouping."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.graph import PredictorSpec
+from seldon_core_tpu.contracts.payload import SeldonError, SeldonMessage
+from seldon_core_tpu.runtime.engine import GraphEngine
+from seldon_core_tpu.runtime.microbatch import MicroBatcher
+
+
+class Double(SeldonComponent):
+    """Row-wise model that counts engine-level calls."""
+
+    def __init__(self):
+        self.calls = 0
+        self.batch_sizes = []
+
+    def predict(self, X, names, meta=None):
+        X = np.asarray(X)
+        self.calls += 1
+        self.batch_sizes.append(X.shape[0])
+        return X * 2.0
+
+
+def make(max_batch=64, max_delay_ms=5.0):
+    comp = Double()
+    spec = PredictorSpec.from_dict({"name": "p", "graph": {"name": "m", "type": "MODEL"}})
+    engine = GraphEngine(spec, components={"m": comp})
+    return MicroBatcher(engine, max_batch=max_batch, max_delay_ms=max_delay_ms), comp
+
+
+def msg(rows):
+    return SeldonMessage.from_dict({"data": {"ndarray": rows}})
+
+
+def test_concurrent_requests_coalesce_and_split():
+    batcher, comp = make()
+
+    async def go():
+        outs = await asyncio.gather(
+            *[batcher.predict(msg([[float(i)], [float(i) + 0.5]])) for i in range(8)]
+        )
+        return outs
+
+    outs = asyncio.run(go())
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(
+            out.data.to_numpy(), [[2.0 * i], [2.0 * i + 1.0]], rtol=1e-6
+        )
+    assert comp.calls < 8  # coalesced
+    assert sum(comp.batch_sizes) == 16
+    assert batcher.batched_requests >= 2
+    # every caller gets a distinct puid
+    puids = {o.meta.puid for o in outs}
+    assert len(puids) == 8
+
+
+def test_max_batch_triggers_flush():
+    batcher, comp = make(max_batch=4, max_delay_ms=10_000.0)  # delay never fires
+
+    async def go():
+        return await asyncio.gather(*[batcher.predict(msg([[1.0]])) for _ in range(4)])
+
+    outs = asyncio.run(go())
+    assert len(outs) == 4
+    assert comp.calls == 1
+    assert comp.batch_sizes == [4]
+
+
+def test_mixed_shapes_batch_separately():
+    batcher, comp = make(max_delay_ms=5.0)
+
+    async def go():
+        return await asyncio.gather(
+            batcher.predict(msg([[1.0]])),
+            batcher.predict(msg([[1.0, 2.0]])),
+            batcher.predict(msg([[3.0]])),
+        )
+
+    a, b, c = asyncio.run(go())
+    np.testing.assert_allclose(a.data.to_numpy(), [[2.0]])
+    np.testing.assert_allclose(b.data.to_numpy(), [[2.0, 4.0]])
+    np.testing.assert_allclose(c.data.to_numpy(), [[6.0]])
+
+
+def test_router_graph_rejected():
+    spec = PredictorSpec.from_dict(
+        {
+            "name": "p",
+            "graph": {
+                "name": "r", "type": "ROUTER", "implementation": "RANDOM_ABTEST",
+                "children": [
+                    {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                ],
+            },
+        }
+    )
+    engine = GraphEngine(spec)
+    with pytest.raises(SeldonError, match="row-wise"):
+        MicroBatcher(engine)
+    # strict=False degrades to passthrough
+    mb = MicroBatcher(engine, strict=False)
+
+    async def go():
+        return await mb.predict(msg([[1.0]]))
+
+    out = asyncio.run(go())
+    assert out.data.to_numpy().shape == (1, 3)
+
+
+def test_non_array_payload_passthrough():
+    class Echo(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return X
+
+    spec = PredictorSpec.from_dict({"name": "p", "graph": {"name": "m", "type": "MODEL"}})
+    engine = GraphEngine(spec, components={"m": Echo()})
+    batcher = MicroBatcher(engine)
+
+    async def go():
+        return await batcher.predict(SeldonMessage.from_str("hello"))
+
+    assert asyncio.run(go()).str_data == "hello"
+
+
+def test_engine_error_propagates_to_all_callers():
+    class Boom(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            raise SeldonError("boom")
+
+    spec = PredictorSpec.from_dict({"name": "p", "graph": {"name": "m", "type": "MODEL"}})
+    engine = GraphEngine(spec, components={"m": Boom()})
+    batcher = MicroBatcher(engine, max_batch=2, max_delay_ms=10_000.0)
+
+    async def go():
+        results = await asyncio.gather(
+            batcher.predict(msg([[1.0]])),
+            batcher.predict(msg([[2.0]])),
+            return_exceptions=True,
+        )
+        return results
+
+    res = asyncio.run(go())
+    assert all(isinstance(r, SeldonError) for r in res)
