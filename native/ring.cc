@@ -20,6 +20,7 @@
 #include <atomic>
 #include <cerrno>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 
 #include <fcntl.h>
@@ -32,7 +33,8 @@ namespace {
 constexpr uint64_t kMagic = 0x53454c52494e4731ull;  // "SELRING1"
 
 struct Header {
-  uint64_t magic;
+  std::atomic<uint64_t> magic;  // written last (release) so attachers see a
+                                // fully initialised header (acquire)
   uint64_t capacity;   // power of two
   uint64_t slot_size;  // payload bytes per cell
   uint64_t cell_stride;
@@ -69,7 +71,10 @@ size_t total_size(uint64_t capacity, uint64_t cell_stride) {
 
 extern "C" {
 
-// Create (or overwrite) a ring file. capacity must be a power of two.
+// Create (or replace) a ring file. capacity must be a power of two.
+// The ring is initialised in a temp file and atomically renamed over the
+// target, so re-creating a ring never truncates the inode that still-attached
+// workers have mapped (they keep the old ring; new attachers get the new one).
 // Returns an opaque handle or nullptr.
 void* scr_create(const char* path, uint64_t capacity, uint64_t slot_size) {
   if (capacity == 0 || (capacity & (capacity - 1)) != 0) return nullptr;
@@ -77,15 +82,22 @@ void* scr_create(const char* path, uint64_t capacity, uint64_t slot_size) {
   stride = (stride + 63) & ~63ull;  // 64B-align cells
   size_t len = total_size(capacity, stride);
 
-  int fd = ::open(path, O_RDWR | O_CREAT | O_TRUNC, 0600);
+  char tmp[4096];
+  int n = ::snprintf(tmp, sizeof(tmp), "%s.tmp.%d", path, ::getpid());
+  if (n < 0 || static_cast<size_t>(n) >= sizeof(tmp)) return nullptr;
+  int fd = ::open(tmp, O_RDWR | O_CREAT | O_TRUNC, 0600);
   if (fd < 0) return nullptr;
   if (::ftruncate(fd, static_cast<off_t>(len)) != 0) {
     ::close(fd);
+    ::unlink(tmp);
     return nullptr;
   }
   void* mem = ::mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   ::close(fd);
-  if (mem == MAP_FAILED) return nullptr;
+  if (mem == MAP_FAILED) {
+    ::unlink(tmp);
+    return nullptr;
+  }
 
   auto* h = static_cast<Header*>(mem);
   h->capacity = capacity;
@@ -99,8 +111,13 @@ void* scr_create(const char* path, uint64_t capacity, uint64_t slot_size) {
     cell_at(ring, i)->seq.store(i, std::memory_order_relaxed);
     cell_at(ring, i)->len = 0;
   }
-  std::atomic_thread_fence(std::memory_order_seq_cst);
-  h->magic = kMagic;
+  h->magic.store(kMagic, std::memory_order_release);
+  if (::rename(tmp, path) != 0) {
+    ::munmap(mem, len);
+    ::unlink(tmp);
+    delete ring;
+    return nullptr;
+  }
   return ring;
 }
 
@@ -118,7 +135,7 @@ void* scr_attach(const char* path) {
   ::close(fd);
   if (mem == MAP_FAILED) return nullptr;
   auto* h = static_cast<Header*>(mem);
-  if (h->magic != kMagic ||
+  if (h->magic.load(std::memory_order_acquire) != kMagic ||
       static_cast<size_t>(st.st_size) < total_size(h->capacity, h->cell_stride)) {
     ::munmap(mem, static_cast<size_t>(st.st_size));
     return nullptr;

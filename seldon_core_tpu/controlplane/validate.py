@@ -46,14 +46,19 @@ _SERVER_IMPLS = {
 
 def default_deployment(sdep: SeldonDeploymentSpec) -> SeldonDeploymentSpec:
     """Fill the fields the reference's defaulting webhook would: predictor
-    names, replicas>=1, and a 100% traffic weight for a lone predictor."""
+    names, replicas>=1, and traffic weights when none are set (100 for a lone
+    predictor; an even split across non-shadow predictors otherwise, so the
+    rendered VirtualService never routes 0% everywhere)."""
     for i, p in enumerate(sdep.predictors):
         if not p.name:
             p.name = f"predictor-{i}"
         if p.replicas < 1:
             p.replicas = 1
-    if len(sdep.predictors) == 1 and sdep.predictors[0].traffic == 0:
-        sdep.predictors[0].traffic = 100
+    live = [p for p in sdep.predictors if not p.shadow]
+    if live and not any(p.traffic for p in live):
+        share, rem = divmod(100, len(live))
+        for i, p in enumerate(live):
+            p.traffic = share + (1 if i < rem else 0)
     return sdep
 
 

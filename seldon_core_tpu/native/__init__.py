@@ -6,6 +6,12 @@ builds on demand with the baked-in toolchain (g++) and callers get a clear
 error if the toolchain is missing.
 """
 
-from seldon_core_tpu.native.staging import SharedRing, build_native, native_available
+from seldon_core_tpu.native.staging import (
+    PayloadTooLarge,
+    RingFull,
+    SharedRing,
+    build_native,
+    native_available,
+)
 
-__all__ = ["SharedRing", "build_native", "native_available"]
+__all__ = ["PayloadTooLarge", "RingFull", "SharedRing", "build_native", "native_available"]

@@ -97,7 +97,9 @@ class MicroBatcher:
             # bytes/str/json or scalar payloads pass through unbatched
             return await self.engine.predict(request)
         rows = np.atleast_2d(payload)
-        key = (rows.shape[1:], str(rows.dtype), request.which)
+        # names are part of the key so requests with different feature names
+        # are never merged (group[0]'s names label the merged batch)
+        key = (rows.shape[1:], str(rows.dtype), request.which, tuple(request.names or ()))
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         group = self._groups.setdefault(key, [])
         group.append(_Pending(request, rows, fut))
@@ -169,8 +171,10 @@ class MicroBatcher:
                     resp.meta = out.meta.copy()
                 else:
                     # non-row-wise output (shouldn't happen for validated
-                    # graphs): hand every caller the full response
-                    resp = out
+                    # graphs): every caller gets its own deep copy of the full
+                    # response so the per-caller puid below doesn't clobber a
+                    # shared object
+                    resp = SeldonMessage.from_dict(out.to_dict())
                 # unique puid per caller, as the engine would have assigned
                 from seldon_core_tpu.runtime.engine import make_puid
 

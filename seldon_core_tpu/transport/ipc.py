@@ -26,7 +26,7 @@ import time
 from typing import Any, Dict, Optional
 
 from seldon_core_tpu.contracts.payload import Feedback, SeldonError, SeldonMessage
-from seldon_core_tpu.native import SharedRing
+from seldon_core_tpu.native import PayloadTooLarge, SharedRing
 
 logger = logging.getLogger(__name__)
 
@@ -35,6 +35,11 @@ _RESP_HEADER = struct.Struct("<IB")
 
 KIND_PREDICT = 0
 KIND_FEEDBACK = 1
+
+
+def _error_body(info: str, reason: str) -> bytes:
+    """Error frame body; the client parses status.info/status.reason."""
+    return json.dumps({"status": {"info": info, "reason": reason, "status": 1}}).encode()
 
 
 def request_ring_path(base: str) -> str:
@@ -60,6 +65,14 @@ class IPCEngineServer:
         self.engine = engine
         self.base_path = base_path
         self.batch = batch
+        # sweep temp files orphaned by a previous creator killed mid-create
+        import glob
+
+        for stale in glob.glob(base_path + "*.tmp.*"):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
         self.req_ring = SharedRing(
             request_ring_path(base_path), capacity=capacity, slot_size=slot_size, create=True
         )
@@ -83,7 +96,14 @@ class IPCEngineServer:
         self._stop = True
 
     async def _handle(self, frame: bytes) -> None:
-        worker_id, req_id, kind = _REQ_HEADER.unpack_from(frame)
+        # No failure below may escape: serve_forever gathers these, so one bad
+        # frame / oversized body / stalled worker would kill serving for all
+        # workers.
+        try:
+            worker_id, req_id, kind = _REQ_HEADER.unpack_from(frame)
+        except struct.error:
+            logger.error("dropping malformed IPC frame (%d bytes)", len(frame))
+            return
         try:
             payload = json.loads(frame[_REQ_HEADER.size:])
             if kind == KIND_PREDICT:
@@ -95,18 +115,28 @@ class IPCEngineServer:
             body = json.dumps(out.to_dict()).encode()
             status = 0
         except Exception as e:
-            body = json.dumps(
-                {"status": {"info": str(e), "reason": getattr(e, "reason", "ENGINE_ERROR"),
-                            "status": 1}}
-            ).encode()
+            body = _error_body(str(e), getattr(e, "reason", "ENGINE_ERROR"))
             status = 1
         ring = self.resp_rings.get(worker_id)
         if ring is None:
             logger.error("response for unknown worker %d dropped", worker_id)
             return
-        await asyncio.to_thread(
-            ring.push_wait, _RESP_HEADER.pack(req_id, status) + body, 5.0
-        )
+        try:
+            await asyncio.to_thread(
+                ring.push_wait, _RESP_HEADER.pack(req_id, status) + body, 5.0
+            )
+        except PayloadTooLarge:
+            err = _error_body(
+                f"response too large for IPC slot "
+                f"({len(body)} bytes > {ring.slot_size - _RESP_HEADER.size})",
+                "RESPONSE_TOO_LARGE",
+            )
+            try:
+                await asyncio.to_thread(ring.push_wait, _RESP_HEADER.pack(req_id, 1) + err, 5.0)
+            except Exception:
+                logger.exception("dropping oversized response %d for worker %d", req_id, worker_id)
+        except Exception:
+            logger.exception("dropping response %d for stalled worker %d", req_id, worker_id)
 
 
 class IPCClient:
@@ -114,13 +144,27 @@ class IPCClient:
     response (out-of-order safe — responses for other requests from this
     worker are parked)."""
 
+    _PARKED_MAX = 1024
+
     def __init__(self, base_path: str, worker_id: int, timeout_s: float = 30.0):
         self.worker_id = int(worker_id)
         self.timeout_s = timeout_s
         self.req_ring = SharedRing(request_ring_path(base_path), create=False)
         self.resp_ring = SharedRing(response_ring_path(base_path, worker_id), create=False)
         self._next_id = 0
-        self._parked: Dict[int, bytes] = {}
+        # rid -> (arrival time, frame). Bounded: late responses to requests
+        # that already timed out would otherwise accumulate forever, and after
+        # u32 request-id wraparound a stale frame could match a live request.
+        self._parked: Dict[int, tuple] = {}
+
+    def _prune_parked(self) -> None:
+        now = time.monotonic()
+        stale = [rid for rid, (t, _) in self._parked.items() if now - t > self.timeout_s]
+        for rid in stale:
+            del self._parked[rid]
+        while len(self._parked) > self._PARKED_MAX:
+            oldest = min(self._parked, key=lambda rid: self._parked[rid][0])
+            del self._parked[oldest]
 
     def _call(self, kind: int, payload: Dict[str, Any]) -> Dict[str, Any]:
         req_id = self._next_id
@@ -131,18 +175,20 @@ class IPCClient:
         deadline = time.monotonic() + self.timeout_s
         while True:
             if req_id in self._parked:
-                raw = self._parked.pop(req_id)
+                raw = self._parked.pop(req_id)[1]
             else:
                 raw = self.resp_ring.pop()
                 if raw is None:
                     if time.monotonic() > deadline:
+                        self._prune_parked()
                         raise TimeoutError(f"IPC response {req_id} timed out")
                     time.sleep(0.0002)
                     continue
             rid, status = _RESP_HEADER.unpack_from(raw)
             body = json.loads(raw[_RESP_HEADER.size:])
             if rid != req_id:
-                self._parked[rid] = raw
+                self._parked[rid] = (time.monotonic(), raw)
+                self._prune_parked()
                 continue
             if status != 0:
                 raise SeldonError(
@@ -164,9 +210,14 @@ class IPCClient:
 
 
 def cleanup_rings(base_path: str, n_workers: int) -> None:
-    for p in [request_ring_path(base_path)] + [
+    import glob
+
+    paths = [request_ring_path(base_path)] + [
         response_ring_path(base_path, w) for w in range(n_workers)
-    ]:
+    ]
+    # stale .tmp.<pid> files left by a creator killed between open and rename
+    paths += [t for p in paths for t in glob.glob(p + ".tmp.*")]
+    for p in paths:
         try:
             os.unlink(p)
         except OSError:

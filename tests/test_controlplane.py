@@ -150,3 +150,37 @@ def test_render_rejects_invalid():
     bad = {"name": "p", "graph": {"name": "r", "type": "ROUTER"}}
     with pytest.raises(SeldonError):
         render_manifests(sdep([bad]))
+
+
+def test_multi_predictor_no_traffic_defaults_to_even_split():
+    # With no weights set, a multi-predictor deployment must not render an
+    # all-zero-weight VirtualService (Istio rejects it / routes nothing).
+    two = [
+        dict(SIMPLE, name="a"),
+        {"name": "b", "graph": {"name": "m2", "type": "MODEL", "implementation": "SIMPLE_MODEL"}},
+    ]
+    s = default_deployment(sdep(two))
+    assert [p.traffic for p in s.predictors] == [50, 50]
+
+    three = two + [
+        {"name": "c", "graph": {"name": "m3", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
+    ]
+    s3 = default_deployment(sdep(three))
+    assert sum(p.traffic for p in s3.predictors) == 100
+
+    manifests = render_manifests(sdep(two))
+    vs = [m for m in manifests if m["kind"] == "VirtualService"]
+    assert vs, "multi-predictor deployment should render a VirtualService"
+    weights = [r["weight"] for r in vs[0]["spec"]["http"][0]["route"]]
+    assert sum(weights) == 100 and all(w > 0 for w in weights)
+
+
+def test_shadow_predictor_excluded_from_traffic_split():
+    two = [
+        dict(SIMPLE, name="live"),
+        {"name": "sh", "shadow": True,
+         "graph": {"name": "m2", "type": "MODEL", "implementation": "SIMPLE_MODEL"}},
+    ]
+    s = default_deployment(sdep(two))
+    assert s.predictors[0].traffic == 100
+    assert s.predictors[1].traffic == 0

@@ -114,3 +114,71 @@ def test_ipc_feedback_and_error(ipc_engine):
     with pytest.raises(SeldonError):
         client.predict(SeldonMessage.from_dict({"data": {"tensor": {"shape": [2, 2], "values": [1.0]}}}))
     client.close()
+
+
+def _big_resp_engine_proc(base, stop_evt):
+    """Engine whose predict returns a response far larger than the IPC slot
+    when the input is positive — exercises the oversized-response error frame
+    (the serve loop must survive it, not crash all workers)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from seldon_core_tpu.contracts.payload import SeldonMessage
+    from seldon_core_tpu.transport.ipc import IPCEngineServer
+
+    class BigEngine:
+        async def predict(self, msg):
+            val = float(msg.data.to_numpy().ravel()[0])
+            if val > 0:
+                return SeldonMessage.from_dict({"strData": "x" * (1 << 16)})
+            return SeldonMessage.from_dict({"strData": "ok"})
+
+        async def send_feedback(self, fb):  # pragma: no cover
+            return SeldonMessage.from_dict({})
+
+    server = IPCEngineServer(BigEngine(), base, 1, capacity=64, slot_size=4096)
+
+    async def run():
+        task = asyncio.ensure_future(server.serve_forever())
+        while not stop_evt.is_set():
+            await asyncio.sleep(0.05)
+        server.stop()
+        await task
+
+    asyncio.run(run())
+
+
+def test_ipc_oversized_response_returns_error_and_server_survives(tmp_path):
+    base = str(tmp_path / "ipcbig")
+    ctx = mp.get_context("spawn")
+    stop = ctx.Event()
+    proc = ctx.Process(target=_big_resp_engine_proc, args=(base, stop))
+    proc.start()
+    import time
+
+    from seldon_core_tpu.transport.ipc import request_ring_path
+
+    deadline = time.monotonic() + 60
+    while not os.path.exists(request_ring_path(base)):
+        assert time.monotonic() < deadline and proc.is_alive()
+        time.sleep(0.05)
+    time.sleep(0.2)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from seldon_core_tpu.contracts.payload import SeldonError, SeldonMessage
+    from seldon_core_tpu.transport.ipc import IPCClient
+
+    client = IPCClient(base, 0, timeout_s=20.0)
+    try:
+        with pytest.raises(SeldonError) as exc:
+            client.predict(SeldonMessage.from_dict({"data": {"ndarray": [[1.0]]}}))
+        assert "TOO_LARGE" in (exc.value.reason or "")
+        # the serve loop must still be alive and answering
+        out = client.predict(SeldonMessage.from_dict({"data": {"ndarray": [[-1.0]]}}))
+        assert out.str_data == "ok"
+    finally:
+        client.close()
+        stop.set()
+        proc.join(timeout=30)
