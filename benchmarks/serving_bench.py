@@ -1,0 +1,161 @@
+"""Stub-graph serving benchmark — the reference's published methodology
+(doc/source/reference/benchmarking.md:19-36: locust drives the engine
+directly, in-engine SIMPLE_MODEL stub, so the number is the orchestrator +
+serialization ceiling) reproduced against the native edge on one host.
+
+Writes benchmarks/report_rest_stub.json (and _grpc when available) with the
+loadgen percentiles and the vs-baseline ratio. Run:
+
+    python benchmarks/serving_bench.py [--duration 30]
+
+Baseline (BASELINE.md): REST 12,088.95 rps / gRPC 28,256.39 rps on one GCP
+n1-standard-16 with 3 dedicated 16-vCPU loadgen nodes. Here server AND
+loadgen share one core, so the comparison is conservative.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from seldon_core_tpu.runtime.edgeprogram import (  # noqa: E402
+    EDGE_BINARY,
+    LOADGEN_BINARY,
+    build_edge_binaries,
+)
+
+REST_BASELINE_RPS = 12088.95
+GRPC_BASELINE_RPS = 28256.39
+BODY = '{"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}}'
+
+SINGLE_PROGRAM = {
+    "deployment": "bench",
+    "predictor": "p",
+    "native": True,
+    "root": 0,
+    "units": [{"name": "m", "kind": "SIMPLE_MODEL", "children": []}],
+}
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_live(port: int, deadline_s: float = 15.0) -> None:
+    import urllib.request
+
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/live", timeout=1):
+                return
+        except Exception:
+            time.sleep(0.05)
+    raise RuntimeError("edge did not come up")
+
+
+def run_loadgen(port: int, connections: int, duration: float, label: str,
+                grpc: bool = False) -> dict:
+    binary = LOADGEN_BINARY + ("_grpc" if grpc else "")
+    out = subprocess.run(
+        [binary, "--port", str(port), "--connections", str(connections),
+         "--duration", str(duration), "--warmup", "2", "--label", label]
+        + ([] if grpc else ["--body", BODY]),
+        capture_output=True, text=True, check=False,
+    )
+    if out.returncode not in (0, 3):
+        raise RuntimeError(f"loadgen failed: {out.stderr}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def bench_rest(duration: float) -> dict:
+    prog = os.path.join("/tmp", f"bench_prog_{os.getpid()}.json")
+    with open(prog, "w") as f:
+        json.dump(SINGLE_PROGRAM, f)
+    port = free_port()
+    edge = subprocess.Popen([EDGE_BINARY, "--program", prog, "--port", str(port)],
+                            stderr=subprocess.DEVNULL)
+    try:
+        wait_live(port)
+        runs = [run_loadgen(port, c, duration, f"rest-stub-{c}c") for c in (32, 64, 256)]
+    finally:
+        edge.terminate()
+        edge.wait()
+        os.unlink(prog)
+    best = max(runs, key=lambda r: r["throughput_rps"])
+    return {
+        "metric": "stub-graph REST throughput (native edge, SIMPLE_MODEL)",
+        "best": best,
+        "runs": runs,
+        "baseline_rps": REST_BASELINE_RPS,
+        "vs_baseline": round(best["throughput_rps"] / REST_BASELINE_RPS, 4),
+        "note": "server and loadgen share one core; reference used a 16-vCPU "
+                "server with 3 dedicated loadgen nodes",
+    }
+
+
+def bench_grpc(duration: float) -> dict | None:
+    if not os.path.exists(LOADGEN_BINARY + "_grpc"):
+        return None
+    prog = os.path.join("/tmp", f"bench_prog_{os.getpid()}.json")
+    with open(prog, "w") as f:
+        json.dump(SINGLE_PROGRAM, f)
+    port = free_port()
+    edge = subprocess.Popen(
+        [EDGE_BINARY, "--program", prog, "--grpc-port", str(port)],
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        time.sleep(0.5)
+        runs = [run_loadgen(port, c, duration, f"grpc-stub-{c}c", grpc=True)
+                for c in (16, 64, 128)]
+    finally:
+        edge.terminate()
+        edge.wait()
+        os.unlink(prog)
+    best = max(runs, key=lambda r: r["throughput_rps"])
+    return {
+        "metric": "stub-graph gRPC throughput (native edge, SIMPLE_MODEL)",
+        "best": best,
+        "runs": runs,
+        "baseline_rps": GRPC_BASELINE_RPS,
+        "vs_baseline": round(best["throughput_rps"] / GRPC_BASELINE_RPS, 4),
+        "note": "server and loadgen share one core; reference used a 16-vCPU "
+                "server with 3 dedicated loadgen nodes",
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=30.0)
+    args = ap.parse_args()
+    if not build_edge_binaries():
+        raise SystemExit("native toolchain unavailable")
+    outdir = os.path.join(REPO, "benchmarks")
+    rest = bench_rest(args.duration)
+    with open(os.path.join(outdir, "report_rest_stub.json"), "w") as f:
+        json.dump(rest, f, indent=2)
+    print(json.dumps({"rest_rps": rest["best"]["throughput_rps"],
+                      "vs_baseline": rest["vs_baseline"]}))
+    grpc = bench_grpc(args.duration)
+    if grpc is not None:
+        with open(os.path.join(outdir, "report_grpc_stub.json"), "w") as f:
+            json.dump(grpc, f, indent=2)
+        print(json.dumps({"grpc_rps": grpc["best"]["throughput_rps"],
+                          "vs_baseline": grpc["vs_baseline"]}))
+
+
+if __name__ == "__main__":
+    main()

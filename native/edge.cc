@@ -1,0 +1,1463 @@
+// seldon-edge: native HTTP serving edge for the TPU engine.
+//
+// Role. The reference's published benchmark measures its compiled (Java)
+// orchestrator running in-engine stub units — "the orchestrator +
+// serialization ceiling, not model compute" (BASELINE.md; reference
+// doc/source/reference/benchmarking.md:19-36, SimpleModelUnit.java:33-64).
+// The TPU build's orchestrator ceiling lives here: a compiled edge that
+// owns the HTTP external API (RestClientController.java:76-245 parity),
+// executes graphs of builtin units natively when the whole graph compiles
+// to an "edge program" (SIMPLE_MODEL / SIMPLE_ROUTER / RANDOM_ABTEST /
+// AVERAGE_COMBINER — PredictorConfigBean.java:77-82), and otherwise
+// forwards requests over the shared-memory ring (ring.cc) to the
+// device-owning Python/XLA engine process. Python stays the brain (graph
+// build, XLA compute, control plane); C++ owns the per-request byte work:
+// HTTP parse, JSON decode/encode, puid generation, metrics.
+//
+// Design notes.
+// - Single-threaded epoll event loop per worker; --workers N forks N loops
+//   sharing the port via SO_REUSEPORT (one is optimal on a 1-core host;
+//   real hosts scale linearly).
+// - Zero allocations on the hot path after warm-up: per-connection growable
+//   buffers are reused; responses are assembled into a scratch buffer.
+// - Response floats print like Python repr (shortest round-trip) so native
+//   and Python engines produce byte-comparable payloads.
+// - The ring fallback polls with a timerfd while requests are in flight;
+//   the Python engine side is seldon_core_tpu/transport/ipc.py.
+
+#include <atomic>
+#include <cctype>
+#include <cerrno>
+#include <cinttypes>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/timerfd.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+// ---------------------------------------------------------------------------
+// Shared ring (ring.cc) — linked in; used for the Python-engine fallback.
+// ---------------------------------------------------------------------------
+extern "C" {
+void* scr_create(const char* path, uint64_t capacity, uint64_t slot_size);
+void* scr_attach(const char* path);
+void scr_detach(void* h);
+uint64_t scr_slot_size(void* h);
+int scr_push(void* h, const void* data, uint32_t len);
+int scr_pop(void* h, void* out, uint32_t cap);
+}
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Small utils
+// ---------------------------------------------------------------------------
+
+struct Buf {
+  std::vector<char> v;
+  void clear() { v.clear(); }
+  size_t size() const { return v.size(); }
+  const char* data() const { return v.data(); }
+  void append(const char* p, size_t n) { v.insert(v.end(), p, p + n); }
+  void append(std::string_view s) { append(s.data(), s.size()); }
+  void push(char c) { v.push_back(c); }
+  void append_u64(uint64_t x) {
+    char tmp[24];
+    int n = snprintf(tmp, sizeof(tmp), "%" PRIu64, x);
+    append(tmp, n);
+  }
+  void append_i64(int64_t x) {
+    char tmp[24];
+    int n = snprintf(tmp, sizeof(tmp), "%" PRId64, x);
+    append(tmp, n);
+  }
+  // Shortest round-trip double formatting (Python repr parity).
+  void append_double(double x) {
+    char tmp[32];
+    for (int prec = 1; prec <= 17; ++prec) {
+      int n = snprintf(tmp, sizeof(tmp), "%.*g", prec, x);
+      double back = strtod(tmp, nullptr);
+      if (back == x) {
+        // Python renders integral floats as "1.0", %g as "1" — fix up.
+        bool has_dot = false;
+        for (int i = 0; i < n; ++i)
+          if (tmp[i] == '.' || tmp[i] == 'e' || tmp[i] == 'n' || tmp[i] == 'i') has_dot = true;
+        append(tmp, n);
+        if (!has_dot) append(".0");
+        return;
+      }
+    }
+    append(tmp, strlen(tmp));
+  }
+  void append_json_escaped(std::string_view s) {
+    for (char c : s) {
+      switch (c) {
+        case '"': append("\\\""); break;
+        case '\\': append("\\\\"); break;
+        case '\n': append("\\n"); break;
+        case '\r': append("\\r"); break;
+        case '\t': append("\\t"); break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char tmp[8];
+            append(tmp, snprintf(tmp, sizeof(tmp), "\\u%04x", c));
+          } else {
+            push(c);
+          }
+      }
+    }
+  }
+};
+
+// xorshift128+ puid generator (entropy class of the reference's SecureRandom
+// 130-bit id, service/PredictionService.java:77-83; speed matters here).
+struct Rng {
+  uint64_t s0, s1;
+  void seed() {
+    FILE* f = fopen("/dev/urandom", "rb");
+    if (f) {
+      size_t got = fread(&s0, 8, 1, f) + fread(&s1, 8, 1, f);
+      (void)got;
+      fclose(f);
+    }
+    if (!s0) s0 = 0x9e3779b97f4a7c15ull ^ getpid();
+    if (!s1) s1 = 0xbf58476d1ce4e5b9ull ^ (uint64_t)&s0;
+  }
+  uint64_t next() {
+    uint64_t x = s0, y = s1;
+    s0 = y;
+    x ^= x << 23;
+    s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+    return s1 + y;
+  }
+  double uniform() { return (next() >> 11) * (1.0 / 9007199254740992.0); }
+  void puid_hex(char out[33]) {
+    static const char* hex = "0123456789abcdef";
+    uint64_t a = next(), b = next();
+    for (int i = 0; i < 16; ++i) out[i] = hex[(a >> (i * 4)) & 15];
+    for (int i = 0; i < 16; ++i) out[16 + i] = hex[(b >> (i * 4)) & 15];
+    out[32] = 0;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Minimal JSON parser (DOM over string_views into the request buffer).
+// ---------------------------------------------------------------------------
+
+struct JValue;
+using JMember = std::pair<std::string_view, int>;  // key -> node index
+
+struct JValue {
+  enum Type { Null, Bool, Num, Str, Arr, Obj } type = Null;
+  std::string_view raw;     // full span (for verbatim echo)
+  std::string_view sv;      // string contents (unescaped lazily) / number text
+  bool b = false;
+  int first_child = -1;     // Arr/Obj: index into nodes/members
+  int n_children = 0;
+};
+
+struct JDoc {
+  std::vector<JValue> nodes;
+  std::vector<int> arr_items;       // flattened child lists
+  std::vector<JMember> obj_members; // flattened member lists
+  const char* err = nullptr;
+
+  const JValue* get(const JValue& obj, std::string_view key) const {
+    if (obj.type != JValue::Obj) return nullptr;
+    for (int i = 0; i < obj.n_children; ++i) {
+      const auto& m = obj_members[obj.first_child + i];
+      if (m.first == key) return &nodes[m.second];
+    }
+    return nullptr;
+  }
+  const JValue* item(const JValue& arr, int i) const {
+    if (arr.type != JValue::Arr || i >= arr.n_children) return nullptr;
+    return &nodes[arr_items[arr.first_child + i]];
+  }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  JDoc* doc;
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  }
+  bool fail(const char* msg) {
+    if (!doc->err) doc->err = msg;
+    return false;
+  }
+  // returns node index or -1
+  int parse_value() {
+    skip_ws();
+    if (p >= end) return fail("unexpected end"), -1;
+    const char* start = p;
+    int idx = (int)doc->nodes.size();
+    doc->nodes.emplace_back();
+    switch (*p) {
+      case '{': {
+        ++p;
+        std::vector<JMember> members;
+        skip_ws();
+        if (p < end && *p == '}') {
+          ++p;
+        } else {
+          for (;;) {
+            skip_ws();
+            if (p >= end || *p != '"') return fail("expected key"), -1;
+            std::string_view key;
+            if (!parse_string_into(key)) return -1;
+            skip_ws();
+            if (p >= end || *p != ':') return fail("expected ':'"), -1;
+            ++p;
+            int child = parse_value();
+            if (child < 0) return -1;
+            members.push_back({key, child});
+            skip_ws();
+            if (p < end && *p == ',') {
+              ++p;
+              continue;
+            }
+            if (p < end && *p == '}') {
+              ++p;
+              break;
+            }
+            return fail("expected ',' or '}'"), -1;
+          }
+        }
+        JValue& v = doc->nodes[idx];
+        v.type = JValue::Obj;
+        v.first_child = (int)doc->obj_members.size();
+        v.n_children = (int)members.size();
+        for (auto& m : members) doc->obj_members.push_back(m);
+        v.raw = {start, (size_t)(p - start)};
+        return idx;
+      }
+      case '[': {
+        ++p;
+        std::vector<int> items;
+        skip_ws();
+        if (p < end && *p == ']') {
+          ++p;
+        } else {
+          for (;;) {
+            int child = parse_value();
+            if (child < 0) return -1;
+            items.push_back(child);
+            skip_ws();
+            if (p < end && *p == ',') {
+              ++p;
+              continue;
+            }
+            if (p < end && *p == ']') {
+              ++p;
+              break;
+            }
+            return fail("expected ',' or ']'"), -1;
+          }
+        }
+        JValue& v = doc->nodes[idx];
+        v.type = JValue::Arr;
+        v.first_child = (int)doc->arr_items.size();
+        v.n_children = (int)items.size();
+        for (int it : items) doc->arr_items.push_back(it);
+        v.raw = {start, (size_t)(p - start)};
+        return idx;
+      }
+      case '"': {
+        std::string_view s;
+        if (!parse_string_into(s)) return -1;
+        JValue& v = doc->nodes[idx];
+        v.type = JValue::Str;
+        v.sv = s;
+        v.raw = {start, (size_t)(p - start)};
+        return idx;
+      }
+      case 't':
+        if (end - p >= 4 && !memcmp(p, "true", 4)) {
+          p += 4;
+          JValue& v = doc->nodes[idx];
+          v.type = JValue::Bool;
+          v.b = true;
+          v.raw = {start, 4};
+          return idx;
+        }
+        return fail("bad literal"), -1;
+      case 'f':
+        if (end - p >= 5 && !memcmp(p, "false", 5)) {
+          p += 5;
+          JValue& v = doc->nodes[idx];
+          v.type = JValue::Bool;
+          v.raw = {start, 5};
+          return idx;
+        }
+        return fail("bad literal"), -1;
+      case 'n':
+        if (end - p >= 4 && !memcmp(p, "null", 4)) {
+          p += 4;
+          JValue& v = doc->nodes[idx];
+          v.type = JValue::Null;
+          v.raw = {start, 4};
+          return idx;
+        }
+        return fail("bad literal"), -1;
+      default: {
+        const char* q = p;
+        if (q < end && (*q == '-' || *q == '+')) ++q;
+        while (q < end && (isdigit((unsigned char)*q) || *q == '.' || *q == 'e' ||
+                           *q == 'E' || *q == '-' || *q == '+'))
+          ++q;
+        if (q == p) return fail("bad value"), -1;
+        JValue& v = doc->nodes[idx];
+        v.type = JValue::Num;
+        v.sv = {p, (size_t)(q - p)};
+        v.raw = v.sv;
+        p = q;
+        return idx;
+      }
+    }
+  }
+  bool parse_string_into(std::string_view& out) {
+    // *p == '"'
+    ++p;
+    const char* s = p;
+    while (p < end && *p != '"') {
+      if (*p == '\\') ++p;  // skip escaped char (slice keeps escapes; fine for
+                            // keys/compares which are ASCII in our schema)
+      ++p;
+    }
+    if (p >= end) return fail("unterminated string");
+    out = {s, (size_t)(p - s)};
+    ++p;
+    return true;
+  }
+};
+
+bool json_parse(const char* data, size_t len, JDoc& doc) {
+  doc.nodes.clear();
+  doc.arr_items.clear();
+  doc.obj_members.clear();
+  doc.err = nullptr;
+  doc.nodes.reserve(64);
+  JParser parser{data, data + len, &doc};
+  int root = parser.parse_value();
+  if (root < 0) return false;
+  parser.skip_ws();
+  if (parser.p != parser.end) {
+    doc.err = "trailing data";
+    return false;
+  }
+  return true;
+}
+
+double jnum(const JValue& v) { return strtod(std::string(v.sv).c_str(), nullptr); }
+
+// ---------------------------------------------------------------------------
+// Edge program: the natively-executable graph.
+// ---------------------------------------------------------------------------
+
+enum class Kind { SimpleModel, SimpleRouter, RandomABTest, AverageCombiner };
+
+struct Unit {
+  std::string name;
+  Kind kind;
+  std::vector<int> children;
+  double ratioA = 0.5;
+  int n_branches = 2;
+};
+
+struct Program {
+  std::string deployment, predictor;
+  std::vector<Unit> units;
+  int root = -1;
+  bool native = false;  // false => every request goes over the ring
+};
+
+const char* kind_class(Kind k) {
+  switch (k) {
+    case Kind::SimpleModel: return "SimpleModel";
+    case Kind::SimpleRouter: return "SimpleRouter";
+    case Kind::RandomABTest: return "RandomABTest";
+    case Kind::AverageCombiner: return "AverageCombiner";
+  }
+  return "";
+}
+
+bool load_program(const char* path, Program& prog) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  std::string text;
+  char tmp[4096];
+  size_t n;
+  while ((n = fread(tmp, 1, sizeof(tmp), f)) > 0) text.append(tmp, n);
+  fclose(f);
+  JDoc doc;
+  if (!json_parse(text.data(), text.size(), doc)) return false;
+  const JValue& rootv = doc.nodes[0];
+  if (auto* d = doc.get(rootv, "deployment")) prog.deployment = std::string(d->sv);
+  if (auto* d = doc.get(rootv, "predictor")) prog.predictor = std::string(d->sv);
+  auto* nat = doc.get(rootv, "native");
+  prog.native = nat && nat->b;
+  if (!prog.native) return true;
+  auto* units = doc.get(rootv, "units");
+  auto* rootidx = doc.get(rootv, "root");
+  if (!units || !rootidx) return false;
+  for (int i = 0; i < units->n_children; ++i) {
+    const JValue& u = *doc.item(*units, i);
+    Unit unit;
+    if (auto* v = doc.get(u, "name")) unit.name = std::string(v->sv);
+    std::string kind;
+    if (auto* v = doc.get(u, "kind")) kind = std::string(v->sv);
+    if (kind == "SIMPLE_MODEL") unit.kind = Kind::SimpleModel;
+    else if (kind == "SIMPLE_ROUTER") unit.kind = Kind::SimpleRouter;
+    else if (kind == "RANDOM_ABTEST") unit.kind = Kind::RandomABTest;
+    else if (kind == "AVERAGE_COMBINER") unit.kind = Kind::AverageCombiner;
+    else return false;
+    if (auto* v = doc.get(u, "ratioA")) unit.ratioA = jnum(*v);
+    if (auto* v = doc.get(u, "nBranches")) unit.n_branches = (int)jnum(*v);
+    if (auto* v = doc.get(u, "children"))
+      for (int c = 0; c < v->n_children; ++c)
+        unit.children.push_back((int)jnum(*doc.item(*v, c)));
+    prog.units.push_back(std::move(unit));
+  }
+  prog.root = (int)jnum(*rootidx);
+  return prog.root >= 0 && prog.root < (int)prog.units.size();
+}
+
+// ---------------------------------------------------------------------------
+// Metrics (Prometheus text exposition; name parity with metrics/registry.py)
+// ---------------------------------------------------------------------------
+
+constexpr double kBuckets[] = {0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                               0.05,   0.1,   0.25,   0.5,   1.0,  2.5, 5.0};
+constexpr int kNBuckets = sizeof(kBuckets) / sizeof(kBuckets[0]);
+
+struct Histo {
+  uint64_t bucket[kNBuckets + 1] = {};
+  double sum = 0;
+  uint64_t count = 0;
+  void observe(double v) {
+    for (int i = 0; i < kNBuckets; ++i)
+      if (v <= kBuckets[i]) ++bucket[i];
+    ++bucket[kNBuckets];
+    sum += v;
+    ++count;
+  }
+};
+
+struct Metrics {
+  std::string deployment, predictor;
+  // method/code counters for the engine API
+  std::unordered_map<std::string, uint64_t> api;  // "method|code"
+  std::unordered_map<std::string, Histo> latency; // method
+  uint64_t feedback_events = 0;
+  double feedback_reward = 0;
+  // in-band custom metrics from builtin units
+  double mycounter = 0;
+  double mygauge = 0;
+  Histo mytimer;
+  uint64_t custom_seen = 0;
+
+  void observe_api(const char* method, int code, double secs) {
+    char key[64];
+    snprintf(key, sizeof(key), "%s|%d", method, code);
+    ++api[key];
+    latency[method].observe(secs);
+  }
+  void labels(Buf& b, const char* extra = nullptr) {
+    b.append("{deployment_name=\"");
+    b.append_json_escaped(deployment);
+    b.append("\",predictor_name=\"");
+    b.append_json_escaped(predictor);
+    b.push('"');
+    if (extra) {
+      b.push(',');
+      b.append(extra);
+    }
+    b.push('}');
+  }
+  void expose(Buf& b) {
+    b.append("# HELP seldon_api_executor_server_requests_total API requests by method and code\n");
+    b.append("# TYPE seldon_api_executor_server_requests_total counter\n");
+    for (auto& [key, count] : api) {
+      auto bar = key.find('|');
+      char extra[96];
+      snprintf(extra, sizeof(extra), "method=\"%s\",code=\"%s\"",
+               key.substr(0, bar).c_str(), key.substr(bar + 1).c_str());
+      b.append("seldon_api_executor_server_requests_total");
+      labels(b, extra);
+      b.push(' ');
+      b.append_double((double)count);
+      b.push('\n');
+    }
+    b.append("# HELP seldon_api_executor_server_requests_seconds API latency\n");
+    b.append("# TYPE seldon_api_executor_server_requests_seconds histogram\n");
+    for (auto& [method, h] : latency) {
+      uint64_t cum = 0;
+      for (int i = 0; i <= kNBuckets; ++i) {
+        cum = h.bucket[i];
+        char extra[96];
+        if (i < kNBuckets)
+          snprintf(extra, sizeof(extra), "method=\"%s\",le=\"%g\"", method.c_str(), kBuckets[i]);
+        else
+          snprintf(extra, sizeof(extra), "method=\"%s\",le=\"+Inf\"", method.c_str());
+        b.append("seldon_api_executor_server_requests_seconds_bucket");
+        labels(b, extra);
+        b.push(' ');
+        b.append_u64(cum);
+        b.push('\n');
+      }
+      char extra[96];
+      snprintf(extra, sizeof(extra), "method=\"%s\"", method.c_str());
+      b.append("seldon_api_executor_server_requests_seconds_sum");
+      labels(b, extra);
+      b.push(' ');
+      b.append_double(h.sum);
+      b.push('\n');
+      b.append("seldon_api_executor_server_requests_seconds_count");
+      labels(b, extra);
+      b.push(' ');
+      b.append_u64(h.count);
+      b.push('\n');
+    }
+    b.append("# TYPE seldon_api_model_feedback_total counter\n");
+    b.append("seldon_api_model_feedback_total");
+    labels(b);
+    b.push(' ');
+    b.append_double((double)feedback_events);
+    b.push('\n');
+    b.append("# TYPE seldon_api_model_feedback_reward_total counter\n");
+    b.append("seldon_api_model_feedback_reward_total");
+    labels(b);
+    b.push(' ');
+    b.append_double(feedback_reward);
+    b.push('\n');
+    if (custom_seen) {
+      b.append("# TYPE mycounter_total counter\nmycounter_total ");
+      b.append_double(mycounter);
+      b.append("\n# TYPE mygauge gauge\nmygauge ");
+      b.append_double(mygauge);
+      b.append("\n# TYPE mytimer histogram\nmytimer_sum ");
+      b.append_double(mytimer.sum);
+      b.append("\nmytimer_count ");
+      b.append_u64(mytimer.count);
+      b.push('\n');
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Native graph execution
+// ---------------------------------------------------------------------------
+
+enum class PKind { None, NDArray, Tensor, Str, Bin, Json };
+
+struct Payload {
+  PKind kind = PKind::None;
+  int64_t rows = 0;
+  std::string_view echo;  // raw span for strData/binData (with escapes)
+};
+
+struct ExecOut {
+  // collected while walking
+  std::vector<std::pair<std::string_view, int>> routing;  // router name -> branch
+  std::vector<std::pair<std::string_view, const char*>> path;  // unit -> class
+  int model_visits = 0;
+  Kind owner = Kind::SimpleModel;  // flow-final payload owner
+  Payload out;
+  const char* err = nullptr;
+  int err_code = 0;
+  const char* err_reason = nullptr;
+  std::string err_info;
+};
+
+struct EdgeError {
+  int code;
+  const char* reason;
+  std::string info;
+};
+
+// Recursive eval; returns flow-final payload owner kind.
+bool eval_unit(const Program& prog, int idx, Rng& rng, Payload in, ExecOut& out,
+               Payload& result, Kind& owner) {
+  const Unit& u = prog.units[idx];
+  switch (u.kind) {
+    case Kind::SimpleModel: {
+      Payload mine;
+      if (in.kind == PKind::Str || in.kind == PKind::Bin) {
+        mine = in;  // echo (SimpleModelUnit echoes bytes/str)
+      } else if (in.kind == PKind::NDArray || in.kind == PKind::Tensor) {
+        mine.kind = in.kind;
+        mine.rows = in.rows;
+      } else if (in.kind == PKind::Json) {
+        out.err_code = 500;
+        out.err_reason = "INTERNAL_ERROR";
+        out.err_info = "jsonData payload is not numeric";
+        return false;
+      } else {
+        out.err_code = 400;
+        out.err_reason = "MICROSERVICE_BAD_DATA";
+        out.err_info =
+            "Unknown data type returned as payload (must be array, list, str, "
+            "bytes or dict): NoneType";
+        return false;
+      }
+      ++out.model_visits;
+      Payload final_out = mine;
+      Kind sub_owner = Kind::SimpleModel;
+      if (!u.children.empty()) {
+        if (!eval_unit(prog, u.children[0], rng, mine, out, final_out, sub_owner))
+          return false;
+      }
+      out.path.push_back({u.name, kind_class(u.kind)});
+      result = final_out;
+      owner = u.children.empty() ? Kind::SimpleModel : sub_owner;
+      return true;
+    }
+    case Kind::SimpleRouter:
+    case Kind::RandomABTest: {
+      int branch = 0;
+      if (u.kind == Kind::RandomABTest) {
+        if (u.n_branches == 2)
+          branch = rng.uniform() < u.ratioA ? 0 : 1;
+        else
+          branch = (int)(rng.uniform() * u.n_branches) % u.n_branches;
+      }
+      if (branch >= (int)u.children.size()) {
+        out.err_code = 500;
+        out.err_reason = "BAD_ROUTING";
+        out.err_info = "router returned branch outside children";
+        return false;
+      }
+      out.routing.push_back({u.name, branch});
+      if (!eval_unit(prog, u.children[branch], rng, in, out, result, owner))
+        return false;
+      out.path.push_back({u.name, kind_class(u.kind)});
+      return true;
+    }
+    case Kind::AverageCombiner: {
+      if (in.kind == PKind::Str || in.kind == PKind::Bin || in.kind == PKind::Json) {
+        out.err_code = 500;
+        out.err_reason = "INTERNAL_ERROR";
+        out.err_info = "AverageCombiner requires numeric child outputs";
+        return false;
+      }
+      Payload merged;
+      Kind sub_owner;
+      for (size_t i = 0; i < u.children.size(); ++i) {
+        Payload child_out;
+        if (!eval_unit(prog, u.children[i], rng, in, out, child_out, sub_owner))
+          return false;
+        if (i == 0) merged = child_out;
+        else if (child_out.rows != merged.rows) {
+          out.err_code = 500;
+          out.err_reason = "INTERNAL_ERROR";
+          out.err_info = "AverageCombiner inputs must share a shape";
+          return false;
+        }
+      }
+      if (u.children.empty()) merged = in;
+      out.path.push_back({u.name, kind_class(u.kind)});
+      result = merged;
+      owner = Kind::AverageCombiner;
+      return true;
+    }
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------------------
+// HTTP layer
+// ---------------------------------------------------------------------------
+
+struct RingPending {
+  int conn_fd;
+  uint32_t conn_gen;  // guards against kernel fd-number reuse
+  uint64_t started_ns;
+  bool is_feedback;
+};
+
+struct Conn {
+  int fd = -1;
+  uint32_t gen = 0;  // bumped on close so stale ring responses can't match
+  Buf in;
+  Buf outbuf;
+  size_t out_off = 0;
+  bool want_close = false;
+  bool waiting_ring = false;  // response will come from the ring
+};
+
+uint64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+struct Server {
+  Program prog;
+  Metrics metrics;
+  Rng rng;
+  bool paused = false;
+  std::string openapi;  // served at /seldon.json when provided
+
+  // ring fallback
+  void* req_ring = nullptr;
+  void* resp_ring = nullptr;
+  uint32_t ring_slot = 0;
+  uint32_t next_req_id = 1;
+  std::unordered_map<uint32_t, RingPending> pending;
+  uint16_t ring_worker_id = 0;
+  std::vector<char> ring_buf;  // reused drain buffer (slot-sized)
+  static constexpr uint64_t kRingTimeoutNs = 30ull * 1000000000ull;
+
+  std::vector<Conn> conns;
+  int epfd = -1;
+  int timer_fd = -1;
+  bool timer_armed = false;
+
+  Conn& conn(int fd) {
+    if ((size_t)fd >= conns.size()) conns.resize(fd + 1);
+    return conns[(size_t)fd];
+  }
+
+  // ---- response helpers ----
+  void http_head(Buf& b, int code, const char* text, size_t body_len,
+                 const char* ctype, bool close_conn) {
+    b.append("HTTP/1.1 ");
+    b.append_i64(code);
+    b.push(' ');
+    b.append(text);
+    b.append("\r\nContent-Type: ");
+    b.append(ctype);
+    b.append("\r\nContent-Length: ");
+    b.append_u64(body_len);
+    if (close_conn) b.append("\r\nConnection: close");
+    b.append("\r\n\r\n");
+  }
+  void respond(Conn& c, int code, const char* text, std::string_view body,
+               const char* ctype = "application/json; charset=utf-8") {
+    http_head(c.outbuf, code, text, body.size(), ctype, c.want_close);
+    c.outbuf.append(body);
+  }
+  void respond_error(Conn& c, int code, const char* reason, std::string_view info) {
+    Buf body;
+    body.append("{\"status\": {\"code\": ");
+    body.append_i64(code);
+    body.append(", \"info\": \"");
+    body.append_json_escaped(info);
+    body.append("\", \"reason\": \"");
+    body.append(reason);
+    body.append("\", \"status\": \"FAILURE\"}}");
+    const char* text = code == 400 ? "Bad Request"
+                       : code == 404 ? "Not Found"
+                       : code == 405 ? "Method Not Allowed"
+                       : code == 413 ? "Payload Too Large"
+                       : code == 503 ? "Service Unavailable"
+                       : code == 504 ? "Gateway Timeout"
+                                     : "Internal Server Error";
+    respond(c, code, text, {body.data(), body.size()});
+  }
+
+  // ---- predictions ----
+  void handle_predictions(Conn& c, std::string_view body, uint64_t t0) {
+    if (paused) {
+      respond(c, 503, "Service Unavailable",
+              "{\"status\": {\"code\": 503, \"info\": \"paused\", \"status\": \"FAILURE\"}}");
+      metrics.observe_api("predictions", 503, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    if (!prog.native) {
+      forward_ring(c, 0, body, t0);
+      return;
+    }
+    JDoc doc;
+    if (!json_parse(body.data(), body.size(), doc)) {
+      std::string info = std::string("Invalid JSON body: ") + (doc.err ? doc.err : "parse error");
+      respond_error(c, 400, "MICROSERVICE_BAD_DATA", info);
+      metrics.observe_api("predictions", 400, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    const JValue& root = doc.nodes[0];
+    if (root.type != JValue::Obj) {
+      respond_error(c, 400, "MICROSERVICE_BAD_DATA", "request must be a JSON object");
+      metrics.observe_api("predictions", 400, 1e-9 * (now_ns() - t0));
+      return;
+    }
+
+    // --- decode request payload ---
+    Payload in;
+    const JValue* data = doc.get(root, "data");
+    const JValue* strData = doc.get(root, "strData");
+    const JValue* binData = doc.get(root, "binData");
+    const JValue* jsonData = doc.get(root, "jsonData");
+    const JValue* tensor = nullptr;
+    if (data && data->type == JValue::Obj) {
+      if (auto* nd = doc.get(*data, "ndarray")) {
+        in.kind = PKind::NDArray;
+        if (nd->type != JValue::Arr) {
+          respond_error(c, 400, "MICROSERVICE_BAD_DATA", "ndarray must be an array");
+          metrics.observe_api("predictions", 400, 1e-9 * (now_ns() - t0));
+          return;
+        }
+        // rows = len(ndarray) if 2-D else 1
+        bool two_d = nd->n_children > 0 && doc.item(*nd, 0)->type == JValue::Arr;
+        in.rows = two_d ? nd->n_children : 1;
+      } else if ((tensor = doc.get(*data, "tensor"))) {
+        in.kind = PKind::Tensor;
+        const JValue* shape = doc.get(*tensor, "shape");
+        const JValue* values = doc.get(*tensor, "values");
+        int64_t prod = 1, r = 1;
+        if (shape && shape->type == JValue::Arr && shape->n_children > 0) {
+          for (int i = 0; i < shape->n_children; ++i) {
+            int64_t d = (int64_t)jnum(*doc.item(*shape, i));
+            prod *= d;
+            if (i == 0) r = d;
+          }
+        } else {
+          r = 1;
+          prod = values ? values->n_children : 0;
+        }
+        int64_t nvals = values ? values->n_children : 0;
+        if (prod != nvals) {
+          char msg[128];
+          snprintf(msg, sizeof(msg), "tensor values do not fit shape: %" PRId64
+                   " values for %" PRId64 " elements", nvals, prod);
+          respond_error(c, 400, "MICROSERVICE_BAD_DATA", msg);
+          metrics.observe_api("predictions", 400, 1e-9 * (now_ns() - t0));
+          return;
+        }
+        in.rows = shape && shape->n_children >= 2 ? r : 1;
+      }
+    } else if (strData) {
+      in.kind = PKind::Str;
+      in.echo = strData->sv;
+    } else if (binData) {
+      in.kind = PKind::Bin;
+      in.echo = binData->sv;
+    } else if (jsonData) {
+      in.kind = PKind::Json;
+    }
+
+    // --- run the graph ---
+    ExecOut ex;
+    Payload result;
+    Kind owner;
+    if (!eval_unit(prog, prog.root, rng, in, ex, result, owner)) {
+      respond_error(c, ex.err_code, ex.err_reason, ex.err_info);
+      metrics.observe_api("predictions", ex.err_code, 1e-9 * (now_ns() - t0));
+      return;
+    }
+
+    // --- response meta ---
+    const JValue* meta = doc.get(root, "meta");
+    std::string_view req_puid;
+    const JValue* req_tags = nullptr;
+    const JValue* req_routing = nullptr;
+    const JValue* req_path = nullptr;
+    const JValue* req_metrics = nullptr;
+    if (meta && meta->type == JValue::Obj) {
+      if (auto* v = doc.get(*meta, "puid")) req_puid = v->sv;
+      if (auto* v = doc.get(*meta, "tags")) req_tags = v;
+      if (auto* v = doc.get(*meta, "routing")) req_routing = v;
+      if (auto* v = doc.get(*meta, "requestPath")) req_path = v;
+      if (auto* v = doc.get(*meta, "metrics")) req_metrics = v;
+    }
+    char puid[33];
+    if (req_puid.empty()) rng.puid_hex(puid);
+
+    Buf& b = c.outbuf;
+    Buf body_buf;
+    body_buf.append("{\"meta\": {\"puid\": \"");
+    if (req_puid.empty()) body_buf.append(puid, 32);
+    else body_buf.append(req_puid);
+    body_buf.push('"');
+    if (req_tags && req_tags->n_children > 0) {
+      body_buf.append(", \"tags\": ");
+      body_buf.append(req_tags->raw);
+    }
+    if (!ex.routing.empty() || (req_routing && req_routing->n_children > 0)) {
+      body_buf.append(", \"routing\": {");
+      bool first = true;
+      for (auto& [name, branch] : ex.routing) {
+        if (!first) body_buf.append(", ");
+        first = false;
+        body_buf.push('"');
+        body_buf.append(name);
+        body_buf.append("\": ");
+        body_buf.append_i64(branch);
+      }
+      if (req_routing) {
+        for (int i = 0; i < req_routing->n_children; ++i) {
+          const auto& m = doc.obj_members[req_routing->first_child + i];
+          bool dup = false;
+          for (auto& [name, _] : ex.routing)
+            if (name == m.first) dup = true;
+          if (dup) continue;
+          if (!first) body_buf.append(", ");
+          first = false;
+          body_buf.push('"');
+          body_buf.append(m.first);
+          body_buf.append("\": ");
+          body_buf.append(doc.nodes[m.second].raw);
+        }
+      }
+      body_buf.push('}');
+    }
+    body_buf.append(", \"requestPath\": {");
+    {
+      bool first = true;
+      if (req_path) {
+        for (int i = 0; i < req_path->n_children; ++i) {
+          const auto& m = doc.obj_members[req_path->first_child + i];
+          bool dup = false;
+          for (auto& [name, _] : ex.path)
+            if (name == m.first) dup = true;
+          if (dup) continue;
+          if (!first) body_buf.append(", ");
+          first = false;
+          body_buf.push('"');
+          body_buf.append(m.first);
+          body_buf.append("\": ");
+          body_buf.append(doc.nodes[m.second].raw);
+        }
+      }
+      for (auto& [name, cls] : ex.path) {
+        if (!first) body_buf.append(", ");
+        first = false;
+        body_buf.push('"');
+        body_buf.append(name);
+        body_buf.append("\": \"");
+        body_buf.append(cls);
+        body_buf.push('"');
+      }
+    }
+    body_buf.push('}');
+    if (ex.model_visits > 0 || (req_metrics && req_metrics->n_children > 0)) {
+      // Engine merge order (runtime/engine.py _merge_meta + fused path):
+      // flow-owner's metrics, then request-carried metrics, then the other
+      // executed units' metrics.
+      body_buf.append(", \"metrics\": [");
+      bool first = true;
+      static const char* kModelMetrics =
+          "{\"key\": \"mycounter\", \"type\": \"COUNTER\", \"value\": 1.0}, "
+          "{\"key\": \"mygauge\", \"type\": \"GAUGE\", \"value\": 100.0}, "
+          "{\"key\": \"mytimer\", \"type\": \"TIMER\", \"value\": 20.6}";
+      int remaining = ex.model_visits;
+      if (owner != Kind::AverageCombiner && remaining > 0) {
+        body_buf.append(kModelMetrics);
+        first = false;
+        --remaining;
+      }
+      if (req_metrics) {
+        for (int i = 0; i < req_metrics->n_children; ++i) {
+          if (!first) body_buf.append(", ");
+          first = false;
+          body_buf.append(doc.item(*req_metrics, i)->raw);
+        }
+      }
+      for (int i = 0; i < remaining; ++i) {
+        if (!first) body_buf.append(", ");
+        first = false;
+        body_buf.append(kModelMetrics);
+      }
+      body_buf.push(']');
+    }
+    body_buf.push('}');
+
+    // --- response payload ---
+    static const char* kRowVals =
+        "0.10000000149011612, 0.8999999761581421, 0.5";
+    if (result.kind == PKind::Str) {
+      body_buf.append(", \"strData\": \"");
+      body_buf.append(result.echo);
+      body_buf.push('"');
+    } else if (result.kind == PKind::Bin) {
+      body_buf.append(", \"binData\": \"");
+      body_buf.append(result.echo);
+      body_buf.push('"');
+    } else if (result.kind == PKind::NDArray || result.kind == PKind::Tensor) {
+      body_buf.append(", \"data\": {\"names\": ");
+      if (owner == Kind::AverageCombiner)
+        body_buf.append("[\"t:0\", \"t:1\", \"t:2\"]");
+      else
+        body_buf.append("[\"class0\", \"class1\", \"class2\"]");
+      if (result.kind == PKind::NDArray) {
+        body_buf.append(", \"ndarray\": [");
+        for (int64_t r = 0; r < result.rows; ++r) {
+          if (r) body_buf.append(", ");
+          body_buf.push('[');
+          body_buf.append(kRowVals);
+          body_buf.push(']');
+        }
+        body_buf.append("]}");
+      } else {
+        body_buf.append(", \"tensor\": {\"shape\": [");
+        body_buf.append_i64(result.rows);
+        body_buf.append(", 3], \"values\": [");
+        for (int64_t r = 0; r < result.rows; ++r) {
+          if (r) body_buf.append(", ");
+          body_buf.append(kRowVals);
+        }
+        body_buf.append("]}}");
+      }
+    }
+    body_buf.push('}');
+
+    http_head(b, 200, "OK", body_buf.size(), "application/json; charset=utf-8",
+              c.want_close);
+    b.append(body_buf.data(), body_buf.size());
+    // custom metrics as the Python registry would register them
+    metrics.mycounter += ex.model_visits;
+    if (ex.model_visits) {
+      metrics.mygauge = 100.0;
+      for (int i = 0; i < ex.model_visits; ++i) metrics.mytimer.observe(20.6 / 1000.0);
+      metrics.custom_seen += ex.model_visits;
+    }
+    metrics.observe_api("predictions", 200, 1e-9 * (now_ns() - t0));
+  }
+
+  void handle_feedback(Conn& c, std::string_view body, uint64_t t0) {
+    if (!prog.native) {
+      forward_ring(c, 1, body, t0);
+      return;
+    }
+    JDoc doc;
+    if (!json_parse(body.data(), body.size(), doc)) {
+      respond_error(c, 400, "MICROSERVICE_BAD_DATA", "Invalid JSON body");
+      metrics.observe_api("feedback", 400, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    double reward = 0;
+    if (doc.nodes[0].type == JValue::Obj)
+      if (auto* r = doc.get(doc.nodes[0], "reward")) reward = jnum(*r);
+    ++metrics.feedback_events;
+    if (reward != 0) metrics.feedback_reward += reward < 0 ? -reward : reward;
+    respond(c, 200, "OK", "{\"meta\": {}}");
+    metrics.observe_api("feedback", 200, 1e-9 * (now_ns() - t0));
+  }
+
+  // ---- ring fallback ----
+  void forward_ring(Conn& c, uint8_t kind, std::string_view body, uint64_t t0) {
+    const char* method = kind == 1 ? "feedback" : "predictions";
+    if (!req_ring || !resp_ring) {
+      respond_error(c, 500, "INTERNAL_ERROR", "no native program and no engine ring");
+      metrics.observe_api(method, 500, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    uint32_t req_id = next_req_id++;
+    // frame: u16 worker | u32 req_id | u8 kind | body  (transport/ipc.py)
+    std::vector<char> frame(7 + body.size());
+    memcpy(frame.data(), &ring_worker_id, 2);
+    memcpy(frame.data() + 2, &req_id, 4);
+    frame[6] = (char)kind;
+    memcpy(frame.data() + 7, body.data(), body.size());
+    int rc = scr_push(req_ring, frame.data(), (uint32_t)frame.size());
+    if (rc != 0) {
+      respond_error(c, rc == -2 ? 413 : 503,
+                    rc == -2 ? "PAYLOAD_TOO_LARGE" : "ENGINE_BUSY",
+                    rc == -2 ? "request larger than ring slot" : "engine request ring full");
+      metrics.observe_api(method, rc == -2 ? 413 : 503, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    c.waiting_ring = true;
+    pending[req_id] = {c.fd, c.gen, t0, kind == 1};
+    arm_timer();
+  }
+
+  void arm_timer() {
+    if (timer_armed) return;
+    itimerspec its{};
+    its.it_interval.tv_nsec = 200000;  // 200us poll while work in flight
+    its.it_value.tv_nsec = 200000;
+    timerfd_settime(timer_fd, 0, &its, nullptr);
+    timer_armed = true;
+  }
+  void disarm_timer() {
+    if (!timer_armed) return;
+    itimerspec its{};
+    timerfd_settime(timer_fd, 0, &its, nullptr);
+    timer_armed = false;
+  }
+
+  void drain_ring_responses() {
+    if (!resp_ring) return;
+    if (ring_buf.size() < ring_slot) ring_buf.resize(ring_slot);
+    for (;;) {
+      int len = scr_pop(resp_ring, ring_buf.data(), ring_slot);
+      if (len < 0) break;
+      if (len < 5) continue;
+      uint32_t req_id;
+      memcpy(&req_id, ring_buf.data(), 4);
+      uint8_t status = (uint8_t)ring_buf[4];
+      auto it = pending.find(req_id);
+      if (it == pending.end()) continue;
+      RingPending rp = it->second;
+      pending.erase(it);
+      Conn& c = conn(rp.conn_fd);
+      if (c.fd != rp.conn_fd || c.gen != rp.conn_gen)
+        continue;  // connection closed (and possibly fd reused) meanwhile
+      c.waiting_ring = false;
+      std::string_view body{ring_buf.data() + 5, (size_t)len - 5};
+      if (status == 0) {
+        respond(c, 200, "OK", body);
+      } else {
+        // body is {"status": {...}} from the Python engine
+        respond(c, 500, "Internal Server Error", body);
+      }
+      metrics.observe_api(rp.is_feedback ? "feedback" : "predictions",
+                          status == 0 ? 200 : 500, 1e-9 * (now_ns() - rp.started_ns));
+      flush_out(c);
+      if (c.fd >= 0 && c.in.size() > 0) process_in(c);  // pipelined requests
+    }
+    // Engine gone or stalled: time out waiters so connections don't hang and
+    // the poll timer doesn't spin forever.
+    uint64_t now = now_ns();
+    for (auto it = pending.begin(); it != pending.end();) {
+      if (now - it->second.started_ns < kRingTimeoutNs) {
+        ++it;
+        continue;
+      }
+      RingPending rp = it->second;
+      it = pending.erase(it);
+      Conn& c = conn(rp.conn_fd);
+      if (c.fd == rp.conn_fd && c.gen == rp.conn_gen) {
+        c.waiting_ring = false;
+        respond_error(c, 504, "ENGINE_TIMEOUT", "engine did not answer within deadline");
+        metrics.observe_api(rp.is_feedback ? "feedback" : "predictions", 504,
+                            1e-9 * (now - rp.started_ns));
+        flush_out(c);
+      }
+    }
+    if (pending.empty()) disarm_timer();
+  }
+
+  // ---- request routing ----
+  void dispatch(Conn& c, std::string_view method, std::string_view path,
+                std::string_view body) {
+    uint64_t t0 = now_ns();
+    if (path == "/api/v0.1/predictions" || path == "/predict") {
+      if (method != "POST") return respond_error(c, 405, "METHOD_NOT_ALLOWED", "use POST");
+      return handle_predictions(c, body, t0);
+    }
+    if (path == "/api/v0.1/feedback" || path == "/send-feedback") {
+      if (method != "POST") return respond_error(c, 405, "METHOD_NOT_ALLOWED", "use POST");
+      return handle_feedback(c, body, t0);
+    }
+    if (path == "/ready") {
+      if (paused) return respond(c, 503, "Service Unavailable", "not ready", "text/plain; charset=utf-8");
+      return respond(c, 200, "OK", "ready", "text/plain; charset=utf-8");
+    }
+    if (path == "/live") return respond(c, 200, "OK", "live", "text/plain; charset=utf-8");
+    if (path == "/ping") return respond(c, 200, "OK", "pong", "text/plain; charset=utf-8");
+    if (path == "/pause") {
+      paused = true;
+      return respond(c, 200, "OK", "paused", "text/plain; charset=utf-8");
+    }
+    if (path == "/unpause") {
+      paused = false;
+      return respond(c, 200, "OK", "unpaused", "text/plain; charset=utf-8");
+    }
+    if (path == "/metrics" || path == "/prometheus") {
+      Buf b;
+      metrics.expose(b);
+      return respond(c, 200, "OK", {b.data(), b.size()}, "text/plain; charset=utf-8");
+    }
+    if (path == "/seldon.json" && !openapi.empty())
+      return respond(c, 200, "OK", openapi);
+    respond_error(c, 404, "NOT_FOUND", "no such route");
+  }
+
+  // ---- connection I/O ----
+  void flush_out(Conn& c) {
+    while (c.out_off < c.outbuf.size()) {
+      ssize_t n = ::send(c.fd, c.outbuf.data() + c.out_off,
+                         c.outbuf.size() - c.out_off, MSG_NOSIGNAL);
+      if (n > 0) {
+        c.out_off += (size_t)n;
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.fd = c.fd;
+        epoll_ctl(epfd, EPOLL_CTL_MOD, c.fd, &ev);
+        return;
+      }
+      close_conn(c);
+      return;
+    }
+    c.outbuf.clear();
+    c.out_off = 0;
+    if (c.want_close) close_conn(c);
+  }
+
+  void close_conn(Conn& c) {
+    if (c.fd < 0) return;
+    epoll_ctl(epfd, EPOLL_CTL_DEL, c.fd, nullptr);
+    ::close(c.fd);
+    c.fd = -1;
+    ++c.gen;
+    c.in.clear();
+    c.outbuf.clear();
+    c.out_off = 0;
+    c.want_close = false;
+    c.waiting_ring = false;
+  }
+
+  // Try to parse and handle complete requests in c.in; returns when more
+  // bytes are needed.
+  void process_in(Conn& c) {
+    for (;;) {
+      if (c.waiting_ring) return;  // one request at a time when ring-pending
+      std::string_view data{c.in.data(), c.in.size()};
+      size_t hdr_end = data.find("\r\n\r\n");
+      if (hdr_end == std::string_view::npos) {
+        if (data.size() > (1u << 20)) close_conn(c);
+        return;
+      }
+      std::string_view head = data.substr(0, hdr_end);
+      size_t line_end = head.find("\r\n");
+      std::string_view req_line = head.substr(0, line_end == std::string_view::npos ? head.size() : line_end);
+      size_t sp1 = req_line.find(' ');
+      size_t sp2 = req_line.rfind(' ');
+      if (sp1 == std::string_view::npos || sp2 == sp1) {
+        close_conn(c);
+        return;
+      }
+      std::string_view method = req_line.substr(0, sp1);
+      std::string_view target = req_line.substr(sp1 + 1, sp2 - sp1 - 1);
+      size_t q = target.find('?');
+      std::string_view path = q == std::string_view::npos ? target : target.substr(0, q);
+      // headers we care about
+      size_t content_len = 0;
+      bool close_hdr = false;
+      size_t pos = line_end == std::string_view::npos ? head.size() : line_end + 2;
+      while (pos < head.size()) {
+        size_t eol = head.find("\r\n", pos);
+        std::string_view line = head.substr(pos, (eol == std::string_view::npos ? head.size() : eol) - pos);
+        pos = eol == std::string_view::npos ? head.size() : eol + 2;
+        size_t colon = line.find(':');
+        if (colon == std::string_view::npos) continue;
+        std::string_view name = line.substr(0, colon);
+        std::string_view value = line.substr(colon + 1);
+        while (!value.empty() && value.front() == ' ') value.remove_prefix(1);
+        if (name.size() == 14 && strncasecmp(name.data(), "content-length", 14) == 0)
+          content_len = strtoul(std::string(value).c_str(), nullptr, 10);
+        else if (name.size() == 10 && strncasecmp(name.data(), "connection", 10) == 0)
+          close_hdr = value.size() == 5 && strncasecmp(value.data(), "close", 5) == 0;
+      }
+      size_t total = hdr_end + 4 + content_len;
+      if (data.size() < total) return;  // need more body bytes
+      std::string_view body = data.substr(hdr_end + 4, content_len);
+      c.want_close = close_hdr;
+      dispatch(c, method, path, body);
+      // consume the request
+      size_t remaining = data.size() - total;
+      if (remaining > 0) memmove(c.in.v.data(), c.in.v.data() + total, remaining);
+      c.in.v.resize(remaining);
+      if (!c.waiting_ring) flush_out(c);
+      if (c.fd < 0) return;
+      if (remaining == 0) return;
+    }
+  }
+
+  void on_readable(Conn& c) {
+    char tmp[65536];
+    for (;;) {
+      ssize_t n = ::recv(c.fd, tmp, sizeof(tmp), 0);
+      if (n > 0) {
+        c.in.append(tmp, (size_t)n);
+        if (n < (ssize_t)sizeof(tmp)) break;
+        continue;
+      }
+      if (n == 0) {
+        close_conn(c);
+        return;
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      close_conn(c);
+      return;
+    }
+    process_in(c);
+  }
+
+  int run(const char* host, int port) {
+    signal(SIGPIPE, SIG_IGN);
+    int lfd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    addr.sin_addr.s_addr = INADDR_ANY;
+    if (host) {
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
+        fprintf(stderr, "cannot resolve host %s\n", host);
+        return 1;
+      }
+      addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
+    if (bind(lfd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+      perror("bind");
+      return 1;
+    }
+    if (listen(lfd, 1024) != 0) {
+      perror("listen");
+      return 1;
+    }
+    epfd = epoll_create1(0);
+    timer_fd = timerfd_create(CLOCK_MONOTONIC, TFD_NONBLOCK);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = lfd;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, lfd, &ev);
+    ev.data.fd = timer_fd;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, timer_fd, &ev);
+    fprintf(stderr, "seldon-edge listening on %s:%d (native=%d)\n",
+            host ? host : "0.0.0.0", port, prog.native ? 1 : 0);
+
+    std::vector<epoll_event> events(256);
+    for (;;) {
+      int n = epoll_wait(epfd, events.data(), (int)events.size(), -1);
+      for (int i = 0; i < n; ++i) {
+        int fd = events[i].data.fd;
+        if (fd == lfd) {
+          for (;;) {
+            int cfd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+            if (cfd < 0) break;
+            int off = 1;
+            setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &off, sizeof(off));
+            Conn& c = conn(cfd);
+            c.fd = cfd;
+            c.in.clear();
+            c.outbuf.clear();
+            c.out_off = 0;
+            c.want_close = false;
+            c.waiting_ring = false;
+            epoll_event cev{};
+            cev.events = EPOLLIN;
+            cev.data.fd = cfd;
+            epoll_ctl(epfd, EPOLL_CTL_ADD, cfd, &cev);
+          }
+          continue;
+        }
+        if (fd == timer_fd) {
+          uint64_t expirations;
+          while (read(timer_fd, &expirations, 8) == 8) {
+          }
+          drain_ring_responses();
+          continue;
+        }
+        Conn& c = conn(fd);
+        if (c.fd != fd) continue;
+        if (events[i].events & (EPOLLHUP | EPOLLERR)) {
+          close_conn(c);
+          continue;
+        }
+        if (events[i].events & EPOLLOUT) {
+          epoll_event cev{};
+          cev.events = EPOLLIN;
+          cev.data.fd = fd;
+          epoll_ctl(epfd, EPOLL_CTL_MOD, fd, &cev);
+          flush_out(c);
+          if (c.fd < 0) continue;
+        }
+        if (events[i].events & EPOLLIN) on_readable(c);
+      }
+    }
+    return 0;
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* program_path = nullptr;
+  const char* ring_base = nullptr;
+  const char* openapi_path = nullptr;
+  const char* host = nullptr;
+  int port = 8000;
+  int workers = 1;
+  int ring_worker = 0;
+  for (int i = 1; i < argc; ++i) {
+    std::string_view a = argv[i];
+    auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : nullptr; };
+    if (a == "--program") program_path = next();
+    else if (a == "--port") port = atoi(next());
+    else if (a == "--host") host = next();
+    else if (a == "--ring") ring_base = next();
+    else if (a == "--ring-worker") ring_worker = atoi(next());
+    else if (a == "--openapi") openapi_path = next();
+    else if (a == "--workers") workers = atoi(next());
+    else {
+      fprintf(stderr, "unknown arg %s\n", argv[i]);
+      return 2;
+    }
+  }
+  if (!program_path) {
+    fprintf(stderr,
+            "usage: seldon_edge --program prog.json [--port N] [--host H] "
+            "[--ring BASE] [--ring-worker W] [--openapi FILE] [--workers N]\n");
+    return 2;
+  }
+
+  // SO_REUSEPORT worker processes (linear scaling on multi-core hosts);
+  // parent and children all run an event loop on the shared port.
+  for (int w = 1; w < workers; ++w) {
+    pid_t pid = fork();
+    if (pid == 0) break;  // child proceeds to serve
+    if (pid < 0) return 1;
+  }
+
+  Server srv;
+  srv.rng.seed();
+  if (!load_program(program_path, srv.prog)) {
+    fprintf(stderr, "cannot load program %s\n", program_path);
+    return 1;
+  }
+  srv.metrics.deployment = srv.prog.deployment;
+  srv.metrics.predictor = srv.prog.predictor;
+  if (openapi_path) {
+    FILE* f = fopen(openapi_path, "rb");
+    if (f) {
+      char tmp[8192];
+      size_t n;
+      while ((n = fread(tmp, 1, sizeof(tmp), f)) > 0) srv.openapi.append(tmp, n);
+      fclose(f);
+    }
+  }
+  if (ring_base) {
+    std::string req = std::string(ring_base) + ".req";
+    std::string resp = std::string(ring_base) + ".resp." + std::to_string(ring_worker);
+    srv.req_ring = scr_attach(req.c_str());
+    srv.resp_ring = scr_attach(resp.c_str());
+    srv.ring_worker_id = (uint16_t)ring_worker;
+    if (!srv.req_ring || !srv.resp_ring) {
+      fprintf(stderr, "cannot attach rings at %s\n", ring_base);
+      return 1;
+    }
+    srv.ring_slot = (uint32_t)scr_slot_size(srv.resp_ring);
+  }
+  return srv.run(host, port);
+}

@@ -1,0 +1,213 @@
+// Closed-loop keep-alive HTTP load generator (the reference drives its
+// benchmark with a distributed locust fleet, util/loadtester/scripts/
+// predict_rest_locust.py:17-53; on a single host the equivalent pressure
+// needs a compiled client — Python asyncio cannot generate >10k rps/core).
+//
+// N connections, each with exactly one request in flight (locust-style
+// closed loop). Reports throughput + latency percentiles as one JSON line.
+
+#include <algorithm>
+#include <cerrno>
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <ctime>
+
+namespace {
+
+uint64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+struct Conn {
+  int fd = -1;
+  std::string inbuf;
+  size_t sent = 0;
+  uint64_t t_send = 0;
+  bool in_flight = false;
+};
+
+struct Stats {
+  std::vector<uint32_t> lat_us;
+  uint64_t ok = 0, errors = 0, bytes = 0;
+};
+
+int connect_nonblock(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
+    fprintf(stderr, "cannot resolve host %s\n", host);
+    close(fd);
+    return -1;
+  }
+  addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  return fd;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* host = "127.0.0.1";
+  int port = 8000;
+  const char* path = "/api/v0.1/predictions";
+  std::string body = "{\"data\": {\"ndarray\": [[1.0, 2.0, 3.0, 4.0]]}}";
+  int connections = 32;
+  double duration_s = 10.0, warmup_s = 1.0;
+  const char* label = "rest";
+  for (int i = 1; i < argc; ++i) {
+    std::string_view a = argv[i];
+    auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "--host") host = next();
+    else if (a == "--port") port = atoi(next());
+    else if (a == "--path") path = next();
+    else if (a == "--body") body = next();
+    else if (a == "--body-file") {
+      FILE* f = fopen(next(), "rb");
+      if (!f) { perror("body-file"); return 2; }
+      body.clear();
+      char tmp[4096];
+      size_t n;
+      while ((n = fread(tmp, 1, sizeof(tmp), f)) > 0) body.append(tmp, n);
+      fclose(f);
+    } else if (a == "--connections") connections = atoi(next());
+    else if (a == "--duration") duration_s = atof(next());
+    else if (a == "--warmup") warmup_s = atof(next());
+    else if (a == "--label") label = next();
+    else { fprintf(stderr, "unknown arg %s\n", argv[i]); return 2; }
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  char reqbuf[65536];
+  int reqlen = snprintf(reqbuf, sizeof(reqbuf),
+                        "POST %s HTTP/1.1\r\nHost: %s:%d\r\nContent-Type: "
+                        "application/json\r\nContent-Length: %zu\r\n\r\n%s",
+                        path, host, port, body.size(), body.c_str());
+  if (reqlen <= 0 || reqlen >= (int)sizeof(reqbuf)) {
+    fprintf(stderr, "request too large\n");
+    return 2;
+  }
+
+  std::vector<Conn> conns(connections);
+  int epfd = epoll_create1(0);
+  for (int i = 0; i < connections; ++i) {
+    conns[i].fd = connect_nonblock(host, port);
+    if (conns[i].fd < 0) {
+      fprintf(stderr, "connect failed\n");
+      return 1;
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = (uint32_t)i;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, conns[i].fd, &ev);
+  }
+
+  Stats stats;
+  stats.lat_us.reserve(1 << 20);
+  uint64_t t_start = now_ns();
+  uint64_t t_measure = t_start + (uint64_t)(warmup_s * 1e9);
+  uint64_t t_end = t_measure + (uint64_t)(duration_s * 1e9);
+  bool measuring = warmup_s <= 0;
+
+  auto send_req = [&](Conn& c) {
+    c.t_send = now_ns();
+    c.in_flight = true;
+    ssize_t n = ::send(c.fd, reqbuf, reqlen, MSG_NOSIGNAL);
+    (void)n;  // closed loop on loopback: the request fits the socket buffer
+  };
+  for (auto& c : conns) send_req(c);
+
+  std::vector<epoll_event> events(256);
+  char rbuf[65536];
+  for (;;) {
+    uint64_t now = now_ns();
+    if (now >= t_end) break;
+    if (!measuring && now >= t_measure) {
+      measuring = true;
+      stats.ok = stats.errors = stats.bytes = 0;
+      stats.lat_us.clear();
+    }
+    int n = epoll_wait(epfd, events.data(), (int)events.size(), 100);
+    for (int i = 0; i < n; ++i) {
+      Conn& c = conns[events[i].data.u32];
+      ssize_t got = ::recv(c.fd, rbuf, sizeof(rbuf), 0);
+      if (got <= 0) {
+        if (got < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) continue;
+        fprintf(stderr, "connection lost\n");
+        return 1;
+      }
+      c.inbuf.append(rbuf, (size_t)got);
+      // complete response? headers + content-length body
+      for (;;) {
+        size_t hdr_end = c.inbuf.find("\r\n\r\n");
+        if (hdr_end == std::string::npos) break;
+        size_t clpos = c.inbuf.find("Content-Length:");
+        if (clpos == std::string::npos || clpos > hdr_end) break;
+        size_t content_len = strtoul(c.inbuf.c_str() + clpos + 15, nullptr, 10);
+        size_t total = hdr_end + 4 + content_len;
+        if (c.inbuf.size() < total) break;
+        bool ok = c.inbuf.compare(0, 12, "HTTP/1.1 200") == 0;
+        uint64_t lat = now_ns() - c.t_send;
+        if (measuring) {
+          if (ok) ++stats.ok;
+          else ++stats.errors;
+          stats.bytes += total;
+          stats.lat_us.push_back((uint32_t)(lat / 1000));
+        }
+        c.inbuf.erase(0, total);
+        c.in_flight = false;
+        send_req(c);
+      }
+    }
+  }
+  double elapsed = 1e-9 * (now_ns() - t_measure);
+  std::sort(stats.lat_us.begin(), stats.lat_us.end());
+  auto pct = [&](double p) -> double {
+    if (stats.lat_us.empty()) return 0;
+    size_t idx = (size_t)(p / 100.0 * stats.lat_us.size());
+    if (idx >= stats.lat_us.size()) idx = stats.lat_us.size() - 1;
+    return stats.lat_us[idx] / 1000.0;  // ms
+  };
+  double mean = 0;
+  for (auto v : stats.lat_us) mean += v;
+  mean = stats.lat_us.empty() ? 0 : mean / stats.lat_us.size() / 1000.0;
+  printf("{\"label\": \"%s\", \"throughput_rps\": %.2f, \"requests\": %" PRIu64
+         ", \"failures\": %" PRIu64
+         ", \"duration_s\": %.2f, \"connections\": %d, \"latency_ms\": "
+         "{\"mean\": %.3f, \"p50\": %.3f, \"p75\": %.3f, \"p90\": %.3f, "
+         "\"p95\": %.3f, \"p98\": %.3f, \"p99\": %.3f, \"max\": %.3f}}\n",
+         label, (stats.ok + stats.errors) / elapsed, stats.ok, stats.errors,
+         elapsed, connections, mean, pct(50), pct(75), pct(90), pct(95),
+         pct(98), pct(99),
+         stats.lat_us.empty() ? 0 : stats.lat_us.back() / 1000.0);
+  return stats.errors == 0 ? 0 : 3;
+}

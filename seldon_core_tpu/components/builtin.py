@@ -44,7 +44,9 @@ class SimpleModel(SeldonComponent):
     def _fn(params: Any, x):
         import jax.numpy as jnp
 
-        rows = x.shape[0] if x.ndim >= 1 else 1
+        # row semantics must match the host path above: a 1-D payload is one
+        # sample, not shape[0] samples
+        rows = x.shape[0] if x.ndim >= 2 else 1
         out = jnp.tile(jnp.asarray(SimpleModel.values, dtype=jnp.float32), (rows, 1))
         return out
 

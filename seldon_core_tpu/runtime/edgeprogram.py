@@ -1,0 +1,107 @@
+"""Edge-program compiler: PredictorSpec -> native edge graph program.
+
+The control plane compiles inference graphs whose every unit is a builtin
+(the reference's in-engine hardcoded units, `engine/src/main/java/io/seldon/
+engine/predictors/PredictorConfigBean.java:77-82`) into a compact JSON
+program that the native edge server (native/edge.cc) executes without
+touching Python — the compiled-orchestrator hot path that the reference gets
+from its Java engine. Graphs with any other unit (JAX models, remote
+endpoints, stateful routers) return None and are served by the Python engine
+behind the edge's shared-memory-ring fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from seldon_core_tpu.contracts.graph import (
+    PredictiveUnit,
+    PredictorSpec,
+    UnitImplementation,
+)
+
+_NATIVE_KINDS = {
+    UnitImplementation.SIMPLE_MODEL: "SIMPLE_MODEL",
+    UnitImplementation.SIMPLE_ROUTER: "SIMPLE_ROUTER",
+    UnitImplementation.RANDOM_ABTEST: "RANDOM_ABTEST",
+    UnitImplementation.AVERAGE_COMBINER: "AVERAGE_COMBINER",
+}
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native"
+)
+EDGE_BINARY = os.path.join(_NATIVE_DIR, "build", "seldon_edge")
+LOADGEN_BINARY = os.path.join(_NATIVE_DIR, "build", "seldon_loadgen")
+
+
+def build_edge_binaries() -> bool:
+    """Build the native edge/loadgen if needed; False when no toolchain."""
+    if os.path.exists(EDGE_BINARY) and os.path.exists(LOADGEN_BINARY):
+        src = max(
+            os.path.getmtime(os.path.join(_NATIVE_DIR, f))
+            for f in ("edge.cc", "ring.cc", "loadgen_http.cc")
+        )
+        if min(os.path.getmtime(EDGE_BINARY), os.path.getmtime(LOADGEN_BINARY)) >= src:
+            return True
+    if shutil.which("make") is None:
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True, capture_output=True)
+        return True
+    except subprocess.CalledProcessError:
+        return False
+
+
+def compile_edge_program(
+    spec: PredictorSpec, deployment: str = "", predictor: str = ""
+) -> Optional[Dict[str, Any]]:
+    """Return the native edge program for this graph, or None if any unit
+    cannot execute natively (the edge then runs in ring-fallback mode)."""
+    units: List[Dict[str, Any]] = []
+
+    def compile_unit(unit: PredictiveUnit) -> Optional[int]:
+        kind = _NATIVE_KINDS.get(unit.implementation)
+        if kind is None:
+            return None
+        params = unit.parameters_dict()
+        children: List[int] = []
+        for child in unit.children:
+            idx = compile_unit(child)
+            if idx is None:
+                return None
+            children.append(idx)
+        out: Dict[str, Any] = {"name": unit.name, "kind": kind, "children": children}
+        if kind == "RANDOM_ABTEST":
+            out["ratioA"] = float(params.get("ratioA", 0.5))
+            out["nBranches"] = int(params.get("n_branches", 2))
+        units.append(out)
+        return len(units) - 1
+
+    root = compile_unit(spec.graph)
+    if root is None:
+        return None
+    return {
+        "deployment": deployment,
+        "predictor": predictor or spec.name,
+        "native": True,
+        "units": units,
+        "root": root,
+    }
+
+
+def fallback_program(spec: PredictorSpec, deployment: str = "", predictor: str = "") -> Dict[str, Any]:
+    return {
+        "deployment": deployment,
+        "predictor": predictor or spec.name,
+        "native": False,
+    }
+
+
+def write_program(program: Dict[str, Any], path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(program, f)
+    return path

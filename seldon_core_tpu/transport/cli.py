@@ -101,27 +101,13 @@ def run_microservice(args: argparse.Namespace) -> None:
 
 def run_engine(args: argparse.Namespace) -> None:
     setup_logging()
-    from seldon_core_tpu.contracts.graph import load_predictor_spec_from_env
     from seldon_core_tpu.metrics.registry import MetricsRegistry
     from seldon_core_tpu.runtime.engine import GraphEngine
     from seldon_core_tpu.transport.rest import make_engine_app, serve
 
-    spec = None
-    if args.spec:
-        from seldon_core_tpu.contracts.graph import PredictorSpec
-
-        with open(args.spec) as f:
-            spec = PredictorSpec.from_dict(json.load(f))
-    else:
-        spec = load_predictor_spec_from_env()
-    if spec is None:
-        # Default single SIMPLE_MODEL spec, as the reference engine does when
-        # unconfigured (`EnginePredictor.java:122-141`).
-        from seldon_core_tpu.contracts.graph import PredictorSpec
-
-        spec = PredictorSpec.from_dict(
-            {"name": "default", "graph": {"name": "simple", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
-        )
+    # Spec from file, ENGINE_PREDICTOR env, or the default SIMPLE_MODEL the
+    # reference engine uses when unconfigured (`EnginePredictor.java:122-141`).
+    spec = _load_spec(args.spec)
     engine = GraphEngine(spec)
     metrics = MetricsRegistry(predictor=spec.name)
     port = args.port or int(os.environ.get("ENGINE_SERVER_PORT", "8000"))
@@ -145,6 +131,139 @@ def run_engine(args: argparse.Namespace) -> None:
         asyncio.run(server.serve_forever())
     else:
         serve(make_engine_app(engine, metrics=metrics), host=args.host, port=port)
+
+
+def _load_spec(path: Optional[str]):
+    from seldon_core_tpu.contracts.graph import PredictorSpec, load_predictor_spec_from_env
+
+    if path:
+        with open(path) as f:
+            return PredictorSpec.from_dict(json.load(f))
+    spec = load_predictor_spec_from_env()
+    if spec is None:
+        spec = PredictorSpec.from_dict(
+            {"name": "default", "graph": {"name": "simple", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
+        )
+    return spec
+
+
+def run_edge(args: argparse.Namespace) -> None:
+    """Serve a predictor graph behind the native edge (native/edge.cc).
+
+    All-builtin graphs compile to an edge program and execute entirely in the
+    compiled edge process; anything else keeps the edge as the HTTP frontend
+    with this process running the Python/XLA engine behind the shared-memory
+    ring (the reference's engine-pod split, collapsed onto one host)."""
+    import subprocess
+    import tempfile
+
+    setup_logging()
+    from seldon_core_tpu.runtime.edgeprogram import (
+        EDGE_BINARY,
+        build_edge_binaries,
+        compile_edge_program,
+        fallback_program,
+        write_program,
+    )
+
+    if not build_edge_binaries():
+        raise SystemExit("native toolchain unavailable; use `engine` instead")
+    spec = _load_spec(args.spec)
+    deployment = os.environ.get("DEPLOYMENT_NAME", "")
+    program = compile_edge_program(spec, deployment=deployment)
+    port = args.port or int(os.environ.get("ENGINE_SERVER_PORT", "8000"))
+    tmp = tempfile.mkdtemp(prefix="seldon-edge-")
+    openapi_path = os.path.join(tmp, "openapi.json")
+    from seldon_core_tpu.transport.openapi import engine_spec
+
+    with open(openapi_path, "w") as f:
+        json.dump(engine_spec(), f)
+
+    if program is not None:
+        prog_path = write_program(program, os.path.join(tmp, "program.json"))
+        logger.info("graph compiled natively; edge serving on port %d", port)
+        os.execv(
+            EDGE_BINARY,
+            [
+                EDGE_BINARY, "--program", prog_path, "--port", str(port),
+                "--openapi", openapi_path, "--workers", str(args.workers),
+            ],
+        )
+
+    # Fallback: Python engine behind the ring, edge as frontend.
+    import asyncio
+
+    from seldon_core_tpu.runtime.engine import GraphEngine
+    from seldon_core_tpu.transport.ipc import IPCEngineServer, cleanup_rings
+
+    prog_path = write_program(
+        fallback_program(spec, deployment=deployment), os.path.join(tmp, "program.json")
+    )
+    engine = GraphEngine(spec)
+    base = args.ipc_base or os.path.join(tmp, "ring")
+    # One edge process per worker, each with its own response ring (an edge's
+    # internal fork cannot be used here: forked loops would race on one ring).
+    n_workers = max(1, args.workers)
+    server = IPCEngineServer(engine, base, n_workers=n_workers)
+    edges = [
+        subprocess.Popen(
+            [
+                EDGE_BINARY, "--program", prog_path, "--port", str(port),
+                "--ring", base, "--ring-worker", str(w), "--openapi", openapi_path,
+            ]
+        )
+        for w in range(n_workers)
+    ]
+    logger.info(
+        "graph needs the Python engine; %d edge frontend(s) on port %d, ring %s",
+        n_workers, port, base,
+    )
+
+    async def run():
+        serve_task = asyncio.ensure_future(server.serve_forever())
+        try:
+            while all(e.poll() is None for e in edges):
+                await asyncio.sleep(0.2)
+        finally:
+            server.stop()
+            await serve_task
+
+    try:
+        asyncio.run(run())
+    finally:
+        for e in edges:
+            if e.poll() is None:
+                e.terminate()
+        cleanup_rings(base, n_workers)
+
+
+def run_loadtest_native(args: argparse.Namespace) -> None:
+    """Drive the native closed-loop loadgen and (optionally) write the
+    benchmark report the driver/judge reads."""
+    import subprocess
+
+    from seldon_core_tpu.runtime.edgeprogram import LOADGEN_BINARY, build_edge_binaries
+
+    if not build_edge_binaries():
+        raise SystemExit("native toolchain unavailable")
+    cmd = [
+        LOADGEN_BINARY, "--host", args.host, "--port", str(args.port),
+        "--connections", str(args.connections), "--duration", str(args.duration),
+        "--warmup", str(args.warmup), "--label", args.label,
+    ]
+    if args.body:
+        cmd += ["--body", args.body]
+    if args.path:
+        cmd += ["--path", args.path]
+    out = subprocess.run(cmd, capture_output=True, text=True)
+    sys.stdout.write(out.stdout)
+    sys.stderr.write(out.stderr)
+    if out.returncode not in (0, 3):
+        raise SystemExit(out.returncode)
+    if args.report:
+        report = json.loads(out.stdout.strip().splitlines()[-1])
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2)
 
 
 def run_render(args: argparse.Namespace) -> None:
@@ -232,6 +351,25 @@ def main(argv: Optional[list] = None) -> None:
     rl.add_argument("--port", type=int, default=2222)
     rl.add_argument("--host", default="0.0.0.0")
     rl.set_defaults(func=run_request_logger)
+
+    edge = sub.add_parser("edge", help="serve a graph behind the native C++ edge")
+    edge.add_argument("--spec", default=None, help="path to PredictorSpec JSON")
+    edge.add_argument("--port", type=int, default=None)
+    edge.add_argument("--workers", type=int, default=1, help="SO_REUSEPORT event loops")
+    edge.add_argument("--ipc-base", default=None, help="ring path base for fallback mode")
+    edge.set_defaults(func=run_edge)
+
+    ltn = sub.add_parser("loadtest-native", help="native closed-loop load generator")
+    ltn.add_argument("host")
+    ltn.add_argument("port", type=int)
+    ltn.add_argument("--connections", type=int, default=32)
+    ltn.add_argument("--duration", type=float, default=10.0)
+    ltn.add_argument("--warmup", type=float, default=1.0)
+    ltn.add_argument("--body", default=None)
+    ltn.add_argument("--path", default=None)
+    ltn.add_argument("--label", default="rest")
+    ltn.add_argument("--report", default=None, help="write JSON report to this file")
+    ltn.set_defaults(func=run_loadtest_native)
 
     lt = sub.add_parser("loadtest", help="async load generator (locust equivalent)")
     lt.add_argument("host")

@@ -65,10 +65,15 @@ class IPCEngineServer:
         self.engine = engine
         self.base_path = base_path
         self.batch = batch
-        # sweep temp files orphaned by a previous creator killed mid-create
+        # sweep temp files orphaned by a previous creator killed mid-create;
+        # glob per exact ring path so a sibling base sharing this prefix
+        # (e.g. "<base>2") is never touched mid-create
         import glob
 
-        for stale in glob.glob(base_path + "*.tmp.*"):
+        ring_paths = [request_ring_path(base_path)] + [
+            response_ring_path(base_path, w) for w in range(n_workers)
+        ]
+        for stale in (t for p in ring_paths for t in glob.glob(p + ".tmp.*")):
             try:
                 os.unlink(stale)
             except OSError:

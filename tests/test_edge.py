@@ -1,0 +1,289 @@
+"""Native edge server (native/edge.cc): response parity with the Python
+engine, error paths, drain, metrics, and the ring-fallback mode.
+
+The edge is the compiled orchestrator hot path (reference parity: the Java
+engine's in-process stub units, `engine/.../SimpleModelUnit.java:33-64`,
+behind `RestClientController.java:76-245`); these tests hold it to the Python
+engine's exact response contract.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+from seldon_core_tpu.contracts.graph import PredictorSpec
+from seldon_core_tpu.contracts.payload import SeldonMessage
+from seldon_core_tpu.runtime.edgeprogram import (
+    EDGE_BINARY,
+    build_edge_binaries,
+    compile_edge_program,
+    write_program,
+)
+from seldon_core_tpu.runtime.engine import GraphEngine
+
+pytestmark = pytest.mark.skipif(not build_edge_binaries(), reason="no C++ toolchain")
+
+SINGLE = {"name": "p", "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
+AB_FORCED = {
+    "name": "p",
+    "graph": {
+        "name": "ab", "type": "ROUTER", "implementation": "RANDOM_ABTEST",
+        "parameters": [{"name": "ratioA", "value": "1.0", "type": "FLOAT"}],
+        "children": [
+            {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+            {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+        ],
+    },
+}
+COMBINER = {
+    "name": "p",
+    "graph": {
+        "name": "c", "type": "COMBINER", "implementation": "AVERAGE_COMBINER",
+        "children": [
+            {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+            {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+        ],
+    },
+}
+CHAIN = {
+    "name": "p",
+    "graph": {
+        "name": "m1", "type": "MODEL", "implementation": "SIMPLE_MODEL",
+        "children": [{"name": "m2", "type": "MODEL", "implementation": "SIMPLE_MODEL"}],
+    },
+}
+
+REQUESTS = [
+    {"data": {"ndarray": [[1.0, 2.0], [3.0, 4.0]]}},
+    {"data": {"ndarray": [1.0, 2.0]}},
+    {"data": {"tensor": {"shape": [2, 2], "values": [1.0, 2.0, 3.0, 4.0]}}},
+    {"strData": "hello"},
+    {"binData": "aGVsbG8="},
+    {
+        "meta": {"puid": "PUID123", "tags": {"t1": "v", "n": 5}, "routing": {"x": 7},
+                 "requestPath": {"x": "X"},
+                 "metrics": [{"key": "k", "type": "GAUGE", "value": 1.5}]},
+        "data": {"ndarray": [[1.0]]},
+    },
+    {"data": {"names": ["f1", "f2"], "ndarray": [[1.0, 2.0]]}},
+]
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def post(port, path, body, timeout=10.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode() if isinstance(body, dict) else body,
+        method="POST",
+    )
+
+    def decode(raw):
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            return raw
+
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, decode(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, decode(e.read())
+
+
+def get(port, path, timeout=10.0):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def wait_ready(port, proc, deadline_s=30.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, "edge process died"
+        try:
+            status, _ = get(port, "/live", timeout=1.0)
+            if status == 200:
+                return
+        except Exception:
+            time.sleep(0.05)
+    raise AssertionError("edge never became live")
+
+
+@pytest.fixture(scope="module")
+def edge(tmp_path_factory):
+    """One edge process per graph, torn down at module end."""
+    procs = {}
+    tmp = tmp_path_factory.mktemp("edge")
+
+    def start(key, spec_dict):
+        if key in procs:
+            return procs[key][1]
+        spec = PredictorSpec.from_dict(spec_dict)
+        program = compile_edge_program(spec)
+        assert program is not None
+        path = write_program(program, str(tmp / f"{key}.json"))
+        port = free_port()
+        proc = subprocess.Popen(
+            [EDGE_BINARY, "--program", path, "--port", str(port)],
+            stderr=subprocess.DEVNULL,
+        )
+        wait_ready(port, proc)
+        procs[key] = (proc, port)
+        return port
+
+    yield start
+    for proc, _ in procs.values():
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def strip_puid(d):
+    d = json.loads(json.dumps(d))
+    if "meta" in d:
+        d["meta"].pop("puid", None)
+    return d
+
+
+@pytest.mark.parametrize("graph_key,spec", [
+    ("single", SINGLE), ("ab", AB_FORCED), ("comb", COMBINER), ("chain", CHAIN),
+])
+@pytest.mark.parametrize("req_idx", range(len(REQUESTS)))
+def test_parity_with_python_engine(edge, graph_key, spec, req_idx):
+    """Edge responses must match the Python engine response-for-response."""
+    from seldon_core_tpu.contracts.payload import SeldonError
+
+    req = REQUESTS[req_idx]
+    engine = GraphEngine(PredictorSpec.from_dict(spec))
+    port = edge(graph_key, spec)
+    try:
+        expected = engine.predict_sync(SeldonMessage.from_dict(json.loads(json.dumps(req))))
+    except Exception as e:
+        # Python raised: the edge must report the same failure class
+        # (SeldonError keeps its status code; anything else is a 500)
+        want = e.status_code if isinstance(e, SeldonError) else 500
+        status, got = post(port, "/api/v0.1/predictions", req)
+        assert status == want
+        assert got["status"]["status"] == "FAILURE"
+        return
+    status, got = post(port, "/api/v0.1/predictions", req)
+    assert status == 200
+    assert strip_puid(got) == strip_puid(expected.to_dict())
+    if (req.get("meta") or {}).get("puid"):
+        assert got["meta"]["puid"] == req["meta"]["puid"]
+    else:
+        assert len(got["meta"]["puid"]) == 32
+
+
+def test_error_paths(edge):
+    port = edge("single", SINGLE)
+    status, body = post(port, "/api/v0.1/predictions", b"not json")
+    assert status == 400 and body["status"]["reason"] == "MICROSERVICE_BAD_DATA"
+    status, body = post(port, "/api/v0.1/predictions", {})
+    assert status == 400 and "Unknown data type" in body["status"]["info"]
+    status, body = post(
+        port, "/api/v0.1/predictions", {"data": {"tensor": {"shape": [2, 2], "values": [1.0]}}}
+    )
+    assert status == 400 and "tensor values do not fit shape" in body["status"]["info"]
+    status, body = post(port, "/api/v0.1/predictions", {"jsonData": {"a": 1}})
+    assert status == 500
+
+
+def test_feedback_and_metrics(edge):
+    port = edge("single", SINGLE)
+    status, body = post(
+        port, "/api/v0.1/feedback",
+        {"request": {"data": {"ndarray": [[1.0]]}}, "response": {"meta": {}}, "reward": 0.5},
+    )
+    assert status == 200 and body == {"meta": {}}
+    post(port, "/api/v0.1/predictions", {"data": {"ndarray": [[1.0]]}})
+    status, text = get(port, "/metrics")
+    text = text.decode()
+    assert status == 200
+    assert "seldon_api_executor_server_requests_total" in text
+    assert "seldon_api_model_feedback_total" in text
+    assert "mycounter_total" in text
+
+
+def test_pause_drain(edge):
+    port = edge("single", SINGLE)
+    try:
+        assert get(port, "/ready")[0] == 200
+        assert get(port, "/ping")[1] == b"pong"
+        status, _ = post(port, "/pause", {})
+        assert status == 200
+        assert get(port, "/ready")[0] == 503
+        status, body = post(port, "/api/v0.1/predictions", {"data": {"ndarray": [[1.0]]}})
+        assert status == 503 and body["status"]["info"] == "paused"
+    finally:
+        post(port, "/unpause", {})
+    assert get(port, "/ready")[0] == 200
+    status, _ = post(port, "/api/v0.1/predictions", {"data": {"ndarray": [[1.0]]}})
+    assert status == 200
+
+
+def test_keepalive_many_requests(edge):
+    """One connection, many sequential requests (keep-alive reuse)."""
+    import http.client
+
+    port = edge("single", SINGLE)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    body = json.dumps({"data": {"ndarray": [[1.0]]}})
+    puids = set()
+    for _ in range(200):
+        conn.request("POST", "/predict", body)
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200
+        puids.add(out["meta"]["puid"])
+    conn.close()
+    assert len(puids) == 200  # unique puid per request
+
+
+def test_fallback_mode_serves_python_engine(tmp_path):
+    """A graph the edge cannot compile (stateful bandit router) is served by
+    the Python engine behind the shared-memory ring, edge as frontend."""
+    spec = {
+        "name": "p",
+        "graph": {
+            "name": "eg", "type": "ROUTER", "implementation": "EPSILON_GREEDY",
+            "parameters": [{"name": "n_branches", "value": "2", "type": "INT"}],
+            "children": [
+                {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+            ],
+        },
+    }
+    assert compile_edge_program(PredictorSpec.from_dict(spec)) is None
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(spec))
+    port = free_port()
+    env = dict(os.environ)
+    proc = subprocess.Popen(
+        ["python", "-m", "seldon_core_tpu.transport.cli", "edge",
+         "--spec", str(spec_path), "--port", str(port)],
+        env=env, stderr=subprocess.DEVNULL,
+    )
+    try:
+        wait_ready(port, proc, deadline_s=60)
+        status, got = post(port, "/api/v0.1/predictions", {"data": {"ndarray": [[1.0]]}}, timeout=30)
+        assert status == 200
+        assert got["meta"]["routing"]["eg"] in (0, 1)
+        assert got["meta"]["tags"]["bandit"] == "EpsilonGreedy"
+        assert got["data"]["ndarray"][0] == pytest.approx([0.1, 0.9, 0.5], rel=1e-6)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
