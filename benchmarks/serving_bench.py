@@ -113,12 +113,14 @@ def bench_grpc(duration: float) -> dict | None:
     with open(prog, "w") as f:
         json.dump(SINGLE_PROGRAM, f)
     port = free_port()
+    http_port = free_port()  # explicit: the edge always opens an HTTP listener
     edge = subprocess.Popen(
-        [EDGE_BINARY, "--program", prog, "--grpc-port", str(port)],
+        [EDGE_BINARY, "--program", prog, "--port", str(http_port),
+         "--grpc-port", str(port)],
         stderr=subprocess.DEVNULL,
     )
     try:
-        time.sleep(0.5)
+        wait_live(http_port)
         runs = [run_loadgen(port, c, duration, f"grpc-stub-{c}c", grpc=True)
                 for c in (16, 64, 128)]
     finally:

@@ -34,6 +34,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <string>
 #include <string_view>
 #include <unordered_map>
@@ -128,7 +129,7 @@ struct Buf {
 // xorshift128+ puid generator (entropy class of the reference's SecureRandom
 // 130-bit id, service/PredictionService.java:77-83; speed matters here).
 struct Rng {
-  uint64_t s0, s1;
+  uint64_t s0 = 0, s1 = 0;
   void seed() {
     FILE* f = fopen("/dev/urandom", "rb");
     if (f) {
@@ -684,6 +685,383 @@ bool eval_unit(const Program& prog, int idx, Rng& rng, Payload in, ExecOut& out,
 }
 
 // ---------------------------------------------------------------------------
+// HPACK (RFC 7541) — decoder without Huffman. grpc-c encodes header literals
+// raw (verified against the grpcio in this image); a Huffman-coded :path is
+// rejected with a stream error rather than misrouted.
+// ---------------------------------------------------------------------------
+
+static const char* kHpackStatic[62][2] = {
+    {"", ""},  // 1-based
+    {":authority", ""}, {":method", "GET"}, {":method", "POST"}, {":path", "/"},
+    {":path", "/index.html"}, {":scheme", "http"}, {":scheme", "https"},
+    {":status", "200"}, {":status", "204"}, {":status", "206"},
+    {":status", "304"}, {":status", "400"}, {":status", "404"},
+    {":status", "500"}, {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"}, {"accept-language", ""},
+    {"accept-ranges", ""}, {"accept", ""}, {"access-control-allow-origin", ""},
+    {"age", ""}, {"allow", ""}, {"authorization", ""}, {"cache-control", ""},
+    {"content-disposition", ""}, {"content-encoding", ""},
+    {"content-language", ""}, {"content-length", ""}, {"content-location", ""},
+    {"content-range", ""}, {"content-type", ""}, {"cookie", ""}, {"date", ""},
+    {"etag", ""}, {"expect", ""}, {"expires", ""}, {"from", ""}, {"host", ""},
+    {"if-match", ""}, {"if-modified-since", ""}, {"if-none-match", ""},
+    {"if-range", ""}, {"if-unmodified-since", ""}, {"last-modified", ""},
+    {"link", ""}, {"location", ""}, {"max-forwards", ""},
+    {"proxy-authenticate", ""}, {"proxy-authorization", ""}, {"range", ""},
+    {"referer", ""}, {"refresh", ""}, {"retry-after", ""}, {"server", ""},
+    {"set-cookie", ""}, {"strict-transport-security", ""},
+    {"transfer-encoding", ""}, {"user-agent", ""}, {"vary", ""}, {"via", ""},
+    {"www-authenticate", ""},
+};
+constexpr uint64_t kHpackStaticCount = 61;
+
+struct HpackDyn {
+  std::vector<std::pair<std::string, std::string>> entries;  // front = newest
+  size_t bytes = 0;
+  size_t cap = 4096;
+
+  void add(std::string name, std::string value) {
+    size_t sz = name.size() + value.size() + 32;
+    entries.insert(entries.begin(), {std::move(name), std::move(value)});
+    bytes += sz;
+    evict();
+  }
+  void set_cap(size_t c) {
+    cap = c;
+    evict();
+  }
+  void evict() {
+    while (bytes > cap && !entries.empty()) {
+      auto& e = entries.back();
+      bytes -= e.first.size() + e.second.size() + 32;
+      entries.pop_back();
+    }
+  }
+  bool get(uint64_t idx, std::string& name, std::string& value) const {
+    if (idx >= 1 && idx <= kHpackStaticCount) {
+      name = kHpackStatic[idx][0];
+      value = kHpackStatic[idx][1];
+      return true;
+    }
+    uint64_t d = idx - kHpackStaticCount - 1;
+    if (d < entries.size()) {
+      name = entries[d].first;
+      value = entries[d].second;
+      return true;
+    }
+    return false;
+  }
+};
+
+bool hpack_int(const uint8_t*& p, const uint8_t* end, int prefix, uint64_t& out) {
+  if (p >= end) return false;
+  uint64_t max_prefix = (1u << prefix) - 1;
+  out = *p & max_prefix;
+  ++p;
+  if (out < max_prefix) return true;
+  int shift = 0;
+  while (p < end) {
+    uint8_t b = *p++;
+    out += (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) return true;
+    shift += 7;
+    if (shift > 56) return false;
+  }
+  return false;
+}
+
+// Decoded field; value_huffman marks values we could not decode.
+struct HpackField {
+  std::string name, value;
+  bool value_huffman = false;
+};
+
+bool hpack_string(const uint8_t*& p, const uint8_t* end, std::string& out,
+                  bool& huffman) {
+  if (p >= end) return false;
+  huffman = (*p & 0x80) != 0;
+  uint64_t len;
+  if (!hpack_int(p, end, 7, len)) return false;
+  if ((uint64_t)(end - p) < len) return false;
+  out.assign((const char*)p, len);  // raw bytes (encoded if huffman)
+  p += len;
+  return true;
+}
+
+bool hpack_decode(const uint8_t* p, const uint8_t* end, HpackDyn& dyn,
+                  std::vector<HpackField>& out) {
+  while (p < end) {
+    uint8_t b = *p;
+    if (b & 0x80) {  // indexed
+      uint64_t idx;
+      if (!hpack_int(p, end, 7, idx)) return false;
+      HpackField f;
+      if (!dyn.get(idx, f.name, f.value)) return false;
+      out.push_back(std::move(f));
+    } else if ((b & 0xc0) == 0x40) {  // literal, incremental indexing
+      uint64_t idx;
+      if (!hpack_int(p, end, 6, idx)) return false;
+      HpackField f;
+      bool name_huff = false;
+      if (idx == 0) {
+        if (!hpack_string(p, end, f.name, name_huff)) return false;
+      } else {
+        std::string v;
+        if (!dyn.get(idx, f.name, v)) return false;
+      }
+      if (!hpack_string(p, end, f.value, f.value_huffman)) return false;
+      // Huffman-coded strings are stored encoded; an indexed re-reference
+      // yields the same bytes, so matching stays consistent without a
+      // Huffman decoder (we only ever *compare* values, never display them).
+      (void)name_huff;
+      dyn.add(f.name, f.value);
+      out.push_back(std::move(f));
+    } else if ((b & 0xe0) == 0x20) {  // dynamic table size update
+      uint64_t cap;
+      if (!hpack_int(p, end, 5, cap)) return false;
+      dyn.set_cap(cap);
+    } else {  // literal without indexing / never indexed (prefix 4 bits)
+      uint64_t idx;
+      if (!hpack_int(p, end, 4, idx)) return false;
+      HpackField f;
+      bool name_huff = false;
+      if (idx == 0) {
+        if (!hpack_string(p, end, f.name, name_huff)) return false;
+      } else {
+        std::string v;
+        if (!dyn.get(idx, f.name, v)) return false;
+      }
+      if (!hpack_string(p, end, f.value, f.value_huffman)) return false;
+      out.push_back(std::move(f));
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Protobuf wire helpers (hand-rolled; schema = proto/prediction.proto)
+// ---------------------------------------------------------------------------
+
+struct PbReader {
+  const uint8_t* p;
+  const uint8_t* end;
+
+  bool varint(uint64_t& out) {
+    out = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      out |= (uint64_t)(b & 0x7f) << shift;
+      if (!(b & 0x80)) return true;
+      shift += 7;
+      if (shift > 63) return false;
+    }
+    return false;
+  }
+  bool tag(uint32_t& field, uint32_t& wire) {
+    if (p >= end) return false;
+    uint64_t t;
+    if (!varint(t)) return false;
+    field = (uint32_t)(t >> 3);
+    wire = (uint32_t)(t & 7);
+    return true;
+  }
+  bool len_span(std::string_view& out) {
+    uint64_t len;
+    if (!varint(len)) return false;
+    if ((uint64_t)(end - p) < len) return false;
+    out = {(const char*)p, (size_t)len};
+    p += len;
+    return true;
+  }
+  bool skip(uint32_t wire) {
+    uint64_t tmp;
+    std::string_view sv;
+    switch (wire) {
+      case 0: return varint(tmp);
+      case 1: if (end - p < 8) return false; p += 8; return true;
+      case 2: return len_span(sv);
+      case 5: if (end - p < 4) return false; p += 4; return true;
+      default: return false;
+    }
+  }
+};
+
+struct PbWriter {
+  Buf& b;
+  void varint(uint64_t v) {
+    while (v >= 0x80) {
+      b.push((char)(v | 0x80));
+      v >>= 7;
+    }
+    b.push((char)v);
+  }
+  void tag(uint32_t field, uint32_t wire) { varint((uint64_t)field << 3 | wire); }
+  void str(uint32_t field, std::string_view s) {
+    tag(field, 2);
+    varint(s.size());
+    b.append(s);
+  }
+  void raw_len(uint32_t field, std::string_view s) { str(field, s); }
+  void fixed32(uint32_t field, float v) {
+    tag(field, 5);
+    b.append((const char*)&v, 4);
+  }
+  void fixed64_raw(double v) { b.append((const char*)&v, 8); }
+};
+
+// Parsed gRPC SeldonMessage request (spans into the request buffer).
+struct PbSeldonMsg {
+  Payload in;
+  std::string_view puid;
+  std::vector<std::string_view> meta_echo;  // raw Meta fields 2/3/4/5 (tag+len+payload)
+  std::vector<std::string_view> req_metrics_raw;  // Meta field 5 entries
+  int64_t tensor_prod = -1, tensor_nvals = -1;
+  const char* err = nullptr;
+};
+
+inline uint64_t pb_key(uint32_t field, uint32_t wire) { return (uint64_t)field << 3 | wire; }
+
+// Parse a Meta submessage (echo spans + puid).
+bool pb_parse_meta(std::string_view span, PbSeldonMsg& out) {
+  PbReader r{(const uint8_t*)span.data(), (const uint8_t*)span.data() + span.size()};
+  while (r.p < r.end) {
+    const uint8_t* field_start = r.p;
+    uint32_t field, wire;
+    if (!r.tag(field, wire)) return false;
+    if (field == 1 && wire == 2) {
+      if (!r.len_span(out.puid)) return false;
+    } else if ((field >= 2 && field <= 5) && wire == 2) {
+      std::string_view sv;
+      if (!r.len_span(sv)) return false;
+      std::string_view full{(const char*)field_start, (size_t)(r.p - field_start)};
+      if (field == 5) out.req_metrics_raw.push_back(full);
+      else out.meta_echo.push_back(full);
+    } else {
+      if (!r.skip(wire)) return false;
+    }
+  }
+  return true;
+}
+
+// ListValue rows: count of top-level Value elements; 2-D iff first is a list.
+bool pb_listvalue_rows(std::string_view span, int64_t& rows) {
+  PbReader r{(const uint8_t*)span.data(), (const uint8_t*)span.data() + span.size()};
+  int64_t count = 0;
+  bool first_is_list = false;
+  while (r.p < r.end) {
+    uint32_t field, wire;
+    if (!r.tag(field, wire)) return false;
+    if (field == 1 && wire == 2) {
+      std::string_view value_span;
+      if (!r.len_span(value_span)) return false;
+      if (count == 0) {
+        PbReader vr{(const uint8_t*)value_span.data(),
+                    (const uint8_t*)value_span.data() + value_span.size()};
+        uint32_t vf, vw;
+        if (vr.tag(vf, vw)) first_is_list = (vf == 6);
+      }
+      ++count;
+    } else if (!r.skip(wire)) {
+      return false;
+    }
+  }
+  rows = first_is_list ? count : (count > 0 ? 1 : 0);
+  return true;
+}
+
+bool pb_parse_tensor(std::string_view span, PbSeldonMsg& out) {
+  PbReader r{(const uint8_t*)span.data(), (const uint8_t*)span.data() + span.size()};
+  int64_t prod = 1, rows = 1, nvals = 0, ndims = 0;
+  while (r.p < r.end) {
+    uint32_t field, wire;
+    if (!r.tag(field, wire)) return false;
+    if (field == 1 && wire == 2) {  // packed shape
+      std::string_view sv;
+      if (!r.len_span(sv)) return false;
+      PbReader sr{(const uint8_t*)sv.data(), (const uint8_t*)sv.data() + sv.size()};
+      uint64_t d;
+      while (sr.p < sr.end && sr.varint(d)) {
+        if (ndims == 0) rows = (int64_t)d;
+        prod *= (int64_t)d;
+        ++ndims;
+      }
+    } else if (field == 1 && wire == 0) {  // unpacked shape element
+      uint64_t d;
+      if (!r.varint(d)) return false;
+      if (ndims == 0) rows = (int64_t)d;
+      prod *= (int64_t)d;
+      ++ndims;
+    } else if (field == 2 && wire == 2) {  // packed doubles
+      std::string_view sv;
+      if (!r.len_span(sv)) return false;
+      nvals += (int64_t)(sv.size() / 8);
+    } else if (field == 2 && wire == 1) {
+      if (!r.skip(wire)) return false;
+      ++nvals;
+    } else if (!r.skip(wire)) {
+      return false;
+    }
+  }
+  if (ndims == 0) {
+    prod = nvals;
+    rows = 1;
+  }
+  out.tensor_prod = prod;
+  out.tensor_nvals = nvals;
+  out.in.kind = PKind::Tensor;
+  out.in.rows = ndims >= 2 ? rows : 1;
+  return true;
+}
+
+bool pb_parse_seldon_message(std::string_view msg, PbSeldonMsg& out) {
+  PbReader r{(const uint8_t*)msg.data(), (const uint8_t*)msg.data() + msg.size()};
+  while (r.p < r.end) {
+    uint32_t field, wire;
+    if (!r.tag(field, wire)) return false;
+    if (field == 2 && wire == 2) {  // meta
+      std::string_view sv;
+      if (!r.len_span(sv)) return false;
+      if (!pb_parse_meta(sv, out)) return false;
+    } else if (field == 3 && wire == 2) {  // DefaultData
+      std::string_view data_span;
+      if (!r.len_span(data_span)) return false;
+      PbReader dr{(const uint8_t*)data_span.data(),
+                  (const uint8_t*)data_span.data() + data_span.size()};
+      while (dr.p < dr.end) {
+        uint32_t df, dw;
+        if (!dr.tag(df, dw)) return false;
+        if (df == 2 && dw == 2) {
+          std::string_view tspan;
+          if (!dr.len_span(tspan)) return false;
+          if (!pb_parse_tensor(tspan, out)) return false;
+        } else if (df == 3 && dw == 2) {
+          std::string_view nd;
+          if (!dr.len_span(nd)) return false;
+          out.in.kind = PKind::NDArray;
+          if (!pb_listvalue_rows(nd, out.in.rows)) return false;
+        } else if (!dr.skip(dw)) {
+          return false;
+        }
+      }
+    } else if (field == 4 && wire == 2) {
+      if (!r.len_span(out.in.echo)) return false;
+      out.in.kind = PKind::Bin;
+    } else if (field == 5 && wire == 2) {
+      if (!r.len_span(out.in.echo)) return false;
+      out.in.kind = PKind::Str;
+    } else if (field == 6 && wire == 2) {
+      std::string_view sv;
+      if (!r.len_span(sv)) return false;
+      out.in.kind = PKind::Json;
+    } else if (!r.skip(wire)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
 // HTTP layer
 // ---------------------------------------------------------------------------
 
@@ -694,6 +1072,21 @@ struct RingPending {
   bool is_feedback;
 };
 
+struct H2Stream {
+  std::string path;
+  Buf data;
+  bool headers_done = false;
+  bool path_huffman = false;
+};
+
+struct H2State {
+  HpackDyn hpack;
+  std::unordered_map<uint32_t, H2Stream> streams;
+  int64_t send_window = 65535;
+  uint32_t recv_unacked = 0;
+  std::vector<std::string> blocked;  // DATA frames awaiting window
+};
+
 struct Conn {
   int fd = -1;
   uint32_t gen = 0;  // bumped on close so stale ring responses can't match
@@ -702,6 +1095,8 @@ struct Conn {
   size_t out_off = 0;
   bool want_close = false;
   bool waiting_ring = false;  // response will come from the ring
+  bool is_h2 = false;
+  std::unique_ptr<H2State> h2;
 };
 
 uint64_t now_ns() {
@@ -1115,14 +1510,29 @@ struct Server {
         continue;  // connection closed (and possibly fd reused) meanwhile
       c.waiting_ring = false;
       std::string_view body{ring_buf.data() + 5, (size_t)len - 5};
+      int http_code = 200;
       if (status == 0) {
         respond(c, 200, "OK", body);
       } else {
-        // body is {"status": {...}} from the Python engine
-        respond(c, 500, "Internal Server Error", body);
+        // body is {"status": {"code": N, ...}} from the Python engine —
+        // surface the engine's own status code (400 vs 500 matters)
+        http_code = 500;
+        JDoc doc;
+        if (json_parse(body.data(), body.size(), doc) &&
+            doc.nodes[0].type == JValue::Obj) {
+          if (auto* st = doc.get(doc.nodes[0], "status"))
+            if (auto* code = doc.get(*st, "code")) {
+              int parsed = (int)jnum(*code);
+              if (parsed >= 400 && parsed < 600) http_code = parsed;
+            }
+        }
+        const char* text = http_code == 400 ? "Bad Request"
+                           : http_code == 503 ? "Service Unavailable"
+                                              : "Internal Server Error";
+        respond(c, http_code, text, body);
       }
       metrics.observe_api(rp.is_feedback ? "feedback" : "predictions",
-                          status == 0 ? 200 : 500, 1e-9 * (now_ns() - rp.started_ns));
+                          http_code, 1e-9 * (now_ns() - rp.started_ns));
       flush_out(c);
       if (c.fd >= 0 && c.in.size() > 0) process_in(c);  // pipelined requests
     }
@@ -1184,6 +1594,448 @@ struct Server {
     respond_error(c, 404, "NOT_FOUND", "no such route");
   }
 
+  // ------------------------------------------------------------------
+  // HTTP/2 + gRPC (external API parity: grpc/SeldonGrpcServer.java,
+  // Seldon.Predict / Seldon.SendFeedback)
+  // ------------------------------------------------------------------
+
+  // Constant response fragments, built once in init_grpc_constants().
+  std::string ndarray_row_bytes;   // one ListValue.values entry (a 3-number row)
+  std::string tensor_row_bytes;    // 3 LE doubles
+  std::string h2_resp_headers;     // :status 200 + content-type application/grpc
+  std::string h2_trailers_ok;      // grpc-status: 0
+
+  void init_grpc_constants() {
+    const double vals[3] = {(double)(float)0.1, (double)(float)0.9, 0.5};
+    Buf num;  // three Value{number_value} entries wrapped as ListValue.values
+    for (double v : vals) {
+      Buf inner;
+      PbWriter iw{inner};
+      iw.tag(2, 1);
+      iw.fixed64_raw(v);
+      PbWriter nw{num};
+      nw.tag(1, 2);
+      nw.varint(inner.size());
+      num.append(inner.data(), inner.size());
+    }
+    Buf row;  // Value{list_value = ListValue{the three numbers}}
+    PbWriter rw{row};
+    rw.tag(6, 2);
+    rw.varint(num.size());
+    row.append(num.data(), num.size());
+    Buf entry;  // ListValue.values entry holding the row Value
+    PbWriter ew{entry};
+    ew.tag(1, 2);
+    ew.varint(row.size());
+    entry.append(row.data(), row.size());
+    ndarray_row_bytes.assign(entry.data(), entry.size());
+    tensor_row_bytes.assign((const char*)vals, 24);
+
+    h2_resp_headers.push_back((char)0x88);  // :status 200 (static 8)
+    // content-type (static name 31), literal without indexing
+    h2_resp_headers.push_back((char)0x0f);
+    h2_resp_headers.push_back((char)0x10);
+    h2_resp_headers.push_back((char)16);
+    h2_resp_headers += "application/grpc";
+    // grpc-status: 0 trailer, literal without indexing, new name
+    h2_trailers_ok.push_back((char)0x00);
+    h2_trailers_ok.push_back((char)11);
+    h2_trailers_ok += "grpc-status";
+    h2_trailers_ok.push_back((char)1);
+    h2_trailers_ok += "0";
+  }
+
+  void h2_frame(Buf& out, uint8_t type, uint8_t flags, uint32_t sid,
+                std::string_view payload) {
+    uint32_t len = (uint32_t)payload.size();
+    char hdr[9] = {(char)(len >> 16), (char)(len >> 8), (char)len,
+                   (char)type, (char)flags,
+                   (char)(sid >> 24), (char)(sid >> 16), (char)(sid >> 8), (char)sid};
+    out.append(hdr, 9);
+    out.append(payload);
+  }
+
+  void h2_begin(Conn& c) {
+    c.is_h2 = true;
+    c.h2 = std::make_unique<H2State>();
+    h2_frame(c.outbuf, 4, 0, 0, {});  // server SETTINGS (defaults)
+  }
+
+  void grpc_trailers_error(Conn& c, uint32_t sid, int grpc_code, std::string_view msg) {
+    Buf headers;
+    headers.append(h2_resp_headers);
+    h2_frame(c.outbuf, 1, 0x4, sid, {headers.data(), headers.size()});
+    Buf tr;
+    char code_str[8];
+    int n = snprintf(code_str, sizeof(code_str), "%d", grpc_code);
+    tr.push((char)0x00);
+    tr.push((char)11);
+    tr.append("grpc-status");
+    tr.push((char)n);
+    tr.append(code_str, n);
+    if (!msg.empty() && msg.size() < 120) {
+      tr.push((char)0x00);
+      tr.push((char)12);
+      tr.append("grpc-message");
+      tr.push((char)msg.size());
+      tr.append(msg);
+    }
+    h2_frame(c.outbuf, 1, 0x5, sid, {tr.data(), tr.size()});  // END_HEADERS|END_STREAM
+  }
+
+  void grpc_respond_msg(Conn& c, uint32_t sid, std::string_view msg) {
+    h2_frame(c.outbuf, 1, 0x4, sid, h2_resp_headers);
+    Buf data;
+    data.push(0);  // uncompressed
+    char len4[4] = {(char)(msg.size() >> 24), (char)(msg.size() >> 16),
+                    (char)(msg.size() >> 8), (char)msg.size()};
+    data.append(len4, 4);
+    data.append(msg);
+    if (c.h2->send_window >= (int64_t)data.size() && c.h2->blocked.empty()) {
+      c.h2->send_window -= (int64_t)data.size();
+      h2_frame(c.outbuf, 0, 0, sid, {data.data(), data.size()});
+      h2_frame(c.outbuf, 1, 0x5, sid, h2_trailers_ok);
+    } else {
+      // connection send window exhausted: queue DATA+trailers until the
+      // client opens the window
+      Buf blocked;
+      h2_frame(blocked, 0, 0, sid, {data.data(), data.size()});
+      h2_frame(blocked, 1, 0x5, sid, h2_trailers_ok);
+      c.h2->blocked.emplace_back(blocked.data(), blocked.size());
+    }
+  }
+
+  void h2_drain_blocked(Conn& c) {
+    while (!c.h2->blocked.empty()) {
+      const std::string& frames = c.h2->blocked.front();
+      // first frame is the DATA frame; its payload length is in the header
+      uint32_t dlen = ((uint8_t)frames[0] << 16) | ((uint8_t)frames[1] << 8) |
+                      (uint8_t)frames[2];
+      if (c.h2->send_window < (int64_t)dlen) break;
+      c.h2->send_window -= dlen;
+      c.outbuf.append(frames.data(), frames.size());
+      c.h2->blocked.erase(c.h2->blocked.begin());
+    }
+  }
+
+  // Build the Predict response proto for a parsed request.
+  void grpc_build_response(const PbSeldonMsg& req, const ExecOut& ex,
+                           const Payload& result, Kind owner, Buf& msg) {
+    Buf meta;
+    PbWriter mw{meta};
+    if (!req.puid.empty()) {
+      mw.str(1, req.puid);
+    } else {
+      char puid[33];
+      rng.puid_hex(puid);
+      mw.str(1, {puid, 32});
+    }
+    // Echoed request meta first, computed entries after: for duplicate map
+    // keys protobuf keeps the LAST entry, which makes computed values win —
+    // the proto twin of the Python engine's setdefault/overwrite semantics.
+    for (auto sv : req.meta_echo) meta.append(sv);
+    for (auto& [name, branch] : ex.routing) {
+      Buf e;
+      PbWriter ew{e};
+      ew.str(1, name);
+      ew.tag(2, 0);
+      ew.varint((uint64_t)branch);
+      mw.tag(3, 2);
+      mw.varint(e.size());
+      meta.append(e.data(), e.size());
+    }
+    for (auto& [name, cls] : ex.path) {
+      Buf e;
+      PbWriter ew{e};
+      ew.str(1, name);
+      ew.str(2, cls);
+      mw.tag(4, 2);
+      mw.varint(e.size());
+      meta.append(e.data(), e.size());
+    }
+    // metrics: owner's triplet, echoed request metrics, remaining units
+    auto emit_triplet = [&]() {
+      struct M { const char* key; int type; float value; };
+      static const M kMs[3] = {{"mycounter", 0, 1.0f}, {"mygauge", 1, 100.0f},
+                               {"mytimer", 2, 20.6f}};
+      for (auto& m : kMs) {
+        Buf e;
+        PbWriter ew{e};
+        ew.str(1, m.key);
+        if (m.type != 0) {
+          ew.tag(2, 0);
+          ew.varint((uint64_t)m.type);
+        }
+        ew.fixed32(3, m.value);
+        mw.tag(5, 2);
+        mw.varint(e.size());
+        meta.append(e.data(), e.size());
+      }
+    };
+    int remaining = ex.model_visits;
+    if (owner != Kind::AverageCombiner && remaining > 0) {
+      emit_triplet();
+      --remaining;
+    }
+    for (auto sv : req.req_metrics_raw) meta.append(sv);
+    for (int i = 0; i < remaining; ++i) emit_triplet();
+
+    PbWriter w{msg};
+    w.tag(2, 2);
+    w.varint(meta.size());
+    msg.append(meta.data(), meta.size());
+
+    if (result.kind == PKind::Str) {
+      w.str(5, result.echo);
+    } else if (result.kind == PKind::Bin) {
+      w.str(4, result.echo);
+    } else if (result.kind == PKind::NDArray || result.kind == PKind::Tensor) {
+      Buf dd;
+      PbWriter dw{dd};
+      if (owner == Kind::AverageCombiner) {
+        dw.str(1, "t:0");
+        dw.str(1, "t:1");
+        dw.str(1, "t:2");
+      } else {
+        dw.str(1, "class0");
+        dw.str(1, "class1");
+        dw.str(1, "class2");
+      }
+      if (result.kind == PKind::NDArray) {
+        Buf lv;
+        for (int64_t i = 0; i < result.rows; ++i) lv.append(ndarray_row_bytes);
+        dw.tag(3, 2);
+        dw.varint(lv.size());
+        dd.append(lv.data(), lv.size());
+      } else {
+        Buf t;
+        PbWriter tw{t};
+        Buf shape;
+        PbWriter sw{shape};
+        sw.varint((uint64_t)result.rows);
+        sw.varint(3);
+        tw.tag(1, 2);
+        tw.varint(shape.size());
+        t.append(shape.data(), shape.size());
+        tw.tag(2, 2);
+        tw.varint((uint64_t)result.rows * 24);
+        for (int64_t i = 0; i < result.rows; ++i) t.append(tensor_row_bytes);
+        dw.tag(2, 2);
+        dw.varint(t.size());
+        dd.append(t.data(), t.size());
+      }
+      w.tag(3, 2);
+      w.varint(dd.size());
+      msg.append(dd.data(), dd.size());
+    }
+  }
+
+  void h2_rpc(Conn& c, uint32_t sid, H2Stream& s) {
+    uint64_t t0 = now_ns();
+    bool is_predict = s.path == "/seldon.protos.Seldon/Predict" ||
+                      s.path == "/seldon.protos.Model/Predict";
+    bool is_feedback = s.path == "/seldon.protos.Seldon/SendFeedback" ||
+                       s.path == "/seldon.protos.Model/SendFeedback";
+    const char* method = is_feedback ? "feedback" : "predictions";
+    if (s.path_huffman) {
+      grpc_trailers_error(c, sid, 12, "huffman-coded :path not supported");
+      return;
+    }
+    if (!is_predict && !is_feedback) {
+      grpc_trailers_error(c, sid, 12, "unknown method");
+      return;
+    }
+    if (paused) {
+      grpc_trailers_error(c, sid, 14, "paused");
+      metrics.observe_api(method, 503, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    if (!prog.native) {
+      grpc_trailers_error(c, sid, 12,
+                          "gRPC for non-native graphs is served by the engine process");
+      metrics.observe_api(method, 501, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    std::string_view data{s.data.data(), s.data.size()};
+    if (data.size() < 5 || data[0] != 0) {
+      grpc_trailers_error(c, sid, 13, "bad gRPC frame");
+      metrics.observe_api(method, 500, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    uint32_t mlen = ((uint8_t)data[1] << 24) | ((uint8_t)data[2] << 16) |
+                    ((uint8_t)data[3] << 8) | (uint8_t)data[4];
+    if (data.size() < 5 + (size_t)mlen) {
+      grpc_trailers_error(c, sid, 13, "truncated gRPC frame");
+      metrics.observe_api(method, 500, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    std::string_view body = data.substr(5, mlen);
+
+    if (is_feedback) {
+      // Feedback{reward = field 3 float}
+      PbReader r{(const uint8_t*)body.data(), (const uint8_t*)body.data() + body.size()};
+      float reward = 0;
+      uint32_t field, wire;
+      while (r.p + 1 <= r.end && r.tag(field, wire)) {
+        if (field == 3 && wire == 5 && r.end - r.p >= 4) {
+          memcpy(&reward, r.p, 4);
+          r.p += 4;
+        } else if (!r.skip(wire)) {
+          break;
+        }
+      }
+      ++metrics.feedback_events;
+      if (reward != 0) metrics.feedback_reward += reward < 0 ? -reward : reward;
+      Buf msg;  // SeldonMessage{meta: {}} — REST parity ({"meta": {}})
+      PbWriter w{msg};
+      w.tag(2, 2);
+      w.varint(0);
+      grpc_respond_msg(c, sid, {msg.data(), msg.size()});
+      metrics.observe_api(method, 200, 1e-9 * (now_ns() - t0));
+      return;
+    }
+
+    PbSeldonMsg req;
+    if (!pb_parse_seldon_message(body, req)) {
+      grpc_trailers_error(c, sid, 3, "cannot parse SeldonMessage");
+      metrics.observe_api(method, 400, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    if (req.in.kind == PKind::Tensor && req.tensor_prod != req.tensor_nvals) {
+      grpc_trailers_error(c, sid, 3, "tensor values do not fit shape");
+      metrics.observe_api(method, 400, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    ExecOut ex;
+    Payload result;
+    Kind owner;
+    if (!eval_unit(prog, prog.root, rng, req.in, ex, result, owner)) {
+      grpc_trailers_error(c, sid, ex.err_code == 400 ? 3 : 13, ex.err_info);
+      metrics.observe_api(method, ex.err_code, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    Buf msg;
+    grpc_build_response(req, ex, result, owner, msg);
+    grpc_respond_msg(c, sid, {msg.data(), msg.size()});
+    metrics.mycounter += ex.model_visits;
+    if (ex.model_visits) {
+      metrics.mygauge = 100.0;
+      for (int i = 0; i < ex.model_visits; ++i) metrics.mytimer.observe(20.6 / 1000.0);
+      metrics.custom_seen += ex.model_visits;
+    }
+    metrics.observe_api(method, 200, 1e-9 * (now_ns() - t0));
+  }
+
+  // Frame loop; consumes complete frames from c.in.
+  void h2_process(Conn& c) {
+    size_t off = 0;
+    std::string_view data{c.in.data(), c.in.size()};
+    for (;;) {
+      if (data.size() - off < 9) break;
+      const uint8_t* h = (const uint8_t*)data.data() + off;
+      uint32_t len = (h[0] << 16) | (h[1] << 8) | h[2];
+      uint8_t type = h[3], flags = h[4];
+      uint32_t sid = ((h[5] & 0x7f) << 24) | (h[6] << 16) | (h[7] << 8) | h[8];
+      if (len > (1u << 24)) {
+        close_conn(c);
+        return;
+      }
+      if (data.size() - off < 9 + len) break;
+      std::string_view payload = data.substr(off + 9, len);
+      off += 9 + len;
+      switch (type) {
+        case 0: {  // DATA
+          auto it = c.h2->streams.find(sid);
+          if (flags & 0x8) {  // PADDED
+            if (payload.empty()) break;
+            uint8_t pad = (uint8_t)payload[0];
+            payload = payload.substr(1, payload.size() - 1 - pad);
+          }
+          c.h2->recv_unacked += len;
+          if (it != c.h2->streams.end()) {
+            it->second.data.append(payload);
+            if (flags & 0x1) {  // END_STREAM
+              h2_rpc(c, sid, it->second);
+              c.h2->streams.erase(it);
+            }
+          }
+          break;
+        }
+        case 1: {  // HEADERS
+          if (flags & 0x8) {  // PADDED
+            if (payload.empty()) break;
+            uint8_t pad = (uint8_t)payload[0];
+            payload = payload.substr(1, payload.size() - 1 - pad);
+          }
+          if (flags & 0x20) {  // PRIORITY
+            if (payload.size() < 5) break;
+            payload = payload.substr(5);
+          }
+          if (!(flags & 0x4)) {  // no END_HEADERS: CONTINUATION unsupported
+            close_conn(c);
+            return;
+          }
+          std::vector<HpackField> fields;
+          if (!hpack_decode((const uint8_t*)payload.data(),
+                            (const uint8_t*)payload.data() + payload.size(),
+                            c.h2->hpack, fields)) {
+            close_conn(c);
+            return;
+          }
+          H2Stream& s = c.h2->streams[sid];
+          for (auto& f : fields) {
+            if (f.name == ":path") {
+              s.path = f.value;
+              s.path_huffman = f.value_huffman;
+            }
+          }
+          s.headers_done = true;
+          if (flags & 0x1) {  // END_STREAM with no body
+            h2_rpc(c, sid, s);
+            c.h2->streams.erase(sid);
+          }
+          break;
+        }
+        case 3:  // RST_STREAM
+          c.h2->streams.erase(sid);
+          break;
+        case 4:  // SETTINGS
+          if (!(flags & 0x1)) h2_frame(c.outbuf, 4, 0x1, 0, {});
+          break;
+        case 6:  // PING
+          if (!(flags & 0x1)) h2_frame(c.outbuf, 6, 0x1, 0, payload);
+          break;
+        case 7:  // GOAWAY
+          c.want_close = true;
+          break;
+        case 8: {  // WINDOW_UPDATE
+          if (payload.size() == 4 && sid == 0) {
+            uint32_t inc = ((uint8_t)payload[0] << 24) | ((uint8_t)payload[1] << 16) |
+                           ((uint8_t)payload[2] << 8) | (uint8_t)payload[3];
+            c.h2->send_window += inc & 0x7fffffff;
+            h2_drain_blocked(c);
+          }
+          break;
+        }
+        default:
+          break;  // ignore unknown frames
+      }
+      if (c.fd < 0) return;
+    }
+    if (off > 0) {
+      size_t remaining = data.size() - off;
+      if (remaining > 0) memmove(c.in.v.data(), c.in.v.data() + off, remaining);
+      c.in.v.resize(remaining);
+    }
+    if (c.h2->recv_unacked >= (1u << 15)) {
+      char wu[4] = {(char)(c.h2->recv_unacked >> 24), (char)(c.h2->recv_unacked >> 16),
+                    (char)(c.h2->recv_unacked >> 8), (char)c.h2->recv_unacked};
+      h2_frame(c.outbuf, 8, 0, 0, {wu, 4});
+      c.h2->recv_unacked = 0;
+    }
+    flush_out(c);
+  }
+
   // ---- connection I/O ----
   void flush_out(Conn& c) {
     while (c.out_off < c.outbuf.size()) {
@@ -1219,11 +2071,30 @@ struct Server {
     c.out_off = 0;
     c.want_close = false;
     c.waiting_ring = false;
+    c.is_h2 = false;
+    c.h2.reset();
   }
 
   // Try to parse and handle complete requests in c.in; returns when more
   // bytes are needed.
   void process_in(Conn& c) {
+    if (c.is_h2) {
+      h2_process(c);
+      return;
+    }
+    // HTTP/2 connection preface?
+    if (c.in.size() >= 24 &&
+        memcmp(c.in.data(), "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n", 24) == 0) {
+      size_t remaining = c.in.size() - 24;
+      if (remaining > 0) memmove(c.in.v.data(), c.in.v.data() + 24, remaining);
+      c.in.v.resize(remaining);
+      h2_begin(c);
+      h2_process(c);
+      return;
+    }
+    if (c.in.size() > 0 && c.in.size() < 24 && memcmp(c.in.data(), "PRI ",
+                                                     c.in.size() < 4 ? c.in.size() : 4) == 0)
+      return;  // wait for the full preface
     for (;;) {
       if (c.waiting_ring) return;  // one request at a time when ring-pending
       std::string_view data{c.in.data(), c.in.size()};
@@ -1246,8 +2117,10 @@ struct Server {
       size_t q = target.find('?');
       std::string_view path = q == std::string_view::npos ? target : target.substr(0, q);
       // headers we care about
-      size_t content_len = 0;
+      constexpr size_t kMaxBody = 1u << 30;  // aiohttp client_max_size parity
+      uint64_t content_len = 0;
       bool close_hdr = false;
+      bool chunked = false;
       size_t pos = line_end == std::string_view::npos ? head.size() : line_end + 2;
       while (pos < head.size()) {
         size_t eol = head.find("\r\n", pos);
@@ -1259,11 +2132,25 @@ struct Server {
         std::string_view value = line.substr(colon + 1);
         while (!value.empty() && value.front() == ' ') value.remove_prefix(1);
         if (name.size() == 14 && strncasecmp(name.data(), "content-length", 14) == 0)
-          content_len = strtoul(std::string(value).c_str(), nullptr, 10);
+          content_len = strtoull(std::string(value).c_str(), nullptr, 10);
         else if (name.size() == 10 && strncasecmp(name.data(), "connection", 10) == 0)
           close_hdr = value.size() == 5 && strncasecmp(value.data(), "close", 5) == 0;
+        else if (name.size() == 17 && strncasecmp(name.data(), "transfer-encoding", 17) == 0)
+          chunked = true;
       }
-      size_t total = hdr_end + 4 + content_len;
+      if (chunked) {
+        c.want_close = true;
+        respond_error(c, 501, "NOT_IMPLEMENTED", "chunked transfer encoding not supported");
+        flush_out(c);
+        return;
+      }
+      if (content_len > kMaxBody) {
+        c.want_close = true;
+        respond_error(c, 413, "PAYLOAD_TOO_LARGE", "request body exceeds 1GB limit");
+        flush_out(c);
+        return;
+      }
+      size_t total = hdr_end + 4 + (size_t)content_len;
       if (data.size() < total) return;  // need more body bytes
       std::string_view body = data.substr(hdr_end + 4, content_len);
       c.want_close = close_hdr;
@@ -1298,8 +2185,7 @@ struct Server {
     process_in(c);
   }
 
-  int run(const char* host, int port) {
-    signal(SIGPIPE, SIG_IGN);
+  int make_listener(const char* host, int port) {
     int lfd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
     int one = 1;
     setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
@@ -1313,38 +2199,48 @@ struct Server {
       hints.ai_family = AF_INET;
       if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
         fprintf(stderr, "cannot resolve host %s\n", host);
-        return 1;
+        return -1;
       }
       addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
       freeaddrinfo(res);
     }
-    if (bind(lfd, (sockaddr*)&addr, sizeof(addr)) != 0) {
-      perror("bind");
-      return 1;
+    if (bind(lfd, (sockaddr*)&addr, sizeof(addr)) != 0 || listen(lfd, 1024) != 0) {
+      perror("bind/listen");
+      ::close(lfd);
+      return -1;
     }
-    if (listen(lfd, 1024) != 0) {
-      perror("listen");
-      return 1;
-    }
+    return lfd;
+  }
+
+  int run(const char* host, int port, int grpc_port) {
+    signal(SIGPIPE, SIG_IGN);
+    int lfd = make_listener(host, port);
+    if (lfd < 0) return 1;
+    int gfd = grpc_port > 0 ? make_listener(host, grpc_port) : -1;
+    if (grpc_port > 0 && gfd < 0) return 1;
     epfd = epoll_create1(0);
     timer_fd = timerfd_create(CLOCK_MONOTONIC, TFD_NONBLOCK);
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.fd = lfd;
     epoll_ctl(epfd, EPOLL_CTL_ADD, lfd, &ev);
+    if (gfd >= 0) {
+      ev.data.fd = gfd;
+      epoll_ctl(epfd, EPOLL_CTL_ADD, gfd, &ev);
+    }
     ev.data.fd = timer_fd;
     epoll_ctl(epfd, EPOLL_CTL_ADD, timer_fd, &ev);
-    fprintf(stderr, "seldon-edge listening on %s:%d (native=%d)\n",
-            host ? host : "0.0.0.0", port, prog.native ? 1 : 0);
+    fprintf(stderr, "seldon-edge listening on %s:%d grpc=%d (native=%d)\n",
+            host ? host : "0.0.0.0", port, grpc_port, prog.native ? 1 : 0);
 
     std::vector<epoll_event> events(256);
     for (;;) {
       int n = epoll_wait(epfd, events.data(), (int)events.size(), -1);
       for (int i = 0; i < n; ++i) {
         int fd = events[i].data.fd;
-        if (fd == lfd) {
+        if (fd == lfd || fd == gfd) {
           for (;;) {
-            int cfd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+            int cfd = accept4(fd, nullptr, nullptr, SOCK_NONBLOCK);
             if (cfd < 0) break;
             int off = 1;
             setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &off, sizeof(off));
@@ -1355,6 +2251,8 @@ struct Server {
             c.out_off = 0;
             c.want_close = false;
             c.waiting_ring = false;
+            c.is_h2 = false;
+            c.h2.reset();
             epoll_event cev{};
             cev.events = EPOLLIN;
             cev.data.fd = cfd;
@@ -1398,6 +2296,7 @@ int main(int argc, char** argv) {
   const char* openapi_path = nullptr;
   const char* host = nullptr;
   int port = 8000;
+  int grpc_port = 0;
   int workers = 1;
   int ring_worker = 0;
   for (int i = 1; i < argc; ++i) {
@@ -1405,6 +2304,7 @@ int main(int argc, char** argv) {
     auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : nullptr; };
     if (a == "--program") program_path = next();
     else if (a == "--port") port = atoi(next());
+    else if (a == "--grpc-port") grpc_port = atoi(next());
     else if (a == "--host") host = next();
     else if (a == "--ring") ring_base = next();
     else if (a == "--ring-worker") ring_worker = atoi(next());
@@ -1432,6 +2332,7 @@ int main(int argc, char** argv) {
 
   Server srv;
   srv.rng.seed();
+  srv.init_grpc_constants();
   if (!load_program(program_path, srv.prog)) {
     fprintf(stderr, "cannot load program %s\n", program_path);
     return 1;
@@ -1459,5 +2360,5 @@ int main(int argc, char** argv) {
     }
     srv.ring_slot = (uint32_t)scr_slot_size(srv.resp_ring);
   }
-  return srv.run(host, port);
+  return srv.run(host, port, grpc_port);
 }

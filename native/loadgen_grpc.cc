@@ -1,0 +1,346 @@
+// Closed-loop gRPC (HTTP/2) load generator for the native edge: N
+// connections, K concurrent streams each, every stream a
+// /seldon.protos.Seldon/Predict unary call with a 1x4 tensor payload (the
+// gRPC twin of loadgen_http.cc; reference methodology:
+// util/loadtester/scripts/predict_grpc_locust.py).
+
+#include <algorithm>
+#include <cerrno>
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+uint64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+void frame_header(std::string& out, uint32_t len, uint8_t type, uint8_t flags,
+                  uint32_t sid) {
+  char h[9] = {(char)(len >> 16), (char)(len >> 8), (char)len, (char)type,
+               (char)flags, (char)(sid >> 24), (char)(sid >> 16),
+               (char)(sid >> 8), (char)sid};
+  out.append(h, 9);
+}
+
+// Minimal proto writer for the request message.
+void pb_varint(std::string& b, uint64_t v) {
+  while (v >= 0x80) {
+    b.push_back((char)(v | 0x80));
+    v >>= 7;
+  }
+  b.push_back((char)v);
+}
+void pb_tag(std::string& b, uint32_t f, uint32_t w) { pb_varint(b, f << 3 | w); }
+
+std::string build_request_msg() {
+  // SeldonMessage{data{tensor{shape:[1,4] values:[1,2,3,4]}}}
+  std::string shape;
+  pb_varint(shape, 1);
+  pb_varint(shape, 4);
+  std::string values;
+  for (double v : {1.0, 2.0, 3.0, 4.0}) values.append((const char*)&v, 8);
+  std::string tensor;
+  pb_tag(tensor, 1, 2);
+  pb_varint(tensor, shape.size());
+  tensor += shape;
+  pb_tag(tensor, 2, 2);
+  pb_varint(tensor, values.size());
+  tensor += values;
+  std::string data;
+  pb_tag(data, 2, 2);
+  pb_varint(data, tensor.size());
+  data += tensor;
+  std::string msg;
+  pb_tag(msg, 3, 2);
+  pb_varint(msg, data.size());
+  msg += data;
+  return msg;
+}
+
+std::string build_headers_block(const char* authority) {
+  std::string b;
+  b.push_back((char)0x83);  // :method POST
+  b.push_back((char)0x86);  // :scheme http
+  b.push_back((char)0x04);  // :path, literal w/o indexing, name idx 4
+  const char* path = "/seldon.protos.Seldon/Predict";
+  b.push_back((char)strlen(path));
+  b += path;
+  b.push_back((char)0x01);  // :authority, name idx 1
+  b.push_back((char)strlen(authority));
+  b += authority;
+  b.push_back((char)0x0f);  // content-type, name idx 31 (15 + 16)
+  b.push_back((char)0x10);
+  b.push_back((char)16);
+  b += "application/grpc";
+  b.push_back((char)0x00);  // te: trailers, new name
+  b.push_back((char)2);
+  b += "te";
+  b.push_back((char)8);
+  b += "trailers";
+  return b;
+}
+
+struct Conn {
+  int fd = -1;
+  std::string inbuf;
+  std::string outbuf;
+  uint32_t next_sid = 1;
+  uint32_t recv_unacked = 0;
+  std::unordered_map<uint32_t, uint64_t> t_send;
+};
+
+struct Stats {
+  std::vector<uint32_t> lat_us;
+  uint64_t ok = 0, errors = 0;
+};
+
+int connect_to(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
+    fprintf(stderr, "cannot resolve %s\n", host);
+    close(fd);
+    return -1;
+  }
+  addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  return fd;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* host = "127.0.0.1";
+  int port = 8001;
+  int connections = 16;
+  int streams_per_conn = 8;
+  double duration_s = 10.0, warmup_s = 1.0;
+  const char* label = "grpc";
+  for (int i = 1; i < argc; ++i) {
+    std::string_view a = argv[i];
+    auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "--host") host = next();
+    else if (a == "--port") port = atoi(next());
+    else if (a == "--connections") connections = atoi(next());
+    else if (a == "--streams") streams_per_conn = atoi(next());
+    else if (a == "--duration") duration_s = atof(next());
+    else if (a == "--warmup") warmup_s = atof(next());
+    else if (a == "--label") label = next();
+    else { fprintf(stderr, "unknown arg %s\n", argv[i]); return 2; }
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  char authority[128];
+  snprintf(authority, sizeof(authority), "%s:%d", host, port);
+  std::string headers_block = build_headers_block(authority);
+  std::string msg = build_request_msg();
+  std::string grpc_frame;
+  grpc_frame.push_back(0);
+  uint32_t ml = (uint32_t)msg.size();
+  grpc_frame.push_back((char)(ml >> 24));
+  grpc_frame.push_back((char)(ml >> 16));
+  grpc_frame.push_back((char)(ml >> 8));
+  grpc_frame.push_back((char)ml);
+  grpc_frame += msg;
+
+  std::vector<Conn> conns(connections);
+  int epfd = epoll_create1(0);
+  for (int i = 0; i < connections; ++i) {
+    Conn& c = conns[i];
+    c.fd = connect_to(host, port);
+    if (c.fd < 0) {
+      fprintf(stderr, "connect failed\n");
+      return 1;
+    }
+    c.outbuf += "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+    frame_header(c.outbuf, 0, 4, 0, 0);  // empty SETTINGS
+    // open the connection receive window wide
+    frame_header(c.outbuf, 4, 8, 0, 0);
+    uint32_t inc = 0x7fffffff - 65535;
+    char wu[4] = {(char)(inc >> 24), (char)(inc >> 16), (char)(inc >> 8), (char)inc};
+    c.outbuf.append(wu, 4);
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.u32 = (uint32_t)i;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, c.fd, &ev);
+  }
+
+  auto start_stream = [&](Conn& c) {
+    uint32_t sid = c.next_sid;
+    c.next_sid += 2;
+    frame_header(c.outbuf, (uint32_t)headers_block.size(), 1, 0x4, sid);
+    c.outbuf += headers_block;
+    frame_header(c.outbuf, (uint32_t)grpc_frame.size(), 0, 0x1, sid);
+    c.outbuf += grpc_frame;
+    c.t_send[sid] = now_ns();
+  };
+  for (auto& c : conns)
+    for (int s = 0; s < streams_per_conn; ++s) start_stream(c);
+
+  Stats stats;
+  stats.lat_us.reserve(1 << 20);
+  uint64_t t_measure = now_ns() + (uint64_t)(warmup_s * 1e9);
+  uint64_t t_end = t_measure + (uint64_t)(duration_s * 1e9);
+  bool measuring = warmup_s <= 0;
+
+  auto flush = [&](Conn& c) {
+    while (!c.outbuf.empty()) {
+      ssize_t n = ::send(c.fd, c.outbuf.data(), c.outbuf.size(), MSG_NOSIGNAL);
+      if (n > 0) {
+        c.outbuf.erase(0, (size_t)n);
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+      fprintf(stderr, "send failed\n");
+      exit(1);
+    }
+  };
+
+  std::vector<epoll_event> events(256);
+  char rbuf[65536];
+  for (;;) {
+    uint64_t now = now_ns();
+    if (now >= t_end) break;
+    if (!measuring && now >= t_measure) {
+      measuring = true;
+      stats.ok = stats.errors = 0;
+      stats.lat_us.clear();
+    }
+    int n = epoll_wait(epfd, events.data(), (int)events.size(), 100);
+    for (int i = 0; i < n; ++i) {
+      Conn& c = conns[events[i].data.u32];
+      flush(c);
+      for (;;) {
+        ssize_t got = ::recv(c.fd, rbuf, sizeof(rbuf), 0);
+        if (got > 0) {
+          c.inbuf.append(rbuf, (size_t)got);
+          if (got < (ssize_t)sizeof(rbuf)) break;
+          continue;
+        }
+        if (got == 0) {
+          fprintf(stderr, "server closed connection\n");
+          return 1;
+        }
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        fprintf(stderr, "recv error\n");
+        return 1;
+      }
+      // parse frames
+      size_t off = 0;
+      while (c.inbuf.size() - off >= 9) {
+        const uint8_t* h = (const uint8_t*)c.inbuf.data() + off;
+        uint32_t len = (h[0] << 16) | (h[1] << 8) | h[2];
+        uint8_t type = h[3], flags = h[4];
+        uint32_t sid = ((h[5] & 0x7f) << 24) | (h[6] << 16) | (h[7] << 8) | h[8];
+        if (c.inbuf.size() - off < 9 + len) break;
+        std::string_view payload{c.inbuf.data() + off + 9, len};
+        off += 9 + len;
+        switch (type) {
+          case 0:  // DATA
+            c.recv_unacked += len;
+            break;
+          case 1:  // HEADERS (response or trailers)
+            if (flags & 0x1) {  // END_STREAM -> trailers: stream complete
+              auto it = c.t_send.find(sid);
+              if (it != c.t_send.end()) {
+                uint64_t lat = now_ns() - it->second;
+                bool ok = payload.find("grpc-status") == std::string_view::npos ||
+                          payload.find(std::string_view("grpc-status\x01"
+                                                        "0", 13)) !=
+                              std::string_view::npos;
+                if (measuring) {
+                  if (ok) ++stats.ok;
+                  else ++stats.errors;
+                  stats.lat_us.push_back((uint32_t)(lat / 1000));
+                }
+                c.t_send.erase(it);
+                start_stream(c);
+              }
+            }
+            break;
+          case 3:  // RST_STREAM
+            if (c.t_send.erase(sid)) {
+              if (measuring) ++stats.errors;
+              start_stream(c);
+            }
+            break;
+          case 4:  // SETTINGS
+            if (!(flags & 0x1)) frame_header(c.outbuf, 0, 4, 0x1, 0);
+            break;
+          case 6:  // PING
+            if (!(flags & 0x1)) {
+              frame_header(c.outbuf, len, 6, 0x1, 0);
+              c.outbuf.append(payload);
+            }
+            break;
+          default:
+            break;
+        }
+      }
+      if (off > 0) c.inbuf.erase(0, off);
+      if (c.recv_unacked >= (1u << 15)) {
+        frame_header(c.outbuf, 4, 8, 0, 0);
+        char wu[4] = {(char)(c.recv_unacked >> 24), (char)(c.recv_unacked >> 16),
+                      (char)(c.recv_unacked >> 8), (char)c.recv_unacked};
+        c.outbuf.append(wu, 4);
+        c.recv_unacked = 0;
+      }
+      flush(c);
+    }
+  }
+  double elapsed = 1e-9 * (now_ns() - t_measure);
+  std::sort(stats.lat_us.begin(), stats.lat_us.end());
+  auto pct = [&](double p) -> double {
+    if (stats.lat_us.empty()) return 0;
+    size_t idx = (size_t)(p / 100.0 * stats.lat_us.size());
+    if (idx >= stats.lat_us.size()) idx = stats.lat_us.size() - 1;
+    return stats.lat_us[idx] / 1000.0;
+  };
+  double mean = 0;
+  for (auto v : stats.lat_us) mean += v;
+  mean = stats.lat_us.empty() ? 0 : mean / stats.lat_us.size() / 1000.0;
+  printf("{\"label\": \"%s\", \"throughput_rps\": %.2f, \"requests\": %" PRIu64
+         ", \"failures\": %" PRIu64
+         ", \"duration_s\": %.2f, \"connections\": %d, \"streams_per_conn\": %d, "
+         "\"latency_ms\": {\"mean\": %.3f, \"p50\": %.3f, \"p75\": %.3f, "
+         "\"p90\": %.3f, \"p95\": %.3f, \"p98\": %.3f, \"p99\": %.3f, "
+         "\"max\": %.3f}}\n",
+         label, (stats.ok + stats.errors) / elapsed, stats.ok, stats.errors,
+         elapsed, connections, streams_per_conn, mean, pct(50), pct(75),
+         pct(90), pct(95), pct(98), pct(99),
+         stats.lat_us.empty() ? 0 : stats.lat_us.back() / 1000.0);
+  return stats.errors == 0 ? 0 : 3;
+}

@@ -39,13 +39,14 @@ LOADGEN_BINARY = os.path.join(_NATIVE_DIR, "build", "seldon_loadgen")
 
 
 def build_edge_binaries() -> bool:
-    """Build the native edge/loadgen if needed; False when no toolchain."""
-    if os.path.exists(EDGE_BINARY) and os.path.exists(LOADGEN_BINARY):
+    """Build the native edge/loadgens if needed; False when no toolchain."""
+    binaries = (EDGE_BINARY, LOADGEN_BINARY, LOADGEN_BINARY + "_grpc")
+    if all(os.path.exists(b) for b in binaries):
         src = max(
             os.path.getmtime(os.path.join(_NATIVE_DIR, f))
-            for f in ("edge.cc", "ring.cc", "loadgen_http.cc")
+            for f in ("edge.cc", "ring.cc", "loadgen_http.cc", "loadgen_grpc.cc")
         )
-        if min(os.path.getmtime(EDGE_BINARY), os.path.getmtime(LOADGEN_BINARY)) >= src:
+        if min(os.path.getmtime(b) for b in binaries) >= src:
             return True
     if shutil.which("make") is None:
         return False
@@ -68,6 +69,10 @@ def compile_edge_program(
         if kind is None:
             return None
         params = unit.parameters_dict()
+        if kind == "RANDOM_ABTEST" and params.get("seed") is not None:
+            # seeded routing must reproduce the Python engine's random.Random
+            # sequence exactly; only the Python engine can honor that
+            return None
         children: List[int] = []
         for child in unit.children:
             idx = compile_unit(child)

@@ -37,9 +37,12 @@ KIND_PREDICT = 0
 KIND_FEEDBACK = 1
 
 
-def _error_body(info: str, reason: str) -> bytes:
-    """Error frame body; the client parses status.info/status.reason."""
-    return json.dumps({"status": {"info": info, "reason": reason, "status": 1}}).encode()
+def _error_body(info: str, reason: str, code: int = 500) -> bytes:
+    """Error frame body (Status contract shape, contracts/payload.py Status):
+    clients parse status.info/status.reason; HTTP frontends use status.code."""
+    return json.dumps(
+        {"status": {"code": code, "info": info, "reason": reason, "status": "FAILURE"}}
+    ).encode()
 
 
 def request_ring_path(base: str) -> str:
@@ -120,7 +123,11 @@ class IPCEngineServer:
             body = json.dumps(out.to_dict()).encode()
             status = 0
         except Exception as e:
-            body = _error_body(str(e), getattr(e, "reason", "ENGINE_ERROR"))
+            body = _error_body(
+                str(e),
+                getattr(e, "reason", "ENGINE_ERROR"),
+                int(getattr(e, "status_code", 500)),
+            )
             status = 1
         ring = self.resp_rings.get(worker_id)
         if ring is None:
@@ -135,6 +142,7 @@ class IPCEngineServer:
                 f"response too large for IPC slot "
                 f"({len(body)} bytes > {ring.slot_size - _RESP_HEADER.size})",
                 "RESPONSE_TOO_LARGE",
+                500,
             )
             try:
                 await asyncio.to_thread(ring.push_wait, _RESP_HEADER.pack(req_id, 1) + err, 5.0)

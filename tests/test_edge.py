@@ -272,8 +272,10 @@ def test_fallback_mode_serves_python_engine(tmp_path):
     spec_path.write_text(json.dumps(spec))
     port = free_port()
     env = dict(os.environ)
+    import sys
+
     proc = subprocess.Popen(
-        ["python", "-m", "seldon_core_tpu.transport.cli", "edge",
+        [sys.executable, "-m", "seldon_core_tpu.transport.cli", "edge",
          "--spec", str(spec_path), "--port", str(port)],
         env=env, stderr=subprocess.DEVNULL,
     )
