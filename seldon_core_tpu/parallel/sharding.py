@@ -117,6 +117,38 @@ def _align_specs(params: Any, logical_specs: Any):
     return jax.tree_util.tree_map_with_path(lookup, params)
 
 
+def sharding_report(params: Any) -> dict:
+    """Inspect the actual ``.sharding`` of every array leaf: how many leaves
+    are sharded vs replicated, and which mesh axes carry shards. This is the
+    guard against the silent full-replication fallback — tests and strict
+    callers assert on it rather than trusting that shard_params worked."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    report = {"sharded": 0, "replicated": 0, "other": 0, "axes": set()}
+
+    def visit(leaf):
+        sh = getattr(leaf, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            report["other"] += 1
+            return
+        axes = set()
+        for entry in sh.spec:
+            if entry is None:
+                continue
+            axes.update(entry if isinstance(entry, tuple) else (entry,))
+        # Axes of size 1 don't partition anything.
+        axes = {a for a in axes if sh.mesh.shape[a] > 1}
+        if axes:
+            report["sharded"] += 1
+            report["axes"] |= axes
+        else:
+            report["replicated"] += 1
+
+    jax.tree.map(visit, params)
+    return report
+
+
 def shard_apply(
     apply_fn: Callable,
     module,
@@ -125,12 +157,17 @@ def shard_apply(
     rules=None,
     example_input=None,
     batch_axis: str = "data",
+    strict: bool = False,
 ):
     """Return (jitted_apply, sharded_params) for mesh execution.
 
     - params shard per the module's logical axis names (replicated fallback);
     - inputs/outputs shard their leading batch dim over ``batch_axis``;
     - the mesh is installed as context so flax sharding constraints resolve.
+    - ``strict=True`` raises if the mesh has a non-trivial parameter axis
+      (any axis other than ``batch_axis`` with size > 1) but no param leaf
+      actually sharded over it — i.e. the replication fallback fired on a
+      mesh that was supposed to partition the model.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -144,6 +181,20 @@ def shard_apply(
         except Exception as e:
             logger.warning("could not derive logical axes (%s); replicating params", e)
     sharded_params = shard_params(params, mesh, logical_specs, rules)
+
+    param_axes = {a for a in mesh.axis_names if a != batch_axis and mesh.shape[a] > 1}
+    if param_axes:
+        report = sharding_report(sharded_params)
+        if not (report["axes"] & param_axes):
+            msg = (
+                f"mesh has parameter axes {sorted(param_axes)} but every param "
+                f"leaf is replicated (report: sharded={report['sharded']} "
+                f"replicated={report['replicated']}) — the logical-axis spec "
+                "did not align with the param tree"
+            )
+            if strict:
+                raise ValueError(msg)
+            logger.warning(msg)
 
     batch_sharding = NamedSharding(mesh, P(batch_axis))
     replicated = NamedSharding(mesh, P())

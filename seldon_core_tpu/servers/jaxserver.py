@@ -43,6 +43,8 @@ class JAXServer(SeldonComponent):
         mesh: Optional[Any] = None,
         param_sharding_rules: Optional[Any] = None,
         batch_buckets: Optional[Sequence[int]] = None,
+        strict_sharding: bool = False,
+        tensor_parallel: int = 0,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -50,6 +52,12 @@ class JAXServer(SeldonComponent):
         self.model_name = model
         self.mesh = mesh
         self.param_sharding_rules = param_sharding_rules
+        self.strict_sharding = strict_sharding
+        # Spec-reachable sharding: `tensor_parallel` arrives as a typed unit
+        # parameter from the graph spec (the CR analogue of the reference's
+        # per-predictor `replicas`, proto/seldon_deployment.proto:57) and
+        # builds the standard ('data', 'model') serving mesh at load time.
+        self.tensor_parallel = int(tensor_parallel)
         self.batch_buckets = tuple(batch_buckets) if batch_buckets else DEFAULT_BUCKETS
         self.ready = False
         self._apply = None
@@ -76,6 +84,18 @@ class JAXServer(SeldonComponent):
         module = get_model(name, **self._config.get("kwargs", {}))
         self._module = module
 
+        if self.mesh is None and self.tensor_parallel > 1:
+            from seldon_core_tpu.parallel.mesh import serving_mesh
+
+            n = len(jax.devices())
+            if n % self.tensor_parallel:
+                raise SeldonError(
+                    f"tensor_parallel={self.tensor_parallel} does not divide "
+                    f"{n} available devices",
+                    status_code=500,
+                )
+            self.mesh = serving_mesh(model_parallel=self.tensor_parallel)
+
         params = self._load_params(path)
         apply_kwargs = self._config.get("apply_kwargs", {})
 
@@ -88,6 +108,13 @@ class JAXServer(SeldonComponent):
         if self.mesh is not None:
             from seldon_core_tpu.parallel.sharding import shard_apply
 
+            # The jitted program shards the batch dim over the 'data' axis, so
+            # every compiled bucket must be a multiple of its size — round the
+            # buckets up (padding masks the remainder, sliced off on return).
+            dp = dict(self.mesh.shape).get("data", 1)
+            if dp > 1:
+                self.batch_buckets = tuple(sorted({-(-b // dp) * dp for b in self.batch_buckets}))
+
             example_input = None
             shape = self._config.get("input_shape")
             if shape is not None:
@@ -97,6 +124,7 @@ class JAXServer(SeldonComponent):
             self._apply, params = shard_apply(
                 apply_fn, module, params, self.mesh,
                 rules=self.param_sharding_rules, example_input=example_input,
+                strict=self.strict_sharding,
             )
         else:
             self._apply = jax.jit(apply_fn)

@@ -1,0 +1,183 @@
+"""Multi-device *serving* on the 8-device mesh: parameters must actually
+shard (no silent full-replication fallback), a sharded JAXServer must serve
+through the graph engine, and strict mode must raise when sharding degrades.
+
+The reference's only scaling mechanism is k8s replicas
+(proto/seldon_deployment.proto:57); the GSPMD mesh is this framework's
+replacement, so degrading to replication without noticing would silently
+lose the capability.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.models import get_model
+from seldon_core_tpu.parallel.mesh import make_mesh, serving_mesh
+from seldon_core_tpu.parallel.sharding import shard_apply, sharding_report
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_transformer_params_actually_shard(eight_devices):
+    """shard_apply on the transformer must place attention/mlp/vocab weights
+    over the 'model' axis — assert on the real .sharding of the live arrays,
+    not on the spec derivation."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = make_mesh({"data": 4, "model": 2}, eight_devices)
+    model = get_model("llama-tiny")
+    tokens = jnp.zeros((4, 8), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+
+    def apply_fn(variables, x):
+        logits, _ = model.apply(variables, x)
+        return logits
+
+    example = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+    jitted, sharded = shard_apply(
+        apply_fn, model, variables, mesh, example_input=example, strict=True
+    )
+
+    report = sharding_report(sharded)
+    assert "model" in report["axes"], report
+    assert report["sharded"] > 0, report
+
+    # A concrete leaf: the first block's wq must be split over 'model', so a
+    # per-device shard holds half the heads dim.
+    wq = sharded["params"]["layer_0"]["attention"]["wq"]
+    shard_shape = wq.sharding.shard_shape(wq.shape)
+    assert shard_shape != wq.shape, (shard_shape, wq.shape)
+
+    out = jitted(sharded, tokens)
+    assert out.shape == (4, 8, model.cfg.vocab_size)
+
+
+def test_shard_apply_strict_raises_on_replication(eight_devices):
+    """A module with no logical axis metadata cannot shard over a model axis;
+    strict mode must surface that instead of silently replicating."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = make_mesh({"data": 4, "model": 2}, eight_devices)
+    model = get_model("mlp", features=[8], num_classes=3, dtype="float32")
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+
+    def apply_fn(params, x):
+        return model.apply(params, x)
+
+    with pytest.raises(ValueError, match="replicated"):
+        shard_apply(
+            apply_fn, model, params, mesh,
+            example_input=jax.ShapeDtypeStruct((1, 4), jnp.float32),
+            strict=True,
+        )
+    # Non-strict keeps the old tolerant behavior.
+    jitted, sharded = shard_apply(
+        apply_fn, model, params, mesh,
+        example_input=jax.ShapeDtypeStruct((1, 4), jnp.float32),
+    )
+    out = jitted(sharded, jnp.ones((4, 4)))
+    assert out.shape == (4, 3)
+
+
+def test_engine_serves_sharded_jaxserver(eight_devices, tmp_path):
+    """Engine → JAXServer predict on a serving_mesh(model_parallel=2): the
+    full serving path (spec → engine → bucketed staging → sharded jit) runs
+    with tensor-parallel params, and strict_sharding holds it honest."""
+    import jax
+
+    from seldon_core_tpu.contracts.graph import PredictorSpec
+    from seldon_core_tpu.contracts.payload import SeldonMessage
+    from seldon_core_tpu.runtime.engine import GraphEngine
+    from seldon_core_tpu.servers.jaxserver import JAXServer, export_checkpoint
+
+    model = get_model("llama-tiny")
+    tokens = np.zeros((1, 8), np.int32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), tokens)
+    ckpt = export_checkpoint(
+        str(tmp_path / "ckpt"),
+        model="llama-tiny",
+        params=variables,
+        input_shape=[8],
+        input_dtype="int32",
+        use_orbax=False,
+    )
+
+    mesh = serving_mesh(model_parallel=2, devices=eight_devices)
+    assert mesh.shape == {"data": 4, "model": 2}
+    # Buckets deliberately not multiples of the data axis (4): load() must
+    # round them up or the sharded jit rejects every odd-sized batch.
+    server = JAXServer(
+        model_uri=ckpt, mesh=mesh, batch_buckets=(1, 2, 4), strict_sharding=True
+    )
+    spec = PredictorSpec.from_dict(
+        {"name": "p", "graph": {"name": "llm", "type": "MODEL"}}
+    )
+    engine = GraphEngine(spec, components={"llm": server})
+
+    report = sharding_report(server._params)
+    assert "model" in report["axes"], report
+    assert all(b % 4 == 0 for b in server.batch_buckets), server.batch_buckets
+
+    msg = SeldonMessage.from_dict(
+        {"data": {"tensor": {"shape": [2, 8], "values": [1.0] * 16}}}
+    )
+    out = run(engine.predict(msg))
+    d = out.to_dict()
+    shape = d["data"]["tensor"]["shape"]
+    assert shape == [2, 8, model.cfg.vocab_size]
+    assert np.isfinite(np.asarray(d["data"]["tensor"]["values"])).all()
+
+
+def test_spec_driven_tensor_parallel(eight_devices, tmp_path):
+    """`tensor_parallel` as a typed unit parameter in the graph spec builds
+    the serving mesh at load time — multi-chip serving reachable from a CR,
+    no Python wiring required."""
+    import jax
+
+    from seldon_core_tpu.contracts.graph import PredictorSpec
+    from seldon_core_tpu.contracts.payload import SeldonMessage
+    from seldon_core_tpu.runtime.engine import GraphEngine
+    from seldon_core_tpu.servers.jaxserver import export_checkpoint
+
+    model = get_model("llama-tiny")
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0), np.zeros((1, 8), np.int32)
+    )
+    ckpt = export_checkpoint(
+        str(tmp_path / "ckpt"),
+        model="llama-tiny",
+        params=variables,
+        input_shape=[8],
+        input_dtype="int32",
+        use_orbax=False,
+    )
+    spec = PredictorSpec.from_dict(
+        {
+            "name": "p",
+            "graph": {
+                "name": "llm",
+                "type": "MODEL",
+                "implementation": "JAX_SERVER",
+                "modelUri": ckpt,
+                "parameters": [
+                    {"name": "tensor_parallel", "value": "2", "type": "INT"},
+                    {"name": "strict_sharding", "value": "true", "type": "BOOL"},
+                ],
+            },
+        }
+    )
+    engine = GraphEngine(spec)
+    unit = engine.state.root.component
+    assert unit.mesh is not None and dict(unit.mesh.shape)["model"] == 2
+
+    msg = SeldonMessage.from_dict(
+        {"data": {"tensor": {"shape": [3, 8], "values": [1.0] * 24}}}
+    )
+    out = run(engine.predict(msg))
+    assert out.to_dict()["data"]["tensor"]["shape"] == [3, 8, model.cfg.vocab_size]
