@@ -25,8 +25,10 @@
 // - The ring fallback polls with a timerfd while requests are in flight;
 //   the Python engine side is seldon_core_tpu/transport/ipc.py.
 
+#include <algorithm>
 #include <atomic>
 #include <cctype>
+#include <deque>
 #include <cerrno>
 #include <cinttypes>
 #include <cmath>
@@ -902,7 +904,6 @@ struct PbWriter {
     varint(s.size());
     b.append(s);
   }
-  void raw_len(uint32_t field, std::string_view s) { str(field, s); }
   void fixed32(uint32_t field, float v) {
     tag(field, 5);
     b.append((const char*)&v, 4);
@@ -919,8 +920,6 @@ struct PbSeldonMsg {
   int64_t tensor_prod = -1, tensor_nvals = -1;
   const char* err = nullptr;
 };
-
-inline uint64_t pb_key(uint32_t field, uint32_t wire) { return (uint64_t)field << 3 | wire; }
 
 // Parse a Meta submessage (echo spans + puid).
 bool pb_parse_meta(std::string_view span, PbSeldonMsg& out) {
@@ -1075,16 +1074,30 @@ struct RingPending {
 struct H2Stream {
   std::string path;
   Buf data;
-  bool headers_done = false;
   bool path_huffman = false;
+};
+
+// A response whose DATA has not fully cleared flow control: remaining gRPC
+// message bytes (unframed — frames are cut at send time so they respect the
+// peer's SETTINGS_MAX_FRAME_SIZE) plus this stream's remaining send window.
+struct H2Blocked {
+  uint32_t sid;
+  std::string data;
+  size_t off = 0;
+  int64_t stream_window = 65535;
 };
 
 struct H2State {
   HpackDyn hpack;
   std::unordered_map<uint32_t, H2Stream> streams;
-  int64_t send_window = 65535;
+  int64_t send_window = 65535;            // connection-level send window
+  int64_t client_initial_window = 65535;  // SETTINGS_INITIAL_WINDOW_SIZE
+  uint32_t client_max_frame = 16384;      // SETTINGS_MAX_FRAME_SIZE
   uint32_t recv_unacked = 0;
-  std::vector<std::string> blocked;  // DATA frames awaiting window
+  std::deque<H2Blocked> blocked;  // responses awaiting window
+  // WINDOW_UPDATE credit granted before the response was queued (e.g. a
+  // client using SETTINGS_INITIAL_WINDOW_SIZE=0 + explicit grants).
+  std::unordered_map<uint32_t, int64_t> stream_credit;
 };
 
 struct Conn {
@@ -1685,36 +1698,48 @@ struct Server {
 
   void grpc_respond_msg(Conn& c, uint32_t sid, std::string_view msg) {
     h2_frame(c.outbuf, 1, 0x4, sid, h2_resp_headers);
-    Buf data;
-    data.push(0);  // uncompressed
+    H2Blocked item;
+    item.sid = sid;
+    item.data.reserve(msg.size() + 5);
+    item.data.push_back((char)0);  // uncompressed
     char len4[4] = {(char)(msg.size() >> 24), (char)(msg.size() >> 16),
                     (char)(msg.size() >> 8), (char)msg.size()};
-    data.append(len4, 4);
-    data.append(msg);
-    if (c.h2->send_window >= (int64_t)data.size() && c.h2->blocked.empty()) {
-      c.h2->send_window -= (int64_t)data.size();
-      h2_frame(c.outbuf, 0, 0, sid, {data.data(), data.size()});
-      h2_frame(c.outbuf, 1, 0x5, sid, h2_trailers_ok);
-    } else {
-      // connection send window exhausted: queue DATA+trailers until the
-      // client opens the window
-      Buf blocked;
-      h2_frame(blocked, 0, 0, sid, {data.data(), data.size()});
-      h2_frame(blocked, 1, 0x5, sid, h2_trailers_ok);
-      c.h2->blocked.emplace_back(blocked.data(), blocked.size());
+    item.data.append(len4, 4);
+    item.data.append(msg);
+    item.stream_window = c.h2->client_initial_window;
+    auto credit = c.h2->stream_credit.find(sid);
+    if (credit != c.h2->stream_credit.end()) {
+      item.stream_window += credit->second;
+      c.h2->stream_credit.erase(credit);
     }
+    c.h2->blocked.emplace_back(std::move(item));
+    h2_drain_blocked(c);
   }
 
+  // Emit as much queued DATA as the connection + per-stream send windows
+  // allow, in frames no larger than the peer's SETTINGS_MAX_FRAME_SIZE;
+  // trailers follow the last DATA chunk of each response. A stream whose
+  // window is exhausted doesn't block responses on other streams.
   void h2_drain_blocked(Conn& c) {
-    while (!c.h2->blocked.empty()) {
-      const std::string& frames = c.h2->blocked.front();
-      // first frame is the DATA frame; its payload length is in the header
-      uint32_t dlen = ((uint8_t)frames[0] << 16) | ((uint8_t)frames[1] << 8) |
-                      (uint8_t)frames[2];
-      if (c.h2->send_window < (int64_t)dlen) break;
-      c.h2->send_window -= dlen;
-      c.outbuf.append(frames.data(), frames.size());
-      c.h2->blocked.erase(c.h2->blocked.begin());
+    for (auto it = c.h2->blocked.begin(); it != c.h2->blocked.end();) {
+      H2Blocked& b = *it;
+      while (b.off < b.data.size() && c.h2->send_window > 0 && b.stream_window > 0) {
+        size_t allowed = (size_t)std::min(c.h2->send_window, b.stream_window);
+        size_t chunk = std::min({b.data.size() - b.off,
+                                 (size_t)c.h2->client_max_frame, allowed});
+        h2_frame(c.outbuf, 0, 0, b.sid, {b.data.data() + b.off, chunk});
+        b.off += chunk;
+        c.h2->send_window -= (int64_t)chunk;
+        b.stream_window -= (int64_t)chunk;
+      }
+      if (b.off == b.data.size()) {
+        h2_frame(c.outbuf, 1, 0x5, b.sid, h2_trailers_ok);
+        it = c.h2->blocked.erase(it);
+      } else if (c.h2->send_window <= 0) {
+        break;
+      } else {
+        ++it;
+      }
     }
   }
 
@@ -1947,7 +1972,10 @@ struct Server {
         case 0: {  // DATA
           auto it = c.h2->streams.find(sid);
           if (flags & 0x8) {  // PADDED
-            if (payload.empty()) break;
+            if (payload.empty() || (size_t)(uint8_t)payload[0] > payload.size() - 1) {
+              close_conn(c);  // RFC 7540 §6.1: PROTOCOL_ERROR
+              return;
+            }
             uint8_t pad = (uint8_t)payload[0];
             payload = payload.substr(1, payload.size() - 1 - pad);
           }
@@ -1957,13 +1985,22 @@ struct Server {
             if (flags & 0x1) {  // END_STREAM
               h2_rpc(c, sid, it->second);
               c.h2->streams.erase(it);
+            } else if (len > 0) {
+              // replenish this stream's recv window so bodies larger than
+              // the 64KB initial window keep flowing
+              char wu[4] = {(char)(len >> 24), (char)(len >> 16),
+                            (char)(len >> 8), (char)len};
+              h2_frame(c.outbuf, 8, 0, sid, {wu, 4});
             }
           }
           break;
         }
         case 1: {  // HEADERS
           if (flags & 0x8) {  // PADDED
-            if (payload.empty()) break;
+            if (payload.empty() || (size_t)(uint8_t)payload[0] > payload.size() - 1) {
+              close_conn(c);
+              return;
+            }
             uint8_t pad = (uint8_t)payload[0];
             payload = payload.substr(1, payload.size() - 1 - pad);
           }
@@ -1989,7 +2026,6 @@ struct Server {
               s.path_huffman = f.value_huffman;
             }
           }
-          s.headers_done = true;
           if (flags & 0x1) {  // END_STREAM with no body
             h2_rpc(c, sid, s);
             c.h2->streams.erase(sid);
@@ -1998,9 +2034,30 @@ struct Server {
         }
         case 3:  // RST_STREAM
           c.h2->streams.erase(sid);
+          c.h2->stream_credit.erase(sid);
+          for (auto it = c.h2->blocked.begin(); it != c.h2->blocked.end();) {
+            it = it->sid == sid ? c.h2->blocked.erase(it) : std::next(it);
+          }
           break;
         case 4:  // SETTINGS
-          if (!(flags & 0x1)) h2_frame(c.outbuf, 4, 0x1, 0, {});
+          if (!(flags & 0x1)) {
+            for (size_t i = 0; i + 6 <= payload.size(); i += 6) {
+              const uint8_t* e = (const uint8_t*)payload.data() + i;
+              uint16_t id = (uint16_t)((e[0] << 8) | e[1]);
+              uint32_t val = ((uint32_t)e[2] << 24) | (e[3] << 16) | (e[4] << 8) | e[5];
+              if (id == 4) {  // INITIAL_WINDOW_SIZE
+                if (val > 0x7fffffffu) { close_conn(c); return; }
+                // RFC 7540 §6.9.2: delta applies to existing stream windows
+                int64_t delta = (int64_t)val - c.h2->client_initial_window;
+                c.h2->client_initial_window = (int64_t)val;
+                for (auto& b : c.h2->blocked) b.stream_window += delta;
+                if (delta > 0) h2_drain_blocked(c);
+              } else if (id == 5) {  // MAX_FRAME_SIZE
+                if (val >= 16384 && val <= 16777215) c.h2->client_max_frame = val;
+              }
+            }
+            h2_frame(c.outbuf, 4, 0x1, 0, {});
+          }
           break;
         case 6:  // PING
           if (!(flags & 0x1)) h2_frame(c.outbuf, 6, 0x1, 0, payload);
@@ -2009,10 +2066,27 @@ struct Server {
           c.want_close = true;
           break;
         case 8: {  // WINDOW_UPDATE
-          if (payload.size() == 4 && sid == 0) {
+          if (payload.size() == 4) {
             uint32_t inc = ((uint8_t)payload[0] << 24) | ((uint8_t)payload[1] << 16) |
                            ((uint8_t)payload[2] << 8) | (uint8_t)payload[3];
-            c.h2->send_window += inc & 0x7fffffff;
+            inc &= 0x7fffffff;
+            if (sid == 0) {
+              c.h2->send_window += inc;
+            } else {
+              bool queued = false;
+              for (auto& b : c.h2->blocked) {
+                if (b.sid == sid) {
+                  b.stream_window += inc;
+                  queued = true;
+                }
+              }
+              // grant arrived before the response was queued: bank it for
+              // grpc_respond_msg (only for streams we know about, so bogus
+              // sids can't grow the map)
+              if (!queued && c.h2->streams.count(sid)) {
+                c.h2->stream_credit[sid] += inc;
+              }
+            }
             h2_drain_blocked(c);
           }
           break;
@@ -2135,8 +2209,16 @@ struct Server {
           content_len = strtoull(std::string(value).c_str(), nullptr, 10);
         else if (name.size() == 10 && strncasecmp(name.data(), "connection", 10) == 0)
           close_hdr = value.size() == 5 && strncasecmp(value.data(), "close", 5) == 0;
-        else if (name.size() == 17 && strncasecmp(name.data(), "transfer-encoding", 17) == 0)
-          chunked = true;
+        else if (name.size() == 17 && strncasecmp(name.data(), "transfer-encoding", 17) == 0) {
+          // only "chunked" (possibly last in a list, any case) changes body
+          // framing; "identity" with a Content-Length is a normal request
+          for (size_t ti = 0; ti + 7 <= value.size(); ++ti) {
+            if (strncasecmp(value.data() + ti, "chunked", 7) == 0) {
+              chunked = true;
+              break;
+            }
+          }
+        }
       }
       if (chunked) {
         c.want_close = true;

@@ -92,7 +92,14 @@ def edge_grpc(tmp_path_factory):
         path = write_program(program, str(tmp / f"{key}.json"))
         port = free_port()
         proc = subprocess.Popen(
-            [EDGE_BINARY, "--program", path, "--grpc-port", str(port)],
+            [
+                EDGE_BINARY, "--program", path,
+                # explicit HTTP port: the default (8000) is shared by every
+                # edge in this module via SO_REUSEPORT, which would steal
+                # each other's HTTP traffic if any test used it
+                "--port", str(free_port()),
+                "--grpc-port", str(port),
+            ],
             stderr=subprocess.DEVNULL,
         )
         deadline = time.monotonic() + 15
@@ -194,3 +201,28 @@ def test_grpc_many_requests_one_channel(edge_grpc):
             assert list(resp.data.tensor.shape) == [1, 3]
             puids.add(resp.meta.puid)
     assert len(puids) == 300
+
+
+def test_grpc_large_request_body(edge_grpc):
+    """A request body beyond the 65535-byte initial HTTP/2 stream window:
+    the edge must grant stream-level WINDOW_UPDATEs or the client stalls
+    until DEADLINE_EXCEEDED."""
+    port = edge_grpc("single", SINGLE)
+    n = 20000  # 20k doubles ~ 160KB of packed tensor values
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        stub = predict_stub(ch)
+        resp = stub(tensor_request([n, 1], [1.0] * n), timeout=15)
+        assert list(resp.data.tensor.shape) == [n, 3]
+
+
+def test_grpc_large_response_body(edge_grpc):
+    """A response larger than SETTINGS_MAX_FRAME_SIZE (16384) and the 65535
+    initial stream send window: DATA must be chunked and wait for client
+    WINDOW_UPDATEs instead of blasting one oversized frame."""
+    port = edge_grpc("single", SINGLE)
+    rows = 4000  # response tensor 4000x3 doubles ~ 96KB+ proto
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        stub = predict_stub(ch)
+        resp = stub(tensor_request([rows, 2], [1.0] * (rows * 2)), timeout=15)
+        assert list(resp.data.tensor.shape) == [rows, 3]
+        assert len(resp.data.tensor.values) == rows * 3
