@@ -1074,6 +1074,7 @@ struct RingPending {
 struct H2Stream {
   std::string path;
   Buf data;
+  uint32_t recv_unacked = 0;  // bytes received since the last stream-level grant
   bool path_huffman = false;
 };
 
@@ -1119,6 +1120,9 @@ uint64_t now_ns() {
 }
 
 struct Server {
+  // request-body ceiling for both protocols (aiohttp client_max_size parity
+  // on HTTP/1; per-stream buffer cap on HTTP/2)
+  static constexpr size_t kMaxBody = 1u << 30;
   Program prog;
   Metrics metrics;
   Rng rng;
@@ -1981,16 +1985,32 @@ struct Server {
           }
           c.h2->recv_unacked += len;
           if (it != c.h2->streams.end()) {
-            it->second.data.append(payload);
-            if (flags & 0x1) {  // END_STREAM
-              h2_rpc(c, sid, it->second);
+            H2Stream& s = it->second;
+            if (s.data.size() + payload.size() > kMaxBody) {
+              // cap what a stream may buffer (REST-path parity): refuse the
+              // RPC instead of growing without bound on granted window
+              grpc_trailers_error(c, sid, 8, "request message too large");
               c.h2->streams.erase(it);
-            } else if (len > 0) {
+              c.h2->stream_credit.erase(sid);
+              break;
+            }
+            s.data.append(payload);
+            if (flags & 0x1) {  // END_STREAM
+              h2_rpc(c, sid, s);
+              c.h2->streams.erase(it);
+              c.h2->stream_credit.erase(sid);
+            } else {
               // replenish this stream's recv window so bodies larger than
-              // the 64KB initial window keep flowing
-              char wu[4] = {(char)(len >> 24), (char)(len >> 16),
-                            (char)(len >> 8), (char)len};
-              h2_frame(c.outbuf, 8, 0, sid, {wu, 4});
+              // the 64KB initial window keep flowing; coalesced like the
+              // connection-level grant below
+              s.recv_unacked += len;
+              if (s.recv_unacked >= (1u << 15)) {
+                uint32_t inc = s.recv_unacked;
+                char wu[4] = {(char)(inc >> 24), (char)(inc >> 16),
+                              (char)(inc >> 8), (char)inc};
+                h2_frame(c.outbuf, 8, 0, sid, {wu, 4});
+                s.recv_unacked = 0;
+              }
             }
           }
           break;
@@ -2029,6 +2049,7 @@ struct Server {
           if (flags & 0x1) {  // END_STREAM with no body
             h2_rpc(c, sid, s);
             c.h2->streams.erase(sid);
+            c.h2->stream_credit.erase(sid);
           }
           break;
         }
@@ -2191,7 +2212,7 @@ struct Server {
       size_t q = target.find('?');
       std::string_view path = q == std::string_view::npos ? target : target.substr(0, q);
       // headers we care about
-      constexpr size_t kMaxBody = 1u << 30;  // aiohttp client_max_size parity
+      // (body cap: kMaxBody, shared with the gRPC stream buffer cap)
       uint64_t content_len = 0;
       bool close_hdr = false;
       bool chunked = false;

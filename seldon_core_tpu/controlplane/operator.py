@@ -1,0 +1,352 @@
+"""Control-plane reconcile loop (the operator).
+
+The reference's operator is an external Go controller (cloned at build time,
+`seldon-controller/Makefile:5-9`) deployed by
+`helm-charts/seldon-core-operator/templates/statefulset.yaml:1-70`: it watches
+``SeldonDeployment`` CRs, renders per-predictor Deployments with the engine
+injected, and converges the cluster, with a defaulting/validating webhook in
+front. This module is that loop as a small Python process:
+
+    watch CR sources -> validate + default -> render -> diff -> apply/delete
+                                         \\-> status written back per CR
+
+The cluster is a pluggable backend. ``FileCluster`` (the default) stores
+applied manifests as JSON files keyed by kind/namespace/name — a faithful,
+testable stand-in for ``kubectl apply`` that also works as a local dry-run
+target; a real-cluster backend only needs apply/delete/list to swap in
+(``KubectlCluster`` shells out to kubectl when a cluster is reachable).
+
+Admission (webhook role): a CR that fails validation is NOT partially
+applied — its status goes to Failed with the problem list, matching the
+reference's rejection of bad graphs (`testing/scripts/test_bad_graphs.py`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import signal
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from seldon_core_tpu.contracts.graph import SeldonDeploymentSpec
+from seldon_core_tpu.controlplane.render import render_manifests
+from seldon_core_tpu.controlplane.validate import default_deployment, validate_deployment
+
+logger = logging.getLogger(__name__)
+
+OWNER_LABEL = "seldon-deployment-id"
+
+
+def _manifest_key(m: Dict[str, Any]) -> Tuple[str, str, str]:
+    meta = m.get("metadata", {})
+    return (m.get("kind", ""), meta.get("namespace", "default"), meta.get("name", ""))
+
+
+class FileCluster:
+    """Applied-manifest store: one JSON file per object under
+    ``<root>/<kind>/<namespace>/<name>.json``. apply() is idempotent and
+    reports created/updated/unchanged so the reconciler can log convergence
+    the way a controller's event stream would."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, kind: str, namespace: str, name: str) -> str:
+        return os.path.join(self.root, kind.lower(), namespace, f"{name}.json")
+
+    def apply(self, manifest: Dict[str, Any]) -> str:
+        kind, namespace, name = _manifest_key(manifest)
+        if not kind or not name:
+            raise ValueError(f"manifest missing kind or metadata.name: {manifest}")
+        path = self._path(kind, namespace, name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        serialized = json.dumps(manifest, indent=2, sort_keys=True)
+        if os.path.exists(path):
+            with open(path) as f:
+                if f.read() == serialized:
+                    return "unchanged"
+            status = "updated"
+        else:
+            status = "created"
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(serialized)
+        os.replace(tmp, path)
+        return status
+
+    def delete(self, kind: str, namespace: str, name: str) -> bool:
+        path = self._path(kind, namespace, name)
+        if os.path.exists(path):
+            os.remove(path)
+            return True
+        return False
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        path = self._path(kind, namespace, name)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def list(self, label: Optional[str] = None, value: Optional[str] = None) -> List[Dict[str, Any]]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                if not fn.endswith(".json"):
+                    continue
+                with open(os.path.join(dirpath, fn)) as f:
+                    m = json.load(f)
+                labels = m.get("metadata", {}).get("labels", {})
+                if label is not None and labels.get(label) != value:
+                    continue
+                out.append(m)
+        return out
+
+
+class KubectlCluster:
+    """Real-cluster backend: shells out to kubectl. Only used when a
+    kubeconfig/cluster is actually reachable; everything above it is
+    backend-agnostic."""
+
+    def __init__(self, kubectl: str = "kubectl"):
+        self.kubectl = kubectl
+
+    def apply(self, manifest: Dict[str, Any]) -> str:
+        res = subprocess.run(
+            [self.kubectl, "apply", "-f", "-"],
+            input=json.dumps(manifest).encode(),
+            capture_output=True,
+        )
+        if res.returncode != 0:
+            raise RuntimeError(f"kubectl apply failed: {res.stderr.decode()}")
+        out = res.stdout.decode()
+        if "created" in out:
+            return "created"
+        return "unchanged" if "unchanged" in out else "updated"
+
+    def delete(self, kind: str, namespace: str, name: str) -> bool:
+        res = subprocess.run(
+            [self.kubectl, "delete", kind.lower(), name, "-n", namespace,
+             "--ignore-not-found"],
+            capture_output=True,
+        )
+        return res.returncode == 0 and b"deleted" in res.stdout
+
+    def list(self, label: Optional[str] = None, value: Optional[str] = None) -> List[Dict[str, Any]]:
+        items: List[Dict[str, Any]] = []
+        # VirtualServices queried separately: the Istio CRD may be absent, and
+        # a missing resource type would fail the whole combined query (which
+        # would orphan VirtualServices on prune/delete).
+        for kinds in ("deployments,services,horizontalpodautoscalers",
+                      "virtualservices.networking.istio.io"):
+            args = [self.kubectl, "get", kinds, "-A", "-o", "json"]
+            if label is not None:
+                args += ["-l", f"{label}={value}"]
+            res = subprocess.run(args, capture_output=True)
+            if res.returncode != 0:
+                continue
+            items.extend(json.loads(res.stdout.decode()).get("items", []))
+        return items
+
+
+@dataclass
+class ReconcileResult:
+    name: str
+    ok: bool
+    applied: Dict[str, str] = field(default_factory=dict)  # "Kind/ns/name" -> status
+    deleted: List[str] = field(default_factory=list)
+    problems: List[str] = field(default_factory=list)
+    transient: bool = False  # failed for a reason a retry might fix
+
+    def to_status(self) -> Dict[str, Any]:
+        return {
+            "state": "Available" if self.ok else "Failed",
+            "description": "; ".join(self.problems) if self.problems else "",
+            "applied": self.applied,
+            "deleted": self.deleted,
+        }
+
+
+class Reconciler:
+    """Converge one SeldonDeployment: desired = render(CR), actual = objects
+    in the cluster carrying this CR's owner label; apply the difference."""
+
+    def __init__(
+        self,
+        cluster,
+        namespace: str = "default",
+        engine_image: Optional[str] = None,
+        tpu_chips: int = 1,
+        tpu_topology: Optional[str] = None,
+    ):
+        self.cluster = cluster
+        self.namespace = namespace
+        self.engine_image = engine_image
+        self.tpu_chips = tpu_chips
+        self.tpu_topology = tpu_topology
+
+    def reconcile(self, sdep: SeldonDeploymentSpec | Dict[str, Any]) -> ReconcileResult:
+        if isinstance(sdep, dict):
+            sdep = SeldonDeploymentSpec.from_dict(sdep)
+        sdep = default_deployment(sdep)
+        problems = validate_deployment(sdep)
+        if problems:
+            # webhook semantics: reject outright, change nothing
+            return ReconcileResult(name=sdep.name, ok=False, problems=problems)
+
+        kwargs: Dict[str, Any] = {
+            "namespace": self.namespace,
+            "tpu_chips": self.tpu_chips,
+            "tpu_topology": self.tpu_topology,
+            "validate": False,  # already validated above
+        }
+        if self.engine_image:
+            kwargs["engine_image"] = self.engine_image
+        desired = render_manifests(sdep, **kwargs)
+        for m in desired:
+            m.setdefault("metadata", {}).setdefault("labels", {})[OWNER_LABEL] = sdep.name
+
+        result = ReconcileResult(name=sdep.name, ok=True)
+        desired_keys = set()
+        for m in desired:
+            key = _manifest_key(m)
+            desired_keys.add(key)
+            status = self.cluster.apply(m)
+            result.applied["/".join(key)] = status
+
+        # prune: objects we own that the new spec no longer renders
+        # (e.g. a predictor removed, an HPA dropped, a VirtualService gone)
+        for m in self.cluster.list(label=OWNER_LABEL, value=sdep.name):
+            key = _manifest_key(m)
+            if key not in desired_keys:
+                if self.cluster.delete(*key):
+                    result.deleted.append("/".join(key))
+        return result
+
+    def delete(self, name: str) -> List[str]:
+        """CR removed: delete everything carrying its owner label."""
+        gone = []
+        for m in self.cluster.list(label=OWNER_LABEL, value=name):
+            key = _manifest_key(m)
+            if self.cluster.delete(*key):
+                gone.append("/".join(key))
+        return gone
+
+
+class Operator:
+    """The watch loop over a directory of CR files (*.json / *.yaml / *.yml).
+
+    Each pass: parse every CR source, reconcile the changed ones (content
+    hash), delete owned objects of CRs whose files vanished, and write each
+    CR's status to ``<cr-dir>/.status/<name>.json`` — the stand-in for the
+    CRD status subresource (`templates/crd.yaml` ``subresources.status``)."""
+
+    def __init__(self, cr_dir: str, reconciler: Reconciler, interval: float = 2.0):
+        self.cr_dir = cr_dir
+        self.reconciler = reconciler
+        self.interval = interval
+        self.status_dir = os.path.join(cr_dir, ".status")
+        self._seen: Dict[str, str] = {}  # cr name -> content hash
+        self._sources: Dict[str, str] = {}  # cr name -> file path
+        self._stop = False
+
+    # ------------------------------------------------------------------
+    def _load_crs(self) -> Dict[str, Tuple[Dict[str, Any], str, str]]:
+        """name -> (cr dict, content hash, path). Unparseable files surface
+        as Failed status under the file's basename."""
+        crs: Dict[str, Tuple[Dict[str, Any], str, str]] = {}
+        if not os.path.isdir(self.cr_dir):
+            return crs
+        for fn in sorted(os.listdir(self.cr_dir)):
+            if not fn.endswith((".json", ".yaml", ".yml")):
+                continue
+            path = os.path.join(self.cr_dir, fn)
+            try:
+                with open(path) as f:
+                    raw = f.read()
+                if fn.endswith(".json"):
+                    cr = json.loads(raw)
+                else:
+                    import yaml
+
+                    cr = yaml.safe_load(raw)
+                if not isinstance(cr, dict):
+                    raise ValueError("CR must be a mapping")
+            except Exception as e:
+                name = os.path.splitext(fn)[0]
+                self._write_status(name, {"state": "Failed", "description": f"unparseable CR: {e}"})
+                logger.error("CR %s unparseable: %s", path, e)
+                continue
+            name = cr.get("metadata", {}).get("name") or cr.get("spec", {}).get("name") or cr.get("name") or os.path.splitext(fn)[0]
+            digest = hashlib.sha256(json.dumps(cr, sort_keys=True).encode()).hexdigest()
+            crs[name] = (cr, digest, path)
+        return crs
+
+    def _write_status(self, name: str, status: Dict[str, Any]) -> None:
+        os.makedirs(self.status_dir, exist_ok=True)
+        path = os.path.join(self.status_dir, f"{name}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(status, f, indent=2)
+        os.replace(tmp, path)
+
+    def read_status(self, name: str) -> Optional[Dict[str, Any]]:
+        path = os.path.join(self.status_dir, f"{name}.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    # ------------------------------------------------------------------
+    def run_once(self) -> Dict[str, ReconcileResult]:
+        """One reconcile pass; returns results for CRs that were acted on."""
+        results: Dict[str, ReconcileResult] = {}
+        crs = self._load_crs()
+
+        # deletions first: files that vanished since the last pass
+        for name in list(self._seen):
+            if name not in crs:
+                gone = self.reconciler.delete(name)
+                logger.info("CR %s removed; deleted %d objects", name, len(gone))
+                results[name] = ReconcileResult(name=name, ok=True, deleted=gone)
+                self._write_status(name, {"state": "Deleted", "deleted": gone})
+                del self._seen[name]
+                self._sources.pop(name, None)
+
+        for name, (cr, digest, path) in crs.items():
+            if self._seen.get(name) == digest:
+                continue
+            try:
+                res = self.reconciler.reconcile(cr)
+            except Exception as e:  # keep the loop alive on a bad CR
+                logger.exception("reconcile %s failed", name)
+                res = ReconcileResult(name=name, ok=False, problems=[str(e)], transient=True)
+            results[name] = res
+            self._write_status(name, res.to_status())
+            # Mark seen on success and on stable validation failures (no point
+            # re-spamming those); an exception (apply error, API hiccup) leaves
+            # the CR unseen so the next pass retries it.
+            if not res.transient:
+                self._seen[name] = digest
+            self._sources[name] = path
+            logger.info(
+                "reconciled %s: %s (%d applied, %d deleted)",
+                name, "ok" if res.ok else f"FAILED: {res.problems}",
+                len(res.applied), len(res.deleted),
+            )
+        return results
+
+    def run_forever(self) -> None:
+        signal.signal(signal.SIGTERM, lambda *_: setattr(self, "_stop", True))
+        signal.signal(signal.SIGINT, lambda *_: setattr(self, "_stop", True))
+        logger.info("operator watching %s every %.1fs", self.cr_dir, self.interval)
+        while not self._stop:
+            self.run_once()
+            time.sleep(self.interval)
+        logger.info("operator stopped")

@@ -303,6 +303,33 @@ def run_loadtest(args: argparse.Namespace) -> None:
     loadgen.main(args)
 
 
+def run_operator(args: argparse.Namespace) -> None:
+    setup_logging()
+    from seldon_core_tpu.controlplane.operator import (
+        FileCluster,
+        KubectlCluster,
+        Operator,
+        Reconciler,
+    )
+
+    if args.kubectl:
+        cluster: Any = KubectlCluster()
+    else:
+        cluster = FileCluster(args.cluster)
+    reconciler = Reconciler(
+        cluster,
+        namespace=args.namespace,
+        engine_image=args.engine_image,
+        tpu_chips=args.tpu_chips,
+        tpu_topology=args.tpu_topology,
+    )
+    op = Operator(args.crs, reconciler, interval=args.interval)
+    if args.once:
+        op.run_once()
+    else:
+        op.run_forever()
+
+
 def main(argv: Optional[list] = None) -> None:
     parser = argparse.ArgumentParser(prog="seldon-core-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -346,6 +373,20 @@ def main(argv: Optional[list] = None) -> None:
     render.add_argument("--tpu-topology", default=None)
     render.add_argument("--format", default="yaml", choices=["yaml", "json"])
     render.set_defaults(func=run_render)
+
+    op = sub.add_parser(
+        "operator", help="watch SeldonDeployment CRs and reconcile the cluster"
+    )
+    op.add_argument("--crs", required=True, help="directory of CR JSON/YAML files")
+    op.add_argument("--cluster", default="./cluster", help="FileCluster root dir")
+    op.add_argument("--kubectl", action="store_true", help="apply via kubectl instead")
+    op.add_argument("--namespace", default="default")
+    op.add_argument("--engine-image", default=None)
+    op.add_argument("--tpu-chips", type=int, default=1)
+    op.add_argument("--tpu-topology", default=None)
+    op.add_argument("--interval", type=float, default=2.0)
+    op.add_argument("--once", action="store_true", help="single reconcile pass")
+    op.set_defaults(func=run_operator)
 
     rl = sub.add_parser("request-logger", help="CloudEvents message-pair logger service")
     rl.add_argument("--port", type=int, default=2222)
