@@ -11,10 +11,16 @@ tunnel with ~75 ms RTT and ~120 MB/s bandwidth — per-batch host syncs would
 measure the tunnel, not the serving stack.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``--mode llm`` instead benchmarks autoregressive decode tokens/s through
+LLMServer's compiled prefill+scan-decode path on a ~0.7B-param llama-style
+config (the single-chip share of the BASELINE.json Llama-2-7B stretch
+target); the serving report lives in benchmarks/report_llm_decode.json.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 from functools import partial
@@ -22,6 +28,55 @@ from functools import partial
 import numpy as np
 
 PER_CHIP_BASELINE_IMGS = 1000.0  # 8000 img/s target / 8 chips (BASELINE.json)
+
+
+def main_llm() -> None:
+    import jax
+
+    from seldon_core_tpu.servers.llmserver import LLMServer
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    # ~0.7B params bf16 (~1.4GB): fits one v5e chip with cache headroom
+    kwargs = (
+        dict(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+             n_kv_heads=16, ffn_dim=5504, max_seq_len=2048)
+        if on_tpu
+        else dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                  n_kv_heads=2, ffn_dim=128, max_seq_len=128)
+    )
+    batch = 8 if on_tpu else 2
+    max_new = 128 if on_tpu else 8
+    plen = 128 if on_tpu else 16
+
+    server = LLMServer(
+        model="transformer", model_kwargs=kwargs, init_random=True,
+        max_new_tokens=max_new, len_buckets=(plen,), batch_buckets=(batch,),
+        temperature=0.0, eos_id=-1,  # never stops: steady-state decode rate
+    )
+    server.load()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, kwargs["vocab_size"] - 1, size=plen).tolist()
+               for _ in range(batch)]
+
+    server.generate(prompts, max_new_tokens=max_new)  # compile + warm
+    best = float("inf")
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.perf_counter()
+        out = server.generate(prompts, max_new_tokens=max_new)
+        best = min(best, time.perf_counter() - t0)
+    n_tokens = sum(len(t) for t in out["tokens"])
+    toks_per_s = n_tokens / best
+    print(
+        json.dumps(
+            {
+                "metric": f"llm-decode-0.7b-b{batch}-1chip[{dev.platform}]",
+                "value": round(toks_per_s, 2),
+                "unit": "tok/s",
+                "vs_baseline": 0.0,  # no reference LLM-serving number exists
+            }
+        )
+    )
 
 
 def main() -> None:
@@ -76,4 +131,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="resnet", choices=["resnet", "llm"])
+    if ap.parse_args().mode == "llm":
+        main_llm()
+    else:
+        main()

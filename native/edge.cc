@@ -1990,6 +1990,10 @@ struct Server {
               // cap what a stream may buffer (REST-path parity): refuse the
               // RPC instead of growing without bound on granted window
               grpc_trailers_error(c, sid, 8, "request message too large");
+              // RFC 7540 §8.1: responding before the full request arrived —
+              // RST_STREAM(NO_ERROR) tells the peer to stop sending
+              char rst[4] = {0, 0, 0, 0};
+              h2_frame(c.outbuf, 3, 0, sid, {rst, 4});
               c.h2->streams.erase(it);
               c.h2->stream_credit.erase(sid);
               break;

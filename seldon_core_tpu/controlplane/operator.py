@@ -259,7 +259,9 @@ class Operator:
     # ------------------------------------------------------------------
     def _load_crs(self) -> Dict[str, Tuple[Dict[str, Any], str, str]]:
         """name -> (cr dict, content hash, path). Unparseable files surface
-        as Failed status under the file's basename."""
+        as Failed status under the file's basename — they are NOT treated as
+        deletions (a file caught mid-rewrite must not tear down its live
+        objects; the deletion sweep checks the tracked source path instead)."""
         crs: Dict[str, Tuple[Dict[str, Any], str, str]] = {}
         if not os.path.isdir(self.cr_dir):
             return crs
@@ -309,15 +311,19 @@ class Operator:
         results: Dict[str, ReconcileResult] = {}
         crs = self._load_crs()
 
-        # deletions first: files that vanished since the last pass
-        for name in list(self._seen):
-            if name not in crs:
-                gone = self.reconciler.delete(name)
-                logger.info("CR %s removed; deleted %d objects", name, len(gone))
-                results[name] = ReconcileResult(name=name, ok=True, deleted=gone)
-                self._write_status(name, {"state": "Deleted", "deleted": gone})
-                del self._seen[name]
-                self._sources.pop(name, None)
+        # Deletions first, keyed on the tracked source path (covers CRs whose
+        # reconcile only ever failed transiently, and protects CRs whose file
+        # still exists but momentarily failed to parse): tear down only when
+        # the file is actually gone.
+        for name, path in list(self._sources.items()):
+            if name in crs or os.path.exists(path):
+                continue
+            gone = self.reconciler.delete(name)
+            logger.info("CR %s removed; deleted %d objects", name, len(gone))
+            results[name] = ReconcileResult(name=name, ok=True, deleted=gone)
+            self._write_status(name, {"state": "Deleted", "deleted": gone})
+            self._seen.pop(name, None)
+            del self._sources[name]
 
         for name, (cr, digest, path) in crs.items():
             if self._seen.get(name) == digest:

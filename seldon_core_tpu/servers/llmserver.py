@@ -15,9 +15,14 @@ TPU build's native extension, designed around XLA static shapes:
 - tensor parallelism: pass a mesh and the params shard per the model's
   logical axes (parallel.sharding), with activations following under GSPMD.
 
-``attention_impl='ring'`` (ops.ring_attention) applies to the cache-less
-forward/training path; the cached prefill/decode path used here always runs
-dense attention (single-query blocks; GSPMD shards KV over the mesh).
+Long-context serving shards the KV cache itself: with a mesh carrying a
+'seq' axis, prefill pins each layer's (k, v, pos) cache to a
+NamedSharding that splits the max_len dim across devices, so a context
+longer than one device's cache slice serves correctly — decode's attention
+over the sharded cache becomes a GSPMD sequence-parallel computation (XLA
+inserts the softmax all-reduces over ICI). 'data' shards the batch dim and
+'model' the kv_heads dim when they divide. ``attention_impl='ring'``
+(ops.ring_attention) remains the cache-less forward/training path.
 """
 
 from __future__ import annotations
@@ -111,6 +116,8 @@ class LLMServer(SeldonComponent):
         len_buckets: Optional[Sequence[int]] = None,
         batch_buckets: Optional[Sequence[int]] = None,
         mesh: Optional[Any] = None,
+        tensor_parallel: int = 0,
+        sequence_parallel: int = 0,
         seed: int = 0,
         **kwargs: Any,
     ):
@@ -126,6 +133,10 @@ class LLMServer(SeldonComponent):
         self.len_buckets = tuple(len_buckets or DEFAULT_LEN_BUCKETS)
         self.batch_buckets = tuple(batch_buckets or DEFAULT_BATCH_BUCKETS)
         self.mesh = mesh
+        # Spec-reachable sharding (typed unit parameters, like JAXServer's
+        # tensor_parallel): builds a ('data', 'seq', 'model') mesh at load.
+        self.tensor_parallel = int(tensor_parallel)
+        self.sequence_parallel = int(sequence_parallel)
         self.seed = int(seed)
         self.ready = False
         self._eos_override = eos_id
@@ -169,6 +180,20 @@ class LLMServer(SeldonComponent):
                 jax.random.PRNGKey(self.seed), jnp.zeros((1, 8), jnp.int32)
             )
 
+        if self.mesh is None and (self.tensor_parallel > 1 or self.sequence_parallel > 1):
+            from seldon_core_tpu.parallel.mesh import make_mesh
+
+            tp = max(self.tensor_parallel, 1)
+            sp = max(self.sequence_parallel, 1)
+            n = len(jax.devices())
+            if n % (tp * sp):
+                raise SeldonError(
+                    f"tensor_parallel={tp} * sequence_parallel={sp} does not "
+                    f"divide {n} available devices",
+                    status_code=500,
+                )
+            self.mesh = make_mesh({"data": -1, "seq": sp, "model": tp})
+
         if self.mesh is not None:
             from seldon_core_tpu.parallel.sharding import logical_axis_tree, shard_params
 
@@ -210,6 +235,30 @@ class LLMServer(SeldonComponent):
     # ------------------------------------------------------------------
     # Compiled stages
     # ------------------------------------------------------------------
+    def _cache_shardings(self, b: int, max_len: int):
+        """NamedSharding tree for the per-layer (k, v, pos) caches: max_len
+        over 'seq' (the long-context axis), batch over 'data', kv_heads over
+        'model' — each only when the mesh has that axis and it divides the
+        dim. Returns None when the mesh can't shard anything."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shape = dict(self.mesh.shape)
+
+        def axis(name: str, dim: int):
+            size = shape.get(name, 1)
+            return name if size > 1 and dim % size == 0 else None
+
+        dp = axis("data", b)
+        sp = axis("seq", max_len)
+        tp = axis("model", self._cfg.n_kv_heads)
+        if not (dp or sp or tp):
+            return None
+        kv = NamedSharding(self.mesh, P(dp, sp, tp, None))
+        pos = NamedSharding(self.mesh, P(dp, sp))
+        return [(kv, kv, pos) for _ in range(self._cfg.n_layers)]
+
     def _get_prefill(self, b: int, plen: int, max_len: int):
         key = (b, plen, max_len)
         fn = self._prefill_cache.get(key)
@@ -221,7 +270,6 @@ class LLMServer(SeldonComponent):
 
         module, cfg = self._module, self._cfg
 
-        @jax.jit
         def prefill(params, tokens, positions):
             caches = init_kv_caches(cfg, tokens.shape[0], max_len)
             logits, caches = module.apply(
@@ -229,8 +277,15 @@ class LLMServer(SeldonComponent):
             )
             return logits, caches
 
-        self._prefill_cache[key] = prefill
-        return prefill
+        cache_shardings = self._cache_shardings(b, max_len)
+        if cache_shardings is not None:
+            # pin the cache layout at the jit boundary: decode then runs
+            # sequence-parallel attention over the sharded slices
+            fn = jax.jit(prefill, out_shardings=(None, cache_shardings))
+        else:
+            fn = jax.jit(prefill)
+        self._prefill_cache[key] = fn
+        return fn
 
     def _get_decode(self, b: int, max_len: int):
         key = (b, max_len)
@@ -244,7 +299,6 @@ class LLMServer(SeldonComponent):
         eos_id = self.eos_id
         top_k = self.top_k
 
-        @partial(jax.jit, static_argnames=("n_steps",))
         def decode(params, caches, last_tok, true_len, n_steps, rng, temperature):
             """last_tok [b], true_len [b]; returns tokens [b, n_steps]."""
 
@@ -277,6 +331,17 @@ class LLMServer(SeldonComponent):
             )
             return toks.T  # [b, n_steps]
 
+        cache_shardings = self._cache_shardings(b, max_len)
+        if cache_shardings is not None:
+            # keep the scan carry on the prefill's sharded layout instead of
+            # letting XLA gather the cache onto every device
+            decode = jax.jit(
+                decode,
+                static_argnames=("n_steps",),
+                in_shardings=(None, cache_shardings, None, None, None, None),
+            )
+        else:
+            decode = partial(jax.jit, static_argnames=("n_steps",))(decode)
         self._decode_cache[key] = decode
         return decode
 
@@ -333,6 +398,12 @@ class LLMServer(SeldonComponent):
             logger.warning("prompt of %d tokens truncated to max_seq_len %d", longest, plen)
         token_lists = [t[-plen:] for t in token_lists]  # keep the prompt tail
         max_len = min(plen + max_new, self._cfg.max_seq_len + max_new)
+        if self.mesh is not None:
+            # round the cache length up to a multiple of the seq axis so the
+            # KV cache can actually shard over it
+            sp = dict(self.mesh.shape).get("seq", 1)
+            if sp > 1:
+                max_len = -(-max_len // sp) * sp
 
         tokens = np.zeros((nb, plen), np.int32)
         positions = np.full((nb, plen), PAD_POS, np.int32)

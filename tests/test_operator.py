@@ -196,3 +196,45 @@ def test_transient_failure_retried(tmp_path):
     results = op.run_once()  # same digest, but unseen -> retried
     assert results["m1"].ok
     assert cluster.get("Deployment", "default", "m1-default") is not None
+
+
+def test_unparseable_rewrite_does_not_delete(tmp_path):
+    """A CR file caught mid non-atomic rewrite (momentarily unparseable) must
+    NOT be treated as deleted — live objects stay up."""
+    op, cluster, cr_dir = make_operator(tmp_path)
+    write_cr(cr_dir, "m1", single_model_cr())
+    op.run_once()
+    (cr_dir / "m1.json").write_text('{"apiVersion": "machinelea')  # torn write
+    results = op.run_once()
+    assert cluster.get("Deployment", "default", "m1-default") is not None
+    assert not any(r.deleted for r in results.values())
+    write_cr(cr_dir, "m1", single_model_cr(replicas=2))  # rewrite completes
+    op.run_once()
+    assert cluster.get("Deployment", "default", "m1-default")["spec"]["replicas"] == 2
+
+
+def test_removal_after_transient_failure_still_cleans_up(tmp_path):
+    """Objects applied before a mid-reconcile failure must still be torn down
+    when the CR file is removed (deletion keys on source files, not on
+    successful convergence)."""
+    op, cluster, cr_dir = make_operator(tmp_path)
+    write_cr(cr_dir, "m1", single_model_cr())
+
+    real_apply = cluster.apply
+    calls = {"n": 0}
+
+    def apply_then_fail(manifest):
+        calls["n"] += 1
+        if calls["n"] == 2:  # Deployment lands, Service apply blows up
+            raise RuntimeError("apiserver hiccup")
+        return real_apply(manifest)
+
+    cluster.apply = apply_then_fail
+    results = op.run_once()
+    assert results["m1"].transient
+    assert cluster.get("Deployment", "default", "m1-default") is not None
+
+    os.remove(cr_dir / "m1.json")
+    results = op.run_once()
+    assert "Deployment/default/m1-default" in results["m1"].deleted
+    assert cluster.get("Deployment", "default", "m1-default") is None
