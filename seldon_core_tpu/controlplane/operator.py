@@ -247,24 +247,35 @@ class Operator:
     CR's status to ``<cr-dir>/.status/<name>.json`` — the stand-in for the
     CRD status subresource (`templates/crd.yaml` ``subresources.status``)."""
 
-    def __init__(self, cr_dir: str, reconciler: Reconciler, interval: float = 2.0):
+    def __init__(
+        self,
+        cr_dir: str,
+        reconciler: Reconciler,
+        interval: float = 2.0,
+        status_dir: Optional[str] = None,
+    ):
         self.cr_dir = cr_dir
         self.reconciler = reconciler
         self.interval = interval
-        self.status_dir = os.path.join(cr_dir, ".status")
+        # separate from cr_dir when the CR source is read-only (e.g. a
+        # mounted ConfigMap)
+        self.status_dir = status_dir or os.path.join(cr_dir, ".status")
         self._seen: Dict[str, str] = {}  # cr name -> content hash
         self._sources: Dict[str, str] = {}  # cr name -> file path
         self._stop = False
 
     # ------------------------------------------------------------------
-    def _load_crs(self) -> Dict[str, Tuple[Dict[str, Any], str, str]]:
-        """name -> (cr dict, content hash, path). Unparseable files surface
-        as Failed status under the file's basename — they are NOT treated as
-        deletions (a file caught mid-rewrite must not tear down its live
-        objects; the deletion sweep checks the tracked source path instead)."""
+    def _load_crs(self) -> Tuple[Dict[str, Tuple[Dict[str, Any], str, str]], set]:
+        """Returns (name -> (cr dict, content hash, path), parsed_paths).
+        Unparseable files surface as Failed status under the file's basename —
+        they are NOT treated as deletions (a file caught mid-rewrite must not
+        tear down its live objects); ``parsed_paths`` lets the deletion sweep
+        distinguish a torn write (path absent from it) from a file that
+        parsed fine but now names a different CR."""
         crs: Dict[str, Tuple[Dict[str, Any], str, str]] = {}
+        parsed_paths: set = set()
         if not os.path.isdir(self.cr_dir):
-            return crs
+            return crs, parsed_paths
         for fn in sorted(os.listdir(self.cr_dir)):
             if not fn.endswith((".json", ".yaml", ".yml")):
                 continue
@@ -288,7 +299,8 @@ class Operator:
             name = cr.get("metadata", {}).get("name") or cr.get("spec", {}).get("name") or cr.get("name") or os.path.splitext(fn)[0]
             digest = hashlib.sha256(json.dumps(cr, sort_keys=True).encode()).hexdigest()
             crs[name] = (cr, digest, path)
-        return crs
+            parsed_paths.add(path)
+        return crs, parsed_paths
 
     def _write_status(self, name: str, status: Dict[str, Any]) -> None:
         os.makedirs(self.status_dir, exist_ok=True)
@@ -309,15 +321,18 @@ class Operator:
     def run_once(self) -> Dict[str, ReconcileResult]:
         """One reconcile pass; returns results for CRs that were acted on."""
         results: Dict[str, ReconcileResult] = {}
-        crs = self._load_crs()
+        crs, parsed_paths = self._load_crs()
 
         # Deletions first, keyed on the tracked source path (covers CRs whose
-        # reconcile only ever failed transiently, and protects CRs whose file
-        # still exists but momentarily failed to parse): tear down only when
-        # the file is actually gone.
+        # reconcile only ever failed transiently). A tracked CR is gone when
+        # its file vanished OR the file parsed cleanly to a different name
+        # (rename-in-place). A file that exists but failed to parse is a torn
+        # write: keep the live objects.
         for name, path in list(self._sources.items()):
-            if name in crs or os.path.exists(path):
+            if name in crs:
                 continue
+            if os.path.exists(path) and path not in parsed_paths:
+                continue  # momentarily unparseable — not a deletion
             gone = self.reconciler.delete(name)
             logger.info("CR %s removed; deleted %d objects", name, len(gone))
             results[name] = ReconcileResult(name=name, ok=True, deleted=gone)
@@ -353,6 +368,11 @@ class Operator:
         signal.signal(signal.SIGINT, lambda *_: setattr(self, "_stop", True))
         logger.info("operator watching %s every %.1fs", self.cr_dir, self.interval)
         while not self._stop:
-            self.run_once()
+            try:
+                self.run_once()
+            except Exception:
+                # a broken pass (unwritable status dir, backend outage) must
+                # not crash-loop the controller; retry next tick
+                logger.exception("reconcile pass failed")
             time.sleep(self.interval)
         logger.info("operator stopped")

@@ -323,7 +323,7 @@ def run_operator(args: argparse.Namespace) -> None:
         tpu_chips=args.tpu_chips,
         tpu_topology=args.tpu_topology,
     )
-    op = Operator(args.crs, reconciler, interval=args.interval)
+    op = Operator(args.crs, reconciler, interval=args.interval, status_dir=args.status_dir)
     if args.once:
         op.run_once()
     else:
@@ -385,6 +385,8 @@ def main(argv: Optional[list] = None) -> None:
     op.add_argument("--tpu-chips", type=int, default=1)
     op.add_argument("--tpu-topology", default=None)
     op.add_argument("--interval", type=float, default=2.0)
+    op.add_argument("--status-dir", default=None,
+                    help="status output dir (default <crs>/.status; set when --crs is read-only)")
     op.add_argument("--once", action="store_true", help="single reconcile pass")
     op.set_defaults(func=run_operator)
 

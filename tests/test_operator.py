@@ -238,3 +238,39 @@ def test_removal_after_transient_failure_still_cleans_up(tmp_path):
     results = op.run_once()
     assert "Deployment/default/m1-default" in results["m1"].deleted
     assert cluster.get("Deployment", "default", "m1-default") is None
+
+
+def test_rename_in_place_deletes_old_objects(tmp_path):
+    """Editing a CR file so metadata.name changes must tear down the old
+    name's objects (the file parsed cleanly — this is not a torn write)."""
+    op, cluster, cr_dir = make_operator(tmp_path)
+    write_cr(cr_dir, "m1", single_model_cr(name="m1"))
+    op.run_once()
+    assert cluster.get("Deployment", "default", "m1-default") is not None
+    write_cr(cr_dir, "m1", single_model_cr(name="m2"))  # same file, new name
+    results = op.run_once()
+    assert cluster.get("Deployment", "default", "m2-default") is not None
+    assert "Deployment/default/m1-default" in results["m1"].deleted
+    assert cluster.get("Deployment", "default", "m1-default") is None
+
+
+def test_readonly_cr_dir_separate_status(tmp_path):
+    """--status-dir: CRs mounted read-only (ConfigMap) with status written
+    elsewhere; the reconcile pass must not touch the CR dir."""
+    import stat
+
+    cr_dir = tmp_path / "crs"
+    cr_dir.mkdir()
+    write_cr(cr_dir, "m1", single_model_cr())
+    cluster = FileCluster(str(tmp_path / "cluster"))
+    status_dir = tmp_path / "status"
+    op = Operator(str(cr_dir), Reconciler(cluster), interval=0.01,
+                  status_dir=str(status_dir))
+    os.chmod(cr_dir, stat.S_IRUSR | stat.S_IXUSR)  # read-only source
+    try:
+        results = op.run_once()
+        assert results["m1"].ok
+        assert (status_dir / "m1.json").exists()
+        assert not (cr_dir / ".status").exists()
+    finally:
+        os.chmod(cr_dir, stat.S_IRWXU)

@@ -1,0 +1,183 @@
+"""Rolling-update / zero-downtime e2e: the in-process analogue of the
+reference's 10 rolling-update scenarios (`testing/scripts/
+test_rolling_updates.py:22-80` — fixed models, continuous requests during
+`kubectl apply`, zero failed responses).
+
+Choreography mirrors a k8s rollout with the test playing kube-proxy:
+  1. engine v1 serves; a client thread sends continuous predictions
+  2. engine v2 boots alongside, gated on /ready
+  3. v2 is WARMED (one real predict pre-switch — the TPU compile-cache
+     warm-up of SURVEY.md §7 hard part #6: readiness alone doesn't mean the
+     jitted program exists)
+  4. traffic atomically switches to v2
+  5. v1 drains via /pause (in-flight finishes; the preStop hook contract of
+     controlplane/render.py) and is terminated
+Assertions: zero failed requests, both versions observed, no v1 responses
+after the switch, bounded p99.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+LAUNCH = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from seldon_core_tpu.transport.cli import main
+main(["engine", "--spec", {spec!r}, "--port", {port!r}, "--host", "127.0.0.1"])
+"""
+
+
+def start_engine(tmp_path, version: str, port: int):
+    spec = {"name": "p", "graph": {"name": version, "type": "MODEL",
+                                   "implementation": "SIMPLE_MODEL"}}
+    spec_path = str(tmp_path / f"{version}.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    code = LAUNCH.format(repo=REPO, spec=spec_path, port=str(port))
+    return subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def http(method: str, port: int, path: str, body: bytes = b"", timeout: float = 10.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body if method == "POST" else None,
+        headers={"Content-Type": "application/json"}, method=method,
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def wait_ready(port: int, deadline_s: float = 60.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            status, _ = http("GET", port, "/ready", timeout=2.0)
+            if status == 200:
+                return
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"engine on {port} never became ready")
+
+
+PREDICT_BODY = json.dumps({"data": {"ndarray": [[1.0, 2.0]]}}).encode()
+
+
+def predict_version(port: int) -> str:
+    """One prediction; returns the serving graph's unit name (v1/v2) from
+    meta.requestPath — the fixed-model version marker."""
+    status, body = http("POST", port, "/api/v0.1/predictions", PREDICT_BODY)
+    assert status == 200
+    d = json.loads(body)
+    (unit_name,) = d["meta"]["requestPath"].keys()
+    return unit_name
+
+
+def test_rolling_update_zero_downtime(tmp_path):
+    port_v1, port_v2 = free_port(), free_port()
+    procs = []
+    record = []  # (ok, version, latency_s)
+    primary = {"port": port_v1}
+    stop = threading.Event()
+    t = None
+
+    def client_loop():
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                version = predict_version(primary["port"])
+                record.append((True, version, time.monotonic() - t0))
+            except Exception as e:
+                record.append((False, str(e), time.monotonic() - t0))
+            time.sleep(0.01)
+
+    try:
+        procs.append(start_engine(tmp_path, "v1", port_v1))
+        wait_ready(port_v1)
+        predict_version(port_v1)  # v1 warm-up before load starts
+
+        t = threading.Thread(target=client_loop, daemon=True)
+        t.start()
+        time.sleep(1.0)  # sustained load on v1
+
+        # --- rollout: v2 boots while v1 keeps serving ---
+        procs.append(start_engine(tmp_path, "v2", port_v2))
+        wait_ready(port_v2)
+        assert predict_version(port_v2) == "v2"  # compile-cache warm-up
+        switch_idx = len(record)
+        primary["port"] = port_v2  # kube-proxy flips the endpoint
+
+        time.sleep(1.0)  # sustained load on v2
+
+        # --- drain v1 (preStop /pause), then terminate it ---
+        status, _ = http("GET", port_v1, "/pause")
+        assert status == 200
+        time.sleep(0.3)
+        status, _ = http("GET", port_v1, "/live")  # draining, still alive
+        assert status == 200
+        procs[0].terminate()
+
+        time.sleep(1.0)  # load continues against v2 after v1 is gone
+    finally:
+        stop.set()
+        if t is not None:
+            t.join(timeout=5)
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+
+    failures = [r for r in record if not r[0]]
+    assert failures == [], f"{len(failures)} failed requests: {failures[:3]}"
+    versions = [r[1] for r in record]
+    assert "v1" in versions and "v2" in versions
+    # after the endpoint switch, nothing was served by the old version
+    assert set(versions[switch_idx + 1:]) == {"v2"}
+    latencies = sorted(r[2] for r in record)
+    p99 = latencies[int(len(latencies) * 0.99) - 1]
+    assert p99 < 2.0, f"p99 {p99:.3f}s"
+    assert len(record) > 100
+
+
+def test_pause_rejects_then_unpause_recovers(tmp_path):
+    """Drain contract: /pause -> predictions 503 + /ready 503 (endpoint is
+    pulled) while /live stays 200 (no restart); /unpause restores serving."""
+    port = free_port()
+    proc = start_engine(tmp_path, "v1", port)
+    try:
+        wait_ready(port)
+        assert predict_version(port) == "v1"
+        http("GET", port, "/pause")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            http("POST", port, "/api/v0.1/predictions", PREDICT_BODY)
+        assert err.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as err:
+            http("GET", port, "/ready")
+        assert err.value.code == 503
+        assert http("GET", port, "/live")[0] == 200
+        http("GET", port, "/unpause")
+        assert predict_version(port) == "v1"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
