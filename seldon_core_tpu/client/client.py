@@ -10,6 +10,17 @@ the legacy OAuth APIFE. Two endpoint kinds:
 - ``microservice``: a single component's internal API (`/predict`,
   `/transform-input`, ...; gRPC services Model/Router/Transformer/Combiner) —
   what the engine calls per node.
+- ``gateway``: the engine API through the cluster ingress — REST requests go
+  to ``/seldon/<namespace>/<deployment>/api/v0.1/...`` (the Istio
+  VirtualService prefix rendered by controlplane/render.py, matching the
+  reference's Ambassador/Istio path, `seldon_client.py:513`), gRPC carries
+  ``seldon``/``namespace`` metadata headers for the ingress to route on.
+
+TLS: ``ssl=True`` switches REST to https (``ca_cert``/``client_cert``/
+``client_key`` for verification and mutual TLS) and gRPC to a secure channel
+built from the same PEMs; ``auth_token`` rides as a Bearer header / gRPC
+authorization metadata (reference: `seldon_client.py:1137` channel and call
+credentials).
 """
 
 from __future__ import annotations
@@ -64,27 +75,57 @@ class SeldonClient:
         endpoint_kind: str = "engine",
         timeout_s: float = 10.0,
         names: Optional[Sequence[str]] = None,
+        deployment_name: Optional[str] = None,
+        namespace: str = "default",
+        ssl: bool = False,
+        ca_cert: Optional[str] = None,
+        client_cert: Optional[str] = None,
+        client_key: Optional[str] = None,
+        auth_token: Optional[str] = None,
     ):
         if transport not in ("rest", "grpc"):
             raise ValueError(f"transport must be rest|grpc, got {transport}")
-        if endpoint_kind not in ("engine", "microservice"):
-            raise ValueError(f"endpoint_kind must be engine|microservice, got {endpoint_kind}")
+        if endpoint_kind not in ("engine", "microservice", "gateway"):
+            raise ValueError(
+                f"endpoint_kind must be engine|microservice|gateway, got {endpoint_kind}"
+            )
+        if endpoint_kind == "gateway" and not deployment_name:
+            raise ValueError("gateway endpoint needs deployment_name")
         self.host = host
         self.port = int(port)
         self.transport = transport
         self.endpoint_kind = endpoint_kind
         self.timeout_s = float(timeout_s)
         self.names = list(names or [])
+        self.deployment_name = deployment_name
+        self.namespace = namespace
+        self.ssl = bool(ssl)
+        self.ca_cert = ca_cert
+        self.client_cert = client_cert
+        self.client_key = client_key
+        self.auth_token = auth_token
+        self._channel_credentials = None  # built once on first gRPC call
 
     # ------------------------------------------------------------- REST
     def _rest_url(self, path: str) -> str:
-        return f"http://{self.host}:{self.port}{path}"
+        scheme = "https" if self.ssl else "http"
+        prefix = ""
+        if self.endpoint_kind == "gateway":
+            prefix = f"/seldon/{self.namespace}/{self.deployment_name}"
+        return f"{scheme}://{self.host}:{self.port}{prefix}{path}"
 
     def _rest_call(self, path: str, body: Dict[str, Any]) -> ClientResponse:
         import requests
 
+        kwargs: Dict[str, Any] = {"json": body, "timeout": self.timeout_s}
+        if self.ssl:
+            kwargs["verify"] = self.ca_cert if self.ca_cert else True
+            if self.client_cert:
+                kwargs["cert"] = (self.client_cert, self.client_key)
+        if self.auth_token:
+            kwargs["headers"] = {"Authorization": f"Bearer {self.auth_token}"}
         try:
-            r = requests.post(self._rest_url(path), json=body, timeout=self.timeout_s)
+            r = requests.post(self._rest_url(path), **kwargs)
             raw = r.json()
         except Exception as e:  # connection/JSON errors
             return ClientResponse(False, None, None, error=str(e))
@@ -93,12 +134,35 @@ class SeldonClient:
         return ClientResponse(True, SeldonMessage.from_dict(raw), raw)
 
     # ------------------------------------------------------------- gRPC
+    def _grpc_metadata(self) -> Optional[List]:
+        md = []
+        if self.endpoint_kind == "gateway":
+            # ingress routing headers (reference: grpc_predict_gateway's
+            # seldon/namespace metadata, seldon_client.py:1137+)
+            md += [("seldon", self.deployment_name), ("namespace", self.namespace)]
+        if self.auth_token:
+            md.append(("authorization", f"Bearer {self.auth_token}"))
+        return md or None
+
+    def _grpc_credentials(self):
+        if not self.ssl:
+            return None
+        if self._channel_credentials is None:
+            from seldon_core_tpu.transport.grpc_client import make_channel_credentials
+
+            self._channel_credentials = make_channel_credentials(
+                self.ca_cert, self.client_cert, self.client_key
+            )
+        return self._channel_credentials
+
     def _grpc_call(self, method: str, msg: Any, service: str) -> ClientResponse:
         from seldon_core_tpu.transport import grpc_client
 
         try:
             out = grpc_client.call_sync(
-                f"{self.host}:{self.port}", method, msg, service=service, timeout_s=self.timeout_s
+                f"{self.host}:{self.port}", method, msg, service=service,
+                timeout_s=self.timeout_s, credentials=self._grpc_credentials(),
+                metadata=self._grpc_metadata(),
             )
         except Exception as e:
             return ClientResponse(False, None, None, error=str(e))
@@ -117,9 +181,9 @@ class SeldonClient:
         if (names or self.names) and msg.data is not None:
             msg.data.names = list(names or self.names)
         if self.transport == "rest":
-            path = "/api/v0.1/predictions" if self.endpoint_kind == "engine" else "/predict"
+            path = "/predict" if self.endpoint_kind == "microservice" else "/api/v0.1/predictions"
             return self._rest_call(path, msg.to_dict())
-        service = "Seldon" if self.endpoint_kind == "engine" else "Model"
+        service = "Model" if self.endpoint_kind == "microservice" else "Seldon"
         return self._grpc_call("Predict", msg, service)
 
     def feedback(
@@ -136,9 +200,9 @@ class SeldonClient:
             truth=SeldonMessage.from_array(np.asarray(truth)) if truth is not None else None,
         )
         if self.transport == "rest":
-            path = "/api/v0.1/feedback" if self.endpoint_kind == "engine" else "/send-feedback"
+            path = "/send-feedback" if self.endpoint_kind == "microservice" else "/api/v0.1/feedback"
             return self._rest_call(path, fb.to_dict())
-        service = "Seldon" if self.endpoint_kind == "engine" else "Model"
+        service = "Model" if self.endpoint_kind == "microservice" else "Seldon"
         return self._grpc_call("SendFeedback", fb, service)
 
     # microservice-only graph methods

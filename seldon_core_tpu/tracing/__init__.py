@@ -87,10 +87,12 @@ class Tracer:
             self._record(s)
 
     def _record(self, s: Span) -> None:
+        flush_now = False
         with self._lock:
             self._buffer.append(s)
-            if len(self._buffer) >= self._max_buffer:
-                self.flush()
+            flush_now = len(self._buffer) >= self._max_buffer
+        if flush_now:  # outside the lock: flush() re-acquires it
+            self.flush()
 
     def flush(self) -> None:
         with self._lock:
@@ -130,6 +132,15 @@ def get_tracer() -> Tracer:
             service_name=os.environ.get("JAEGER_SERVICE_NAME", "seldon-tpu"),
             enabled=os.environ.get("TRACING", "0") == "1",
         )
+        from seldon_core_tpu.tracing import export as _export
+
+        flusher = _export.install_from_env(_tracer)
+        if flusher is not None:
+            import atexit
+
+            # final flush at shutdown: the drain-window spans are exactly the
+            # ones an operator debugging a rollout needs
+            atexit.register(flusher.stop)
     return _tracer
 
 

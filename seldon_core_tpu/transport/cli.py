@@ -303,6 +303,13 @@ def run_loadtest(args: argparse.Namespace) -> None:
     loadgen.main(args)
 
 
+def run_analytics(args: argparse.Namespace) -> None:
+    from seldon_core_tpu.observability.dashboards import write_artifacts
+
+    for path in write_artifacts(args.out):
+        print(path)
+
+
 def run_operator(args: argparse.Namespace) -> None:
     setup_logging()
     from seldon_core_tpu.controlplane.operator import (
@@ -389,6 +396,12 @@ def main(argv: Optional[list] = None) -> None:
                     help="status output dir (default <crs>/.status; set when --crs is read-only)")
     op.add_argument("--once", action="store_true", help="single reconcile pass")
     op.set_defaults(func=run_operator)
+
+    an = sub.add_parser(
+        "analytics", help="write Prometheus rules + Grafana dashboard artifacts"
+    )
+    an.add_argument("--out", default="deploy/analytics")
+    an.set_defaults(func=run_analytics)
 
     rl = sub.add_parser("request-logger", help="CloudEvents message-pair logger service")
     rl.add_argument("--port", type=int, default=2222)

@@ -31,12 +31,43 @@ _METHODS = {
 }
 
 
-def get_channel(target: str, options: Optional[list] = None) -> grpc.Channel:
-    key = (target, tuple(options or ()))
+def make_channel_credentials(
+    ca_cert: Optional[str] = None,
+    client_cert: Optional[str] = None,
+    client_key: Optional[str] = None,
+) -> grpc.ChannelCredentials:
+    """TLS channel credentials from PEM file paths (reference parity:
+    `seldon_client.py` channel_credentials for grpc gateway calls). With no
+    paths, system roots are used; cert+key enable mutual TLS."""
+
+    def read(path: Optional[str]) -> Optional[bytes]:
+        if path is None:
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    return grpc.ssl_channel_credentials(
+        root_certificates=read(ca_cert),
+        private_key=read(client_key),
+        certificate_chain=read(client_cert),
+    )
+
+
+def get_channel(
+    target: str,
+    options: Optional[list] = None,
+    credentials: Optional[grpc.ChannelCredentials] = None,
+) -> grpc.Channel:
+    # key on the credentials object identity: two clients with different TLS
+    # material to the same target must not share a channel
+    key = (target, tuple(options or ()), id(credentials) if credentials is not None else None)
     with _lock:
         ch = _channels.get(key)
         if ch is None:
-            ch = grpc.insecure_channel(target, options=options)
+            if credentials is not None:
+                ch = grpc.secure_channel(target, credentials, options=options)
+            else:
+                ch = grpc.insecure_channel(target, options=options)
             _channels[key] = ch
         return ch
 
@@ -58,18 +89,20 @@ def call_sync(
     service: Optional[str] = None,
     timeout_s: float = 5.0,
     options: Optional[list] = None,
+    credentials: Optional[grpc.ChannelCredentials] = None,
+    metadata: Optional[list] = None,
 ) -> SeldonMessage:
     if method not in _METHODS:
         raise ValueError(f"Unknown gRPC method {method}")
     default_service, _req_cls = _METHODS[method]
     service = service or default_service
-    channel = get_channel(target, options)
+    channel = get_channel(target, options, credentials)
     rpc = channel.unary_unary(
         f"/seldon.protos.{service}/{method}",
         request_serializer=lambda m: m.SerializeToString(),
         response_deserializer=pb.SeldonMessage.FromString,
     )
-    out = rpc(_to_proto(msg), timeout=timeout_s)
+    out = rpc(_to_proto(msg), timeout=timeout_s, metadata=metadata)
     return pc.message_from_proto(out)
 
 

@@ -142,9 +142,12 @@ def make_engine_server(
     annotations: Optional[Dict[str, str]] = None,
     max_workers: int = 8,
     loop: Optional[asyncio.AbstractEventLoop] = None,
+    interceptors: Optional[Any] = None,
+    server_credentials: Optional[grpc.ServerCredentials] = None,
 ) -> grpc.Server:
     """Seldon external service over the in-process graph engine. The engine is
-    async; handlers submit onto the engine's event loop (or a private one)."""
+    async; handlers submit onto the engine's event loop (or a private one).
+    ``server_credentials`` switches the listening port to TLS."""
     metrics = metrics or MetricsRegistry()
     own_loop = loop
     if own_loop is None:
@@ -179,7 +182,9 @@ def make_engine_server(
             _abort(context, e)
 
     server = grpc.server(
-        futures.ThreadPoolExecutor(max_workers=max_workers), options=_server_options(annotations)
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=_server_options(annotations),
+        interceptors=tuple(interceptors or ()),
     )
     handler = grpc.method_handlers_generic_handler(
         f"{_SERVICE_PACKAGE}.Seldon",
@@ -198,7 +203,10 @@ def make_engine_server(
     )
     server.add_generic_rpc_handlers((handler,))
     if port is not None:
-        server.add_insecure_port(f"{host}:{port}")
+        if server_credentials is not None:
+            server.add_secure_port(f"{host}:{port}", server_credentials)
+        else:
+            server.add_insecure_port(f"{host}:{port}")
     return server
 
 

@@ -203,3 +203,134 @@ def test_contract_tester_against_engine(rest_engine, tmp_path):
 
 def test_feature_names_helper():
     assert feature_names(CONTRACT) == ["x:0", "x:1", "k"]
+
+
+# ------------------------------------------------------- gateway + TLS
+@pytest.fixture(scope="module")
+def gateway_rest():
+    """Engine app mounted under the ingress prefix /seldon/<ns>/<name>/ —
+    the Istio VirtualService route rendered by controlplane/render.py."""
+    from aiohttp import web
+
+    engine = GraphEngine(PredictorSpec.from_dict(SPEC))
+    root = web.Application()
+    root.add_subapp("/seldon/default/mydep/", make_engine_app(engine))
+    loop = asyncio.new_event_loop()
+    port_holder = {}
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(root)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port_holder["port"] = runner.addresses[0][1]
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    yield port_holder["port"]
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_gateway_rest_prefixed_predict(gateway_rest):
+    c = SeldonClient(port=gateway_rest, endpoint_kind="gateway",
+                     deployment_name="mydep", namespace="default")
+    r = c.predict(np.array([[1.0, 2.0]]))
+    assert r.success, r.error
+    np.testing.assert_allclose(r.data.ravel(), [0.1, 0.9, 0.5])
+    # feedback rides the same prefix
+    assert c.feedback(reward=1.0).success
+
+
+def test_gateway_rest_wrong_prefix_fails(gateway_rest):
+    direct = SeldonClient(port=gateway_rest, endpoint_kind="engine")
+    assert not direct.predict(np.array([[1.0]])).success
+    wrong = SeldonClient(port=gateway_rest, endpoint_kind="gateway",
+                         deployment_name="otherdep")
+    assert not wrong.predict(np.array([[1.0]])).success
+
+
+def test_gateway_grpc_metadata():
+    """The gateway client must attach seldon/namespace routing metadata (what
+    the ingress routes on) and authorization when a token is set."""
+    import grpc as grpc_mod
+
+    captured = {}
+
+    class Capture(grpc_mod.ServerInterceptor):
+        def intercept_service(self, continuation, handler_call_details):
+            captured["md"] = dict(handler_call_details.invocation_metadata)
+            return continuation(handler_call_details)
+
+    engine = GraphEngine(PredictorSpec.from_dict(SPEC))
+    server = make_engine_server(engine, port=None, interceptors=[Capture()])
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        c = SeldonClient(port=port, transport="grpc", endpoint_kind="gateway",
+                         deployment_name="mydep", namespace="ns1",
+                         auth_token="tok123")
+        r = c.predict(np.array([[1.0, 2.0]]))
+        assert r.success, r.error
+        assert captured["md"]["seldon"] == "mydep"
+        assert captured["md"]["namespace"] == "ns1"
+        assert captured["md"]["authorization"] == "Bearer tok123"
+    finally:
+        server.stop(None)
+
+
+@pytest.fixture(scope="module")
+def self_signed_cert(tmp_path_factory):
+    import subprocess
+
+    d = tmp_path_factory.mktemp("tls")
+    key, crt = str(d / "key.pem"), str(d / "cert.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", crt, "-days", "1", "-nodes", "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    return key, crt
+
+
+def test_grpc_tls_round_trip(self_signed_cert):
+    """Secure channel against a TLS engine server: the reference's gRPC
+    channel-credentials surface (`seldon_client.py:1137`)."""
+    import grpc as grpc_mod
+
+    key, crt = self_signed_cert
+    with open(key, "rb") as f:
+        key_pem = f.read()
+    with open(crt, "rb") as f:
+        crt_pem = f.read()
+    creds = grpc_mod.ssl_server_credentials([(key_pem, crt_pem)])
+
+    engine = GraphEngine(PredictorSpec.from_dict(SPEC))
+    server = make_engine_server(engine, port=None)
+    port = server.add_secure_port("localhost:0", creds)
+    server.start()
+    try:
+        c = SeldonClient(host="localhost", port=port, transport="grpc",
+                         ssl=True, ca_cert=crt, timeout_s=10)
+        r = c.predict(np.array([[1.0, 2.0]]))
+        assert r.success, r.error
+        np.testing.assert_allclose(r.data.ravel(), [0.1, 0.9, 0.5])
+        # plaintext client against the TLS port must fail
+        plain = SeldonClient(host="localhost", port=port, transport="grpc",
+                             timeout_s=3)
+        assert not plain.predict(np.array([[1.0]])).success
+    finally:
+        server.stop(None)
+
+
+def test_gateway_requires_deployment_name():
+    with pytest.raises(ValueError, match="deployment_name"):
+        SeldonClient(endpoint_kind="gateway")
