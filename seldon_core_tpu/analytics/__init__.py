@@ -6,6 +6,7 @@ from seldon_core_tpu.analytics.routers import EpsilonGreedy, ThompsonSampling
 from seldon_core_tpu.analytics.outliers import (
     MahalanobisOutlierDetector,
     IsolationForestOutlierDetector,
+    Seq2SeqOutlierDetector,
     VAEOutlierDetector,
 )
 
@@ -14,5 +15,6 @@ __all__ = [
     "ThompsonSampling",
     "MahalanobisOutlierDetector",
     "IsolationForestOutlierDetector",
+    "Seq2SeqOutlierDetector",
     "VAEOutlierDetector",
 ]

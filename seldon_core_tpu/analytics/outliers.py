@@ -330,3 +330,147 @@ class VAEOutlierDetector(_OutlierTransformer):
     def __setstate__(self, state):
         super().__setstate__(state)
         self._score_fn = None
+
+
+class Seq2SeqOutlierDetector(_OutlierTransformer):
+    """Sequence reconstruction detector — the 4th detector family
+    (`seq2seq-lstm/CoreSeq2SeqLSTM.py:214`): an encoder-decoder over time
+    windows whose reconstruction MSE flags anomalous stretches of a series.
+
+    TPU-first: the reference's Keras LSTM pair becomes a Flax GRU
+    encoder-decoder trained with a jitted optax loop — recurrence runs as
+    ``lax.scan`` under jit (static shapes, no per-step Python), and scoring
+    is one compiled program per window-batch shape.
+
+    Input contract: a 3-D batch [B, T, F] scores per sequence; a 2-D batch
+    [N, F] (the graph payload case) is framed into non-overlapping
+    ``timesteps`` windows (tail padded by repetition) and each row inherits
+    its window's score, so tags()/metrics() keep their per-row shape.
+    """
+
+    def __init__(
+        self,
+        threshold: float = 0.1,
+        timesteps: int = 8,
+        hidden_dim: int = 32,
+        seed: int = 0,
+        **kwargs: Any,
+    ):
+        super().__init__(threshold=threshold, **kwargs)
+        self.timesteps = int(timesteps)
+        self.hidden_dim = int(hidden_dim)
+        self.seed = int(seed)
+        self._params = None
+        self._d: Optional[int] = None
+        self._score_fn = None
+
+    def _module(self, d: int):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        hidden, T = self.hidden_dim, self.timesteps
+
+        class Seq2SeqAE(nn.Module):
+            @nn.compact
+            def __call__(self, x):  # [B, T, F]
+                enc_out = nn.RNN(nn.GRUCell(hidden))(x)
+                code = enc_out[:, -1]  # [B, H] — the sequence encoding
+                dec_in = jnp.repeat(code[:, None, :], T, axis=1)
+                dec_out = nn.RNN(nn.GRUCell(hidden))(dec_in)
+                # reconstruct the REVERSED sequence (classic seq2seq-AE
+                # target: last-in, first-out eases the decoder's job)
+                return nn.Dense(d)(dec_out)[:, ::-1]
+
+        return Seq2SeqAE()
+
+    # ------------------------------------------------------------------
+    def _frame(self, X: np.ndarray) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """[N, F] -> ([W, T, F], row->window index map); 3-D passes through."""
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 3:
+            if X.shape[1] != self.timesteps:
+                raise ValueError(
+                    f"3-D input must have sequence length {self.timesteps} "
+                    f"(the decoder's unroll length), got {X.shape[1]}"
+                )
+            return X, None
+        X = np.atleast_2d(X)
+        n, d = X.shape
+        T = self.timesteps
+        pad = (-n) % T
+        if pad:
+            X = np.concatenate([X, np.repeat(X[-1:], pad, axis=0)], axis=0)
+        windows = X.reshape(-1, T, d)
+        row_to_window = np.repeat(np.arange(len(windows)), T)[:n]
+        return windows, row_to_window
+
+    def fit(self, X: np.ndarray, epochs: int = 200, lr: float = 1e-2):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        windows, _ = self._frame(X)
+        self._d = windows.shape[-1]
+        module = self._module(self._d)
+        key = jax.random.PRNGKey(self.seed)
+        params = module.init(key, jnp.asarray(windows[:1]))
+
+        tx = optax.adam(lr)
+        opt_state = tx.init(params)
+
+        def loss_fn(params, x):
+            recon = module.apply(params, x)
+            return jnp.mean((recon - x) ** 2)
+
+        @jax.jit
+        def train_step(params, opt_state, x):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x)
+            updates, opt_state = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        xs = jnp.asarray(windows)
+        for _ in range(epochs):
+            params, opt_state, loss = train_step(params, opt_state, xs)
+        self._params = params
+        self._build_score(module)
+        logger.info("Seq2Seq fit done: final loss %.6f", float(loss))
+        return self
+
+    def _build_score(self, module=None):
+        import jax
+        import jax.numpy as jnp
+
+        module = module or self._module(self._d)
+
+        @jax.jit
+        def score_fn(params, x):  # [W, T, F] -> [W] per-window mse
+            recon = module.apply(params, x)
+            return jnp.mean((recon - x) ** 2, axis=(1, 2))
+
+        self._score_fn = score_fn
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        if self._params is None:
+            raise RuntimeError("Seq2SeqOutlierDetector needs fit() before scoring")
+        if self._score_fn is None:
+            self._build_score()
+        import jax.numpy as jnp
+
+        windows, row_map = self._frame(X)
+        per_window = np.asarray(self._score_fn(self._params, jnp.asarray(windows)))
+        if row_map is None:
+            return per_window
+        return per_window[row_map]
+
+    def __getstate__(self):
+        import jax
+
+        state = super().__getstate__()
+        state.pop("_score_fn", None)
+        if state.get("_params") is not None:
+            state["_params"] = jax.tree.map(np.asarray, state["_params"])
+        return state
+
+    def __setstate__(self, state):
+        super().__setstate__(state)
+        self._score_fn = None

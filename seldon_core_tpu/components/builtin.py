@@ -122,6 +122,7 @@ def make_builtin(implementation: UnitImplementation, parameters: Optional[Dict[s
         UnitImplementation.MAHALANOBIS_OD: "MahalanobisOutlierDetector",
         UnitImplementation.ISOLATION_FOREST_OD: "IsolationForestOutlierDetector",
         UnitImplementation.VAE_OD: "VAEOutlierDetector",
+        UnitImplementation.SEQ2SEQ_OD: "Seq2SeqOutlierDetector",
     }
     if implementation in analytics:
         import seldon_core_tpu.analytics as _analytics

@@ -61,6 +61,7 @@ class UnitImplementation(str, Enum):
     MAHALANOBIS_OD = "MAHALANOBIS_OD"
     ISOLATION_FOREST_OD = "ISOLATION_FOREST_OD"
     VAE_OD = "VAE_OD"
+    SEQ2SEQ_OD = "SEQ2SEQ_OD"
 
 
 class UnitMethod(str, Enum):

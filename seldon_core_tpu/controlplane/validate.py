@@ -34,6 +34,7 @@ _SELF_CONTAINED = {
     UnitImplementation.MAHALANOBIS_OD,
     UnitImplementation.ISOLATION_FOREST_OD,
     UnitImplementation.VAE_OD,
+    UnitImplementation.SEQ2SEQ_OD,
 }
 _SERVER_IMPLS = {
     UnitImplementation.SKLEARN_SERVER,

@@ -1,0 +1,13 @@
+"""External inference-server integrations.
+
+The reference delegates native-performance serving to external engines
+behind thin proxies (`integrations/{tfserving,nvidia-inference-server,
+sagemaker}`). Here the native path is in-process (servers/jaxserver.py), so
+this package holds only the genuinely-external integrations: the TF-Serving
+proxy lives in servers/tfproxy.py (selected by TENSORFLOW_SERVER), and the
+SageMaker proxy below.
+"""
+
+from seldon_core_tpu.integrations.sagemaker import SageMakerProxy
+
+__all__ = ["SageMakerProxy"]

@@ -1,0 +1,66 @@
+"""SageMaker serving proxy.
+
+Parity with `integrations/sagemaker/SagemakerProxy.py:33` in the reference:
+a MODEL component that forwards the feature batch to a SageMaker container's
+``/invocations`` endpoint and returns the decoded result — so a SageMaker-
+hosted model slots into an inference graph like any other unit. The
+reference depends on the ``sagemaker_containers`` codec package; this
+implementation speaks the same wire contract (JSON in, JSON or CSV out)
+with no extra dependency.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.payload import SeldonError
+
+
+class SageMakerProxy(SeldonComponent):
+    def __init__(self, endpoint: str = "", timeout_s: float = 10.0, **kwargs: Any):
+        super().__init__(**kwargs)
+        if not endpoint:
+            raise SeldonError("SageMakerProxy needs endpoint=<container url>", status_code=500)
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self._session = None  # pooled connections; rebuilt after unpickling
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_session"] = None
+        return state
+
+    def _http(self):
+        if self._session is None:
+            import requests
+
+            self._session = requests.Session()
+        return self._session
+
+    def predict(self, X, names: Sequence[str], meta: Optional[Dict] = None) -> np.ndarray:
+        r = self._http().post(
+            self.endpoint + "/invocations",
+            json=np.asarray(X).tolist(),
+            timeout=self.timeout_s,
+        )
+        if r.status_code != 200:
+            raise SeldonError(
+                f"SageMaker endpoint error {r.status_code}: {r.text[:200]}",
+                reason="MICROSERVICE_BAD_RESPONSE",
+                status_code=502,
+            )
+        content_type = r.headers.get("content-type", "application/json")
+        if "csv" in content_type:
+            rows = [
+                [float(v) for v in line.split(",")]
+                for line in r.text.strip().splitlines()
+                if line
+            ]
+            result = np.asarray(rows)
+        else:
+            result = np.asarray(json.loads(r.content))
+        return np.atleast_2d(result)

@@ -9,11 +9,20 @@ from functools import partial
 from typing import Any, Callable, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from seldon_core_tpu.models.registry import register_model
 
 ModuleDef = Any
+
+
+class _NoNorm(nn.Module):
+    """Identity stand-in for BatchNorm in the folded inference variant."""
+
+    @nn.compact
+    def __call__(self, x):
+        return x
 
 
 class BottleneckBlock(nn.Module):
@@ -45,17 +54,27 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    # Inference-only folded variant: convs carry a bias and BatchNorm sites
+    # are identity — run it with params from fold_batchnorm(). Removes every
+    # BN stats read + f32 affine chain from the serving graph (HBM traffic),
+    # leaving pure conv+bias+relu for XLA to fuse.
+    fused: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(
-            nn.BatchNorm,
-            use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
-            dtype=self.dtype,
-        )
+        if self.fused and train:
+            raise ValueError("fused=True is inference-only (BN is folded away)")
+        conv = partial(nn.Conv, use_bias=self.fused, dtype=self.dtype)
+        if self.fused:
+            norm = lambda **kw: _NoNorm()  # noqa: E731 (name kwarg dropped)
+        else:
+            norm = partial(
+                nn.BatchNorm,
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=self.dtype,
+            )
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
@@ -76,18 +95,66 @@ class ResNet(nn.Module):
         return x
 
 
+_BN_EPS = 1e-5  # must match the BatchNorm epsilon above
+
+
+def fold_batchnorm(variables):
+    """Fold BatchNorm into the adjacent convs: trained {'params',
+    'batch_stats'} -> {'params'} for the ``fused=True`` module.
+
+    BN(conv(x)) = conv(x)*s + b with s = gamma/rsqrt(var+eps) and
+    b = beta - mean*s; s scales the conv kernel's output channels and b
+    becomes the conv bias. Pairs: conv_init<->bn_init, Conv_j<->BatchNorm_j,
+    conv_proj<->norm_proj; the classifier head passes through. Numerics: the
+    fold runs in f32 regardless of serving dtype."""
+    import jax.numpy as jnp
+
+    params = variables["params"]
+    stats = variables["batch_stats"]
+
+    def fold_pair(conv, bn, bn_stats):
+        s = bn["scale"].astype(jnp.float32) * jax.lax.rsqrt(
+            bn_stats["var"].astype(jnp.float32) + _BN_EPS
+        )
+        b = bn["bias"].astype(jnp.float32) - bn_stats["mean"].astype(jnp.float32) * s
+        kernel = conv["kernel"].astype(jnp.float32) * s  # [..., out] broadcast
+        return {"kernel": kernel.astype(conv["kernel"].dtype), "bias": b}
+
+    out = {}
+    for key, scope in params.items():
+        if key == "conv_init":
+            out[key] = fold_pair(scope, params["bn_init"], stats["bn_init"])
+        elif key.startswith("BottleneckBlock_"):
+            block_out = {}
+            for ck, cv in scope.items():
+                if ck.startswith("Conv_"):
+                    bn_key = "BatchNorm_" + ck.split("_")[1]
+                    block_out[ck] = fold_pair(cv, scope[bn_key], stats[key][bn_key])
+                elif ck == "conv_proj":
+                    block_out[ck] = fold_pair(cv, scope["norm_proj"], stats[key]["norm_proj"])
+            out[key] = block_out
+        elif key in ("bn_init",) or key.startswith("BatchNorm") or key == "norm_proj":
+            continue
+        else:  # head and anything param-only
+            out[key] = scope
+    return {"params": out}
+
+
 @register_model("resnet50")
-def make_resnet50(num_classes: int = 1000, dtype: str = "bfloat16"):
-    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=jnp.dtype(dtype))
+def make_resnet50(num_classes: int = 1000, dtype: str = "bfloat16", fused: bool = False):
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
+                  dtype=jnp.dtype(dtype), fused=fused)
 
 
 @register_model("resnet18")
-def make_resnet18(num_classes: int = 1000, dtype: str = "bfloat16"):
+def make_resnet18(num_classes: int = 1000, dtype: str = "bfloat16", fused: bool = False):
     # 18-layer variant uses the same bottleneck stack shrunk to (2,2,2,2);
     # kept bottleneck (not basic-block) for MXU-friendly 1x1 convs.
-    return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes, dtype=jnp.dtype(dtype))
+    return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes,
+                  dtype=jnp.dtype(dtype), fused=fused)
 
 
 @register_model("resnet101")
-def make_resnet101(num_classes: int = 1000, dtype: str = "bfloat16"):
-    return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes, dtype=jnp.dtype(dtype))
+def make_resnet101(num_classes: int = 1000, dtype: str = "bfloat16", fused: bool = False):
+    return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes,
+                  dtype=jnp.dtype(dtype), fused=fused)

@@ -4,6 +4,7 @@ outlier-detection test suites); here additionally through the in-process
 graph engine (routing meta + feedback replay)."""
 
 import asyncio
+import json
 import pickle
 
 import numpy as np
@@ -192,3 +193,100 @@ def test_outlier_graph_transformer():
     assert out["meta"]["tags"]["is_outlier"] == [1]
     keys = {m["key"] for m in out["meta"]["metrics"]}
     assert "outlier_score_max" in keys
+
+
+def test_seq2seq_outlier_detector():
+    """Seq2Seq reconstruction detector: a sine-wave series trains well; a
+    noise burst reconstructs poorly and scores higher. Pickle round-trips
+    (router/detector persistence contract)."""
+    from seldon_core_tpu.analytics import Seq2SeqOutlierDetector
+
+    t = np.arange(512, dtype=np.float32)
+    series = np.stack([np.sin(t / 5.0), np.cos(t / 7.0)], axis=1)
+    det = Seq2SeqOutlierDetector(timesteps=8, hidden_dim=24, seed=0, threshold=0.05)
+    det.fit(series, epochs=300)
+
+    inlier = det.score(series[:64])
+    rng = np.random.default_rng(0)
+    burst = rng.uniform(-3, 3, size=(16, 2)).astype(np.float32)
+    outlier = det.score(burst)
+    assert outlier.mean() > inlier.mean() * 3, (outlier.mean(), inlier.mean())
+    # per-row scores align rows to their window
+    assert inlier.shape == (64,)
+    # 3-D input scores per sequence
+    seq_scores = det.score(series[:32].reshape(4, 8, 2))
+    assert seq_scores.shape == (4,)
+
+    det2 = pickle.loads(pickle.dumps(det))
+    np.testing.assert_allclose(det2.score(series[:16]), det.score(series[:16]), rtol=1e-4)
+
+
+def test_seq2seq_from_graph_spec():
+    """SEQ2SEQ_OD reachable as a graph implementation (4th detector family)."""
+    from seldon_core_tpu.analytics import Seq2SeqOutlierDetector
+    from seldon_core_tpu.components.builtin import make_builtin
+    from seldon_core_tpu.contracts.graph import UnitImplementation
+
+    det = make_builtin(UnitImplementation.SEQ2SEQ_OD, {"timesteps": 4, "threshold": 0.5})
+    assert isinstance(det, Seq2SeqOutlierDetector)
+    assert det.timesteps == 4 and det.threshold == 0.5
+
+
+def test_sagemaker_proxy_round_trip():
+    """SageMaker proxy against a local /invocations stub (JSON and CSV
+    responses, error surface)."""
+    import http.server
+    import threading
+
+    from seldon_core_tpu.contracts.payload import SeldonError
+    from seldon_core_tpu.integrations import SageMakerProxy
+
+    mode = {"kind": "json"}  # json | csv | scalar | err
+
+    class Stub(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            assert self.path == "/invocations"
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            X = np.asarray(json.loads(body))
+            if mode["kind"] == "json":
+                out = (X * 2).tolist()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(json.dumps(out).encode())
+            elif mode["kind"] == "scalar":
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(b"0.87")  # bare-scalar single prediction
+            elif mode["kind"] == "csv":
+                lines = "\n".join(",".join(str(v * 2) for v in row) for row in X)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/csv")
+                self.end_headers()
+                self.wfile.write(lines.encode())
+            else:
+                self.send_response(500)
+                self.end_headers()
+                self.wfile.write(b"boom")
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        proxy = SageMakerProxy(endpoint=f"http://127.0.0.1:{srv.server_port}")
+        out = proxy.predict(np.array([[1.0, 2.0]]), ["a", "b"])
+        np.testing.assert_allclose(out, [[2.0, 4.0]])
+        mode["kind"] = "csv"
+        out = proxy.predict(np.array([[1.0, 2.0], [3.0, 4.0]]), ["a", "b"])
+        np.testing.assert_allclose(out, [[2.0, 4.0], [6.0, 8.0]])
+        mode["kind"] = "scalar"
+        out = proxy.predict(np.array([[1.0]]), ["a"])
+        np.testing.assert_allclose(out, [[0.87]])
+        mode["kind"] = "err"
+        with pytest.raises(SeldonError):
+            proxy.predict(np.array([[1.0]]), ["a"])
+    finally:
+        srv.shutdown()

@@ -98,3 +98,45 @@ def test_llama2_7b_has_untied_head():
     tokens = jnp.array([[1]], dtype=jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), tokens)
     assert "lm_head" in variables["params"], "untied lm_head required for Llama-2 checkpoints"
+
+
+def test_fold_batchnorm_matches_unfused():
+    """fused=True + fold_batchnorm(vars) must reproduce the unfused
+    inference forward exactly (with non-trivial running stats, so the fold
+    arithmetic — not just identity stats — is exercised)."""
+    import flax
+
+    from seldon_core_tpu.models.resnet import fold_batchnorm
+
+    m = get_model("resnet18", num_classes=10, dtype="float32")
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((2, 32, 32, 3), dtype=np.float32)
+    )
+    v = m.init(jax.random.PRNGKey(0), x)
+    flat = flax.traverse_util.flatten_dict(v["batch_stats"])
+    rng = np.random.default_rng(1)
+    flat = {
+        k: jnp.asarray(
+            rng.uniform(0.5, 2.0, a.shape) if k[-1] == "var" else rng.normal(0, 0.3, a.shape),
+            a.dtype,
+        )
+        for k, a in flat.items()
+    }
+    v = {"params": v["params"], "batch_stats": flax.traverse_util.unflatten_dict(flat)}
+
+    ref = m.apply(v, x, train=False)
+    fused = get_model("resnet18", num_classes=10, dtype="float32", fused=True)
+    got = fused.apply(fold_batchnorm(v), x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+    # fused is inference-only
+    with pytest.raises(ValueError, match="inference-only"):
+        fused.apply(fold_batchnorm(v), x, train=True)
+
+
+def test_seq2seq_bad_sequence_length_raises():
+    from seldon_core_tpu.analytics import Seq2SeqOutlierDetector
+
+    det = Seq2SeqOutlierDetector(timesteps=8)
+    with pytest.raises(ValueError, match="sequence length 8"):
+        det._frame(np.zeros((4, 16, 2), np.float32))
