@@ -1,0 +1,186 @@
+"""Distributed load-generation fleet.
+
+The reference load-tests with a locust master + slave fleet spread over
+nodes (`util/loadtester/scripts/predict_rest_locust.py:17-53`,
+`helm-charts/seldon-core-loadtesting/templates/{locust-master,locust-slave}
+.yaml`). The equivalent here drives the native closed-loop generators
+(native/loadgen_http.cc, loadgen_grpc.cc) as a fleet:
+
+- **local fleet**: N generator processes on this host, one per core,
+  started concurrently against the same target (a single process saturates
+  ~1 core; the fleet scales the offered load linearly);
+- **remote workers**: ``loadtest-worker --listen <port>`` turns any host
+  into a slave — the master connects over TCP, ships the job spec as one
+  JSON object, and collects the report (the locust master/slave wire role,
+  minus the UI).
+
+Reports merge by summing throughput/requests/failures; merged latency
+percentiles are request-count-weighted averages of the per-worker
+percentiles (approximate — workers report quantiles, not histograms — and
+labelled as such in the report).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional
+
+
+def _loadgen_binary(grpc: bool) -> str:
+    from seldon_core_tpu.runtime.edgeprogram import LOADGEN_BINARY, build_edge_binaries
+
+    if not build_edge_binaries():
+        raise RuntimeError("native loadgen unavailable (no C++ toolchain)")
+    return LOADGEN_BINARY + ("_grpc" if grpc else "")
+
+
+def run_one(job: Dict[str, Any]) -> Dict[str, Any]:
+    """Run one native generator to completion; returns its JSON report."""
+    grpc = bool(job.get("grpc"))
+    args = [
+        _loadgen_binary(grpc),
+        "--host", str(job.get("host", "127.0.0.1")),
+        "--port", str(job["port"]),
+        "--connections", str(job.get("connections", 32)),
+        "--duration", str(job.get("duration", 10.0)),
+        "--warmup", str(job.get("warmup", 1.0)),
+        "--label", str(job.get("label", "fleet")),
+    ]
+    if not grpc:
+        if job.get("body"):
+            args += ["--body", job["body"]]
+        if job.get("path"):
+            args += ["--path", job["path"]]
+    out = subprocess.run(args, capture_output=True, text=True, check=False)
+    if out.returncode not in (0, 3):
+        raise RuntimeError(f"loadgen failed rc={out.returncode}: {out.stderr[:400]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def merge_reports(reports: List[Dict[str, Any]]) -> Dict[str, Any]:
+    reports = [r for r in reports if r]
+    if not reports:
+        raise ValueError("no worker reports to merge")
+    total_requests = sum(r.get("requests", 0) for r in reports)
+    merged_lat: Dict[str, float] = {}
+    keys = reports[0].get("latency_ms", {}).keys()
+    for key in keys:
+        if key == "max":
+            merged_lat[key] = max(r["latency_ms"][key] for r in reports)
+        else:
+            weights = [max(r.get("requests", 0), 1) for r in reports]
+            merged_lat[key] = round(
+                sum(r["latency_ms"][key] * w for r, w in zip(reports, weights))
+                / sum(weights),
+                3,
+            )
+    return {
+        "workers": len(reports),
+        "throughput_rps": round(sum(r.get("throughput_rps", 0.0) for r in reports), 2),
+        "requests": total_requests,
+        "failures": sum(r.get("failures", 0) for r in reports),
+        "duration_s": max(r.get("duration_s", 0.0) for r in reports),
+        "connections": sum(r.get("connections", 0) for r in reports),
+        "latency_ms": merged_lat,
+        "latency_note": "percentiles are request-weighted averages of per-worker quantiles",
+        "per_worker": reports,
+    }
+
+
+def run_local_fleet(job: Dict[str, Any], n_workers: int) -> Dict[str, Any]:
+    """N concurrent generator processes on this host, merged report."""
+    reports: List[Optional[Dict[str, Any]]] = [None] * n_workers
+    errors: List[Exception] = []
+
+    def work(i: int) -> None:
+        w_job = dict(job, label=f"{job.get('label', 'fleet')}-w{i}")
+        try:
+            reports[i] = run_one(w_job)
+        except Exception as e:  # surfaced after join
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return merge_reports([r for r in reports if r])
+
+
+# ---------------------------------------------------------------- workers
+def worker_serve(listen_port: int, host: str = "0.0.0.0", once: bool = False) -> None:
+    """Slave loop: accept a connection, read one JSON job (newline-framed),
+    run it, write the JSON report back. One job at a time — load generation
+    wants the whole host."""
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, listen_port))
+    srv.listen(4)
+    print(f"loadtest worker listening on {host}:{srv.getsockname()[1]}", flush=True)
+    while True:
+        conn, _ = srv.accept()
+        served = False
+        try:
+            # a held-open probe connection must not wedge the worker
+            conn.settimeout(30.0)
+            f = conn.makefile("rwb")
+            line = f.readline()
+            if line:
+                job = json.loads(line)
+                try:
+                    conn.settimeout(float(job.get("duration", 10.0)) + 60.0)
+                    report = run_one(job)
+                    served = True
+                except Exception as e:
+                    report = {"error": str(e)}
+                    served = True
+                f.write(json.dumps(report).encode() + b"\n")
+                f.flush()
+        except (socket.timeout, OSError, ValueError):
+            pass  # bad/slow client; keep serving
+        finally:
+            conn.close()
+        # --once exits only after a real job, not after a probe connect
+        if once and served:
+            srv.close()
+            return
+
+
+def run_distributed(workers: List[str], job: Dict[str, Any],
+                    timeout_s: Optional[float] = None) -> Dict[str, Any]:
+    """Master: ship the job to every worker (host:port), merge the reports."""
+    if timeout_s is None:
+        timeout_s = float(job.get("duration", 10.0)) + float(job.get("warmup", 1.0)) + 30.0
+    reports: List[Optional[Dict[str, Any]]] = [None] * len(workers)
+    errors: List[Exception] = []
+
+    def drive(i: int, addr: str) -> None:
+        host, _, port = addr.rpartition(":")
+        try:
+            with socket.create_connection((host or "127.0.0.1", int(port)),
+                                          timeout=timeout_s) as conn:
+                conn.settimeout(timeout_s)
+                f = conn.makefile("rwb")
+                w_job = dict(job, label=f"{job.get('label', 'fleet')}-{addr}")
+                f.write(json.dumps(w_job).encode() + b"\n")
+                f.flush()
+                resp = json.loads(f.readline())
+            if "error" in resp:
+                raise RuntimeError(f"worker {addr}: {resp['error']}")
+            reports[i] = resp
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=drive, args=(i, w)) for i, w in enumerate(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return merge_reports([r for r in reports if r])

@@ -20,6 +20,7 @@ from seldon_core_tpu.contracts.graph import (
     UnitType,
 )
 from seldon_core_tpu.contracts.payload import SeldonError
+from seldon_core_tpu.controlplane.quantity import validate_resources
 
 _NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")  # RFC 1123 label
 
@@ -131,6 +132,20 @@ def validate_deployment(sdep: SeldonDeploymentSpec) -> List[str]:
                 problems.append(f"{path}: hpaSpec needs maxReplicas")
             elif mn > mx:
                 problems.append(f"{path}: hpaSpec minReplicas {mn} > maxReplicas {mx}")
+        # k8s Quantity grammar for every resources block the CR carries
+        # (svcOrchSpec and componentSpecs containers — the surface the
+        # reference's vendored QuantityUtils JSON parser accepted)
+        if p.svc_orch_spec.get("resources"):
+            validate_resources(p.svc_orch_spec["resources"], f"{path}.svcOrchSpec.resources", problems)
+        for ci, cs in enumerate(p.component_specs):
+            spec = cs.get("spec", cs)
+            for cj, container in enumerate(spec.get("containers", []) or []):
+                if container.get("resources"):
+                    validate_resources(
+                        container["resources"],
+                        f"{path}.componentSpecs[{ci}].containers[{cj}].resources",
+                        problems,
+                    )
         _validate_unit(p.graph, path, problems, seen=set())
 
     if any_traffic and len([p for p in sdep.predictors if not p.shadow]) > 1 and total_traffic != 100:

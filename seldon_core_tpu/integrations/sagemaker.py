@@ -42,9 +42,10 @@ class SageMakerProxy(SeldonComponent):
         return self._session
 
     def predict(self, X, names: Sequence[str], meta: Optional[Dict] = None) -> np.ndarray:
+        X = np.asarray(X)
         r = self._http().post(
             self.endpoint + "/invocations",
-            json=np.asarray(X).tolist(),
+            json=X.tolist(),
             timeout=self.timeout_s,
         )
         if r.status_code != 200:
@@ -63,4 +64,8 @@ class SageMakerProxy(SeldonComponent):
             result = np.asarray(rows)
         else:
             result = np.asarray(json.loads(r.content))
+        # a flat list of one prediction per input row must stay row-aligned,
+        # not transpose into a single (1, N) row
+        if result.ndim == 1 and X.ndim >= 2 and len(result) == X.shape[0] > 1:
+            return result[:, None]
         return np.atleast_2d(result)

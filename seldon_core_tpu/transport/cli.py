@@ -310,6 +310,35 @@ def run_analytics(args: argparse.Namespace) -> None:
         print(path)
 
 
+def run_loadtest_worker(args: argparse.Namespace) -> None:
+    from seldon_core_tpu.benchmarks.fleet import worker_serve
+
+    worker_serve(args.listen, host=args.host, once=args.once)
+
+
+def run_loadtest_fleet(args: argparse.Namespace) -> None:
+    from seldon_core_tpu.benchmarks.fleet import run_distributed, run_local_fleet
+
+    job = {
+        "host": args.host,
+        "port": args.port,
+        "connections": args.connections,
+        "duration": args.duration,
+        "grpc": args.grpc,
+        "body": args.body,
+        "path": args.path,
+    }
+    if args.workers:
+        report = run_distributed([w.strip() for w in args.workers.split(",") if w.strip()], job)
+    else:
+        report = run_local_fleet(job, max(args.local_workers, 1))
+    out = json.dumps(report, indent=2)
+    print(out)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(out)
+
+
 def run_operator(args: argparse.Namespace) -> None:
     setup_logging()
     from seldon_core_tpu.controlplane.operator import (
@@ -426,6 +455,30 @@ def main(argv: Optional[list] = None) -> None:
     ltn.add_argument("--label", default="rest")
     ltn.add_argument("--report", default=None, help="write JSON report to this file")
     ltn.set_defaults(func=run_loadtest_native)
+
+    ltw = sub.add_parser("loadtest-worker", help="fleet slave: run loadgen jobs sent over TCP")
+    ltw.add_argument("--listen", type=int, required=True)
+    ltw.add_argument("--host", default="0.0.0.0")
+    ltw.add_argument("--once", action="store_true")
+    ltw.set_defaults(func=run_loadtest_worker)
+
+    ltf = sub.add_parser(
+        "loadtest-fleet",
+        help="fleet master: local multi-process or remote-worker load generation",
+    )
+    ltf.add_argument("host")
+    ltf.add_argument("port", type=int)
+    ltf.add_argument("--local-workers", type=int, default=0,
+                     help="spawn N generator processes on this host")
+    ltf.add_argument("--workers", default="",
+                     help="comma-separated host:port loadtest-worker addresses")
+    ltf.add_argument("--connections", type=int, default=32, help="per worker")
+    ltf.add_argument("--duration", type=float, default=10.0)
+    ltf.add_argument("--grpc", action="store_true")
+    ltf.add_argument("--body", default=None)
+    ltf.add_argument("--path", default=None)
+    ltf.add_argument("--report", default=None, help="write merged JSON report here")
+    ltf.set_defaults(func=run_loadtest_fleet)
 
     lt = sub.add_parser("loadtest", help="async load generator (locust equivalent)")
     lt.add_argument("host")

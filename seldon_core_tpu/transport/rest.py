@@ -284,6 +284,50 @@ def make_engine_app(engine: Any, metrics: Optional[MetricsRegistry] = None) -> w
 
         return web.json_response(engine_spec())
 
+    profile_state = {"active": False}
+
+    async def profile(request):
+        """Device-level profiling (SURVEY.md §5: the XLA/jax-profiler half of
+        the tracing story): capture a jax.profiler trace for ?seconds=N and
+        write it under SELDON_PROFILE_DIR. Gated by that env var — profiling
+        allocates and serializes device state, so it is opt-in."""
+        base = os.environ.get("SELDON_PROFILE_DIR", "")
+        if not base:
+            return web.json_response(
+                {"status": {"code": 403, "info": "set SELDON_PROFILE_DIR to enable"}},
+                status=403,
+            )
+        if profile_state["active"]:
+            return web.json_response(
+                {"status": {"code": 409, "info": "profile already running"}}, status=409
+            )
+        import math
+
+        try:
+            seconds = float(request.query.get("seconds", "2"))
+        except ValueError:
+            seconds = 2.0
+        if not (math.isfinite(seconds) and 0 < seconds <= 60):
+            seconds = 2.0
+
+        import jax
+
+        out_dir = os.path.join(base, f"trace_{int(time.time())}")
+        profile_state["active"] = True
+        started = False
+        try:
+            jax.profiler.start_trace(out_dir)
+            started = True
+            await asyncio.sleep(seconds)
+        finally:
+            profile_state["active"] = False
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # double-stop on teardown races
+                    logger.exception("stop_trace failed")
+        return web.json_response({"trace_dir": out_dir, "seconds": seconds})
+
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_post("/predict", predictions)
     app.router.add_post("/api/v0.1/feedback", feedback)
@@ -298,6 +342,7 @@ def make_engine_app(engine: Any, metrics: Optional[MetricsRegistry] = None) -> w
     app.router.add_get("/metrics", prom)
     app.router.add_get("/prometheus", prom)
     app.router.add_get("/seldon.json", openapi)
+    app.router.add_post("/profile", profile)
     return app
 
 

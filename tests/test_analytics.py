@@ -259,6 +259,12 @@ def test_sagemaker_proxy_round_trip():
                 self.send_header("Content-Type", "application/json")
                 self.end_headers()
                 self.wfile.write(b"0.87")  # bare-scalar single prediction
+            elif mode["kind"] == "flat":
+                out = (X[:, 0] * 2).tolist()  # one score per input row
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(json.dumps(out).encode())
             elif mode["kind"] == "csv":
                 lines = "\n".join(",".join(str(v * 2) for v in row) for row in X)
                 self.send_response(200)
@@ -285,6 +291,10 @@ def test_sagemaker_proxy_round_trip():
         mode["kind"] = "scalar"
         out = proxy.predict(np.array([[1.0]]), ["a"])
         np.testing.assert_allclose(out, [[0.87]])
+        mode["kind"] = "flat"
+        out = proxy.predict(np.array([[1.0], [2.0], [3.0]]), ["a"])
+        assert out.shape == (3, 1)  # per-row scores stay row-aligned
+        np.testing.assert_allclose(out.ravel(), [2.0, 4.0, 6.0])
         mode["kind"] = "err"
         with pytest.raises(SeldonError):
             proxy.predict(np.array([[1.0]]), ["a"])

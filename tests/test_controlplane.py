@@ -184,3 +184,39 @@ def test_shadow_predictor_excluded_from_traffic_split():
     s = default_deployment(sdep(two))
     assert s.predictors[0].traffic == 100
     assert s.predictors[1].traffic == 0
+
+
+def test_parse_quantity_grammar():
+    from seldon_core_tpu.controlplane.quantity import parse_int_or_string, parse_quantity
+
+    assert parse_quantity("500m") == pytest.approx(0.5)
+    assert parse_quantity("1Gi") == 2**30
+    assert parse_quantity("1.5G") == pytest.approx(1.5e9)
+    assert parse_quantity("2") == 2.0
+    assert parse_quantity(3) == 3.0
+    assert parse_quantity("1e3") == 1000.0
+    assert parse_quantity("128Ki") == 2**17
+    for bad in ("", "abc", "1GiB", "--1", "1 Gi"):
+        with pytest.raises(ValueError):
+            parse_quantity(bad)
+
+    assert parse_int_or_string(5) == 5
+    assert parse_int_or_string("5") == 5
+    assert parse_int_or_string("25%") == "25%"
+    assert parse_int_or_string("http") == "http"
+
+
+def test_validate_rejects_bad_resource_quantities():
+    sd = sdep([{
+        "name": "default",
+        "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+        "svcOrchSpec": {"resources": {"requests": {"cpu": "not-a-qty"}}},
+        "componentSpecs": [{"spec": {"containers": [
+            {"name": "c", "resources": {"limits": {"memory": "4Gi", "cpu": "-1"}}}
+        ]}}],
+    }])
+    problems = validate_deployment(sd)
+    assert any("svcOrchSpec.resources.requests.cpu: invalid quantity" in p for p in problems)
+    assert any("containers[0].resources.limits.cpu: negative quantity" in p for p in problems)
+    # the valid 4Gi limit is not flagged
+    assert not any("memory" in p for p in problems)

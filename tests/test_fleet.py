@@ -1,0 +1,118 @@
+"""Distributed load-fleet tests: local multi-process fleet and TCP
+master/worker mode against a live native edge (the locust master/slave
+capability, `helm-charts/seldon-core-loadtesting/templates/`)."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from seldon_core_tpu.benchmarks.fleet import (
+    merge_reports,
+    run_distributed,
+    run_local_fleet,
+    worker_serve,
+)
+from seldon_core_tpu.runtime.edgeprogram import EDGE_BINARY, build_edge_binaries
+
+from test_edge import free_port
+
+pytestmark = pytest.mark.skipif(not build_edge_binaries(), reason="no C++ toolchain")
+
+PROGRAM = {
+    "deployment": "t", "predictor": "p", "native": True, "root": 0,
+    "units": [{"name": "m", "kind": "SIMPLE_MODEL", "children": []}],
+}
+BODY = '{"data": {"ndarray": [[1.0, 2.0]]}}'
+
+
+@pytest.fixture(scope="module")
+def edge(tmp_path_factory):
+    prog = tmp_path_factory.mktemp("fleet") / "prog.json"
+    prog.write_text(json.dumps(PROGRAM))
+    port = free_port()
+    proc = subprocess.Popen([EDGE_BINARY, "--program", str(prog), "--port", str(port)],
+                            stderr=subprocess.DEVNULL)
+    import urllib.request
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/live", timeout=1)
+            break
+        except Exception:
+            time.sleep(0.05)
+    yield port
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def job(port, **kw):
+    base = {"host": "127.0.0.1", "port": port, "connections": 4,
+            "duration": 1.0, "warmup": 0.2, "body": BODY}
+    base.update(kw)
+    return base
+
+
+def test_local_fleet_merges(edge):
+    report = run_local_fleet(job(edge), n_workers=2)
+    assert report["workers"] == 2
+    assert report["failures"] == 0
+    assert report["requests"] > 100
+    assert report["connections"] == 8  # 4 per worker
+    assert report["latency_ms"]["p99"] > 0
+    assert len(report["per_worker"]) == 2
+    # merged throughput is the sum of the workers'
+    assert report["throughput_rps"] == pytest.approx(
+        sum(w["throughput_rps"] for w in report["per_worker"]), rel=1e-6
+    )
+
+
+def test_distributed_master_worker(edge):
+    wport = free_port()
+    t = threading.Thread(target=worker_serve, args=(wport,),
+                         kwargs={"host": "127.0.0.1", "once": True}, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    report = run_distributed([f"127.0.0.1:{wport}"], job(edge))
+    t.join(timeout=10)
+    assert report["workers"] == 1
+    assert report["failures"] == 0
+    assert report["requests"] > 50
+
+
+def test_worker_subprocess_cli(edge, tmp_path):
+    """Full wire path through the CLI: worker process + fleet master."""
+    wport = free_port()
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "seldon_core_tpu.transport.cli",
+         "loadtest-worker", "--listen", str(wport), "--host", "127.0.0.1", "--once"],
+        cwd="/root/repo",
+    )
+    time.sleep(1.0)
+    report_path = tmp_path / "report.json"
+    subprocess.run(
+        [sys.executable, "-m", "seldon_core_tpu.transport.cli",
+         "loadtest-fleet", "127.0.0.1", str(edge),
+         "--workers", f"127.0.0.1:{wport}", "--connections", "4",
+         "--duration", "1", "--body", BODY, "--report", str(report_path)],
+        cwd="/root/repo", check=True, capture_output=True,
+    )
+    worker.wait(timeout=15)
+    report = json.loads(report_path.read_text())
+    assert report["failures"] == 0 and report["requests"] > 50
+
+
+def test_merge_reports_weighting():
+    r1 = {"throughput_rps": 100.0, "requests": 100, "failures": 0, "duration_s": 1.0,
+          "connections": 4, "latency_ms": {"p50": 1.0, "max": 5.0}}
+    r2 = {"throughput_rps": 300.0, "requests": 300, "failures": 1, "duration_s": 1.0,
+          "connections": 4, "latency_ms": {"p50": 3.0, "max": 9.0}}
+    m = merge_reports([r1, r2])
+    assert m["throughput_rps"] == 400.0
+    assert m["failures"] == 1
+    assert m["latency_ms"]["max"] == 9.0
+    assert m["latency_ms"]["p50"] == pytest.approx(2.5)  # weighted 1:3

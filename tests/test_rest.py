@@ -180,3 +180,23 @@ def test_multipart_bin_data():
             assert base64.b64decode(body["binData"]) == b"\x01\x02payload"
 
     asyncio.run(go())
+
+
+def test_profile_endpoint_gated_and_captures(tmp_path, monkeypatch):
+    """Device profiling: 403 without SELDON_PROFILE_DIR; with it, /profile
+    captures a jax.profiler trace directory."""
+    engine = GraphEngine(PredictorSpec.from_dict(
+        {"name": "p", "graph": {"name": "m", "type": "MODEL",
+                                "implementation": "SIMPLE_MODEL"}}))
+
+    monkeypatch.delenv("SELDON_PROFILE_DIR", raising=False)
+    status, body = call(make_engine_app(engine), "/profile")
+    assert status == 403
+
+    monkeypatch.setenv("SELDON_PROFILE_DIR", str(tmp_path))
+    status, body = call(make_engine_app(engine), "/profile", params={"seconds": "0.2"})
+    assert status == 200, body
+    assert body["trace_dir"].startswith(str(tmp_path))
+    import os
+
+    assert os.path.isdir(body["trace_dir"])  # jax wrote the trace tree
