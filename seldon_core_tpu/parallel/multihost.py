@@ -1,0 +1,146 @@
+"""Multi-host bootstrap + hybrid ICI/DCN meshes.
+
+The reference scales across hosts with Kubernetes replicas over the pod
+network (SURVEY.md §2 parallelism note). The TPU-native equivalent is a
+multi-host JAX runtime: every host runs the same program,
+``jax.distributed`` wires the processes into one device world, and a
+*hybrid* mesh lays parallelism axes so that bandwidth-hungry collectives
+(tensor/sequence parallel) ride ICI inside a slice while only
+gradient/data-parallel traffic crosses DCN between slices — the layout the
+scaling playbook prescribes.
+
+Nothing here requires multiple hosts to import or test: ``initialize()`` is
+a no-op on a single process, and ``hybrid_mesh`` degrades to a plain
+single-granule mesh when there is one slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+# DCN-tolerant axes: one all-reduce per step (data parallel) or point-to-point
+# stage handoff (pipeline). Everything else belongs on ICI.
+DCN_FRIENDLY_AXES = ("data", "pipe")
+
+
+def coordinator_config(env: Optional[Dict[str, str]] = None) -> Optional[Dict[str, object]]:
+    """Resolve the distributed-init triple from the environment, or None for
+    single-host. Accepts the standard JAX env (JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID) and the common launcher spellings
+    (COORDINATOR_ADDRESS, NUM_PROCESSES/WORLD_SIZE, PROCESS_ID/RANK)."""
+    env = env if env is not None else dict(os.environ)
+
+    def pick(*names: str) -> Optional[str]:
+        for n in names:
+            v = env.get(n)
+            if v:
+                return v
+        return None
+
+    addr = pick("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
+    if not addr:
+        return None
+    n = pick("JAX_NUM_PROCESSES", "NUM_PROCESSES", "WORLD_SIZE")
+    pid = pick("JAX_PROCESS_ID", "PROCESS_ID", "RANK")
+    if n is None or pid is None:
+        raise ValueError(
+            "coordinator address set but process count/id missing: need "
+            "JAX_NUM_PROCESSES (or WORLD_SIZE) and JAX_PROCESS_ID (or RANK)"
+        )
+    return {
+        "coordinator_address": addr,
+        "num_processes": int(n),
+        "process_id": int(pid),
+    }
+
+
+def initialize(env: Optional[Dict[str, str]] = None) -> bool:
+    """Join the multi-host world if the environment describes one; returns
+    whether distributed init ran. Call once, before any backend use — same
+    contract as ``jax.distributed.initialize``."""
+    cfg = coordinator_config(env)
+    if cfg is None:
+        logger.debug("single-host: skipping jax.distributed.initialize")
+        return False
+    import jax
+
+    jax.distributed.initialize(**cfg)  # type: ignore[arg-type]
+    logger.info(
+        "joined distributed world: process %s/%s via %s",
+        cfg["process_id"], cfg["num_processes"], cfg["coordinator_address"],
+    )
+    return True
+
+
+def hybrid_mesh(
+    ici_axes: Dict[str, int],
+    dcn_axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Mesh over multiple slices: ``dcn_axes`` partition across slices (keep
+    to DCN_FRIENDLY_AXES), ``ici_axes`` partition within a slice. With no
+    dcn_axes (or one slice) this is a plain mesh of the ici_axes.
+
+    Sizes of -1 are inferred: at most one per group (ici from per-slice
+    device count, dcn from slice count)."""
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    from seldon_core_tpu.parallel.mesh import make_mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    dcn_axes = dict(dcn_axes or {})
+    if -1 in dcn_axes.values():
+        raise ValueError("dcn axis sizes must be explicit (slice count is not inferable)")
+    if not dcn_axes or all(v == 1 for v in dcn_axes.values()):
+        return make_mesh({**dcn_axes, **ici_axes}, devices)
+
+    for axis in dcn_axes:
+        if axis not in DCN_FRIENDLY_AXES:
+            logger.warning(
+                "axis %r crosses DCN; tensor/seq-parallel collectives over DCN "
+                "will dominate step time (keep them on ICI)", axis
+            )
+
+    import math
+
+    n = len(devices)
+    dcn_known = math.prod(dcn_axes.values())
+    ici_known = math.prod(v for v in ici_axes.values() if v != -1)
+    per_slice = n // dcn_known
+    if n % dcn_known:
+        raise ValueError(f"{n} devices not divisible by dcn product {dcn_known}")
+    ici = dict(ici_axes)
+    unknown = [k for k, v in ici.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"at most one -1 ici axis, got {unknown}")
+    if unknown:
+        if per_slice % ici_known:
+            raise ValueError(f"{per_slice} per-slice devices not divisible by {ici_known}")
+        ici[unknown[0]] = per_slice // ici_known
+
+    axis_names = list(dcn_axes.keys()) + list(ici.keys())
+    mesh_shape = [1] * len(dcn_axes) + list(ici.values())
+    dcn_shape = list(dcn_axes.values()) + [1] * len(ici)
+    if all(hasattr(d, "slice_index") for d in devices):
+        # real multi-slice platform: let mesh_utils group by slice; layout
+        # errors here are real errors and must propagate
+        mesh_devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape, dcn_shape, devices=devices, allow_split_physical_axes=True
+        )
+    else:
+        # Devices without a slice_index attribute (CPU mesh in tests,
+        # single-slice platforms): group contiguously — device enumeration
+        # is slice-major on real pods, so granule = contiguous block.
+        import numpy as np
+
+        logger.debug("no slice_index on devices; contiguous hybrid grouping")
+        mesh_devices = np.array(devices).reshape(
+            *dcn_axes.values(), *ici.values()
+        )
+    return Mesh(mesh_devices, tuple(axis_names))

@@ -45,6 +45,7 @@ class JAXServer(SeldonComponent):
         batch_buckets: Optional[Sequence[int]] = None,
         strict_sharding: bool = False,
         tensor_parallel: int = 0,
+        quantize: str = "",
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -58,6 +59,9 @@ class JAXServer(SeldonComponent):
         # per-predictor `replicas`, proto/seldon_deployment.proto:57) and
         # builds the standard ('data', 'model') serving mesh at load time.
         self.tensor_parallel = int(tensor_parallel)
+        # "int8": weight-only PTQ — weights live in HBM as int8, dequant
+        # fuses into the matmuls (ops/quantize.py)
+        self.quantize = str(quantize or "")
         self.batch_buckets = tuple(batch_buckets) if batch_buckets else DEFAULT_BUCKETS
         self.ready = False
         self._apply = None
@@ -104,6 +108,24 @@ class JAXServer(SeldonComponent):
             if isinstance(out, tuple):
                 out = out[0]
             return out
+
+        quantize = self.quantize or self._config.get("quantize", "")
+        if quantize:
+            if quantize != "int8":
+                raise SeldonError(f"unsupported quantize={quantize!r} (int8 only)", status_code=500)
+            if self.mesh is not None or self.tensor_parallel > 1:
+                raise SeldonError(
+                    "quantize=int8 with a mesh is not supported yet "
+                    "(quantized leaves don't carry logical axis names)",
+                    status_code=500,
+                )
+            from seldon_core_tpu.ops.quantize import dequantize_params, quantize_params
+
+            params = quantize_params(params)
+            base_apply = apply_fn
+
+            def apply_fn(params, x):  # noqa: F811 — quantized wrapper
+                return base_apply(dequantize_params(params), x)
 
         if self.mesh is not None:
             from seldon_core_tpu.parallel.sharding import shard_apply

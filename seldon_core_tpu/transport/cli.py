@@ -82,6 +82,7 @@ def build_component(interface_name: str, persistence: bool = False):
 
 def run_microservice(args: argparse.Namespace) -> None:
     setup_logging()
+    _bootstrap_multihost()
     component, _ = build_component(args.interface_name, persistence=args.persistence)
     port = args.port or int(os.environ.get("PREDICTIVE_UNIT_SERVICE_PORT", "5000"))
     unit_id = os.environ.get("PREDICTIVE_UNIT_ID", "")
@@ -99,8 +100,18 @@ def run_microservice(args: argparse.Namespace) -> None:
         raise SystemExit(f"Unknown API type {api} (use REST or GRPC)")
 
 
+def _bootstrap_multihost() -> None:
+    """Join the multi-host device world when the environment describes one
+    (JAX_COORDINATOR_ADDRESS etc.) — must run before any component load in
+    every serving entrypoint; single-host is a no-op."""
+    from seldon_core_tpu.parallel.multihost import initialize as multihost_init
+
+    multihost_init()
+
+
 def run_engine(args: argparse.Namespace) -> None:
     setup_logging()
+    _bootstrap_multihost()
     from seldon_core_tpu.metrics.registry import MetricsRegistry
     from seldon_core_tpu.runtime.engine import GraphEngine
     from seldon_core_tpu.transport.rest import make_engine_app, serve
