@@ -1,0 +1,105 @@
+"""Pallas TPU kernel: int8 weight-only matmul with in-kernel dequantization.
+
+The serving path for weight-only int8 (ops/quantize.py) relies on XLA to
+fuse the convert+multiply dequant into the consuming matmul. This kernel is
+the explicit-control variant of that contract — the weight tile crosses
+HBM->VMEM as int8 (half the bytes of bf16), is dequantized in VMEM
+registers, and feeds the MXU per (M, N) grid tile with f32 accumulation —
+the quantization-kernel pattern from the TPU Pallas playbook. Its role: the
+public ``quantized_matmul`` entry point (exported via ops.quantize) for
+user components with int8 weights, and the probe for validating/benching
+the XLA fusion path against a known-good explicit schedule; swapping it
+into the model families is gated on the TPU benchmark showing a win over
+the fused XLA path.
+
+``int8_matmul`` pads all dims to MXU-friendly tiles, runs the kernel on
+TPU, and falls back to the equivalent XLA expression elsewhere (tests run
+the kernel itself via the Pallas interpreter, so the body is exercised on
+CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref):
+    import jax.numpy as jnp
+
+    # dequant in VMEM: int8 tile -> f32, scaled per output channel
+    w = q_ref[...].astype(jnp.float32) * s_ref[...].astype(jnp.float32)[None, :]
+    o_ref[...] = jnp.dot(
+        x_ref[...].astype(jnp.float32), w, preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _tile_sizes(m: int, n: int):
+    # lane dim is fixed at 128; sublane tile shrinks for small batches but
+    # stays a multiple of the f32 min tile (8)
+    tm = 128 if m >= 128 else max(8, 1 << max(m - 1, 0).bit_length())
+    return tm, 128
+
+
+def int8_matmul(x, q, scale, out_dtype=None, interpret: bool | None = None):
+    """x [M, K] float; q [K, N] int8; scale [N] f32 -> [M, N].
+
+    Equivalent to ``x @ (q * scale)`` with f32 accumulation. On TPU the
+    weight tiles stream into VMEM as int8; elsewhere (or with
+    ``interpret=True``) the same kernel runs under the Pallas interpreter.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    m, k = x.shape
+    kq, n = q.shape
+    assert k == kq and scale.shape == (n,), (x.shape, q.shape, scale.shape)
+    out_dtype = out_dtype or x.dtype
+
+    platform = jax.devices()[0].platform
+    if interpret is None:
+        interpret = platform != "tpu"
+    if interpret and platform != "cpu":
+        # interpreter is a CPU debugger; anything else uses the XLA fallback
+        return (x.astype(jnp.float32) @ (q.astype(jnp.float32) * scale[None, :])).astype(out_dtype)
+
+    tm, tn = _tile_sizes(m, n)
+    pm = -(-m // tm) * tm
+    pn = -(-n // tn) * tn
+    # K is the int8 sublane dim of q and the lane dim of x: pad to 128 so
+    # Mosaic tiling holds for any K (zero rows/cols contribute nothing)
+    pk = -(-k // 128) * 128
+    xp = jnp.pad(x, ((0, pm - m), (0, pk - k))) if (pm, pk) != (m, k) else x
+    qp = jnp.pad(q, ((0, pk - k), (0, pn - n))) if (pk, pn) != (k, n) else q
+    sp = jnp.pad(scale, (0, pn - n)) if pn != n else scale
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=(pm // tm, pn // tn),
+        in_specs=[
+            pl.BlockSpec((tm, pk), lambda i, j: (i, 0)),
+            pl.BlockSpec((pk, tn), lambda i, j: (0, j)),
+            pl.BlockSpec((tn,), lambda i, j: (j,)),
+        ],
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((pm, pn), out_dtype),
+        interpret=interpret,
+    )(xp, qp, sp)
+    return out[:m, :n]
+
+
+def int8_dense(x, qt, out_dtype=None):
+    """Apply a quantized kernel (ops.quantize.QuantizedTensor holding a
+    [K, N] weight) to activations [..., K] — reshapes to 2-D around the
+    kernel so any leading batch structure works. Output dtype defaults to
+    the weight's original dtype (matching dequantize_params semantics)."""
+    out_dtype = out_dtype or qt.orig_dtype
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    x2 = x.reshape((-1, k)) if lead else x.reshape((1, k))
+    out = int8_matmul(x2, qt.q, qt.scale, out_dtype=out_dtype)
+    n = out.shape[-1]
+    return out.reshape((*lead, n)) if lead else out.reshape((n,))

@@ -111,6 +111,14 @@ def dequantize_params(params: Any, dtype=None) -> Any:
     return jax.tree.map(visit, params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
 
 
+def quantized_matmul(x, qt: QuantizedTensor, out_dtype=None):
+    """Public int8-weight matmul for user components: the explicit Pallas
+    kernel on TPU, the XLA-fused dequant expression elsewhere."""
+    from seldon_core_tpu.ops.pallas_int8 import int8_dense
+
+    return int8_dense(x, qt, out_dtype=out_dtype)
+
+
 def quantized_bytes(params: Any) -> int:
     """HBM footprint of the (possibly mixed) tree — for reporting."""
     import jax

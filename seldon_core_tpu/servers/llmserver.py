@@ -118,6 +118,7 @@ class LLMServer(SeldonComponent):
         mesh: Optional[Any] = None,
         tensor_parallel: int = 0,
         sequence_parallel: int = 0,
+        quantize: str = "",
         seed: int = 0,
         **kwargs: Any,
     ):
@@ -137,6 +138,9 @@ class LLMServer(SeldonComponent):
         # tensor_parallel): builds a ('data', 'seq', 'model') mesh at load.
         self.tensor_parallel = int(tensor_parallel)
         self.sequence_parallel = int(sequence_parallel)
+        # "int8": weight-only PTQ (ops/quantize.py) — the KV cache and
+        # activations stay in the model dtype; only weights go int8 in HBM
+        self.quantize = str(quantize or "")
         self.seed = int(seed)
         self.ready = False
         self._eos_override = eos_id
@@ -199,6 +203,19 @@ class LLMServer(SeldonComponent):
 
             logical = logical_axis_tree(self._module, jax.ShapeDtypeStruct((1, 8), jnp.int32))
             params = shard_params(params, self.mesh, logical)
+
+        self._dequant = lambda p: p
+        if self.quantize:
+            if self.quantize != "int8":
+                raise SeldonError(f"unsupported quantize={self.quantize!r} (int8 only)", status_code=500)
+            if self.mesh is not None:
+                raise SeldonError(
+                    "quantize=int8 with a mesh is not supported yet", status_code=500
+                )
+            from seldon_core_tpu.ops.quantize import dequantize_params, quantize_params
+
+            params = quantize_params(params)
+            self._dequant = dequantize_params
         self._params = params
 
         if self.tokenizer_name == "bytes":
@@ -269,11 +286,12 @@ class LLMServer(SeldonComponent):
         from seldon_core_tpu.models.transformer import init_kv_caches
 
         module, cfg = self._module, self._cfg
+        deq = self._dequant
 
         def prefill(params, tokens, positions):
             caches = init_kv_caches(cfg, tokens.shape[0], max_len)
             logits, caches = module.apply(
-                params, tokens, positions=positions, caches=caches, cache_index=0
+                deq(params), tokens, positions=positions, caches=caches, cache_index=0
             )
             return logits, caches
 
@@ -298,6 +316,7 @@ class LLMServer(SeldonComponent):
         module = self._module
         eos_id = self.eos_id
         top_k = self.top_k
+        deq = self._dequant
 
         def decode(params, caches, last_tok, true_len, n_steps, rng, temperature):
             """last_tok [b], true_len [b]; returns tokens [b, n_steps]."""
@@ -314,8 +333,11 @@ class LLMServer(SeldonComponent):
                 caches, tok, offset, done, key = carry
                 positions = (true_len + offset)[:, None]
                 cache_index = true_len + offset
+                # dequant inside the scan body: the int8 copy is the one that
+                # persists in HBM (hoisting the f32 copy out of the loop
+                # would double weight residency for the whole decode)
                 logits, caches = module.apply(
-                    params, tok[:, None], positions=positions, caches=caches,
+                    deq(params), tok[:, None], positions=positions, caches=caches,
                     cache_index=cache_index,
                 )
                 key, sub = jax.random.split(key)

@@ -124,3 +124,41 @@ def test_bfloat16_checkpoint_quantizes():
     assert back.dtype == jnp.bfloat16
     err = np.abs(np.asarray(back, np.float32) - np.asarray(w, np.float32))
     assert err.max() < 0.05
+
+
+def test_llmserver_int8_generates():
+    """Quantized LLM decode: int8 weights through prefill + scan decode;
+    greedy output stays close to the fp32 server (same seed/params)."""
+    from seldon_core_tpu.servers.llmserver import LLMServer
+
+    base = LLMServer(model="llama-tiny", init_random=True, max_new_tokens=6,
+                     len_buckets=(16,), batch_buckets=(1,), temperature=0.0, seed=5)
+    base.load()
+    quant = LLMServer(model="llama-tiny", init_random=True, max_new_tokens=6,
+                      len_buckets=(16,), batch_buckets=(1,), temperature=0.0, seed=5,
+                      quantize="int8")
+    quant.load()
+
+    from seldon_core_tpu.ops.quantize import QuantizedTensor as QT
+
+    n_quant = sum(isinstance(l, QT) for l in
+                  jax.tree.flatten(quant._params, is_leaf=lambda x: isinstance(x, QT))[0])
+    assert n_quant > 0
+
+    prompt = [5, 9, 17, 33, 2, 7]
+    out_q = quant.generate([prompt], max_new_tokens=6)["tokens"][0]
+    assert all(0 <= t < 256 for t in out_q)
+
+    # robust numeric check: prefill logits of the quantized path stay within
+    # the int8 noise floor of the fp32 path (token-exact greedy agreement
+    # would hinge on near-tie argmaxes of a random-init model)
+    import jax.numpy as jnp
+
+    tokens = jnp.asarray([prompt], jnp.int32)
+    positions = jnp.arange(len(prompt))[None, :]
+    pf_f = base._get_prefill(1, len(prompt), 16)
+    pf_q = quant._get_prefill(1, len(prompt), 16)
+    logits_f, _ = pf_f(base._params, tokens, positions)
+    logits_q, _ = pf_q(quant._params, tokens, positions)
+    err = np.abs(np.asarray(logits_q, np.float32) - np.asarray(logits_f, np.float32))
+    assert err.max() < 0.15, err.max()
