@@ -90,13 +90,21 @@ def merge_reports(reports: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
-def run_local_fleet(job: Dict[str, Any], n_workers: int) -> Dict[str, Any]:
-    """N concurrent generator processes on this host, merged report."""
+def run_local_fleet(
+    job: Dict[str, Any],
+    n_workers: int,
+    per_worker: Optional[List[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """N concurrent generator processes on this host, merged report.
+    ``per_worker[i]`` overrides job fields for worker i (e.g. a distinct
+    contract-generated body per worker)."""
     reports: List[Optional[Dict[str, Any]]] = [None] * n_workers
     errors: List[Exception] = []
 
     def work(i: int) -> None:
         w_job = dict(job, label=f"{job.get('label', 'fleet')}-w{i}")
+        if per_worker and i < len(per_worker):
+            w_job.update(per_worker[i])
         try:
             reports[i] = run_one(w_job)
         except Exception as e:  # surfaced after join
@@ -152,7 +160,8 @@ def worker_serve(listen_port: int, host: str = "0.0.0.0", once: bool = False) ->
 
 
 def run_distributed(workers: List[str], job: Dict[str, Any],
-                    timeout_s: Optional[float] = None) -> Dict[str, Any]:
+                    timeout_s: Optional[float] = None,
+                    per_worker: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
     """Master: ship the job to every worker (host:port), merge the reports."""
     if timeout_s is None:
         timeout_s = float(job.get("duration", 10.0)) + float(job.get("warmup", 1.0)) + 30.0
@@ -167,6 +176,8 @@ def run_distributed(workers: List[str], job: Dict[str, Any],
                 conn.settimeout(timeout_s)
                 f = conn.makefile("rwb")
                 w_job = dict(job, label=f"{job.get('label', 'fleet')}-{addr}")
+                if per_worker and i < len(per_worker):
+                    w_job.update(per_worker[i])
                 f.write(json.dumps(w_job).encode() + b"\n")
                 f.flush()
                 resp = json.loads(f.readline())

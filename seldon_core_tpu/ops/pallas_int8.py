@@ -61,9 +61,11 @@ def int8_matmul(x, q, scale, out_dtype=None, interpret: bool | None = None):
 
     platform = jax.devices()[0].platform
     if interpret is None:
-        interpret = platform != "tpu"
-    if interpret and platform != "cpu":
-        # interpreter is a CPU debugger; anything else uses the XLA fallback
+        interpret = False
+    if not interpret and platform != "tpu":
+        # the Pallas interpreter is a test/debug vehicle only (orders of
+        # magnitude slower); every non-TPU production platform takes the
+        # equivalent XLA expression
         return (x.astype(jnp.float32) @ (q.astype(jnp.float32) * scale[None, :])).astype(out_dtype)
 
     tm, tn = _tile_sizes(m, n)

@@ -330,6 +330,26 @@ def run_loadtest_worker(args: argparse.Namespace) -> None:
 def run_loadtest_fleet(args: argparse.Namespace) -> None:
     from seldon_core_tpu.benchmarks.fleet import run_distributed, run_local_fleet
 
+    workers = [w.strip() for w in args.workers.split(",") if w.strip()]
+    n_workers = len(workers) or max(args.local_workers, 1)
+
+    per_worker = None
+    if args.contract:
+        if args.grpc:
+            raise SystemExit("--contract payloads are REST-only (the native gRPC "
+                             "generator uses its fixed proto request)")
+        # contract-conforming payloads, a distinct draw per worker — the
+        # fleet analogue of the reference's locust drivers sampling the
+        # contract's feature ranges (predict_rest_locust.py:17-53); the
+        # native generator replays its body, so variety is per worker
+        from seldon_core_tpu.client.contract import generate_batch, load_contract
+
+        contract = load_contract(args.contract)
+        per_worker = [
+            {"body": json.dumps({"data": {"ndarray": generate_batch(
+                contract, max(args.batch, 1), seed=i).tolist()}})}
+            for i in range(n_workers)
+        ]
     job = {
         "host": args.host,
         "port": args.port,
@@ -339,10 +359,10 @@ def run_loadtest_fleet(args: argparse.Namespace) -> None:
         "body": args.body,
         "path": args.path,
     }
-    if args.workers:
-        report = run_distributed([w.strip() for w in args.workers.split(",") if w.strip()], job)
+    if workers:
+        report = run_distributed(workers, job, per_worker=per_worker)
     else:
-        report = run_local_fleet(job, max(args.local_workers, 1))
+        report = run_local_fleet(job, n_workers, per_worker=per_worker)
     out = json.dumps(report, indent=2)
     print(out)
     if args.report:
@@ -487,6 +507,10 @@ def main(argv: Optional[list] = None) -> None:
     ltf.add_argument("--duration", type=float, default=10.0)
     ltf.add_argument("--grpc", action="store_true")
     ltf.add_argument("--body", default=None)
+    ltf.add_argument("--contract", default=None,
+                     help="contract.json: each worker replays a distinct payload "
+                          "drawn from the feature ranges (REST only)")
+    ltf.add_argument("--batch", type=int, default=1, help="rows per contract payload")
     ltf.add_argument("--path", default=None)
     ltf.add_argument("--report", default=None, help="write merged JSON report here")
     ltf.set_defaults(func=run_loadtest_fleet)

@@ -116,3 +116,25 @@ def test_merge_reports_weighting():
     assert m["failures"] == 1
     assert m["latency_ms"]["max"] == 9.0
     assert m["latency_ms"]["p50"] == pytest.approx(2.5)  # weighted 1:3
+
+
+def test_fleet_contract_payloads(edge, tmp_path):
+    """--contract: payloads generated from feature ranges (locust parity)."""
+    contract = {
+        "features": [
+            {"name": "x", "ftype": "continuous", "range": [0, 1], "shape": [2]},
+        ],
+        "targets": [],
+    }
+    cpath = tmp_path / "contract.json"
+    cpath.write_text(json.dumps(contract))
+    report_path = tmp_path / "report.json"
+    subprocess.run(
+        [sys.executable, "-m", "seldon_core_tpu.transport.cli",
+         "loadtest-fleet", "127.0.0.1", str(edge),
+         "--local-workers", "1", "--connections", "4", "--duration", "1",
+         "--contract", str(cpath), "--report", str(report_path)],
+        cwd="/root/repo", check=True, capture_output=True,
+    )
+    report = json.loads(report_path.read_text())
+    assert report["failures"] == 0 and report["requests"] > 50
