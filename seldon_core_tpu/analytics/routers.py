@@ -36,6 +36,14 @@ class _BanditRouter(SeldonComponent):
         self.pulls = np.zeros(self.n_branches, dtype=np.int64)
         self.reward_sum = np.zeros(self.n_branches, dtype=np.float64)
         self.fail_sum = np.zeros(self.n_branches, dtype=np.float64)
+        # Peer replicas' contributions (multi-replica DP serving): this
+        # replica's feedback lands in the local arrays above; ReplicaSync
+        # periodically publishes the local counts and refreshes these sums
+        # of the other replicas' counts — a G-counter, so no CAS and no
+        # double counting. Decisions read local + peers.
+        self.peer_pulls = np.zeros(self.n_branches, dtype=np.int64)
+        self.peer_reward_sum = np.zeros(self.n_branches, dtype=np.float64)
+        self.peer_fail_sum = np.zeros(self.n_branches, dtype=np.float64)
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
         self._last_branch: Optional[int] = None
@@ -49,6 +57,11 @@ class _BanditRouter(SeldonComponent):
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._lock = threading.Lock()
+        # snapshots from before multi-replica sync lack the peer arrays
+        for name in ("peer_pulls", "peer_reward_sum", "peer_fail_sum"):
+            if name not in self.__dict__:
+                dtype = np.int64 if name == "peer_pulls" else np.float64
+                setattr(self, name, np.zeros(self.n_branches, dtype=dtype))
 
     def send_feedback(
         self,
@@ -70,9 +83,77 @@ class _BanditRouter(SeldonComponent):
             self.reward_sum[branch] += r
             self.fail_sum[branch] += 1.0 - r
 
+    # ------------------------------------------------------- replica sync
+    def stats_snapshot(self) -> Dict[str, Any]:
+        """This replica's own accumulated statistics (not the peers')."""
+        with self._lock:
+            return {
+                "pulls": self.pulls.copy(),
+                "reward_sum": self.reward_sum.copy(),
+                "fail_sum": self.fail_sum.copy(),
+            }
+
+    def reset_local_stats(self) -> None:
+        """Zero this replica's own counters (used when a fresh replica booted
+        from a shared-key snapshot: those counts belong to another replica
+        and must not be republished under this replica's key)."""
+        with self._lock:
+            self.pulls = np.zeros(self.n_branches, dtype=np.int64)
+            self.reward_sum = np.zeros(self.n_branches, dtype=np.float64)
+            self.fail_sum = np.zeros(self.n_branches, dtype=np.float64)
+
+    def _valid_snapshot(self, s: Dict[str, Any]) -> bool:
+        try:
+            return all(
+                np.asarray(s[k]).shape == (self.n_branches,)
+                for k in ("pulls", "reward_sum", "fail_sum")
+            )
+        except (KeyError, TypeError):
+            return False
+
+    def load_stats_snapshot(self, s: Dict[str, Any]) -> bool:
+        """Install a snapshot as this replica's own counters (boot resume).
+        Rejects snapshots whose shape doesn't match n_branches (e.g. the
+        router was redeployed with a different branch count)."""
+        if not self._valid_snapshot(s):
+            return False
+        with self._lock:
+            self.pulls = np.asarray(s["pulls"], dtype=np.int64).copy()
+            self.reward_sum = np.asarray(s["reward_sum"], dtype=np.float64).copy()
+            self.fail_sum = np.asarray(s["fail_sum"], dtype=np.float64).copy()
+        return True
+
+    def apply_peer_stats(self, snapshots: Sequence[Dict[str, Any]]) -> None:
+        """Replace the peer contribution with the sum of the given replica
+        snapshots (each the ``stats_snapshot()`` of one other replica).
+        Mis-shaped snapshots (stale keys from an older branch count) are
+        skipped rather than poisoning the arrays."""
+        pulls = np.zeros(self.n_branches, dtype=np.int64)
+        reward = np.zeros(self.n_branches, dtype=np.float64)
+        fail = np.zeros(self.n_branches, dtype=np.float64)
+        for s in snapshots:
+            if not self._valid_snapshot(s):
+                continue
+            pulls += np.asarray(s["pulls"], dtype=np.int64)
+            reward += np.asarray(s["reward_sum"], dtype=np.float64)
+            fail += np.asarray(s["fail_sum"], dtype=np.float64)
+        with self._lock:
+            self.peer_pulls = pulls
+            self.peer_reward_sum = reward
+            self.peer_fail_sum = fail
+
+    def _totals(self):
+        """Combined (local + peer) stats; callers hold the lock."""
+        return (
+            self.pulls + self.peer_pulls,
+            self.reward_sum + self.peer_reward_sum,
+            self.fail_sum + self.peer_fail_sum,
+        )
+
     def branch_means(self) -> np.ndarray:
         with self._lock:
-            return self.reward_sum / np.maximum(self.pulls, 1)
+            pulls, reward, _ = self._totals()
+            return reward / np.maximum(pulls, 1)
 
     def tags(self) -> Dict[str, Any]:
         return {
@@ -118,12 +199,13 @@ class EpsilonGreedy(_BanditRouter):
 
     def route(self, X: np.ndarray, names: Sequence[str]) -> int:
         with self._lock:
+            pulls, reward, _ = self._totals()
             if self._rng.random() < self.epsilon:
                 branch = int(self._rng.integers(self.n_branches))
-            elif self.pulls.sum() == 0:
+            elif pulls.sum() == 0:
                 branch = self.best_branch
             else:
-                means = self.reward_sum / np.maximum(self.pulls, 1)
+                means = reward / np.maximum(pulls, 1)
                 branch = int(np.argmax(means))
             self._last_branch = branch
             return branch
@@ -151,8 +233,9 @@ class ThompsonSampling(_BanditRouter):
 
     def route(self, X: np.ndarray, names: Sequence[str]) -> int:
         with self._lock:
-            a = self.alpha0 + self.reward_sum
-            b = self.beta0 + self.fail_sum
+            _, reward, fail = self._totals()
+            a = self.alpha0 + reward
+            b = self.beta0 + fail
             theta = self._rng.beta(a, b)
             branch = int(np.argmax(theta))
             self._last_branch = branch

@@ -37,6 +37,10 @@ class StateStore:
     def restore(self, key: str) -> Optional[Any]:
         raise NotImplementedError
 
+    def list(self, prefix: str) -> list:
+        """Keys starting with ``prefix`` (replica discovery)."""
+        raise NotImplementedError
+
 
 class FileStateStore(StateStore):
     def __init__(self, root: Optional[str] = None):
@@ -59,6 +63,13 @@ class FileStateStore(StateStore):
         with open(path, "rb") as f:
             return pickle.load(f)
 
+    def list(self, prefix: str) -> list:
+        return sorted(
+            fn[: -len(".pkl")]
+            for fn in os.listdir(self.root)
+            if fn.endswith(".pkl") and fn.startswith(prefix)
+        )
+
 
 class RedisStateStore(StateStore):
     def __init__(self, host: Optional[str] = None, port: int = 6379):
@@ -76,6 +87,12 @@ class RedisStateStore(StateStore):
     def restore(self, key: str) -> Optional[Any]:
         raw = self._client.get(key)
         return pickle.loads(raw) if raw else None
+
+    def list(self, prefix: str) -> list:
+        return sorted(
+            k.decode() if isinstance(k, bytes) else k
+            for k in self._client.scan_iter(match=prefix + "*")
+        )
 
 
 def make_store() -> StateStore:
@@ -111,10 +128,10 @@ class PersistenceThread(threading.Thread):
         self.key = key or state_key()
         self.store = store or make_store()
         self.period_s = period_s
-        self._stop = threading.Event()
+        self._halt = threading.Event()
 
     def run(self) -> None:
-        while not self._stop.wait(self.period_s):
+        while not self._halt.wait(self.period_s):
             self.snapshot()
 
     def snapshot(self) -> None:
@@ -124,5 +141,94 @@ class PersistenceThread(threading.Thread):
             logger.exception("persistence snapshot failed")
 
     def stop(self) -> None:
-        self._stop.set()
+        self._halt.set()
         self.snapshot()
+
+
+def replica_id(env: Optional[dict] = None) -> str:
+    """Identity for this serving replica. REPLICA_ID (set it to the pod
+    name/ordinal in k8s) gives a STABLE identity: a restarted replica
+    resumes its own counter. The default is hostname-pid — collision-free
+    for co-hosted replicas; a restart starts a fresh counter while the old
+    key keeps contributing as a peer, so no feedback is lost either way."""
+    env = env if env is not None else dict(os.environ)
+    explicit = env.get("REPLICA_ID")
+    if explicit:
+        return explicit
+    return f"{env.get('HOSTNAME', 'host')}-pid{os.getpid()}"
+
+
+class ReplicaSync(threading.Thread):
+    """Multi-replica state sharing for stateful routers (SURVEY.md §7 hard
+    part #4: bandit feedback under replicated data-parallel serving).
+
+    G-counter protocol — no CAS, no double counting: each replica OWNS the
+    key ``{key}:replica:{id}`` and periodically publishes only its local
+    statistics there; it then reads every *other* replica's snapshot and
+    installs the sum as its peer contribution
+    (`_BanditRouter.apply_peer_stats`). Decisions see local + peers, so all
+    replicas converge on the global posterior between sync periods, any
+    replica can crash without corrupting shared state, and a restarted
+    replica resumes its own counter from its own key.
+
+    Works over any StateStore with list(): a shared volume (FileStateStore)
+    or Redis — the same backends the reference's single-writer pickle used.
+    """
+
+    def __init__(
+        self,
+        component: Any,
+        key: Optional[str] = None,
+        store: Optional[StateStore] = None,
+        rid: Optional[str] = None,
+        period_s: float = 5.0,
+    ):
+        super().__init__(daemon=True, name="seldon-replica-sync")
+        for method in ("stats_snapshot", "apply_peer_stats", "load_stats_snapshot"):
+            if not hasattr(component, method):
+                raise TypeError(
+                    f"{type(component).__name__} does not expose {method} "
+                    "(required for replica sync)"
+                )
+        self.component = component
+        self.key = key or state_key()
+        self.store = store or make_store()
+        self.rid = rid or replica_id()
+        self.period_s = period_s
+        self._halt = threading.Event()
+
+    @property
+    def own_key(self) -> str:
+        return f"{self.key}:replica:{self.rid}"
+
+    def sync(self) -> None:
+        try:
+            self.store.save(self.own_key, self.component.stats_snapshot())
+            peers = []
+            for k in self.store.list(f"{self.key}:replica:"):
+                if k == self.own_key:
+                    continue
+                snap = self.store.restore(k)
+                if snap is not None:
+                    peers.append(snap)
+            self.component.apply_peer_stats(peers)
+        except Exception:
+            logger.exception("replica sync failed (will retry)")
+
+    def restore_own(self) -> bool:
+        """On boot: resume this replica's own counter if present and
+        shape-compatible (the component validates — a redeploy with a
+        different branch count rejects the stale snapshot)."""
+        snap = self.store.restore(self.own_key)
+        if snap is None:
+            return False
+        return bool(self.component.load_stats_snapshot(snap))
+
+    def run(self) -> None:
+        while not self._halt.wait(self.period_s):
+            self.sync()
+        self.sync()  # final publish so peers see the last counts
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=self.period_s + 1)

@@ -62,22 +62,43 @@ def import_interface(name: str):
 
 def build_component(interface_name: str, persistence: bool = False):
     from seldon_core_tpu.contracts.parameters import parse_parameters
-    from seldon_core_tpu.runtime.persistence import PersistenceThread, restore_component
+    from seldon_core_tpu.runtime.persistence import (
+        PersistenceThread,
+        ReplicaSync,
+        restore_component,
+    )
 
     klass = import_interface(interface_name)
     parameters = parse_parameters()
     component = None
-    thread = None
+    restored_shared = False
     if persistence:
         component = restore_component(klass)
+        restored_shared = component is not None
     if component is None:
         component = klass(**parameters)
     if hasattr(component, "load"):
         component.load()
+    threads = []
     if persistence:
         thread = PersistenceThread(component)
         thread.start()
-    return component, thread
+        threads.append(thread)
+        # stateful routers under replicated serving additionally share their
+        # feedback counters across replicas (G-counter ReplicaSync)
+        if hasattr(component, "stats_snapshot"):
+            sync = ReplicaSync(component, store=thread.store)
+            if not sync.restore_own() and restored_shared and hasattr(component, "reset_local_stats"):
+                # shared-key snapshot came from some other replica: don't
+                # republish its counts under this replica's key
+                component.reset_local_stats()
+            sync.sync()  # publish + pull peers NOW, not after one period
+            sync.start()
+            threads.append(sync)
+            import atexit
+
+            atexit.register(sync.stop)  # final publish on shutdown
+    return component, threads
 
 
 def run_microservice(args: argparse.Namespace) -> None:

@@ -300,3 +300,90 @@ def test_sagemaker_proxy_round_trip():
             proxy.predict(np.array([[1.0]]), ["a"])
     finally:
         srv.shutdown()
+
+
+# --------------------------------------------------------- replica sync
+def test_replica_sync_converges_bandits(tmp_path):
+    """Two serving replicas of one epsilon-greedy router share feedback via
+    the G-counter ReplicaSync: each sees the other's counts, decisions use
+    the combined posterior, and nothing double-counts."""
+    from seldon_core_tpu.analytics import EpsilonGreedy
+    from seldon_core_tpu.runtime.persistence import FileStateStore, ReplicaSync
+
+    store = FileStateStore(str(tmp_path))
+    r1 = EpsilonGreedy(n_branches=2, epsilon=0.0, seed=1)
+    r2 = EpsilonGreedy(n_branches=2, epsilon=0.0, seed=2)
+    s1 = ReplicaSync(r1, key="k", store=store, rid="a", period_s=999)
+    s2 = ReplicaSync(r2, key="k", store=store, rid="b", period_s=999)
+
+    # replica 1 learns branch 1 is great; replica 2 sees no feedback at all
+    for _ in range(10):
+        r1.send_feedback(np.zeros(1), [], reward=1.0, truth=None, routing=1)
+        r1.send_feedback(np.zeros(1), [], reward=0.0, truth=None, routing=0)
+    s1.sync()
+    s2.sync()
+
+    # replica 2 now exploits branch 1 purely from peer knowledge
+    assert r2.route(np.zeros((1, 1)), []) == 1
+    np.testing.assert_allclose(r2.branch_means(), r1.branch_means())
+
+    # repeated syncs must not double-count (G-counter, not accumulation)
+    s1.sync(); s2.sync(); s1.sync(); s2.sync()
+    assert int(r2.peer_pulls.sum()) == 20
+    assert int(r1.peer_pulls.sum()) == 0  # r2 never saw feedback
+
+    # totals = own + peers on both sides
+    total = (r1.pulls + r1.peer_pulls) + 0
+    np.testing.assert_array_equal(total, r2.pulls + r2.peer_pulls)
+
+
+def test_replica_sync_restart_resumes_own_counter(tmp_path):
+    from seldon_core_tpu.analytics import ThompsonSampling
+    from seldon_core_tpu.runtime.persistence import FileStateStore, ReplicaSync
+
+    store = FileStateStore(str(tmp_path))
+    r = ThompsonSampling(n_branches=3, seed=0)
+    for _ in range(5):
+        r.send_feedback(np.zeros(1), [], reward=1.0, truth=None, routing=2)
+    ReplicaSync(r, key="k", store=store, rid="a", period_s=999).sync()
+
+    # replica restarts: fresh object, same replica id
+    r_new = ThompsonSampling(n_branches=3, seed=0)
+    s_new = ReplicaSync(r_new, key="k", store=store, rid="a", period_s=999)
+    assert s_new.restore_own()
+    assert int(r_new.pulls[2]) == 5
+    s_new.sync()
+    assert int(r_new.peer_pulls.sum()) == 0  # own key excluded from peers
+
+
+def test_replica_sync_requires_stats_contract():
+    from seldon_core_tpu.runtime.persistence import FileStateStore, ReplicaSync
+
+    class NoStats:
+        pass
+
+    with pytest.raises(TypeError, match="stats_snapshot"):
+        ReplicaSync(NoStats(), key="k", store=FileStateStore("/tmp"), rid="x")
+
+
+def test_replica_sync_shape_mismatch_guard(tmp_path):
+    """A redeploy that changes n_branches must not let stale snapshots (own
+    or peer) poison the new router's arrays."""
+    from seldon_core_tpu.analytics import EpsilonGreedy
+    from seldon_core_tpu.runtime.persistence import FileStateStore, ReplicaSync
+
+    store = FileStateStore(str(tmp_path))
+    old = EpsilonGreedy(n_branches=3, seed=0)
+    for _ in range(4):
+        old.send_feedback(np.zeros(1), [], reward=1.0, truth=None, routing=2)
+    ReplicaSync(old, key="k", store=store, rid="a", period_s=999).sync()
+
+    fresh = EpsilonGreedy(n_branches=2, seed=0)
+    s_same = ReplicaSync(fresh, key="k", store=store, rid="a", period_s=999)
+    assert not s_same.restore_own()  # stale 3-branch own snapshot rejected
+
+    peer_view = EpsilonGreedy(n_branches=2, seed=0)
+    s_other = ReplicaSync(peer_view, key="k", store=store, rid="b", period_s=999)
+    s_other.sync()  # sees a's stale 3-branch snapshot as a peer
+    assert peer_view.peer_pulls.tolist() == [0, 0]  # skipped, not crashed
+    assert peer_view.route(np.zeros((1, 1)), []) in (0, 1)
