@@ -1,0 +1,146 @@
+"""Pipeline parallelism over the 'pipe' mesh axis.
+
+The scaling playbook's SPMD pipeline: layers are grouped into S stages, one
+per device along 'pipe'; the batch splits into M microbatches; every step
+each stage runs its layers on its in-flight microbatch and hands the
+activation to the next stage with a single ``ppermute`` hop (ICI
+point-to-point within a slice, DCN between slices — 'pipe' is one of the
+two DCN-tolerant axes in parallel/multihost.py). The schedule is GPipe:
+M + S - 1 steps, bubble fraction (S-1)/(M+S-1), so throughput approaches
+ideal as microbatches grow.
+
+Everything is expressed functionally (``shard_map`` + ``lax.scan`` +
+masked writes), so the BACKWARD pass needs no hand scheduling: jax.grad
+differentiates the forward schedule, and the transposed ppermute carries
+gradients stage-to-stage in reverse — the pipeline train step is just
+grad-of-pipeline-forward.
+
+Composes with data parallelism: the batch dim shards over 'data' while
+stages shard over 'pipe' (each data-parallel group runs its own pipeline).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+        # jax>=0.8 renamed check_rep -> check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_vma=check_rep)
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """[S] list of per-stage pytrees -> one pytree with leading stage dim
+    (shard it over 'pipe' before feeding pipeline_apply)."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params: Any,
+    x: jnp.ndarray,
+    mesh,
+    n_microbatches: int,
+    axis: str = "pipe",
+    data_axis: str = "data",
+):
+    """Run S pipeline stages over the batch.
+
+    stage_fn(params, x) -> y with y.shape == x.shape (transformer-block
+    convention: stages preserve the activation shape).
+    stage_params: pytree with leading dim S (stage-stacked).
+    x: [B, ...]; B must divide into n_microbatches per data shard.
+    Returns [B, ...] outputs, numerically identical to applying the stages
+    sequentially.
+    """
+    S = dict(mesh.shape)[axis]
+    M = int(n_microbatches)
+    dp = dict(mesh.shape).get(data_axis, 1)
+    if x.shape[0] % (M * dp):
+        raise ValueError(
+            f"batch {x.shape[0]} must divide into {M} microbatches per "
+            f"{dp} data shard(s)"
+        )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(data_axis)),
+        out_specs=P(data_axis),
+        check_rep=False,
+    )
+    def run(params, x_local):
+        params = jax.tree.map(lambda a: a[0], params)  # this device's stage
+        stage_id = jax.lax.axis_index(axis)
+        mb = x_local.shape[0] // M
+        xs = x_local.reshape(M, mb, *x_local.shape[1:])
+
+        def step(carry, t):
+            recv, outputs = carry
+            # stage 0 ingests microbatch t (clamped; masked out later),
+            # other stages consume what the previous stage sent
+            inp_idx = jnp.clip(t, 0, M - 1)
+            feed = jax.lax.dynamic_index_in_dim(xs, inp_idx, keepdims=False)
+            cur = jnp.where(stage_id == 0, feed, recv)
+            y = stage_fn(params, cur)
+            # last stage completes microbatch t-(S-1)
+            out_idx = t - (S - 1)
+            valid = (stage_id == S - 1) & (out_idx >= 0) & (out_idx < M)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outputs, y.astype(outputs.dtype), jnp.clip(out_idx, 0, M - 1), 0
+            )
+            outputs = jnp.where(valid, updated, outputs)
+            # hand the activation to the next stage (no wraparound: stage 0
+            # reads fresh microbatches, so its incoming slot is unused)
+            recv = jax.lax.ppermute(y, axis, [(i, i + 1) for i in range(S - 1)])
+            return (recv, outputs), None
+
+        recv0 = jnp.zeros_like(xs[0])
+        out0 = jnp.zeros_like(xs)
+        (_, outputs), _ = jax.lax.scan(
+            step, (recv0, out0), jnp.arange(M + S - 1)
+        )
+        # only the last stage holds real outputs; psum replicates them over
+        # 'pipe' so the result is well-defined on every device
+        outputs = jnp.where(stage_id == S - 1, outputs, jnp.zeros_like(outputs))
+        outputs = jax.lax.psum(outputs, axis)
+        return outputs.reshape(x_local.shape)
+
+    return run(stage_params, x)
+
+
+def make_pipeline_train_step(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    tx,
+    mesh,
+    n_microbatches: int,
+    axis: str = "pipe",
+):
+    """Pipeline-parallel training: grad of the pipelined forward. loss_fn
+    maps (outputs, batch) -> scalar. Returns run(params, opt_state, batch)
+    -> (params, opt_state, loss); params carry the stage-stacked layout
+    sharded over 'pipe'."""
+    import optax
+
+    def objective(params, batch):
+        out = pipeline_apply(stage_fn, params, batch["x"], mesh, n_microbatches, axis=axis)
+        return loss_fn(out, batch)
+
+    @jax.jit
+    def run(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(objective)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return run

@@ -41,6 +41,14 @@ class StateStore:
         """Keys starting with ``prefix`` (replica discovery)."""
         raise NotImplementedError
 
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def save_if_absent(self, key: str, obj: Any) -> bool:
+        """Atomically create; False if the key already exists (one-shot
+        claims, e.g. legacy-state adoption)."""
+        raise NotImplementedError
+
 
 class FileStateStore(StateStore):
     def __init__(self, root: Optional[str] = None):
@@ -51,7 +59,10 @@ class FileStateStore(StateStore):
         return os.path.join(self.root, key + ".pkl")
 
     def save(self, key: str, obj: Any) -> None:
-        tmp = self._path(key) + ".tmp"
+        # tmp name unique per process: on a shared volume multiple replicas
+        # save the same key concurrently — a shared tmp file would interleave
+        # writes and os.replace would install a torn pickle
+        tmp = f"{self._path(key)}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             pickle.dump(obj, f)
         os.replace(tmp, self._path(key))
@@ -69,6 +80,21 @@ class FileStateStore(StateStore):
             for fn in os.listdir(self.root)
             if fn.endswith(".pkl") and fn.startswith(prefix)
         )
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def save_if_absent(self, key: str, obj: Any) -> bool:
+        try:
+            fd = os.open(self._path(key), os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(obj, f)
+        return True
 
 
 class RedisStateStore(StateStore):
@@ -93,6 +119,12 @@ class RedisStateStore(StateStore):
             k.decode() if isinstance(k, bytes) else k
             for k in self._client.scan_iter(match=prefix + "*")
         )
+
+    def delete(self, key: str) -> None:
+        self._client.delete(key)
+
+    def save_if_absent(self, key: str, obj: Any) -> bool:
+        return bool(self._client.set(key, pickle.dumps(obj), nx=True))
 
 
 def make_store() -> StateStore:
@@ -175,6 +207,12 @@ class ReplicaSync(threading.Thread):
     or Redis — the same backends the reference's single-writer pickle used.
     """
 
+    # dead-replica keys older than this are garbage-collected by any live
+    # replica's sync (REPLICA_ID users republish continuously, so only truly
+    # dead counters expire; their history has already been observed and will
+    # drift out of relevance as live counts grow)
+    DEFAULT_EXPIRE_S = 7 * 24 * 3600.0
+
     def __init__(
         self,
         component: Any,
@@ -182,6 +220,7 @@ class ReplicaSync(threading.Thread):
         store: Optional[StateStore] = None,
         rid: Optional[str] = None,
         period_s: float = 5.0,
+        expire_after_s: Optional[float] = DEFAULT_EXPIRE_S,
     ):
         super().__init__(daemon=True, name="seldon-replica-sync")
         for method in ("stats_snapshot", "apply_peer_stats", "load_stats_snapshot"):
@@ -195,6 +234,7 @@ class ReplicaSync(threading.Thread):
         self.store = store or make_store()
         self.rid = rid or replica_id()
         self.period_s = period_s
+        self.expire_after_s = expire_after_s
         self._halt = threading.Event()
 
     @property
@@ -203,14 +243,23 @@ class ReplicaSync(threading.Thread):
 
     def sync(self) -> None:
         try:
-            self.store.save(self.own_key, self.component.stats_snapshot())
+            snap = self.component.stats_snapshot()
+            snap["ts"] = time.time()
+            self.store.save(self.own_key, snap)
             peers = []
+            now = time.time()
             for k in self.store.list(f"{self.key}:replica:"):
                 if k == self.own_key:
                     continue
-                snap = self.store.restore(k)
-                if snap is not None:
-                    peers.append(snap)
+                peer = self.store.restore(k)
+                if peer is None:
+                    continue
+                age = now - float(peer.get("ts", now))
+                if self.expire_after_s is not None and age > self.expire_after_s:
+                    logger.info("expiring dead replica key %s (age %.0fs)", k, age)
+                    self.store.delete(k)
+                    continue
+                peers.append(peer)
             self.component.apply_peer_stats(peers)
         except Exception:
             logger.exception("replica sync failed (will retry)")

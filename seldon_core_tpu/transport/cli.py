@@ -89,9 +89,14 @@ def build_component(interface_name: str, persistence: bool = False):
         if hasattr(component, "stats_snapshot"):
             sync = ReplicaSync(component, store=thread.store)
             if not sync.restore_own() and restored_shared and hasattr(component, "reset_local_stats"):
-                # shared-key snapshot came from some other replica: don't
-                # republish its counts under this replica's key
-                component.reset_local_stats()
+                # The shared-key snapshot predates replica-keyed sync (legacy
+                # single-key persistence). Exactly ONE replica may adopt those
+                # counts as its own — an exclusive claim decides which; the
+                # rest zero their counters and learn the history as peers.
+                if thread.store.save_if_absent(f"{sync.key}:legacy-claim", sync.rid):
+                    logger.info("adopted legacy persisted counters as replica %s", sync.rid)
+                else:
+                    component.reset_local_stats()
             sync.sync()  # publish + pull peers NOW, not after one period
             sync.start()
             threads.append(sync)

@@ -387,3 +387,43 @@ def test_replica_sync_shape_mismatch_guard(tmp_path):
     s_other.sync()  # sees a's stale 3-branch snapshot as a peer
     assert peer_view.peer_pulls.tolist() == [0, 0]  # skipped, not crashed
     assert peer_view.route(np.zeros((1, 1)), []) in (0, 1)
+
+
+def test_replica_sync_expires_dead_keys(tmp_path):
+    """Snapshots from dead replicas older than expire_after_s are
+    garbage-collected instead of biasing the posterior forever."""
+    import pickle
+    import time as _time
+
+    from seldon_core_tpu.analytics import EpsilonGreedy
+    from seldon_core_tpu.runtime.persistence import FileStateStore, ReplicaSync
+
+    store = FileStateStore(str(tmp_path))
+    dead = {"pulls": np.array([9, 0]), "reward_sum": np.array([9.0, 0.0]),
+            "fail_sum": np.array([0.0, 0.0]), "ts": _time.time() - 3600}
+    store.save("k:replica:dead", dead)
+
+    r = EpsilonGreedy(n_branches=2, seed=0)
+    s = ReplicaSync(r, key="k", store=store, rid="live", period_s=999,
+                    expire_after_s=60.0)
+    s.sync()
+    assert r.peer_pulls.tolist() == [0, 0]  # expired, not summed
+    assert store.restore("k:replica:dead") is None  # and deleted
+
+    # fresh peers ARE summed
+    fresh = dict(dead, ts=_time.time())
+    store.save("k:replica:d2", fresh)
+    s.sync()
+    assert r.peer_pulls.tolist() == [9, 0]
+
+
+def test_state_store_save_if_absent_and_unique_tmp(tmp_path):
+    from seldon_core_tpu.runtime.persistence import FileStateStore
+
+    store = FileStateStore(str(tmp_path))
+    assert store.save_if_absent("claim", "a") is True
+    assert store.save_if_absent("claim", "b") is False
+    assert store.restore("claim") == "a"
+    store.delete("claim")
+    assert store.restore("claim") is None
+    store.delete("claim")  # idempotent
