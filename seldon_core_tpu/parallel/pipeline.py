@@ -70,6 +70,13 @@ def pipeline_apply(
             f"batch {x.shape[0]} must divide into {M} microbatches per "
             f"{dp} data shard(s)"
         )
+    n_stages = jax.tree.leaves(stage_params)[0].shape[0]
+    if n_stages != S:
+        # a mismatch that still divides would silently run a subset of stages
+        raise ValueError(
+            f"stage_params has {n_stages} stages but the '{axis}' axis has "
+            f"{S} devices (one stage per device)"
+        )
 
     @partial(
         shard_map,

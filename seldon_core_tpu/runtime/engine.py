@@ -129,12 +129,16 @@ class GraphEngine:
         factory: Optional[ComponentFactory] = None,
         fuse: bool = True,
         remote_client: Optional[Any] = None,
+        annotations: Optional[Dict[str, str]] = None,
     ):
         self.spec = spec
         self._components = dict(components or {})
         self._factory = factory
         self._fuse = fuse
         self._remote_client = remote_client
+        # deployment annotations tune the remote-node client (retry counts,
+        # connect/read deadlines — the reference's per-deployment flags)
+        self._annotations = dict(annotations or {})
         self.state = self._build(spec)
         if fuse:
             self._try_fuse(self.state.root)
@@ -170,7 +174,10 @@ class GraphEngine:
         elif unit.endpoint is not None and unit.endpoint.service_host:
             from seldon_core_tpu.runtime.remote import RemoteComponent
 
-            comp = RemoteComponent(unit.endpoint, client=self._remote_client)
+            comp = RemoteComponent(
+                unit.endpoint, client=self._remote_client,
+                annotations=self._annotations or None,
+            )
         elif self._factory is not None:
             comp = self._factory(unit)
         else:

@@ -68,11 +68,13 @@ class FileStateStore(StateStore):
         os.replace(tmp, self._path(key))
 
     def restore(self, key: str) -> Optional[Any]:
-        path = self._path(key)
-        if not os.path.exists(path):
+        try:
+            with open(self._path(key), "rb") as f:
+                return pickle.load(f)
+        except FileNotFoundError:
+            # a concurrent GC (replica-key expiry) may delete between list
+            # and open; absent is absent
             return None
-        with open(path, "rb") as f:
-            return pickle.load(f)
 
     def list(self, prefix: str) -> list:
         return sorted(
@@ -88,13 +90,19 @@ class FileStateStore(StateStore):
             pass
 
     def save_if_absent(self, key: str, obj: Any) -> bool:
+        # write the payload fully in a tmp file, then link into place —
+        # the key only becomes visible complete, and a crash mid-dump can't
+        # leave a torn claim that blocks every future claimant
+        tmp = f"{self._path(key)}.claim.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f)
         try:
-            fd = os.open(self._path(key), os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.link(tmp, self._path(key))
+            return True
         except FileExistsError:
             return False
-        with os.fdopen(fd, "wb") as f:
-            pickle.dump(obj, f)
-        return True
+        finally:
+            os.unlink(tmp)
 
 
 class RedisStateStore(StateStore):

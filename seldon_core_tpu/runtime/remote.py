@@ -27,6 +27,40 @@ logger = logging.getLogger(__name__)
 
 DEFAULT_RETRIES = 3  # reference default (`InternalPredictionService.java:84`)
 DEFAULT_TIMEOUT_S = 5.0
+# no separate connect deadline unless the annotation asks for one — the
+# total timeout already bounds slow connects, and a default connect cap
+# would break formerly-working slow-handshake deployments
+DEFAULT_CONNECT_TIMEOUT_S = None
+
+# the reference's per-deployment tuning annotations
+# (`InternalPredictionService.java:82-91`, catalog doc/source/graph/annotations.md)
+ANNOTATION_REST_READ_TIMEOUT = "seldon.io/rest-read-timeout"        # ms
+ANNOTATION_REST_CONNECTION_TIMEOUT = "seldon.io/rest-connection-timeout"  # ms
+ANNOTATION_REST_RETRIES = "seldon.io/rest-connect-retries"
+ANNOTATION_GRPC_READ_TIMEOUT = "seldon.io/grpc-read-timeout"        # ms
+
+
+def config_from_annotations(annotations: Optional[dict]) -> dict:
+    """Remote-call tuning from deployment annotations; missing/garbage
+    values keep the defaults (same tolerance as the reference's parser)."""
+    annotations = annotations or {}
+
+    def ms(key: str, default_s: Optional[float]) -> Optional[float]:
+        try:
+            return float(annotations[key]) / 1000.0
+        except (KeyError, TypeError, ValueError):
+            return default_s
+
+    try:
+        retries = int(annotations[ANNOTATION_REST_RETRIES])
+    except (KeyError, TypeError, ValueError):
+        retries = DEFAULT_RETRIES
+    return {
+        "retries": max(retries, 1),
+        "timeout_s": ms(ANNOTATION_REST_READ_TIMEOUT, DEFAULT_TIMEOUT_S),
+        "connect_timeout_s": ms(ANNOTATION_REST_CONNECTION_TIMEOUT, DEFAULT_CONNECT_TIMEOUT_S),
+        "grpc_timeout_s": ms(ANNOTATION_GRPC_READ_TIMEOUT, DEFAULT_TIMEOUT_S),
+    }
 
 
 class RemoteComponent(SeldonComponent):
@@ -41,11 +75,22 @@ class RemoteComponent(SeldonComponent):
         client: Optional[Any] = None,
         retries: int = DEFAULT_RETRIES,
         timeout_s: float = DEFAULT_TIMEOUT_S,
+        connect_timeout_s: Optional[float] = DEFAULT_CONNECT_TIMEOUT_S,
+        grpc_timeout_s: Optional[float] = None,
+        annotations: Optional[dict] = None,
     ):
         super().__init__()
         self.endpoint = endpoint
+        if annotations:
+            cfg = config_from_annotations(annotations)
+            retries = cfg["retries"]
+            timeout_s = cfg["timeout_s"]
+            connect_timeout_s = cfg["connect_timeout_s"]
+            grpc_timeout_s = cfg["grpc_timeout_s"]
         self.retries = retries
         self.timeout_s = timeout_s
+        self.connect_timeout_s = connect_timeout_s
+        self.grpc_timeout_s = grpc_timeout_s if grpc_timeout_s is not None else timeout_s
         self._client = client
         # ClientSessions bind to the event loop they were created on; engines
         # may be driven from several short-lived loops (predict_sync), so keep
@@ -81,7 +126,9 @@ class RemoteComponent(SeldonComponent):
                 async with session.post(
                     url,
                     json=payload,
-                    timeout=aiohttp.ClientTimeout(total=self.timeout_s),
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.timeout_s, connect=self.connect_timeout_s
+                    ),
                 ) as resp:
                     body = await resp.text()
                     if resp.status != 200:
@@ -108,7 +155,7 @@ class RemoteComponent(SeldonComponent):
             f"{self.endpoint.service_host}:{self.endpoint.service_port}",
             method,
             request_msg,
-            timeout_s=self.timeout_s,
+            timeout_s=self.grpc_timeout_s,
         )
 
     async def _call(self, rest_path: str, grpc_method: str, msg: Any) -> SeldonMessage:

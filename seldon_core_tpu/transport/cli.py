@@ -145,7 +145,7 @@ def run_engine(args: argparse.Namespace) -> None:
     # Spec from file, ENGINE_PREDICTOR env, or the default SIMPLE_MODEL the
     # reference engine uses when unconfigured (`EnginePredictor.java:122-141`).
     spec = _load_spec(args.spec)
-    engine = GraphEngine(spec)
+    engine = GraphEngine(spec, annotations=load_annotations())
     metrics = MetricsRegistry(predictor=spec.name)
     port = args.port or int(os.environ.get("ENGINE_SERVER_PORT", "8000"))
     logger.info("engine serving predictor %r on port %d", spec.name, port)
@@ -236,7 +236,7 @@ def run_edge(args: argparse.Namespace) -> None:
     prog_path = write_program(
         fallback_program(spec, deployment=deployment), os.path.join(tmp, "program.json")
     )
-    engine = GraphEngine(spec)
+    engine = GraphEngine(spec, annotations=load_annotations())
     base = args.ipc_base or os.path.join(tmp, "ring")
     # One edge process per worker, each with its own response ring (an edge's
     # internal fork cannot be used here: forked loops would race on one ring).

@@ -284,3 +284,41 @@ def test_tags_merge_across_nodes():
     engine = GraphEngine(spec(graph), components={"t": T1(), "m": M1()})
     out = run(engine.predict(tensor_msg([1.0], [1, 1])))
     assert out.meta.tags == {"from_t": 1, "from_m": 2}
+
+
+def test_remote_annotations_config():
+    """Deployment annotations tune the remote-node client (the reference's
+    per-deployment flag system, InternalPredictionService.java:82-91)."""
+    from seldon_core_tpu.runtime.remote import RemoteComponent, config_from_annotations
+    from seldon_core_tpu.contracts.graph import Endpoint
+
+    cfg = config_from_annotations({
+        "seldon.io/rest-read-timeout": "12000",
+        "seldon.io/rest-connection-timeout": "250",
+        "seldon.io/rest-connect-retries": "5",
+        "seldon.io/grpc-read-timeout": "7000",
+    })
+    assert cfg == {"retries": 5, "timeout_s": 12.0,
+                   "connect_timeout_s": 0.25, "grpc_timeout_s": 7.0}
+    # garbage/missing values keep defaults
+    cfg = config_from_annotations({"seldon.io/rest-read-timeout": "soon"})
+    assert cfg["timeout_s"] == 5.0 and cfg["retries"] == 3
+
+    rc = RemoteComponent(
+        Endpoint(service_host="h", service_port=1, type="REST"),
+        annotations={"seldon.io/rest-connect-retries": "2",
+                     "seldon.io/rest-read-timeout": "1000"},
+    )
+    assert rc.retries == 2 and rc.timeout_s == 1.0
+
+
+def test_engine_passes_annotations_to_remote_nodes():
+    engine = GraphEngine(
+        spec({"name": "r", "type": "MODEL",
+              "endpoint": {"service_host": "127.0.0.1", "service_port": 59999,
+                           "type": "REST"}}),
+        annotations={"seldon.io/rest-connect-retries": "1",
+                     "seldon.io/rest-read-timeout": "1500"},
+    )
+    rc = engine.state.root.component
+    assert rc.retries == 1 and rc.timeout_s == 1.5

@@ -112,3 +112,12 @@ def test_pipeline_rejects_indivisible_batch(eight_devices):
     stacked = stack_stage_params(make_params(np.random.default_rng(0), 4))
     with pytest.raises(ValueError, match="microbatches"):
         pipeline_apply(stage_fn, stacked, jnp.zeros((7, D)), mesh, n_microbatches=4)
+
+
+def test_pipeline_rejects_stage_count_mismatch(eight_devices):
+    """4 stacked stages on a pipe=2 mesh would silently run stages [0, 2]
+    and drop [1, 3]; must be an explicit error."""
+    mesh = make_mesh({"data": -1, "pipe": 2}, eight_devices)
+    stacked = stack_stage_params(make_params(np.random.default_rng(0), 4))
+    with pytest.raises(ValueError, match="4 stages.*2 devices"):
+        pipeline_apply(stage_fn, stacked, jnp.zeros((8, D)), mesh, n_microbatches=2)
