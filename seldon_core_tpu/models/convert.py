@@ -1,0 +1,138 @@
+"""Hugging Face Llama checkpoint -> native transformer params.
+
+A user of the reference serves pretrained models from standard artifact
+formats; the native equivalent is importing HF Llama weights into
+models/transformer.py and exporting a JAXServer/LLMServer-servable
+checkpoint. Layout notes:
+
+- torch Linear stores [out, in]; our matmuls are x @ W with W [in, out], so
+  every projection transposes;
+- RoPE conventions already agree (both rotate-half with the same inverse
+  frequencies), so q/k need no head-permutation;
+- lm_head maps to the untied output head; if the HF checkpoint ties word
+  embeddings, ``tie_embeddings`` is set instead.
+
+The parity test (tests/test_convert.py) holds this module to the canonical
+implementation: a converted model must reproduce transformers' logits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+def config_kwargs_from_hf(hf_config: Any) -> Dict[str, Any]:
+    """TransformerConfig kwargs from a transformers LlamaConfig."""
+    return {
+        "vocab_size": hf_config.vocab_size,
+        "dim": hf_config.hidden_size,
+        "n_layers": hf_config.num_hidden_layers,
+        "n_heads": hf_config.num_attention_heads,
+        "n_kv_heads": getattr(hf_config, "num_key_value_heads", None)
+        or hf_config.num_attention_heads,
+        "ffn_dim": hf_config.intermediate_size,
+        "max_seq_len": hf_config.max_position_embeddings,
+        "rope_theta": getattr(hf_config, "rope_theta", 10000.0),
+        "norm_eps": hf_config.rms_norm_eps,
+        "tie_embeddings": bool(getattr(hf_config, "tie_word_embeddings", False)),
+    }
+
+
+def _np_dtype(name: str):
+    """numpy dtype by name, including the ml_dtypes families (bfloat16,
+    float8_*) that plain np.dtype() rejects."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def convert_llama_state_dict(
+    state_dict: Dict[str, Any],
+    n_layers: int,
+    dtype: str = "float32",
+    tie_embeddings: bool = False,
+) -> Dict[str, Any]:
+    """HF Llama state dict -> our flax param tree ({"params": ...}).
+    ``tie_embeddings`` must mirror the HF config: tied checkpoints still
+    carry an lm_head entry in state_dict(), but exporting it would add a
+    vocab*dim param the module doesn't define (breaking sharding-spec
+    alignment for tensor parallelism)."""
+    np_dtype = _np_dtype(dtype)
+
+    def t(key: str) -> np.ndarray:
+        w = state_dict[key]
+        if hasattr(w, "detach"):  # torch tensor
+            w = w.detach().to("cpu").float().numpy()
+        return np.asarray(w).astype(np_dtype)
+
+    params: Dict[str, Any] = {
+        "tok_embeddings": t("model.embed_tokens.weight"),  # [vocab, dim]
+        "norm": {"weight": t("model.norm.weight")},
+    }
+    for i in range(n_layers):
+        hf = f"model.layers.{i}"
+        params[f"layer_{i}"] = {
+            "attention": {
+                "wq": t(f"{hf}.self_attn.q_proj.weight").T,
+                "wk": t(f"{hf}.self_attn.k_proj.weight").T,
+                "wv": t(f"{hf}.self_attn.v_proj.weight").T,
+                "wo": t(f"{hf}.self_attn.o_proj.weight").T,
+            },
+            "ffn": {
+                "w1": t(f"{hf}.mlp.gate_proj.weight").T,
+                "w2": t(f"{hf}.mlp.down_proj.weight").T,
+                "w3": t(f"{hf}.mlp.up_proj.weight").T,
+            },
+            "attention_norm": {"weight": t(f"{hf}.input_layernorm.weight")},
+            "ffn_norm": {"weight": t(f"{hf}.post_attention_layernorm.weight")},
+        }
+    if not tie_embeddings and "lm_head.weight" in state_dict:
+        params["lm_head"] = t("lm_head.weight").T  # [dim, vocab]
+    return {"params": params}
+
+
+def convert_hf_model(hf_model: Any) -> Tuple[Any, Dict[str, Any]]:
+    """In-memory transformers LlamaForCausalLM -> (our module, variables)."""
+    from seldon_core_tpu.models import get_model
+
+    kwargs = config_kwargs_from_hf(hf_model.config)
+    variables = convert_llama_state_dict(
+        hf_model.state_dict(), n_layers=kwargs["n_layers"],
+        tie_embeddings=kwargs["tie_embeddings"],
+    )
+    module = get_model("transformer", dtype="float32", **kwargs)
+    return module, variables
+
+
+def convert_checkpoint(hf_path: str, out_dir: str, dtype: str = "bfloat16") -> str:
+    """HF checkpoint directory -> LLMServer/JAXServer-servable directory
+    (config.json + orbax params). Loads on CPU; works fully offline against
+    a local HF snapshot."""
+    import torch
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_config = AutoConfig.from_pretrained(hf_path)
+    model = AutoModelForCausalLM.from_pretrained(
+        hf_path, torch_dtype=torch.float32, low_cpu_mem_usage=True
+    )
+    kwargs = config_kwargs_from_hf(hf_config)
+    # weights stored in the serving dtype (bf16 halves checkpoint size vs f32)
+    variables = convert_llama_state_dict(
+        model.state_dict(), n_layers=kwargs["n_layers"], dtype=dtype,
+        tie_embeddings=kwargs["tie_embeddings"],
+    )
+
+    from seldon_core_tpu.servers.jaxserver import export_checkpoint
+
+    return export_checkpoint(
+        out_dir,
+        model="transformer",
+        params=variables,
+        kwargs={**kwargs, "dtype": dtype},
+        input_dtype="int32",
+    )

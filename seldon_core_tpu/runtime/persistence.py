@@ -90,17 +90,22 @@ class FileStateStore(StateStore):
             pass
 
     def save_if_absent(self, key: str, obj: Any) -> bool:
-        # write the payload fully in a tmp file, then link into place —
-        # the key only becomes visible complete, and a crash mid-dump can't
-        # leave a torn claim that blocks every future claimant
-        tmp = f"{self._path(key)}.claim.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            pickle.dump(obj, f)
+        # write the payload fully in a RANDOM tmp file, then link into place:
+        # the key only becomes visible complete, a crash mid-dump can't leave
+        # a torn claim, and the random name can't collide across replicas
+        # (pid-keyed tmp names do collide — every container's main process
+        # tends to be pid 1)
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(prefix=".claim-", dir=self.root)
         try:
-            os.link(tmp, self._path(key))
-            return True
-        except FileExistsError:
-            return False
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(obj, f)
+            try:
+                os.link(tmp, self._path(key))
+                return True
+            except FileExistsError:
+                return False
         finally:
             os.unlink(tmp)
 

@@ -180,7 +180,19 @@ class JAXServer(SeldonComponent):
             target = jax.eval_shape(lambda: module.init(jax.random.PRNGKey(0), jax.numpy.zeros(example.shape, example.dtype)))
             target = jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), target)
             with open(msgpack_file, "rb") as f:
-                return flax.serialization.from_bytes(target, f.read())
+                blob = f.read()
+            try:
+                return flax.serialization.from_bytes(target, blob)
+            except ValueError as orig:
+                # params-only checkpoint (e.g. converted from HF): retry
+                # against the params subtree; surface the original
+                # diagnostic if that also fails
+                if "params" not in target:
+                    raise
+                try:
+                    return flax.serialization.from_bytes({"params": target["params"]}, blob)
+                except ValueError:
+                    raise orig
         raise SeldonError(f"No params found under {path} (expected params/ or params.msgpack)", status_code=500)
 
     # ------------------------------------------------------------------

@@ -246,7 +246,20 @@ class LLMServer(SeldonComponent):
             )
             target = jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), target)
             with open(msgpack, "rb") as f:
-                return flax.serialization.from_bytes(target, f.read())
+                blob = f.read()
+            try:
+                return flax.serialization.from_bytes(target, blob)
+            except ValueError as orig:
+                # checkpoint may hold only the 'params' collection (e.g. a
+                # converted HF checkpoint); if the subtree restore also
+                # fails, surface the ORIGINAL diagnostic (shape mismatch /
+                # corruption), not the fallback's
+                if "params" not in target:
+                    raise
+                try:
+                    return flax.serialization.from_bytes({"params": target["params"]}, blob)
+                except ValueError:
+                    raise orig
         raise SeldonError(f"No params under {path}", status_code=500)
 
     # ------------------------------------------------------------------

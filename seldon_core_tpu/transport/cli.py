@@ -340,6 +340,14 @@ def run_loadtest(args: argparse.Namespace) -> None:
     loadgen.main(args)
 
 
+def run_convert(args: argparse.Namespace) -> None:
+    setup_logging()
+    from seldon_core_tpu.models.convert import convert_checkpoint
+
+    out = convert_checkpoint(args.hf_path, args.out_dir, dtype=args.dtype)
+    print(out)
+
+
 def run_analytics(args: argparse.Namespace) -> None:
     from seldon_core_tpu.observability.dashboards import write_artifacts
 
@@ -482,6 +490,14 @@ def main(argv: Optional[list] = None) -> None:
                     help="status output dir (default <crs>/.status; set when --crs is read-only)")
     op.add_argument("--once", action="store_true", help="single reconcile pass")
     op.set_defaults(func=run_operator)
+
+    cv = sub.add_parser(
+        "convert-llama", help="HF Llama checkpoint -> servable native checkpoint"
+    )
+    cv.add_argument("hf_path", help="local HF snapshot directory (or hub id if cached)")
+    cv.add_argument("out_dir")
+    cv.add_argument("--dtype", default="bfloat16")
+    cv.set_defaults(func=run_convert)
 
     an = sub.add_parser(
         "analytics", help="write Prometheus rules + Grafana dashboard artifacts"
