@@ -3,6 +3,7 @@ transformers (capability of the reference's `components/routers/` and
 `components/outlier-detection/` trees, rebuilt JAX-native)."""
 
 from seldon_core_tpu.analytics.routers import EpsilonGreedy, ThompsonSampling
+from seldon_core_tpu.analytics.explainers import SaliencyExplainer
 from seldon_core_tpu.analytics.outliers import (
     MahalanobisOutlierDetector,
     IsolationForestOutlierDetector,
@@ -12,6 +13,7 @@ from seldon_core_tpu.analytics.outliers import (
 
 __all__ = [
     "EpsilonGreedy",
+    "SaliencyExplainer",
     "ThompsonSampling",
     "MahalanobisOutlierDetector",
     "IsolationForestOutlierDetector",

@@ -1,0 +1,114 @@
+"""Explainer components.
+
+The reference reserves a per-predictor ``explainer`` slot in the CRD
+(`proto/seldon_deployment.proto:45-51,63`) that deploys a sidecar service
+answering "why did the model predict this" (alibi-style, CPU). The
+TPU-native counterpart exploits what the reference couldn't: the served
+model is a differentiable JAX function, so attribution is one compiled
+gradient — no surrogate model, no sampling loop.
+
+``SaliencyExplainer`` loads the SAME checkpoint as the model it explains
+and serves attributions through the standard component contract: predict(X)
+returns gradient x input per feature (integrated gradients when steps > 1),
+jitted per batch-shape bucket.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.payload import SeldonError
+
+logger = logging.getLogger(__name__)
+
+
+class SaliencyExplainer(SeldonComponent):
+    """Gradient-based attribution for a JAXServer checkpoint.
+
+    Parameters: model_uri (the checkpoint to explain), target ("max" = the
+    argmax logit, or an int class index), steps (1 = plain grad x input;
+    >1 = integrated gradients along the zero baseline path).
+    """
+
+    def __init__(
+        self,
+        model_uri: str = "",
+        target: Any = "max",
+        steps: int = 1,
+        batch_buckets: Any = None,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        self.model_uri = model_uri
+        self.target = target
+        self.steps = int(steps)
+        self.batch_buckets = tuple(batch_buckets) if batch_buckets else None
+        self.ready = False
+        self._grad_fn = None
+
+    def load(self) -> None:
+        if self.ready:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.servers.jaxserver import JAXServer
+
+        server = JAXServer(model_uri=self.model_uri)
+        apply, params = server.jax_fn()  # loads; public composition surface
+        if self.batch_buckets is None:
+            self.batch_buckets = server.batch_buckets
+        target = self.target
+        steps = self.steps
+
+        def scalar_out(x):
+            out = apply(params, x)
+            if isinstance(target, int) or (isinstance(target, str) and target.isdigit()):
+                picked = out[..., int(target)]
+            else:  # "max": the predicted class's logit/probability
+                picked = jnp.max(out, axis=-1)
+            return picked.sum(), out
+
+        grad_fn = jax.grad(scalar_out, has_aux=True)
+
+        @jax.jit
+        def attribute(x):
+            if steps <= 1:
+                g, out = grad_fn(x)
+                return g * x, out
+            # integrated gradients: average grads along the 0 -> x path
+            alphas = jnp.linspace(1.0 / steps, 1.0, steps)
+
+            def body(acc, a):
+                g, _ = grad_fn(x * a)
+                return acc + g, None
+
+            total, _ = jax.lax.scan(body, jnp.zeros_like(x), alphas)
+            _, out = grad_fn(x)
+            return (total / steps) * x, out
+
+        self._grad_fn = attribute
+        self._input_dtype = server.input_dtype
+        self.ready = True
+        logger.info("SaliencyExplainer ready over %s (steps=%d)", self.model_uri, steps)
+
+    def predict(self, X, names: Sequence[str], meta: Optional[Dict] = None) -> np.ndarray:
+        if not self.ready:
+            self.load()
+        arr = np.asarray(X, dtype=self._input_dtype)
+        if not np.issubdtype(arr.dtype, np.floating):
+            raise SeldonError("saliency explanations need float inputs", status_code=400)
+        # same bucketing as the server: one compiled gradient program per
+        # bucket, not per request batch size
+        from seldon_core_tpu.codec.staging import pad_batch
+
+        padded, true_n = pad_batch(arr, self.batch_buckets)
+        attributions, _ = self._grad_fn(padded)
+        return np.asarray(attributions)[:true_n]
+
+    def tags(self) -> Dict[str, Any]:
+        return {"explainer": "saliency", "steps": self.steps, "target": str(self.target)}

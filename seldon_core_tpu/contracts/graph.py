@@ -210,6 +210,9 @@ class PredictorSpec:
     # `SeldonHpaSpec` (proto/seldon_deployment.proto:72-76):
     # {minReplicas, maxReplicas, metrics: [...]}
     hpa_spec: Dict[str, Any] = field(default_factory=dict)
+    # `Explainer` (proto/seldon_deployment.proto:45-51):
+    # {type, modelUri, serviceAccountName, envSecretRefName, containerSpec}
+    explainer: Dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -231,6 +234,8 @@ class PredictorSpec:
             d["svcOrchSpec"] = self.svc_orch_spec
         if self.hpa_spec:
             d["hpaSpec"] = self.hpa_spec
+        if self.explainer:
+            d["explainer"] = self.explainer
         return d
 
     @classmethod
@@ -248,6 +253,7 @@ class PredictorSpec:
             component_specs=list(d.get("componentSpecs", []) or []),
             svc_orch_spec=dict(d.get("svcOrchSpec", {}) or {}),
             hpa_spec=dict(d.get("hpaSpec", {}) or {}),
+            explainer=dict(d.get("explainer", {}) or {}),
         )
 
 

@@ -23,6 +23,7 @@ import json
 from typing import Any, Dict, List, Optional
 
 from seldon_core_tpu.contracts.graph import PredictorSpec, SeldonDeploymentSpec
+from seldon_core_tpu.contracts.payload import SeldonError
 from seldon_core_tpu.controlplane.validate import require_valid
 
 DEFAULT_ENGINE_IMAGE = "seldon-core-tpu/engine:latest"
@@ -217,6 +218,72 @@ def _virtual_service(sdep: SeldonDeploymentSpec, namespace: str) -> Dict[str, An
     return vs
 
 
+def _explainer_objects(
+    sdep: SeldonDeploymentSpec, p: PredictorSpec, namespace: str, engine_image: str
+) -> List[Dict[str, Any]]:
+    """Explainer Deployment + Service for a predictor carrying the CRD
+    ``explainer`` field (`proto/seldon_deployment.proto:45-51,63`). Default
+    container serves analytics.explainers.SaliencyExplainer over the
+    predictor's modelUri; ``containerSpec`` overrides it wholesale."""
+    exp = p.explainer
+    exp_type = exp.get("type", "saliency") or "saliency"
+    if not exp.get("containerSpec") and exp_type not in ("saliency",):
+        raise SeldonError(
+            f"unsupported explainer type {exp_type!r}: built-in support is "
+            "'saliency'; other explainers need an explicit containerSpec",
+            reason="BAD_GRAPH",
+        )
+    name = f"{sdep.name}-{p.name}-explainer"
+    labels = {**_dep_labels(sdep, p), "seldon-explainer": p.name}
+    container = exp.get("containerSpec")
+    if not container:
+        model_uri = exp.get("modelUri") or p.graph.model_uri or ""
+        container = {
+            "name": "explainer",
+            "image": engine_image,
+            "args": ["microservice",
+                     "seldon_core_tpu.analytics.explainers.SaliencyExplainer", "REST"],
+            "env": [
+                {"name": "PREDICTIVE_UNIT_SERVICE_PORT", "value": str(ENGINE_HTTP_PORT)},
+                {"name": "PREDICTIVE_UNIT_PARAMETERS", "value": json.dumps([
+                    {"name": "model_uri", "value": model_uri, "type": "STRING"},
+                ])},
+            ],
+            "ports": [{"name": "http", "containerPort": ENGINE_HTTP_PORT}],
+        }
+    pod_spec: Dict[str, Any] = {"containers": [container]}
+    if exp.get("serviceAccountName"):
+        pod_spec["serviceAccountName"] = exp["serviceAccountName"]
+    if exp.get("envSecretRefName"):
+        container.setdefault("envFrom", []).append(
+            {"secretRef": {"name": exp["envSecretRefName"]}}
+        )
+    deployment = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"seldon-explainer-app": name}},
+            "template": {
+                "metadata": {"labels": {**labels, "seldon-explainer-app": name}},
+                "spec": pod_spec,
+            },
+        },
+    }
+    service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels},
+        "spec": {
+            "selector": {"seldon-explainer-app": name},
+            "ports": [{"name": "http", "port": ENGINE_HTTP_PORT,
+                       "targetPort": ENGINE_HTTP_PORT}],
+        },
+    }
+    return [deployment, service]
+
+
 def render_manifests(
     sdep: SeldonDeploymentSpec,
     namespace: str = "default",
@@ -234,6 +301,8 @@ def render_manifests(
         out.append(_service(sdep, p, namespace))
         if p.hpa_spec.get("maxReplicas"):
             out.append(_hpa(sdep, p, namespace))
+        if p.explainer:
+            out.extend(_explainer_objects(sdep, p, namespace, engine_image))
     if len([p for p in sdep.predictors if not p.shadow]) > 1 or any(
         p.shadow for p in sdep.predictors
     ):

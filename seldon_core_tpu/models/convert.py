@@ -24,7 +24,25 @@ import numpy as np
 
 
 def config_kwargs_from_hf(hf_config: Any) -> Dict[str, Any]:
-    """TransformerConfig kwargs from a transformers LlamaConfig."""
+    """TransformerConfig kwargs from a transformers LlamaConfig. Refuses
+    configs the native transformer cannot represent — silent acceptance
+    would convert cleanly and serve wrong logits."""
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling and scaling.get("rope_type", scaling.get("type", "default")) != "default":
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported by the native "
+            "transformer (plain RoPE only); converting would silently "
+            "diverge from HF at long positions"
+        )
+    head_dim = getattr(hf_config, "head_dim", None)
+    derived = hf_config.hidden_size // hf_config.num_attention_heads
+    if head_dim is not None and head_dim != derived:
+        raise ValueError(
+            f"explicit head_dim={head_dim} != hidden_size/num_heads={derived}; "
+            "the native transformer derives head_dim from dim//n_heads"
+        )
+    if getattr(hf_config, "attention_bias", False) or getattr(hf_config, "mlp_bias", False):
+        raise ValueError("attention/mlp biases are not supported by the native transformer")
     return {
         "vocab_size": hf_config.vocab_size,
         "dim": hf_config.hidden_size,
@@ -63,8 +81,10 @@ def convert_llama_state_dict(
     vocab*dim param the module doesn't define (breaking sharding-spec
     alignment for tensor parallelism)."""
     np_dtype = _np_dtype(dtype)
+    consumed = set()
 
     def t(key: str) -> np.ndarray:
+        consumed.add(key)
         w = state_dict[key]
         if hasattr(w, "detach"):  # torch tensor
             w = w.detach().to("cpu").float().numpy()
@@ -93,6 +113,18 @@ def convert_llama_state_dict(
         }
     if not tie_embeddings and "lm_head.weight" in state_dict:
         params["lm_head"] = t("lm_head.weight").T  # [dim, vocab]
+
+    # a weight we didn't map (e.g. projection biases in a fine-tune) would
+    # silently change the served model — refuse instead
+    def ignorable(k: str) -> bool:
+        return (k.endswith(".inv_freq") or k.endswith("rotary_emb.inv_freq")
+                or (tie_embeddings and k == "lm_head.weight"))
+
+    leftover = [k for k in state_dict if k not in consumed and not ignorable(k)]
+    if leftover:
+        raise ValueError(
+            f"unmapped weights in state dict (conversion would drop them): {leftover[:8]}"
+        )
     return {"params": params}
 
 

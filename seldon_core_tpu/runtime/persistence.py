@@ -99,6 +99,7 @@ class FileStateStore(StateStore):
 
         fd, tmp = tempfile.mkstemp(prefix=".claim-", dir=self.root)
         try:
+            os.fchmod(fd, 0o644)  # mkstemp's 0600 would follow the hard link
             with os.fdopen(fd, "wb") as f:
                 pickle.dump(obj, f)
             try:

@@ -220,6 +220,11 @@ class JAXServer(SeldonComponent):
     def class_names(self):
         return self._config.get("class_names")
 
+    @property
+    def input_dtype(self):
+        """Declared request dtype from the checkpoint config."""
+        return np.dtype(self._config.get("input_dtype", "float32"))
+
 
 def export_checkpoint(
     out_dir: str,

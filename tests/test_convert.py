@@ -135,3 +135,34 @@ def test_bfloat16_weight_conversion():
     import ml_dtypes
 
     assert out["params"]["tok_embeddings"].dtype == np.dtype(ml_dtypes.bfloat16)
+
+
+def test_unsupported_configs_rejected(tiny_llama):
+    """Configs the native transformer can't represent must refuse to convert
+    rather than serve wrong logits."""
+    from transformers import LlamaConfig
+
+    from seldon_core_tpu.models.convert import config_kwargs_from_hf
+
+    scaled = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                         num_hidden_layers=1, num_attention_heads=2,
+                         rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                                       "original_max_position_embeddings": 8192,
+                                       "low_freq_factor": 1.0, "high_freq_factor": 4.0})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_kwargs_from_hf(scaled)
+
+    biased = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                         num_hidden_layers=1, num_attention_heads=2,
+                         attention_bias=True)
+    with pytest.raises(ValueError, match="bias"):
+        config_kwargs_from_hf(biased)
+
+
+def test_unmapped_weights_rejected(tiny_llama):
+    from seldon_core_tpu.models.convert import convert_llama_state_dict
+
+    sd = dict(tiny_llama.state_dict())
+    sd["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(64)
+    with pytest.raises(ValueError, match="unmapped weights"):
+        convert_llama_state_dict(sd, n_layers=2)
