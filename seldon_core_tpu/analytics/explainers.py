@@ -71,25 +71,22 @@ class SaliencyExplainer(SeldonComponent):
                 picked = out[..., int(target)]
             else:  # "max": the predicted class's logit/probability
                 picked = jnp.max(out, axis=-1)
-            return picked.sum(), out
+            return picked.sum()
 
-        grad_fn = jax.grad(scalar_out, has_aux=True)
+        grad_fn = jax.grad(scalar_out)
 
         @jax.jit
         def attribute(x):
             if steps <= 1:
-                g, out = grad_fn(x)
-                return g * x, out
+                return grad_fn(x) * x
             # integrated gradients: average grads along the 0 -> x path
             alphas = jnp.linspace(1.0 / steps, 1.0, steps)
 
             def body(acc, a):
-                g, _ = grad_fn(x * a)
-                return acc + g, None
+                return acc + grad_fn(x * a), None
 
             total, _ = jax.lax.scan(body, jnp.zeros_like(x), alphas)
-            _, out = grad_fn(x)
-            return (total / steps) * x, out
+            return (total / steps) * x
 
         self._grad_fn = attribute
         self._input_dtype = server.input_dtype
@@ -99,15 +96,24 @@ class SaliencyExplainer(SeldonComponent):
     def predict(self, X, names: Sequence[str], meta: Optional[Dict] = None) -> np.ndarray:
         if not self.ready:
             self.load()
-        arr = np.asarray(X, dtype=self._input_dtype)
-        if not np.issubdtype(arr.dtype, np.floating):
-            raise SeldonError("saliency explanations need float inputs", status_code=400)
+        # gradients are taken wrt the model INPUT: the checkpoint must take
+        # continuous features (an int-input model, e.g. token ids, has no
+        # meaningful input gradient); numeric requests cast to that dtype
+        if not np.issubdtype(self._input_dtype, np.floating):
+            raise SeldonError(
+                f"saliency needs a float-input model, checkpoint declares "
+                f"{self._input_dtype}", status_code=400,
+            )
+        raw = np.asarray(X)
+        if not np.issubdtype(raw.dtype, np.number):
+            raise SeldonError("saliency explanations need numeric inputs", status_code=400)
+        arr = raw.astype(self._input_dtype, copy=False)
         # same bucketing as the server: one compiled gradient program per
         # bucket, not per request batch size
         from seldon_core_tpu.codec.staging import pad_batch
 
         padded, true_n = pad_batch(arr, self.batch_buckets)
-        attributions, _ = self._grad_fn(padded)
+        attributions = self._grad_fn(padded)
         return np.asarray(attributions)[:true_n]
 
     def tags(self) -> Dict[str, Any]:

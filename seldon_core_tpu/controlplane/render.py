@@ -235,7 +235,9 @@ def _explainer_objects(
         )
     name = f"{sdep.name}-{p.name}-explainer"
     labels = {**_dep_labels(sdep, p), "seldon-explainer": p.name}
-    container = exp.get("containerSpec")
+    # copy: the spec's nested dict must not accumulate mutations (envFrom)
+    # across renders of the same held spec object
+    container = dict(exp["containerSpec"]) if exp.get("containerSpec") else None
     if not container:
         model_uri = exp.get("modelUri") or p.graph.model_uri or ""
         container = {
@@ -255,9 +257,9 @@ def _explainer_objects(
     if exp.get("serviceAccountName"):
         pod_spec["serviceAccountName"] = exp["serviceAccountName"]
     if exp.get("envSecretRefName"):
-        container.setdefault("envFrom", []).append(
+        container["envFrom"] = list(container.get("envFrom", [])) + [
             {"secretRef": {"name": exp["envSecretRefName"]}}
-        )
+        ]
     deployment = {
         "apiVersion": "apps/v1",
         "kind": "Deployment",

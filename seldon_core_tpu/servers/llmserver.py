@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+from collections import OrderedDict
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -119,6 +120,7 @@ class LLMServer(SeldonComponent):
         tensor_parallel: int = 0,
         sequence_parallel: int = 0,
         quantize: str = "",
+        prefix_cache_size: int = 0,
         seed: int = 0,
         **kwargs: Any,
     ):
@@ -141,6 +143,13 @@ class LLMServer(SeldonComponent):
         # "int8": weight-only PTQ (ops/quantize.py) — the KV cache and
         # activations stay in the model dtype; only weights go int8 in HBM
         self.quantize = str(quantize or "")
+        # Prefix caching (opt-in): single-prompt requests reuse the KV cache
+        # of the longest previously-prefilled token prefix (shared system
+        # prompts prefill once); entries are LRU-evicted past this size.
+        # Safe to share: jax arrays are immutable, decode never mutates them.
+        self.prefix_cache_size = int(prefix_cache_size)
+        self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._prefix_hits = 0
         self.seed = int(seed)
         self.ready = False
         self._eos_override = eos_id
@@ -289,6 +298,52 @@ class LLMServer(SeldonComponent):
         pos = NamedSharding(self.mesh, P(dp, sp))
         return [(kv, kv, pos) for _ in range(self._cfg.n_layers)]
 
+    def _get_extend(self, b: int, slen: int, max_len: int):
+        """Suffix prefill: write ``slen`` tokens into an EXISTING cache at
+        offset ``start`` (prefix-cache continuation). Padded slots carry
+        PAD_POS positions, so they are never attended."""
+        key = ("extend", b, slen, max_len)
+        fn = self._prefill_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+
+        module = self._module
+        deq = self._dequant
+
+        @jax.jit
+        def extend(params, caches, tokens, positions, start):
+            logits, caches = module.apply(
+                deq(params), tokens, positions=positions, caches=caches,
+                cache_index=start,
+            )
+            return logits, caches
+
+        self._prefill_cache[key] = extend
+        return extend
+
+    def _prefix_lookup(self, tokens: List[int], max_len: int):
+        """Longest cached prefix of ``tokens`` with a compatible cache size;
+        returns (prefix_len, caches, last_logits) or None. Exact full-prompt
+        hits return the stored logits so prefill is skipped entirely."""
+        best = None
+        for key, (entry_max_len, caches, last_logits) in self._prefix_cache.items():
+            k = len(key)
+            if entry_max_len != max_len or k > len(tokens):
+                continue
+            if list(key) == tokens[:k] and (best is None or k > best[0]):
+                best = (k, caches, last_logits)
+        if best is not None:
+            self._prefix_cache.move_to_end(tuple(tokens[: best[0]]))
+        return best
+
+    def _prefix_store(self, tokens: List[int], max_len: int, caches, last_logits):
+        key = tuple(tokens)
+        self._prefix_cache[key] = (max_len, caches, last_logits)
+        self._prefix_cache.move_to_end(key)
+        while len(self._prefix_cache) > self.prefix_cache_size:
+            self._prefix_cache.popitem(last=False)
+
     def _get_prefill(self, b: int, plen: int, max_len: int):
         key = (b, plen, max_len)
         fn = self._prefill_cache.get(key)
@@ -432,7 +487,17 @@ class LLMServer(SeldonComponent):
         if longest > plen:
             logger.warning("prompt of %d tokens truncated to max_seq_len %d", longest, plen)
         token_lists = [t[-plen:] for t in token_lists]  # keep the prompt tail
-        max_len = min(plen + max_new, self._cfg.max_seq_len + max_new)
+        if self.prefix_cache_size > 0 and n == 1:
+            # one shared cache size for all single-prompt requests — a
+            # per-request max_len would make every different prompt-length
+            # bucket a guaranteed prefix-cache miss. Never smaller than the
+            # actual prompt bucket (over-long prompts exceed the top bucket).
+            max_len = (
+                max(plen, min(self.len_buckets[-1], self._cfg.max_seq_len))
+                + max(max_new, self.max_new_tokens)
+            )
+        else:
+            max_len = min(plen + max_new, self._cfg.max_seq_len + max_new)
         if self.mesh is not None:
             # round the cache length up to a multiple of the seq axis so the
             # KV cache can actually shard over it
@@ -451,12 +516,42 @@ class LLMServer(SeldonComponent):
             true_len[i] = L
             last_tok[i] = toks[-1]
 
-        prefill = self._get_prefill(nb, plen, max_len)
         decode = self._get_decode(nb, max_len)
 
-        logits, caches = prefill(self._params, jnp.asarray(tokens), jnp.asarray(positions))
-        # next-token logits live at each sequence's last real slot
-        first_logits = np.asarray(logits[jnp.arange(nb), jnp.asarray(true_len) - 1]).astype(np.float32)
+        # Prefix cache: single-prompt requests skip recomputing the KV of a
+        # previously-seen token prefix (e.g. a shared system prompt); only
+        # the suffix prefills, at its own bucketed length.
+        use_prefix = self.prefix_cache_size > 0 and n == 1 and nb == 1
+        hit = self._prefix_lookup(token_lists[0], max_len) if use_prefix else None
+        if hit is not None and hit[0] == len(token_lists[0]):
+            self._prefix_hits += 1
+            _, caches, first_logits = hit
+        elif hit is not None:
+            self._prefix_hits += 1
+            p0, caches, _ = hit
+            suffix = token_lists[0][p0:]
+            L = len(suffix)
+            slen = min(_bucket(L, self.len_buckets), max_len - p0)
+            stoks = np.zeros((1, slen), np.int32)
+            spos = np.full((1, slen), PAD_POS, np.int32)
+            stoks[0, :L] = suffix
+            spos[0, :L] = np.arange(p0, p0 + L)
+            extend = self._get_extend(1, slen, max_len)
+            logits, caches = extend(
+                self._params, caches, jnp.asarray(stoks), jnp.asarray(spos),
+                jnp.asarray(p0, jnp.int32),
+            )
+            first_logits = np.asarray(logits[:, L - 1]).astype(np.float32)
+            self._prefix_store(token_lists[0], max_len, caches, first_logits)
+        else:
+            prefill = self._get_prefill(nb, plen, max_len)
+            logits, caches = prefill(self._params, jnp.asarray(tokens), jnp.asarray(positions))
+            # next-token logits live at each sequence's last real slot
+            first_logits = np.asarray(
+                logits[jnp.arange(nb), jnp.asarray(true_len) - 1]
+            ).astype(np.float32)
+            if use_prefix:
+                self._prefix_store(token_lists[0], max_len, caches, first_logits)
         # explicit seed => reproducible; otherwise vary per request
         rng = jax.random.PRNGKey(
             int(seed) if seed is not None else self.seed + self._request_count
@@ -524,4 +619,8 @@ class LLMServer(SeldonComponent):
         return padded
 
     def tags(self) -> Dict[str, Any]:
-        return {"llm_requests": self._request_count}
+        out = {"llm_requests": self._request_count}
+        if self.prefix_cache_size:
+            out["prefix_cache_hits"] = self._prefix_hits
+            out["prefix_cache_entries"] = len(self._prefix_cache)
+        return out

@@ -268,3 +268,79 @@ def test_spec_driven_sequence_parallel(eight_devices):
     assert dict(s.mesh.shape) == {"data": 1, "seq": 4, "model": 2}
     out = s.generate([[7, 12, 80, 4]], max_new_tokens=4)["tokens"][0]
     assert len(out) <= 4
+
+
+# ---------------------------------------------------------------------------
+# Prefix caching
+# ---------------------------------------------------------------------------
+
+def make_servers(**extra):
+    base = LLMServer(model="llama-tiny", init_random=True, max_new_tokens=6,
+                     len_buckets=(16, 32), batch_buckets=(1,), temperature=0.0,
+                     seed=9)
+    base.load()
+    cached = LLMServer(model="llama-tiny", init_random=True, max_new_tokens=6,
+                       len_buckets=(16, 32), batch_buckets=(1,), temperature=0.0,
+                       seed=9, prefix_cache_size=4, **extra)
+    cached.load()
+    return base, cached
+
+
+def test_prefix_cache_exact_hit_matches_uncached():
+    base, cached = make_servers()
+    prompt = [5, 9, 17, 33, 2, 7, 40, 3]
+    want = base.generate([prompt], max_new_tokens=6)["tokens"][0]
+    first = cached.generate([prompt], max_new_tokens=6)["tokens"][0]
+    again = cached.generate([prompt], max_new_tokens=6)["tokens"][0]
+    assert first == want and again == want
+    assert cached._prefix_hits == 1  # second call skipped prefill entirely
+    assert cached.tags()["prefix_cache_hits"] == 1
+
+
+def test_prefix_cache_shared_system_prompt():
+    """Two prompts sharing a system prefix: the second reuses the prefix KV
+    and still decodes exactly like an uncached server."""
+    base, cached = make_servers()
+    rng = np.random.default_rng(3)
+    system = rng.integers(1, 255, size=12).tolist()
+    p1 = system + [10, 11, 12]
+    p2 = system + [20, 21]
+
+    want1 = base.generate([p1], max_new_tokens=6)["tokens"][0]
+    want2 = base.generate([p2], max_new_tokens=6)["tokens"][0]
+
+    # seed the cache with the bare system prefix, then serve both prompts
+    cached.generate([system], max_new_tokens=1)
+    got1 = cached.generate([p1], max_new_tokens=6)["tokens"][0]
+    got2 = cached.generate([p2], max_new_tokens=6)["tokens"][0]
+    assert got1 == want1, (got1, want1)
+    assert got2 == want2, (got2, want2)
+    assert cached._prefix_hits >= 2  # both continuations hit the prefix
+
+
+def test_prefix_cache_lru_eviction():
+    _, cached = make_servers()
+    cached.prefix_cache_size = 2
+    for seed in range(4):
+        prompt = np.random.default_rng(seed).integers(1, 255, size=6).tolist()
+        cached.generate([prompt], max_new_tokens=1)
+    assert len(cached._prefix_cache) <= 2
+
+
+def test_prefix_cache_off_for_batches():
+    _, cached = make_servers()
+    # batch requests bypass the cache (nb > 1 would need per-row prefixes)
+    cached.batch_buckets = (2,)
+    cached.generate([[1, 2, 3], [4, 5, 6]], max_new_tokens=2)
+    assert len(cached._prefix_cache) == 0
+
+
+def test_prefix_cache_overlong_prompt():
+    """A prompt past the top length bucket must still get a cache that fits
+    it (regression: cached-mode max_len could undercut plen)."""
+    _, cached = make_servers()
+    prompt = np.random.default_rng(5).integers(1, 255, size=40).tolist()
+    out = cached.generate([prompt], max_new_tokens=3)["tokens"][0]
+    assert len(out) <= 3
+    again = cached.generate([prompt], max_new_tokens=3)["tokens"][0]
+    assert again == out
