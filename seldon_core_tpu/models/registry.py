@@ -30,6 +30,7 @@ def get_model(name: str, **kwargs: Any):
         import seldon_core_tpu.models.mlp  # noqa: F401
         import seldon_core_tpu.models.resnet  # noqa: F401
         import seldon_core_tpu.models.transformer  # noqa: F401
+        import seldon_core_tpu.models.vit  # noqa: F401
     if name not in _REGISTRY:
         raise KeyError(f"Unknown model {name!r}; registered: {sorted(_REGISTRY)}")
     return _REGISTRY[name](**kwargs)

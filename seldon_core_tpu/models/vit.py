@@ -1,0 +1,122 @@
+"""Vision Transformer family — MXU-first image classification.
+
+No reference counterpart (the reference is model-agnostic and ships no
+models); this is the second vision family beside ResNet. The design plays
+to the MXU harder than convs do: patchify is ONE strided conv (equivalently
+a reshaped matmul), after which the entire network is large batched matmuls
+(attention + MLP) in bfloat16 with f32 layernorm statistics — no im2col, no
+spatial loops. Logical axis names ride param_with_axes so the GSPMD rules in
+parallel/sharding.py shard it exactly like the language models: heads/mlp
+over 'model', batch over 'data'.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import partitioning as nn_partitioning
+
+from seldon_core_tpu.models.registry import register_model
+
+param_with_axes = nn_partitioning.param_with_axes
+
+
+class _Mlp(nn.Module):
+    dim: int
+    hidden: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        w1 = param_with_axes("w1", nn.initializers.xavier_uniform(), (self.dim, self.hidden),
+                             jnp.float32, axes=("embed", "mlp"))
+        b1 = param_with_axes("b1", nn.initializers.zeros_init(), (self.hidden,),
+                             jnp.float32, axes=("mlp",))
+        w2 = param_with_axes("w2", nn.initializers.xavier_uniform(), (self.hidden, self.dim),
+                             jnp.float32, axes=("mlp", "embed"))
+        b2 = param_with_axes("b2", nn.initializers.zeros_init(), (self.dim,),
+                             jnp.float32, axes=("embed",))
+        dt = self.dtype
+        h = nn.gelu(x @ w1.astype(dt) + b1.astype(dt))
+        return h @ w2.astype(dt) + b2.astype(dt)
+
+
+class _Attention(nn.Module):
+    dim: int
+    n_heads: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        hd = self.dim // self.n_heads
+        dt = self.dtype
+        wqkv = param_with_axes("wqkv", nn.initializers.xavier_uniform(),
+                               (self.dim, 3 * self.dim), jnp.float32, axes=("embed", "heads"))
+        wo = param_with_axes("wo", nn.initializers.xavier_uniform(),
+                             (self.dim, self.dim), jnp.float32, axes=("heads", "embed"))
+        b, s, _ = x.shape
+        qkv = (x @ wqkv.astype(dt)).reshape(b, s, 3, self.n_heads, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * hd**-0.5
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(dt)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, self.dim)
+        return out @ wo.astype(dt)
+
+
+class ViT(nn.Module):
+    patch: int = 16
+    dim: int = 768
+    depth: int = 12
+    n_heads: int = 12
+    mlp_ratio: int = 4
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # ``train`` keeps the vision-family calling convention (ResNet needs
+        # it for BN); this ViT config has no train-only ops (no dropout), so
+        # the flag is accepted and intentionally unused.
+        del train
+        dt = self.dtype
+        x = x.astype(dt)
+        # patchify: one strided conv = a [p*p*c, dim] matmul on the MXU
+        x = nn.Conv(self.dim, (self.patch, self.patch), strides=(self.patch, self.patch),
+                    dtype=dt, name="patch_embed")(x)
+        b, h, w, _ = x.shape
+        x = x.reshape(b, h * w, self.dim)
+
+        cls = param_with_axes("cls", nn.initializers.zeros_init(), (1, 1, self.dim),
+                              jnp.float32, axes=(None, None, "embed"))
+        pos = param_with_axes("pos_embed", nn.initializers.normal(stddev=0.02),
+                              (1, h * w + 1, self.dim), jnp.float32,
+                              axes=(None, None, "embed"))
+        x = jnp.concatenate([jnp.broadcast_to(cls.astype(dt), (b, 1, self.dim)), x], axis=1)
+        x = x + pos.astype(dt)
+
+        for i in range(self.depth):
+            y = nn.LayerNorm(dtype=dt, name=f"ln1_{i}")(x)
+            x = x + _Attention(self.dim, self.n_heads, dt, name=f"attn_{i}")(y)
+            y = nn.LayerNorm(dtype=dt, name=f"ln2_{i}")(x)
+            x = x + _Mlp(self.dim, self.dim * self.mlp_ratio, dt, name=f"mlp_{i}")(y)
+
+        x = nn.LayerNorm(dtype=dt, name="ln_final")(x)
+        head = param_with_axes("head", nn.initializers.zeros_init(),
+                               (self.dim, self.num_classes), jnp.float32,
+                               axes=("embed", "vocab"))
+        return x[:, 0].astype(jnp.float32) @ head
+
+
+@register_model("vit-b16")
+def make_vit_b16(num_classes: int = 1000, dtype: str = "bfloat16"):
+    return ViT(num_classes=num_classes, dtype=jnp.dtype(dtype))
+
+
+@register_model("vit-tiny")
+def make_vit_tiny(num_classes: int = 10, dtype: str = "float32", **kwargs):
+    """Small config for tests."""
+    return ViT(patch=4, dim=32, depth=2, n_heads=2, num_classes=num_classes,
+               dtype=jnp.dtype(dtype), **kwargs)

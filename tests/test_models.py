@@ -140,3 +140,61 @@ def test_seq2seq_bad_sequence_length_raises():
     det = Seq2SeqOutlierDetector(timesteps=8)
     with pytest.raises(ValueError, match="sequence length 8"):
         det._frame(np.zeros((4, 16, 2), np.float32))
+
+
+def test_vit_forward_and_serving(tmp_path):
+    """ViT family: forward shape, GSPMD logical axes present, and the full
+    JAXServer serving path (export -> engine predict)."""
+    import asyncio
+
+    from seldon_core_tpu.contracts.graph import PredictorSpec
+    from seldon_core_tpu.contracts.payload import SeldonMessage
+    from seldon_core_tpu.runtime.engine import GraphEngine
+    from seldon_core_tpu.servers.jaxserver import export_checkpoint
+
+    model = get_model("vit-tiny", num_classes=5)
+    x = jnp.zeros((2, 16, 16, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 5)
+    assert "params_axes" in variables  # sharding rules can apply
+
+    ckpt = export_checkpoint(
+        str(tmp_path / "ckpt"), model="vit-tiny",
+        kwargs={"num_classes": 5},
+        params=variables, input_shape=[16, 16, 3], use_orbax=False,
+    )
+    spec = PredictorSpec.from_dict({
+        "name": "p",
+        "graph": {"name": "m", "type": "MODEL",
+                  "implementation": "JAX_SERVER", "modelUri": ckpt},
+    })
+    engine = GraphEngine(spec)
+    msg = SeldonMessage.from_dict(
+        {"data": {"tensor": {"shape": [1, 16, 16, 3], "values": [0.5] * (16 * 16 * 3)}}}
+    )
+    resp = asyncio.run(engine.predict(msg)).to_dict()
+    assert resp["data"]["tensor"]["shape"] == [1, 5]
+
+
+def test_vit_shards_over_model_axis(eight_devices):
+    from seldon_core_tpu.parallel.mesh import make_mesh
+    from seldon_core_tpu.parallel.sharding import shard_apply, sharding_report
+
+    mesh = make_mesh({"data": 4, "model": 2}, eight_devices)
+    model = get_model("vit-tiny", num_classes=4)
+    x = jnp.zeros((4, 16, 16, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+
+    def apply_fn(v, x):
+        return model.apply(v, x)
+
+    jitted, sharded = shard_apply(
+        apply_fn, model, variables, mesh,
+        example_input=jax.ShapeDtypeStruct((1, 16, 16, 3), jnp.float32),
+        strict=True,
+    )
+    report = sharding_report(sharded)
+    assert "model" in report["axes"], report
+    out = jitted(sharded, x)
+    assert out.shape == (4, 4)
