@@ -53,17 +53,46 @@ def free_port() -> int:
     return port
 
 
-def wait_live(port: int, deadline_s: float = 15.0) -> None:
+def wait_live(port: int, deadline_s: float = 15.0, proc=None, path: str = "/live") -> None:
+    """Poll until the serving path answers; fast-fail if ``proc`` died."""
     import urllib.request
 
     deadline = time.monotonic() + deadline_s
     while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(f"server process exited rc={proc.returncode}")
         try:
-            with urllib.request.urlopen(f"http://127.0.0.1:{port}/live", timeout=1):
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=2):
                 return
         except Exception:
             time.sleep(0.05)
-    raise RuntimeError("edge did not come up")
+    raise RuntimeError(f"server did not answer {path} in {deadline_s}s")
+
+
+def wait_predict_ready(port: int, deadline_s: float, proc=None) -> None:
+    """Readiness = one REAL prediction succeeded (in ring mode /live is
+    answered by the C++ frontend before the engine has jitted anything; the
+    first predict carries the XLA compile and must not land in the measured
+    window)."""
+    import urllib.request
+
+    deadline = time.monotonic() + deadline_s
+    last: Exception = RuntimeError("no attempt")
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(f"server process exited rc={proc.returncode}")
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                data=BODY.encode(), headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                if resp.status == 200:
+                    return
+        except Exception as e:
+            last = e
+            time.sleep(0.2)
+    raise RuntimeError(f"predict path never became ready: {last}")
 
 
 def run_loadgen(port: int, connections: int, duration: float, label: str,
@@ -175,26 +204,22 @@ def bench_ring(duration: float, workers: int = 4) -> dict:
     # own session: the wrapper spawns N edge children, so teardown must kill
     # the whole process group or the edges outlive the bench
     stderr_log = os.path.join("/tmp", f"ring_bench_{os.getpid()}.err")
+    import glob
+
+    pre_existing = set(glob.glob("/tmp/seldon-edge-*"))
     with open(stderr_log, "wb") as errf:
         proc = subprocess.Popen([sys.executable, "-c", code],
                                 stderr=errf, stdout=subprocess.DEVNULL,
                                 start_new_session=True)
     try:
-        deadline = time.monotonic() + 90.0  # engine jit warm-up
-        while time.monotonic() < deadline:
-            if proc.poll() is not None:  # fast-fail with the real reason
-                with open(stderr_log) as f:
-                    tail = f.read()[-2000:]
-                raise RuntimeError(f"edge wrapper exited rc={proc.returncode}: {tail}")
-            try:
-                import urllib.request
-
-                with urllib.request.urlopen(f"http://127.0.0.1:{port}/live", timeout=1):
-                    break
-            except Exception:
-                time.sleep(0.1)
-        else:
-            raise RuntimeError("edge did not come up in 90s")
+        try:
+            wait_live(port, deadline_s=30.0, proc=proc)
+            # readiness = a real prediction (covers the engine's jit compile)
+            wait_predict_ready(port, deadline_s=90.0, proc=proc)
+        except RuntimeError as e:
+            with open(stderr_log) as f:
+                tail = f.read()[-2000:]
+            raise RuntimeError(f"{e}; wrapper stderr: {tail}") from e
         runs = [run_loadgen(port, c, duration, f"ring-eg-{c}c") for c in (16, 64)]
     finally:
         import signal
@@ -211,11 +236,11 @@ def bench_ring(duration: float, workers: int = 4) -> dict:
             except ProcessLookupError:
                 pass
             proc.wait(timeout=5)
-        # killpg preempts run_edge's own cleanup: sweep its ring files + tmpdir
-        import glob
+        # killpg preempts run_edge's own cleanup: sweep ONLY the tmpdirs this
+        # launch created (a concurrent edge's live rings must survive)
         import shutil
 
-        for d in glob.glob("/tmp/seldon-edge-*"):
+        for d in set(glob.glob("/tmp/seldon-edge-*")) - pre_existing:
             shutil.rmtree(d, ignore_errors=True)
         os.unlink(spec_path)
         os.unlink(stderr_log)

@@ -1,0 +1,160 @@
+"""Cluster-shaped e2e without a cluster (the reference's
+`test_helm_charts_clusterwide.py` role): CR -> operator -> rendered
+Deployment -> a live engine booted EXACTLY as a kubelet would boot it — from
+the rendered container's env (`ENGINE_PREDICTOR` base64 spec) — then
+requests flow and a CR edit rolls the graph. Also pins the CRD's
+openAPIV3Schema against every shipped example CR, so the schema can't drift
+from what the operator accepts."""
+
+import base64
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from test_operator import make_operator, single_model_cr, write_cr
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def engine_from_rendered(deployment: dict, port: int) -> subprocess.Popen:
+    """Boot the engine the way its rendered container would run: same env,
+    no spec file — the graph arrives via ENGINE_PREDICTOR."""
+    container = deployment["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in container["env"] if "value" in e}
+    assert "ENGINE_PREDICTOR" in env
+    code = (
+        f"import sys; sys.path.insert(0, {REPO!r})\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from seldon_core_tpu.transport.cli import main\n"
+        f"main(['engine', '--port', '{port}', '--host', '127.0.0.1'])\n"
+    )
+    return subprocess.Popen(
+        [sys.executable, "-c", code],
+        env={**os.environ, **env},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def wait_ready(port: int, deadline_s: float = 60.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/ready", timeout=1) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            time.sleep(0.2)
+    raise TimeoutError("engine never became ready")
+
+
+def predict(port: int) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+        data=b'{"data":{"ndarray":[[1.0, 2.0]]}}',
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_cr_to_live_engine_and_rollout(tmp_path):
+    op, cluster, cr_dir = make_operator(tmp_path)
+    write_cr(cr_dir, "m1", single_model_cr())
+    op.run_once()
+    dep = cluster.get("Deployment", "default", "m1-default")
+
+    # the injected spec round-trips through base64 exactly
+    env = {e["name"]: e["value"] for e in
+           dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    spec = json.loads(base64.b64decode(env["ENGINE_PREDICTOR"]))
+    assert spec["graph"]["implementation"] == "SIMPLE_MODEL"
+
+    port = free_port()
+    proc = engine_from_rendered(dep, port)
+    try:
+        wait_ready(port)
+        out = predict(port)
+        # ndarray in -> ndarray out (the reference's construct-response rule)
+        assert out["data"]["ndarray"][0] == pytest.approx([0.1, 0.9, 0.5])
+        assert out["meta"]["requestPath"] == {"clf": "SimpleModel"}
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+    # CR edit: the rendered env must change, and the rebooted engine must
+    # serve the new graph (the rollout contract the operator feeds)
+    cr = single_model_cr()
+    cr["spec"]["predictors"][0]["graph"] = {
+        "name": "comb", "type": "COMBINER", "implementation": "AVERAGE_COMBINER",
+        "children": [
+            {"name": "c1", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+            {"name": "c2", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+    write_cr(cr_dir, "m1", cr)
+    op.run_once()
+    dep2 = cluster.get("Deployment", "default", "m1-default")
+    env2 = {e["name"]: e["value"] for e in
+            dep2["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env2["ENGINE_PREDICTOR"] != env["ENGINE_PREDICTOR"]
+
+    port2 = free_port()
+    proc2 = engine_from_rendered(dep2, port2)
+    try:
+        wait_ready(port2)
+        out2 = predict(port2)
+        path = out2["meta"]["requestPath"]
+        assert set(path) == {"comb", "c1", "c2"}
+    finally:
+        proc2.terminate()
+        proc2.wait(timeout=10)
+
+
+def test_crd_schema_accepts_example_crs():
+    """deploy/crd.yaml's openAPIV3Schema must validate every shipped example
+    CR (schema drift from the operator's acceptance = broken kubectl apply)."""
+    import jsonschema
+    import yaml
+
+    with open(os.path.join(REPO, "deploy", "crd.yaml")) as f:
+        crd = yaml.safe_load(f)
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+
+    # k8s vendor extension: treat as free-form object for jsonschema
+    def strip_ext(node):
+        if isinstance(node, dict):
+            node.pop("x-kubernetes-preserve-unknown-fields", None)
+            node.pop("x-kubernetes-patch-merge-key", None)
+            node.pop("x-kubernetes-patch-strategy", None)
+            for v in node.values():
+                strip_ext(v)
+        elif isinstance(node, list):
+            for v in node:
+                strip_ext(v)
+
+    strip_ext(schema)
+    examples_dir = os.path.join(REPO, "deploy", "examples")
+    assert os.listdir(examples_dir)
+    for fn in sorted(os.listdir(examples_dir)):
+        with open(os.path.join(examples_dir, fn)) as f:
+            cr = json.load(f)
+        jsonschema.validate(cr, schema)  # raises on drift
+
+    # and it rejects a CR the operator would reject
+    bad = {"spec": {"predictors": [{"graph": {"name": "x", "type": "NOT_A_TYPE"}}]}}
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(bad, schema)
