@@ -49,9 +49,11 @@ def engine_from_rendered(deployment: dict, port: int) -> subprocess.Popen:
     )
 
 
-def wait_ready(port: int, deadline_s: float = 60.0) -> None:
+def wait_ready(port: int, proc: subprocess.Popen, deadline_s: float = 60.0) -> None:
     deadline = time.monotonic() + deadline_s
     while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"engine exited rc={proc.returncode} before ready")
         try:
             with urllib.request.urlopen(f"http://127.0.0.1:{port}/ready", timeout=1) as r:
                 if r.status == 200:
@@ -86,7 +88,7 @@ def test_cr_to_live_engine_and_rollout(tmp_path):
     port = free_port()
     proc = engine_from_rendered(dep, port)
     try:
-        wait_ready(port)
+        wait_ready(port, proc)
         out = predict(port)
         # ndarray in -> ndarray out (the reference's construct-response rule)
         assert out["data"]["ndarray"][0] == pytest.approx([0.1, 0.9, 0.5])
@@ -115,7 +117,7 @@ def test_cr_to_live_engine_and_rollout(tmp_path):
     port2 = free_port()
     proc2 = engine_from_rendered(dep2, port2)
     try:
-        wait_ready(port2)
+        wait_ready(port2, proc2)
         out2 = predict(port2)
         path = out2["meta"]["requestPath"]
         assert set(path) == {"comb", "c1", "c2"}
