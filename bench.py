@@ -139,10 +139,36 @@ def main() -> None:
     )
 
 
+def _probe_tpu(timeout_s: float = 120.0) -> bool:
+    """Is the TPU backend actually reachable? The axon tunnel can wedge so
+    hard that jax.devices() never returns (see benchmarks/MFU_NOTES.md) —
+    probe in a subprocess so a dead tunnel degrades to an honestly-labeled
+    CPU number instead of hanging the whole bench."""
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        return False  # explicitly CPU-forced: don't pay a probe backend init
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s, text=True,
+        )
+        return out.returncode == 0 and "tpu" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="resnet", choices=["resnet", "llm"])
-    if ap.parse_args().mode == "llm":
+    args = ap.parse_args()
+    if not _probe_tpu():
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if args.mode == "llm":
         main_llm()
     else:
         main()

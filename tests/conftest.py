@@ -24,3 +24,23 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+def wait_http_ready(port, proc, path="/ready", deadline_s=60.0):
+    """Shared subprocess-server readiness wait: polls the endpoint and
+    fast-fails if the process died (used by the rollout + cluster e2e
+    suites; one copy so the dead-process fix can't drift)."""
+    import time
+    import urllib.request
+
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(f"server exited rc={proc.returncode} before ready")
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=1) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            time.sleep(0.2)
+    raise TimeoutError(f"server never became ready on {path}")

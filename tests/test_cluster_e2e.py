@@ -50,17 +50,9 @@ def engine_from_rendered(deployment: dict, port: int) -> subprocess.Popen:
 
 
 def wait_ready(port: int, proc: subprocess.Popen, deadline_s: float = 60.0) -> None:
-    deadline = time.monotonic() + deadline_s
-    while time.monotonic() < deadline:
-        if proc.poll() is not None:
-            raise RuntimeError(f"engine exited rc={proc.returncode} before ready")
-        try:
-            with urllib.request.urlopen(f"http://127.0.0.1:{port}/ready", timeout=1) as r:
-                if r.status == 200:
-                    return
-        except Exception:
-            time.sleep(0.2)
-    raise TimeoutError("engine never became ready")
+    from conftest import wait_http_ready
+
+    wait_http_ready(port, proc, deadline_s=deadline_s)
 
 
 def predict(port: int) -> dict:

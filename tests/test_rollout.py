@@ -68,17 +68,10 @@ def http(method: str, port: int, path: str, body: bytes = b"", timeout: float = 
         return resp.status, resp.read()
 
 
-def wait_ready(port: int, deadline_s: float = 60.0) -> None:
-    deadline = time.monotonic() + deadline_s
-    while time.monotonic() < deadline:
-        try:
-            status, _ = http("GET", port, "/ready", timeout=2.0)
-            if status == 200:
-                return
-        except Exception:
-            pass
-        time.sleep(0.2)
-    raise TimeoutError(f"engine on {port} never became ready")
+def wait_ready(port: int, proc=None, deadline_s: float = 60.0) -> None:
+    from conftest import wait_http_ready
+
+    wait_http_ready(port, proc, deadline_s=deadline_s)
 
 
 PREDICT_BODY = json.dumps({"data": {"ndarray": [[1.0, 2.0]]}}).encode()
@@ -114,7 +107,7 @@ def test_rolling_update_zero_downtime(tmp_path):
 
     try:
         procs.append(start_engine(tmp_path, "v1", port_v1))
-        wait_ready(port_v1)
+        wait_ready(port_v1, procs[0])
         predict_version(port_v1)  # v1 warm-up before load starts
 
         t = threading.Thread(target=client_loop, daemon=True)
@@ -123,7 +116,7 @@ def test_rolling_update_zero_downtime(tmp_path):
 
         # --- rollout: v2 boots while v1 keeps serving ---
         procs.append(start_engine(tmp_path, "v2", port_v2))
-        wait_ready(port_v2)
+        wait_ready(port_v2, procs[1])
         assert predict_version(port_v2) == "v2"  # compile-cache warm-up
         switch_idx = len(record)
         primary["port"] = port_v2  # kube-proxy flips the endpoint
@@ -166,7 +159,7 @@ def test_pause_rejects_then_unpause_recovers(tmp_path):
     port = free_port()
     proc = start_engine(tmp_path, "v1", port)
     try:
-        wait_ready(port)
+        wait_ready(port, proc)
         assert predict_version(port) == "v1"
         http("GET", port, "/pause")
         with pytest.raises(urllib.error.HTTPError) as err:
