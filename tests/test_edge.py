@@ -289,3 +289,67 @@ def test_fallback_mode_serves_python_engine(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=15)
+
+
+@pytest.mark.parametrize("graph_key,spec", [
+    ("single", SINGLE), ("ab", AB_FORCED), ("comb", COMBINER), ("chain", CHAIN),
+])
+def test_parity_fuzz_random_payloads(edge, graph_key, spec):
+    """Randomized parity sweep: 48 generated payloads per topology —
+    random tensor/ndarray shapes (1-D, 2-D, singletons), extreme values,
+    strData/binData/jsonData, optional meta — must produce byte-identical
+    success responses (minus puid) from the C++ edge and the Python engine,
+    and matching status codes on failures."""
+    import base64 as b64
+    import zlib
+
+    import numpy as np
+
+    from seldon_core_tpu.contracts.payload import SeldonError
+
+    # crc32, not hash(): str hashes are salted per process, which would
+    # make a failing fuzz case unreproducible
+    rng = np.random.default_rng(zlib.crc32(graph_key.encode()))
+    engine = GraphEngine(PredictorSpec.from_dict(spec))
+    port = edge(graph_key, spec)
+
+    def gen_request(i):
+        kind = i % 6
+        if kind == 0:  # tensor, random shape
+            rows = int(rng.integers(1, 5))
+            cols = int(rng.integers(1, 6))
+            vals = rng.normal(0, 10.0 ** float(rng.integers(-3, 4)), size=rows * cols)
+            return {"data": {"tensor": {"shape": [rows, cols],
+                                        "values": [float(v) for v in vals]}}}
+        if kind == 1:  # ndarray
+            rows = int(rng.integers(1, 4))
+            cols = int(rng.integers(1, 4))
+            return {"data": {"ndarray": rng.uniform(-1e6, 1e6, (rows, cols)).tolist()}}
+        if kind == 2:  # 1-D tensor
+            n = int(rng.integers(1, 8))
+            return {"data": {"tensor": {"shape": [n], "values": [float(v) for v in rng.normal(size=n)]}}}
+        if kind == 3:
+            return {"strData": "".join(chr(int(c)) for c in rng.integers(32, 127, 16))}
+        if kind == 4:
+            return {"jsonData": {"k": int(rng.integers(0, 100)), "v": [1, 2.5, "s"]}}
+        raw = bytes(int(b) for b in rng.integers(0, 256, int(rng.integers(1, 24))))
+        return {"binData": b64.b64encode(raw).decode()}
+
+    for i in range(48):
+        req = gen_request(i)
+        if rng.random() < 0.3:
+            req["meta"] = {"puid": f"fuzz{i:04d}", "tags": {"fuzz": True}}
+        try:
+            expected = engine.predict_sync(
+                SeldonMessage.from_dict(json.loads(json.dumps(req))))
+            want_status, want_body = 200, strip_puid(expected.to_dict())
+        except SeldonError as e:
+            want_status, want_body = e.status_code, None
+        except Exception:
+            want_status, want_body = 500, None
+        status, got = post(port, "/api/v0.1/predictions", req)
+        assert status == want_status, (i, req, status, got)
+        if want_body is not None:
+            assert strip_puid(got) == want_body, (i, req)
+        else:
+            assert got["status"]["status"] == "FAILURE", (i, req)
