@@ -28,12 +28,24 @@ def config_kwargs_from_hf(hf_config: Any) -> Dict[str, Any]:
     configs the native transformer cannot represent — silent acceptance
     would convert cleanly and serve wrong logits."""
     scaling = getattr(hf_config, "rope_scaling", None)
-    if scaling and scaling.get("rope_type", scaling.get("type", "default")) != "default":
-        raise ValueError(
-            f"rope_scaling={scaling!r} is not supported by the native "
-            "transformer (plain RoPE only); converting would silently "
-            "diverge from HF at long positions"
-        )
+    rope_scaling = None
+    if scaling:
+        rope_type = scaling.get("rope_type", scaling.get("type", "default"))
+        if rope_type == "llama3":
+            # supported natively (models/transformer._llama3_scaled_freqs,
+            # parity-tested against transformers)
+            required = ("factor", "low_freq_factor", "high_freq_factor",
+                        "original_max_position_embeddings")
+            missing = [k for k in required if k not in scaling]
+            if missing:
+                raise ValueError(f"llama3 rope_scaling missing keys {missing}: {scaling!r}")
+            rope_scaling = {k: scaling[k] for k in required}
+        elif rope_type != "default":
+            raise ValueError(
+                f"rope_scaling type {rope_type!r} is not supported by the "
+                "native transformer (plain RoPE and llama3 scaling only); "
+                "converting would silently diverge from HF at long positions"
+            )
     head_dim = getattr(hf_config, "head_dim", None)
     derived = hf_config.hidden_size // hf_config.num_attention_heads
     if head_dim is not None and head_dim != derived:
@@ -55,6 +67,7 @@ def config_kwargs_from_hf(hf_config: Any) -> Dict[str, Any]:
         "rope_theta": getattr(hf_config, "rope_theta", 10000.0),
         "norm_eps": hf_config.rms_norm_eps,
         "tie_embeddings": bool(getattr(hf_config, "tie_word_embeddings", False)),
+        **({"rope_scaling": rope_scaling} if rope_scaling else {}),
     }
 
 

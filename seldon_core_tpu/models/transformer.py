@@ -47,6 +47,10 @@ class TransformerConfig:
     ffn_dim: int = 11008
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
+    # Llama-3.x frequency rescaling: tuple of (key, value) pairs (hashable
+    # frozen-dataclass field) with factor / low_freq_factor /
+    # high_freq_factor / original_max_position_embeddings; None = plain RoPE.
+    rope_scaling: Any = None
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     # Llama-2 uses an untied lm_head; tie only for small/test configs.
@@ -73,9 +77,32 @@ def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (norm * weight).astype(x.dtype)
 
 
-def rotary_embedding(positions: jnp.ndarray, head_dim: int, theta: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def _llama3_scaled_freqs(freqs: jnp.ndarray, scaling: dict) -> jnp.ndarray:
+    """Llama-3.1 frequency rescaling (parity with transformers'
+    _compute_llama3_parameters): low-frequency bands divide by ``factor``,
+    high-frequency bands pass through, the middle band interpolates."""
+    import math
+
+    factor = float(scaling["factor"])
+    lo = float(scaling["low_freq_factor"])
+    hi = float(scaling["high_freq_factor"])
+    old_len = float(scaling["original_max_position_embeddings"])
+
+    wavelen = 2.0 * math.pi / freqs
+    scaled = jnp.where(wavelen > old_len / lo, freqs / factor, freqs)
+    smooth = (old_len / wavelen - lo) / (hi - lo)
+    smoothed = (1.0 - smooth) * scaled / factor + smooth * scaled
+    is_medium = (wavelen >= old_len / hi) & (wavelen <= old_len / lo)
+    return jnp.where(is_medium, smoothed, scaled)
+
+
+def rotary_embedding(
+    positions: jnp.ndarray, head_dim: int, theta: float, rope_scaling=None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """cos/sin tables for the given absolute positions: [..., seq, head_dim/2]."""
     freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if rope_scaling:
+        freqs = _llama3_scaled_freqs(freqs, dict(rope_scaling))
     angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., seq, hd/2]
     return jnp.cos(angles), jnp.sin(angles)
 
@@ -140,7 +167,7 @@ class Attention(nn.Module):
         k = (x @ wk.astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
         v = (x @ wv.astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
 
-        cos, sin = rotary_embedding(positions, hd, cfg.rope_theta)
+        cos, sin = rotary_embedding(positions, hd, cfg.rope_theta, cfg.rope_scaling)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
 
@@ -317,7 +344,10 @@ def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int):
 @register_model("transformer")
 def make_transformer(**kwargs):
     dtype = kwargs.pop("dtype", "bfloat16")
-    cfg = TransformerConfig(dtype=jnp.dtype(dtype), **kwargs)
+    scaling = kwargs.pop("rope_scaling", None)
+    if isinstance(scaling, dict):  # normalize to a hashable config field
+        scaling = tuple(sorted(scaling.items()))
+    cfg = TransformerConfig(dtype=jnp.dtype(dtype), rope_scaling=scaling, **kwargs)
     return Transformer(cfg)
 
 

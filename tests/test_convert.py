@@ -146,9 +146,7 @@ def test_unsupported_configs_rejected(tiny_llama):
 
     scaled = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
                          num_hidden_layers=1, num_attention_heads=2,
-                         rope_scaling={"rope_type": "llama3", "factor": 8.0,
-                                       "original_max_position_embeddings": 8192,
-                                       "low_freq_factor": 1.0, "high_freq_factor": 4.0})
+                         rope_scaling={"rope_type": "yarn", "factor": 4.0})
     with pytest.raises(ValueError, match="rope_scaling"):
         config_kwargs_from_hf(scaled)
 
@@ -166,3 +164,35 @@ def test_unmapped_weights_rejected(tiny_llama):
     sd["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(64)
     with pytest.raises(ValueError, match="unmapped weights"):
         convert_llama_state_dict(sd, n_layers=2)
+
+
+def test_llama3_rope_scaling_matches_hf():
+    """Llama-3.x rope scaling: a converted model with llama3 frequency
+    rescaling must reproduce transformers' logits (positions deep enough
+    that every frequency band — pass-through, interpolated, divided — is
+    exercised)."""
+    import jax.numpy as jnp
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-6, rope_theta=10000.0,
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32},
+    )
+    torch.manual_seed(2)
+    model = LlamaForCausalLM(config)
+    model.eval()
+
+    module, variables = convert_hf_model(model)
+    assert module.cfg.rope_scaling is not None
+
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, 128, size=(1, 64))  # past original_max (32)
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(tokens)).logits.numpy()
+    ours, _ = module.apply(variables, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, atol=3e-4, rtol=3e-4)
