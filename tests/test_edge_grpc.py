@@ -226,3 +226,65 @@ def test_grpc_large_response_body(edge_grpc):
         resp = stub(tensor_request([rows, 2], [1.0] * (rows * 2)), timeout=15)
         assert list(resp.data.tensor.shape) == [rows, 3]
         assert len(resp.data.tensor.values) == rows * 3
+
+
+@pytest.mark.parametrize("graph_key,spec", [
+    ("single", SINGLE), ("ab", AB_FORCED), ("comb", COMBINER), ("chain", CHAIN),
+])
+def test_grpc_parity_fuzz(edge_grpc, python_grpc, graph_key, spec):
+    """Randomized gRPC parity: 30 generated proto requests per topology —
+    random tensor/ndarray shapes and magnitudes, strData, optional puid —
+    must round-trip identically through the native HTTP/2 edge and the
+    Python gRPC server."""
+    import zlib
+
+    import numpy as np
+
+    rng = np.random.default_rng(zlib.crc32(graph_key.encode()))
+    eport = edge_grpc(graph_key, spec)
+    pport = python_grpc(graph_key, spec)
+
+    def gen(i):
+        req = pb.SeldonMessage()
+        kind = i % 4
+        if kind == 0:
+            rows, cols = int(rng.integers(1, 5)), int(rng.integers(1, 5))
+            req.data.tensor.shape.extend([rows, cols])
+            req.data.tensor.values.extend(
+                float(v) for v in rng.normal(0, 10.0 ** float(rng.integers(-2, 3)),
+                                             rows * cols))
+        elif kind == 1:
+            for row in rng.uniform(-1e5, 1e5, (int(rng.integers(1, 4)),
+                                               int(rng.integers(1, 4)))).tolist():
+                lv = req.data.ndarray.values.add()
+                for v in row:
+                    lv.list_value.values.add().number_value = v
+        elif kind == 2:
+            n = int(rng.integers(1, 7))
+            req.data.tensor.shape.extend([n])
+            req.data.tensor.values.extend(float(v) for v in rng.normal(size=n))
+        else:
+            req.strData = "".join(chr(int(c)) for c in rng.integers(32, 127, 12))
+        if rng.random() < 0.3:
+            req.meta.puid = f"fz{graph_key}{i:03d}"
+        return req
+
+    with grpc.insecure_channel(f"127.0.0.1:{eport}") as ech, \
+            grpc.insecure_channel(f"127.0.0.1:{pport}") as pch:
+        estub, pstub = predict_stub(ech), predict_stub(pch)
+        for i in range(30):
+            req = gen(i)
+            try:
+                want = pstub(req, timeout=30)
+                want_err = None
+            except grpc.RpcError as e:
+                want_err = e.code()
+            if want_err is None:
+                got = estub(req, timeout=10)
+                assert msg_dict(got) == msg_dict(want), (graph_key, i)
+                if req.meta.puid:
+                    assert got.meta.puid == req.meta.puid
+            else:
+                with pytest.raises(grpc.RpcError) as err:
+                    estub(req, timeout=10)
+                assert err.value.code() == want_err, (graph_key, i)
