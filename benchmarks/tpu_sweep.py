@@ -1,0 +1,183 @@
+"""TPU hardware sweep: every measurement VERDICT r2 asked for, in one shot.
+
+The axon TPU tunnel has wedged at the tail of both prior rounds
+(benchmarks/MFU_NOTES.md measurement log), so this script is built to run
+the moment a hardware window opens and to lose nothing if the window
+closes mid-sweep:
+
+- each individual measurement is appended to ``tpu_sweep_results.jsonl``
+  as soon as it completes (partial progress survives a wedge);
+- the cheapest/most-important measurements run first (headline ResNet
+  number, then the batch sweep, then LLM decode bf16/int8, then the
+  pallas-int8 vs XLA-dequant kernel decision microbench);
+- every JAX call happens in THIS process, so if the tunnel dies the
+  process hangs visibly and the watcher (tpu_watch.sh) reports it; runs
+  already flushed to the jsonl are safe.
+
+Measurements:
+  resnet-bN     ResNet-50 folded-BN bf16 serving img/s at batch N
+                (MFU_NOTES levers 1-3; methodology identical to bench.py:
+                device-resident pool, lax.scan serving loop, best-of-3)
+  llm-bf16      LLMServer decode tok/s, 0.7B config, batch 8 (bench.py --mode llm)
+  llm-int8      same with quantize="int8" (weight-only PTQ)
+  kernel-int8   pallas int8_matmul vs XLA-fused dequant matmul on the
+                llmserver decode GEMM shapes (VERDICT r2 item 4)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from functools import partial
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "tpu_sweep_results.jsonl")
+
+
+def emit(rec: dict) -> None:
+    rec = dict(rec, ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    print(json.dumps(rec), flush=True)
+
+
+def bench_resnet(batch: int, iters: int = 25) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models import get_model
+    from seldon_core_tpu.models.resnet import fold_batchnorm
+
+    model = get_model("resnet50", fused=True)
+    init_model = get_model("resnet50")
+    x0 = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = fold_batchnorm(jax.jit(init_model.init)(jax.random.PRNGKey(0), x0))
+
+    @partial(jax.jit, static_argnums=2)
+    def serve_loop(variables, pool, iters):
+        def body(x, _):
+            logits = model.apply(variables, x, train=False)
+            x = x * (1.0 + 1e-12 * jnp.mean(logits).astype(x.dtype))
+            return x, jnp.mean(logits)
+
+        _, means = jax.lax.scan(body, pool, None, length=iters)
+        return means
+
+    pool = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(0).standard_normal((batch, 224, 224, 3), dtype=np.float32)
+        ).astype(jnp.bfloat16),
+        jax.devices()[0],
+    )
+    t_c0 = time.perf_counter()
+    np.asarray(serve_loop(variables, pool, iters))  # compile + warm
+    compile_s = time.perf_counter() - t_c0
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(serve_loop(variables, pool, iters))
+        best = min(best, time.perf_counter() - t0)
+    imgs = batch * iters / best
+    # 4.09 GFLOPs/img fwd (2*2.04G MACs); v5e bf16 peak ~197 TFLOP/s
+    mfu = imgs * 4.09e9 / 197e12
+    emit({"bench": f"resnet50-folded-bf16-b{batch}", "img_per_s": round(imgs, 2),
+          "ms_per_batch": round(1e3 * best / iters, 3), "mfu_est": round(mfu, 4),
+          "compile_s": round(compile_s, 1)})
+
+
+def bench_llm(quantize: str = "") -> None:
+    from seldon_core_tpu.servers.llmserver import LLMServer
+
+    kwargs = dict(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+                  n_kv_heads=16, ffn_dim=5504, max_seq_len=2048)
+    batch, max_new, plen = 8, 128, 128
+    server = LLMServer(
+        model="transformer", model_kwargs=kwargs, init_random=True,
+        max_new_tokens=max_new, len_buckets=(plen,), batch_buckets=(batch,),
+        temperature=0.0, eos_id=-1, quantize=quantize,
+    )
+    server.load()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, kwargs["vocab_size"] - 1, size=plen).tolist()
+               for _ in range(batch)]
+    server.generate(prompts, max_new_tokens=max_new)  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = server.generate(prompts, max_new_tokens=max_new)
+        best = min(best, time.perf_counter() - t0)
+    n_tokens = sum(len(t) for t in out["tokens"])
+    emit({"bench": f"llm-decode-0.7b-b{batch}{'-' + quantize if quantize else '-bf16'}",
+          "tok_per_s": round(n_tokens / best, 2),
+          "ms_per_step": round(1e3 * best / max_new, 3)})
+
+
+def bench_int8_kernel() -> None:
+    """pallas int8_matmul vs XLA-fused dequant on llmserver decode GEMMs."""
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.ops.pallas_int8 import int8_matmul
+    from seldon_core_tpu.ops.quantize import dequantize_array, quantize_array
+
+    # decode GEMM shapes for the 0.7B config: x is (batch=8, dim), weights
+    # attn qkv/o (2048x2048), ffn up (2048x5504) / down (5504x2048)
+    shapes = [(8, 2048, 2048), (8, 2048, 5504), (8, 5504, 2048)]
+    rng = np.random.default_rng(0)
+    for m, k, n in shapes:
+        x = jnp.asarray(rng.standard_normal((m, k), dtype=np.float32), jnp.bfloat16)
+        w = rng.standard_normal((k, n), dtype=np.float32).astype(np.float32)
+        qt = quantize_array(jnp.asarray(w))
+
+        def xla_path(x, qt):
+            return x @ dequantize_array(qt, jnp.bfloat16)
+
+        def pallas_path(x, qt):
+            return int8_matmul(x, qt.q, qt.scale, out_dtype=jnp.bfloat16)
+
+        for name, fn in (("xla-dequant", xla_path), ("pallas", pallas_path)):
+            try:
+                jf = jax.jit(fn)
+                np.asarray(jf(x, qt))  # compile
+                iters = 200
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    r = jf(x, qt)
+                r.block_until_ready()
+                dt = (time.perf_counter() - t0) / iters
+                emit({"bench": f"int8-gemm-{name}-{m}x{k}x{n}",
+                      "us": round(1e6 * dt, 2),
+                      "gbytes_per_s": round((k * n + 2 * m * k) / dt / 1e9, 1)})
+            except Exception as e:  # pallas may be unsupported on this backend
+                emit({"bench": f"int8-gemm-{name}-{m}x{k}x{n}", "error": str(e)[:200]})
+
+
+def main() -> None:
+    import jax
+
+    dev = jax.devices()[0]
+    emit({"bench": "probe", "platform": dev.platform, "device": str(dev)})
+    if dev.platform != "tpu":
+        emit({"bench": "abort", "reason": "not tpu"})
+        return
+    for batch in (256, 512, 1024):
+        try:
+            bench_resnet(batch)
+        except Exception as e:
+            emit({"bench": f"resnet50-folded-bf16-b{batch}", "error": str(e)[:300]})
+    for q in ("", "int8"):
+        try:
+            bench_llm(q)
+        except Exception as e:
+            emit({"bench": f"llm-decode{'-' + q if q else '-bf16'}", "error": str(e)[:300]})
+    bench_int8_kernel()
+    emit({"bench": "done"})
+
+
+if __name__ == "__main__":
+    main()

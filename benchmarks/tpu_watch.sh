@@ -1,0 +1,22 @@
+#!/bin/sh
+# Watch for a TPU hardware window and run the sweep the moment one opens.
+# The axon tunnel wedges for hours at a time (benchmarks/MFU_NOTES.md), so:
+# probe cheaply in a subprocess with a hard timeout, and only when the probe
+# answers "tpu" launch benchmarks/tpu_sweep.py (which itself flushes every
+# result to tpu_sweep_results.jsonl as it lands).
+cd "$(dirname "$0")/.." || exit 1
+while :; do
+  plat=$(timeout 90 python -c 'import jax; print(jax.devices()[0].platform)' 2>/dev/null)
+  if [ "$plat" = "tpu" ]; then
+    echo "$(date -Is) tunnel up — running sweep" >> benchmarks/tpu_watch.log
+    timeout 3600 python benchmarks/tpu_sweep.py >> benchmarks/tpu_watch.log 2>&1
+    rc=$?
+    echo "$(date -Is) sweep exit rc=$rc" >> benchmarks/tpu_watch.log
+    if [ $rc -eq 0 ] && grep -q '"bench": "done"' benchmarks/tpu_sweep_results.jsonl 2>/dev/null; then
+      exit 0
+    fi
+  else
+    echo "$(date -Is) tunnel down (probe: '$plat')" >> benchmarks/tpu_watch.log
+  fi
+  sleep 600
+done

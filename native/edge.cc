@@ -150,6 +150,50 @@ struct Rng {
     return s1 + y;
   }
   double uniform() { return (next() >> 11) * (1.0 / 9007199254740992.0); }
+  // Marsaglia polar method (no trig); spare cached like numpy's legacy gauss.
+  double normal() {
+    if (have_spare) {
+      have_spare = false;
+      return spare;
+    }
+    double u, v, s;
+    do {
+      u = 2.0 * uniform() - 1.0;
+      v = 2.0 * uniform() - 1.0;
+      s = u * u + v * v;
+    } while (s >= 1.0 || s == 0.0);
+    double m = sqrt(-2.0 * log(s) / s);
+    spare = v * m;
+    have_spare = true;
+    return u * m;
+  }
+  // Marsaglia-Tsang; Thompson posteriors have shape = prior + mass >= 1 but
+  // the boost branch keeps it correct for shape < 1 anyway.
+  double gamma(double shape) {
+    if (shape < 1.0) {
+      double u = uniform();
+      while (u == 0.0) u = uniform();
+      return gamma(shape + 1.0) * pow(u, 1.0 / shape);
+    }
+    double d = shape - 1.0 / 3.0;
+    double c = 1.0 / sqrt(9.0 * d);
+    for (;;) {
+      double x = normal();
+      double v = 1.0 + c * x;
+      if (v <= 0) continue;
+      v = v * v * v;
+      double u = uniform();
+      if (u < 1.0 - 0.0331 * x * x * x * x) return d * v;
+      if (u > 0.0 && log(u) < 0.5 * x * x + d * (1.0 - v + log(v))) return d * v;
+    }
+  }
+  double beta(double a, double b) {
+    double x = gamma(a);
+    double y = gamma(b);
+    return x / (x + y);
+  }
+  bool have_spare = false;
+  double spare = 0;
   void puid_hex(char out[33]) {
     static const char* hex = "0123456789abcdef";
     uint64_t a = next(), b = next();
@@ -376,7 +420,12 @@ double jnum(const JValue& v) { return strtod(std::string(v.sv).c_str(), nullptr)
 // Edge program: the natively-executable graph.
 // ---------------------------------------------------------------------------
 
-enum class Kind { SimpleModel, SimpleRouter, RandomABTest, AverageCombiner };
+enum class Kind { SimpleModel, SimpleRouter, RandomABTest, AverageCombiner,
+                  EpsilonGreedy, ThompsonSampling };
+
+inline bool is_bandit(Kind k) {
+  return k == Kind::EpsilonGreedy || k == Kind::ThompsonSampling;
+}
 
 struct Unit {
   std::string name;
@@ -384,6 +433,22 @@ struct Unit {
   std::vector<int> children;
   double ratioA = 0.5;
   int n_branches = 2;
+  // bandit parameters + per-process learned state (analytics/routers.py
+  // _BanditRouter: pulls / reward_sum / fail_sum per branch, rewards clamped
+  // to [0,1]). Each edge worker learns from the feedback it receives — the
+  // same per-replica-state model as multi-replica Python engines before a
+  // G-counter sync round.
+  double epsilon = 0.1;
+  int best_branch = 0;
+  double alpha0 = 1.0, beta0 = 1.0;
+  mutable std::vector<uint64_t> pulls;
+  mutable std::vector<double> reward_sum, fail_sum;
+
+  void init_bandit_state() {
+    pulls.assign(n_branches, 0);
+    reward_sum.assign(n_branches, 0.0);
+    fail_sum.assign(n_branches, 0.0);
+  }
 };
 
 struct Program {
@@ -399,6 +464,8 @@ const char* kind_class(Kind k) {
     case Kind::SimpleRouter: return "SimpleRouter";
     case Kind::RandomABTest: return "RandomABTest";
     case Kind::AverageCombiner: return "AverageCombiner";
+    case Kind::EpsilonGreedy: return "EpsilonGreedy";
+    case Kind::ThompsonSampling: return "ThompsonSampling";
   }
   return "";
 }
@@ -432,12 +499,22 @@ bool load_program(const char* path, Program& prog) {
     else if (kind == "SIMPLE_ROUTER") unit.kind = Kind::SimpleRouter;
     else if (kind == "RANDOM_ABTEST") unit.kind = Kind::RandomABTest;
     else if (kind == "AVERAGE_COMBINER") unit.kind = Kind::AverageCombiner;
+    else if (kind == "EPSILON_GREEDY") unit.kind = Kind::EpsilonGreedy;
+    else if (kind == "THOMPSON_SAMPLING") unit.kind = Kind::ThompsonSampling;
     else return false;
     if (auto* v = doc.get(u, "ratioA")) unit.ratioA = jnum(*v);
     if (auto* v = doc.get(u, "nBranches")) unit.n_branches = (int)jnum(*v);
+    if (auto* v = doc.get(u, "epsilon")) unit.epsilon = jnum(*v);
+    if (auto* v = doc.get(u, "bestBranch")) unit.best_branch = (int)jnum(*v);
+    if (auto* v = doc.get(u, "alpha")) unit.alpha0 = jnum(*v);
+    if (auto* v = doc.get(u, "beta")) unit.beta0 = jnum(*v);
     if (auto* v = doc.get(u, "children"))
       for (int c = 0; c < v->n_children; ++c)
         unit.children.push_back((int)jnum(*doc.item(*v, c)));
+    if (is_bandit(unit.kind)) {
+      if (unit.n_branches < 1) return false;
+      unit.init_bandit_state();
+    }
     prog.units.push_back(std::move(unit));
   }
   prog.root = (int)jnum(*rootidx);
@@ -582,6 +659,13 @@ struct ExecOut {
   // collected while walking
   std::vector<std::pair<std::string_view, int>> routing;  // router name -> branch
   std::vector<std::pair<std::string_view, const char*>> path;  // unit -> class
+  // Bandit routers traversed, outermost first, with the branch-mean snapshot
+  // taken at route time — the tags fragment the Python engine merges in
+  // (routers.py tags(): {"bandit": cls, "branch_means": [...]}). The
+  // outermost router's fragment wins (engine _merge_meta: target wins, and
+  // the outer router's tags are already on the message when the inner one
+  // merges).
+  std::vector<std::pair<int, std::vector<double>>> bandit_tags;  // unit idx
   int model_visits = 0;
   Kind owner = Kind::SimpleModel;  // flow-final payload owner
   Payload out;
@@ -635,13 +719,50 @@ bool eval_unit(const Program& prog, int idx, Rng& rng, Payload in, ExecOut& out,
       return true;
     }
     case Kind::SimpleRouter:
-    case Kind::RandomABTest: {
+    case Kind::RandomABTest:
+    case Kind::EpsilonGreedy:
+    case Kind::ThompsonSampling: {
       int branch = 0;
       if (u.kind == Kind::RandomABTest) {
         if (u.n_branches == 2)
           branch = rng.uniform() < u.ratioA ? 0 : 1;
         else
           branch = (int)(rng.uniform() * u.n_branches) % u.n_branches;
+      } else if (u.kind == Kind::EpsilonGreedy) {
+        // analytics/routers.py EpsilonGreedy.route: explore with prob eps,
+        // else exploit argmax mean (best_branch before any feedback)
+        uint64_t total = 0;
+        for (uint64_t p : u.pulls) total += p;
+        if (rng.uniform() < u.epsilon) {
+          branch = (int)(rng.next() % (uint64_t)u.n_branches);
+        } else if (total == 0) {
+          branch = u.best_branch;
+        } else {
+          double best = -1.0;
+          for (int i = 0; i < u.n_branches; ++i) {
+            double mean = u.reward_sum[i] / (double)(u.pulls[i] ? u.pulls[i] : 1);
+            if (mean > best) {
+              best = mean;
+              branch = i;
+            }
+          }
+        }
+      } else if (u.kind == Kind::ThompsonSampling) {
+        // theta_i ~ Beta(alpha0 + reward_i, beta0 + fail_i), argmax
+        double best = -1.0;
+        for (int i = 0; i < u.n_branches; ++i) {
+          double theta = rng.beta(u.alpha0 + u.reward_sum[i], u.beta0 + u.fail_sum[i]);
+          if (theta > best) {
+            best = theta;
+            branch = i;
+          }
+        }
+      }
+      if (is_bandit(u.kind)) {
+        std::vector<double> means(u.n_branches);
+        for (int i = 0; i < u.n_branches; ++i)
+          means[i] = u.reward_sum[i] / (double)(u.pulls[i] ? u.pulls[i] : 1);
+        out.bandit_tags.push_back({idx, std::move(means)});
       }
       if (branch >= (int)u.children.size()) {
         out.err_code = 500;
@@ -1300,9 +1421,66 @@ struct Server {
     if (req_puid.empty()) body_buf.append(puid, 32);
     else body_buf.append(req_puid);
     body_buf.push('"');
-    if (req_tags && req_tags->n_children > 0) {
-      body_buf.append(", \"tags\": ");
-      body_buf.append(req_tags->raw);
+    // A non-object tags value can't be key-merged (and indexing it as an
+    // object would read the wrong parser arena): keep the legacy verbatim
+    // echo for it and skip the bandit fragment.
+    bool have_bandit = !ex.bandit_tags.empty();
+    if (req_tags && req_tags->type != JValue::Obj) {
+      if (req_tags->n_children > 0) {
+        body_buf.append(", \"tags\": ");
+        body_buf.append(req_tags->raw);
+      }
+    } else if (have_bandit || (req_tags && req_tags->n_children > 0)) {
+      // Merged tag dict, Python engine order/precedence (_merge_meta: the
+      // router's tags are the source, request tags the target → bandit keys
+      // render first but the request's VALUE wins on a key collision).
+      body_buf.append(", \"tags\": {");
+      bool first = true;
+      auto req_tag_value = [&](std::string_view key) -> const JValue* {
+        if (!req_tags) return nullptr;
+        for (int i = 0; i < req_tags->n_children; ++i) {
+          const auto& m = doc.obj_members[req_tags->first_child + i];
+          if (m.first == key) return &doc.nodes[m.second];
+        }
+        return nullptr;
+      };
+      if (have_bandit) {
+        const Unit& bu = prog.units[ex.bandit_tags[0].first];
+        body_buf.append("\"bandit\": ");
+        if (auto* v = req_tag_value("bandit")) {
+          body_buf.append(v->raw);
+        } else {
+          body_buf.push('"');
+          body_buf.append(kind_class(bu.kind));
+          body_buf.push('"');
+        }
+        body_buf.append(", \"branch_means\": ");
+        if (auto* v = req_tag_value("branch_means")) {
+          body_buf.append(v->raw);
+        } else {
+          body_buf.push('[');
+          const auto& means = ex.bandit_tags[0].second;
+          for (size_t i = 0; i < means.size(); ++i) {
+            if (i) body_buf.append(", ");
+            body_buf.append_double(nearbyint(means[i] * 1e6) / 1e6);  // round(x, 6)
+          }
+          body_buf.push(']');
+        }
+        first = false;
+      }
+      if (req_tags) {
+        for (int i = 0; i < req_tags->n_children; ++i) {
+          const auto& m = doc.obj_members[req_tags->first_child + i];
+          if (have_bandit && (m.first == "bandit" || m.first == "branch_means")) continue;
+          if (!first) body_buf.append(", ");
+          first = false;
+          body_buf.push('"');
+          body_buf.append(m.first);
+          body_buf.append("\": ");
+          body_buf.append(doc.nodes[m.second].raw);
+        }
+      }
+      body_buf.push('}');
     }
     if (!ex.routing.empty() || (req_routing && req_routing->n_children > 0)) {
       body_buf.append(", \"routing\": {");
@@ -1445,6 +1623,40 @@ struct Server {
     metrics.observe_api("predictions", 200, 1e-9 * (now_ns() - t0));
   }
 
+  // Feedback replay down the routed branch (engine._feedback semantics):
+  // bandit units whose name appears in response.meta.routing absorb the
+  // reward (clamped to [0,1]); descent follows the routed branch only, all
+  // children when the unit has no routing entry. Returns false (BAD_ROUTING)
+  // when a routing entry names a branch outside the unit's children.
+  bool feedback_walk(int idx,
+                     const std::vector<std::pair<std::string_view, int>>& routing,
+                     double reward) {
+    const Unit& u = prog.units[idx];
+    int branch = -1;
+    for (auto& [name, b] : routing) {
+      if (name == u.name) {
+        branch = b;
+        break;
+      }
+    }
+    if (is_bandit(u.kind) && branch >= 0 && branch < u.n_branches) {
+      double r = reward < 0 ? 0.0 : (reward > 1 ? 1.0 : reward);
+      u.pulls[branch] += 1;
+      u.reward_sum[branch] += r;
+      u.fail_sum[branch] += 1.0 - r;
+    }
+    if (u.children.empty()) return true;
+    if (branch == -1) {
+      for (int c : u.children)
+        if (!feedback_walk(c, routing, reward)) return false;
+      return true;
+    }
+    // engine._feedback: only -1 fans out; anything else outside [0, len)
+    // (including other negatives) is BAD_ROUTING
+    if (branch < 0 || branch >= (int)u.children.size()) return false;
+    return feedback_walk(u.children[branch], routing, reward);
+  }
+
   void handle_feedback(Conn& c, std::string_view body, uint64_t t0) {
     if (!prog.native) {
       forward_ring(c, 1, body, t0);
@@ -1457,8 +1669,35 @@ struct Server {
       return;
     }
     double reward = 0;
-    if (doc.nodes[0].type == JValue::Obj)
+    std::vector<std::pair<std::string_view, int>> routing_entries;
+    if (doc.nodes[0].type == JValue::Obj) {
       if (auto* r = doc.get(doc.nodes[0], "reward")) reward = jnum(*r);
+      if (auto* resp = doc.get(doc.nodes[0], "response"))
+        if (resp->type == JValue::Obj)
+          if (auto* meta = doc.get(*resp, "meta"))
+            if (meta->type == JValue::Obj)
+              if (auto* routing = doc.get(*meta, "routing"))
+                if (routing->type == JValue::Obj)
+                  for (int i = 0; i < routing->n_children; ++i) {
+                    const auto& m = doc.obj_members[routing->first_child + i];
+                    const JValue& v = doc.nodes[m.second];
+                    if (v.type != JValue::Num) {
+                      // Meta.from_dict int(v) raises -> the engine 400s;
+                      // silently coercing would train the wrong arm
+                      respond_error(c, 400, "MICROSERVICE_BAD_DATA",
+                                    "routing values must be integers");
+                      metrics.observe_api("feedback", 400, 1e-9 * (now_ns() - t0));
+                      return;
+                    }
+                    routing_entries.push_back({m.first, (int)jnum(v)});
+                  }
+    }
+    if (!feedback_walk(prog.root, routing_entries, reward)) {
+      respond_error(c, 400, "BAD_ROUTING",
+                    "Feedback routing names a branch outside the unit's children");
+      metrics.observe_api("feedback", 400, 1e-9 * (now_ns() - t0));
+      return;
+    }
     ++metrics.feedback_events;
     if (reward != 0) metrics.feedback_reward += reward < 0 ? -reward : reward;
     respond(c, 200, "OK", "{\"meta\": {}}");
@@ -1604,6 +1843,33 @@ struct Server {
     if (path == "/metrics" || path == "/prometheus") {
       Buf b;
       metrics.expose(b);
+      // bandit router state (metrics/registry.py exposes the same figures as
+      // bandit_branch_{i}_mean_reward gauges on the Python engine)
+      bool first = true;
+      for (auto& u : prog.units) {
+        if (!is_bandit(u.kind)) continue;
+        if (first) {
+          b.append("# TYPE bandit_branch_mean_reward gauge\n");
+          b.append("# TYPE bandit_branch_pulls_total counter\n");
+          first = false;
+        }
+        for (int i = 0; i < u.n_branches; ++i) {
+          b.append("bandit_branch_mean_reward{router=\"");
+          b.append(u.name);
+          b.append("\",branch=\"");
+          b.append_i64(i);
+          b.append("\"} ");
+          b.append_double(u.reward_sum[i] / (double)(u.pulls[i] ? u.pulls[i] : 1));
+          b.push('\n');
+          b.append("bandit_branch_pulls_total{router=\"");
+          b.append(u.name);
+          b.append("\",branch=\"");
+          b.append_i64(i);
+          b.append("\"} ");
+          b.append_u64(u.pulls[i]);
+          b.push('\n');
+        }
+      }
       return respond(c, 200, "OK", {b.data(), b.size()}, "text/plain; charset=utf-8");
     }
     if (path == "/seldon.json" && !openapi.empty())
@@ -1759,6 +2025,53 @@ struct Server {
       rng.puid_hex(puid);
       mw.str(1, {puid, 32});
     }
+    // Bandit router tags FIRST (for tags the request wins on key collision —
+    // engine _merge_meta target-wins — and protobuf map decoding keeps the
+    // LAST duplicate entry, so echoed request tags override these).
+    if (!ex.bandit_tags.empty()) {
+      const Unit& bu = prog.units[ex.bandit_tags[0].first];
+      {
+        Buf val;  // Value{string_value = class}
+        PbWriter vw{val};
+        vw.str(3, kind_class(bu.kind));
+        Buf e;
+        PbWriter ew{e};
+        ew.str(1, "bandit");
+        ew.tag(2, 2);
+        ew.varint(val.size());
+        e.append(val.data(), val.size());
+        mw.tag(2, 2);
+        mw.varint(e.size());
+        meta.append(e.data(), e.size());
+      }
+      {
+        Buf lv;  // ListValue{values: Value{number_value}}
+        for (double m : ex.bandit_tags[0].second) {
+          Buf num;
+          PbWriter nw{num};
+          nw.tag(2, 1);
+          nw.fixed64_raw(nearbyint(m * 1e6) / 1e6);
+          PbWriter lw{lv};
+          lw.tag(1, 2);
+          lw.varint(num.size());
+          lv.append(num.data(), num.size());
+        }
+        Buf val;  // Value{list_value = ListValue}
+        PbWriter vw{val};
+        vw.tag(6, 2);
+        vw.varint(lv.size());
+        val.append(lv.data(), lv.size());
+        Buf e;
+        PbWriter ew{e};
+        ew.str(1, "branch_means");
+        ew.tag(2, 2);
+        ew.varint(val.size());
+        e.append(val.data(), val.size());
+        mw.tag(2, 2);
+        mw.varint(e.size());
+        meta.append(e.data(), e.size());
+      }
+    }
     // Echoed request meta first, computed entries after: for duplicate map
     // keys protobuf keeps the LAST entry, which makes computed values win —
     // the proto twin of the Python engine's setdefault/overwrite semantics.
@@ -1901,17 +2214,65 @@ struct Server {
     std::string_view body = data.substr(5, mlen);
 
     if (is_feedback) {
-      // Feedback{reward = field 3 float}
+      // Feedback{request=1, response=2, reward=3 float, truth=4}; the
+      // response's meta.routing drives the bandit update + replay branch.
       PbReader r{(const uint8_t*)body.data(), (const uint8_t*)body.data() + body.size()};
       float reward = 0;
+      std::vector<std::pair<std::string_view, int>> routing_entries;
       uint32_t field, wire;
       while (r.p + 1 <= r.end && r.tag(field, wire)) {
         if (field == 3 && wire == 5 && r.end - r.p >= 4) {
           memcpy(&reward, r.p, 4);
           r.p += 4;
+        } else if (field == 2 && wire == 2) {  // response SeldonMessage
+          std::string_view resp_span;
+          if (!r.len_span(resp_span)) break;
+          PbReader rr{(const uint8_t*)resp_span.data(),
+                      (const uint8_t*)resp_span.data() + resp_span.size()};
+          uint32_t rf, rw2;
+          while (rr.p < rr.end && rr.tag(rf, rw2)) {
+            if (rf == 2 && rw2 == 2) {  // Meta
+              std::string_view meta_span;
+              if (!rr.len_span(meta_span)) break;
+              PbReader mr{(const uint8_t*)meta_span.data(),
+                          (const uint8_t*)meta_span.data() + meta_span.size()};
+              uint32_t mf, mw2;
+              while (mr.p < mr.end && mr.tag(mf, mw2)) {
+                if (mf == 3 && mw2 == 2) {  // routing map entry
+                  std::string_view entry;
+                  if (!mr.len_span(entry)) break;
+                  PbReader er{(const uint8_t*)entry.data(),
+                              (const uint8_t*)entry.data() + entry.size()};
+                  std::string_view key;
+                  uint64_t branch = 0;
+                  uint32_t ef, ew2;
+                  while (er.p < er.end && er.tag(ef, ew2)) {
+                    if (ef == 1 && ew2 == 2) {
+                      if (!er.len_span(key)) break;
+                    } else if (ef == 2 && ew2 == 0) {
+                      if (!er.varint(branch)) break;
+                    } else if (!er.skip(ew2)) {
+                      break;
+                    }
+                  }
+                  if (!key.empty()) routing_entries.push_back({key, (int)(int64_t)branch});
+                } else if (!mr.skip(mw2)) {
+                  break;
+                }
+              }
+            } else if (!rr.skip(rw2)) {
+              break;
+            }
+          }
         } else if (!r.skip(wire)) {
           break;
         }
+      }
+      if (prog.native && !feedback_walk(prog.root, routing_entries, reward)) {
+        grpc_trailers_error(c, sid, 3,
+                            "Feedback routing names a branch outside the unit's children");
+        metrics.observe_api(method, 400, 1e-9 * (now_ns() - t0));
+        return;
       }
       ++metrics.feedback_events;
       if (reward != 0) metrics.feedback_reward += reward < 0 ? -reward : reward;

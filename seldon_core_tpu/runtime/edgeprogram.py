@@ -29,6 +29,11 @@ _NATIVE_KINDS = {
     UnitImplementation.SIMPLE_ROUTER: "SIMPLE_ROUTER",
     UnitImplementation.RANDOM_ABTEST: "RANDOM_ABTEST",
     UnitImplementation.AVERAGE_COMBINER: "AVERAGE_COMBINER",
+    # Stateful bandits execute natively too (per-edge-process state, the
+    # multi-replica model of analytics/routers.py); seeded instances fall
+    # back to the Python engine, whose numpy RNG sequence they must replay.
+    UnitImplementation.EPSILON_GREEDY: "EPSILON_GREEDY",
+    UnitImplementation.THOMPSON_SAMPLING: "THOMPSON_SAMPLING",
 }
 
 _NATIVE_DIR = os.path.join(
@@ -69,10 +74,34 @@ def compile_edge_program(
         if kind is None:
             return None
         params = unit.parameters_dict()
-        if kind == "RANDOM_ABTEST" and params.get("seed") is not None:
-            # seeded routing must reproduce the Python engine's random.Random
-            # sequence exactly; only the Python engine can honor that
+        if kind in ("RANDOM_ABTEST", "EPSILON_GREEDY", "THOMPSON_SAMPLING") and (
+            params.get("seed") is not None
+        ):
+            # seeded routing must reproduce the Python engine's RNG sequence
+            # exactly; only the Python engine can honor that
             return None
+        if kind in ("EPSILON_GREEDY", "THOMPSON_SAMPLING"):
+            # Parameters the Python constructor would reject must surface as
+            # its build error, so invalid specs fall back rather than getting
+            # a silently different native default. Only the params each kind
+            # actually consumes are checked — the components ignore foreign
+            # kwargs, and a foreign param must not cost native execution.
+            try:
+                n_branches = int(params.get("n_branches", 2))
+                if n_branches < 1:
+                    return None
+                if kind == "EPSILON_GREEDY":
+                    if not 0.0 <= float(params.get("epsilon", 0.1)) <= 1.0:
+                        return None
+                    if not 0 <= int(params.get("best_branch", 0)) < n_branches:
+                        return None
+                else:
+                    if float(params.get("alpha", 1.0)) <= 0:
+                        return None
+                    if float(params.get("beta", 1.0)) <= 0:
+                        return None
+            except (TypeError, ValueError):
+                return None
         children: List[int] = []
         for child in unit.children:
             idx = compile_unit(child)
@@ -83,6 +112,14 @@ def compile_edge_program(
         if kind == "RANDOM_ABTEST":
             out["ratioA"] = float(params.get("ratioA", 0.5))
             out["nBranches"] = int(params.get("n_branches", 2))
+        elif kind == "EPSILON_GREEDY":
+            out["nBranches"] = int(params.get("n_branches", 2))
+            out["epsilon"] = float(params.get("epsilon", 0.1))
+            out["bestBranch"] = int(params.get("best_branch", 0))
+        elif kind == "THOMPSON_SAMPLING":
+            out["nBranches"] = int(params.get("n_branches", 2))
+            out["alpha"] = float(params.get("alpha", 1.0))
+            out["beta"] = float(params.get("beta", 1.0))
         units.append(out)
         return len(units) - 1
 

@@ -254,13 +254,15 @@ def test_keepalive_many_requests(edge):
 
 
 def test_fallback_mode_serves_python_engine(tmp_path):
-    """A graph the edge cannot compile (stateful bandit router) is served by
-    the Python engine behind the shared-memory ring, edge as frontend."""
+    """A graph the edge cannot compile (a SEEDED bandit router — the numpy
+    RNG sequence can only be replayed by the Python engine) is served by the
+    Python engine behind the shared-memory ring, edge as frontend."""
     spec = {
         "name": "p",
         "graph": {
             "name": "eg", "type": "ROUTER", "implementation": "EPSILON_GREEDY",
-            "parameters": [{"name": "n_branches", "value": "2", "type": "INT"}],
+            "parameters": [{"name": "n_branches", "value": "2", "type": "INT"},
+                           {"name": "seed", "value": "7", "type": "INT"}],
             "children": [
                 {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
                 {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
@@ -353,3 +355,147 @@ def test_parity_fuzz_random_payloads(edge, graph_key, spec):
             assert strip_puid(got) == want_body, (i, req)
         else:
             assert got["status"]["status"] == "FAILURE", (i, req)
+
+
+# ---------------------------------------------------------------------------
+# Native bandit routers (EPSILON_GREEDY / THOMPSON_SAMPLING in edge.cc)
+# ---------------------------------------------------------------------------
+
+EG_EXPLOIT = {
+    "name": "p",
+    "graph": {
+        "name": "eg", "type": "ROUTER", "implementation": "EPSILON_GREEDY",
+        "parameters": [
+            {"name": "n_branches", "value": "2", "type": "INT"},
+            {"name": "epsilon", "value": "0.0", "type": "FLOAT"},
+            {"name": "best_branch", "value": "1", "type": "INT"},
+        ],
+        "children": [
+            {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+            {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+        ],
+    },
+}
+TS_SPEC = {
+    "name": "p",
+    "graph": {
+        "name": "ts", "type": "ROUTER", "implementation": "THOMPSON_SAMPLING",
+        "parameters": [{"name": "n_branches", "value": "2", "type": "INT"}],
+        "children": [
+            {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+            {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+        ],
+    },
+}
+
+
+def test_bandit_compiles_native():
+    for spec in (EG_EXPLOIT, TS_SPEC):
+        prog = compile_edge_program(PredictorSpec.from_dict(spec))
+        assert prog is not None and prog["native"]
+    # seeded -> Python engine fallback (numpy RNG replay)
+    seeded = json.loads(json.dumps(EG_EXPLOIT))
+    seeded["graph"]["parameters"].append({"name": "seed", "value": "3", "type": "INT"})
+    assert compile_edge_program(PredictorSpec.from_dict(seeded)) is None
+    # invalid params -> fallback so the Python engine raises the build error
+    bad = json.loads(json.dumps(EG_EXPLOIT))
+    bad["graph"]["parameters"][1] = {"name": "epsilon", "value": "1.5", "type": "FLOAT"}
+    assert compile_edge_program(PredictorSpec.from_dict(bad)) is None
+
+
+def test_native_epsilon_greedy_parity_deterministic(edge):
+    """epsilon=0 makes the route deterministic: native edge response must be
+    byte-identical (minus puid) to the Python engine's, including the bandit
+    tags fragment, both before and after an identical feedback sequence."""
+    engine = GraphEngine(PredictorSpec.from_dict(EG_EXPLOIT))
+    port = edge("eg_exploit", EG_EXPLOIT)
+    req = {"data": {"ndarray": [[1.0, 2.0]]}}
+
+    expected = engine.predict_sync(SeldonMessage.from_dict(json.loads(json.dumps(req))))
+    status, got = post(port, "/api/v0.1/predictions", req)
+    assert status == 200
+    assert strip_puid(got) == strip_puid(expected.to_dict())
+    assert got["meta"]["routing"]["eg"] == 1
+    assert got["meta"]["tags"]["bandit"] == "EpsilonGreedy"
+
+    # identical feedback stream on both sides: branch 0 pays 1.0 (x3),
+    # branch 1 pays 0.25 (x1) -> exploit flips to branch 0
+    import asyncio
+
+    from seldon_core_tpu.contracts.payload import Feedback
+
+    fbs = [({"eg": 0}, 1.0)] * 3 + [({"eg": 1}, 0.25)]
+    for routing, reward in fbs:
+        fb = {"request": req, "response": {"meta": {"routing": routing}}, "reward": reward}
+        status, body = post(port, "/api/v0.1/feedback", fb)
+        assert status == 200 and body == {"meta": {}}
+        asyncio.run(engine.send_feedback(Feedback.from_dict(json.loads(json.dumps(fb)))))
+
+    expected = engine.predict_sync(SeldonMessage.from_dict(json.loads(json.dumps(req))))
+    status, got = post(port, "/api/v0.1/predictions", req)
+    assert status == 200
+    assert strip_puid(got) == strip_puid(expected.to_dict())
+    assert got["meta"]["routing"]["eg"] == 0
+    assert got["meta"]["tags"]["branch_means"] == [1.0, 0.25]
+
+    # bad feedback routing -> 400 BAD_ROUTING, matching the engine's raise
+    bad = {"request": req, "response": {"meta": {"routing": {"eg": 5}}}, "reward": 1.0}
+    status, body = post(port, "/api/v0.1/feedback", bad)
+    assert status == 400 and body["status"]["reason"] == "BAD_ROUTING"
+
+    # learned state surfaces on /metrics
+    status, text = get(port, "/metrics")
+    assert b'bandit_branch_mean_reward{router="eg",branch="0"} 1.0' in text
+    assert b'bandit_branch_pulls_total{router="eg",branch="1"} 1' in text
+
+
+def test_native_thompson_learns(edge):
+    """Unseeded Thompson: route is stochastic, so assert distributional
+    behavior — after heavy one-sided feedback the posterior argmax must
+    overwhelmingly pick the rewarded branch."""
+    port = edge("ts", TS_SPEC)
+    req = {"data": {"ndarray": [[1.0]]}}
+    for _ in range(40):
+        fb = {"request": req, "response": {"meta": {"routing": {"ts": 1}}}, "reward": 1.0}
+        assert post(port, "/api/v0.1/feedback", fb)[0] == 200
+    for _ in range(10):
+        fb = {"request": req, "response": {"meta": {"routing": {"ts": 0}}}, "reward": 0.0}
+        assert post(port, "/api/v0.1/feedback", fb)[0] == 200
+    picks = [post(port, "/api/v0.1/predictions", req)[1]["meta"]["routing"]["ts"]
+             for _ in range(30)]
+    # Beta(41,1) vs Beta(1,11): P(branch 1) > 0.999 per draw
+    assert sum(picks) >= 28
+    status, got = post(port, "/api/v0.1/predictions", req)
+    assert got["meta"]["tags"]["bandit"] == "ThompsonSampling"
+
+
+def test_bandit_feedback_hardening(edge):
+    """Review regressions: negative routing branches and non-integer routing
+    values must be rejected (the engine raises), never index children or
+    train an arm."""
+    port = edge("eg_exploit", EG_EXPLOIT)
+    req = {"data": {"ndarray": [[1.0]]}}
+    for bad_branch in (-2, -100):
+        fb = {"request": req, "response": {"meta": {"routing": {"eg": bad_branch}}},
+              "reward": 1.0}
+        status, body = post(port, "/api/v0.1/feedback", fb)
+        assert status == 400 and body["status"]["reason"] == "BAD_ROUTING", bad_branch
+    fb = {"request": req, "response": {"meta": {"routing": {"eg": "oops"}}}, "reward": 1.0}
+    status, body = post(port, "/api/v0.1/feedback", fb)
+    assert status == 400 and body["status"]["reason"] == "MICROSERVICE_BAD_DATA"
+    # -1 (explicit fan-out) stays accepted, matching engine._feedback
+    fb = {"request": req, "response": {"meta": {"routing": {"eg": -1}}}, "reward": 1.0}
+    assert post(port, "/api/v0.1/feedback", fb)[0] == 200
+
+
+def test_bandit_foreign_params_stay_native():
+    """A foreign parameter the component would ignore must not cost native
+    execution (review finding: cross-kind validation forced ring fallback)."""
+    spec = json.loads(json.dumps(EG_EXPLOIT))
+    spec["graph"]["parameters"].append({"name": "alpha", "value": "0.0", "type": "FLOAT"})
+    prog = compile_edge_program(PredictorSpec.from_dict(spec))
+    assert prog is not None and prog["native"]
+    ts = json.loads(json.dumps(TS_SPEC))
+    ts["graph"]["parameters"].append({"name": "epsilon", "value": "1.5", "type": "FLOAT"})
+    prog = compile_edge_program(PredictorSpec.from_dict(ts))
+    assert prog is not None and prog["native"]

@@ -288,3 +288,36 @@ def test_grpc_parity_fuzz(edge_grpc, python_grpc, graph_key, spec):
                 with pytest.raises(grpc.RpcError) as err:
                     estub(req, timeout=10)
                 assert err.value.code() == want_err, (graph_key, i)
+
+
+def test_grpc_native_bandit_parity(edge_grpc, python_grpc):
+    """Deterministic (epsilon=0) bandit over gRPC: response dicts — including
+    the bandit/branch_means tags and routing — must match the Python engine
+    before and after an identical feedback stream."""
+    from test_edge import EG_EXPLOIT
+
+    eport = edge_grpc("eg_exploit", EG_EXPLOIT)
+    pport = python_grpc("eg_exploit", EG_EXPLOIT)
+    req = ndarray_request([[1.0, 2.0]])
+    with grpc.insecure_channel(f"127.0.0.1:{eport}") as ech, \
+            grpc.insecure_channel(f"127.0.0.1:{pport}") as pch:
+        got = predict_stub(ech)(req, timeout=10)
+        want = predict_stub(pch)(req, timeout=30)
+        assert msg_dict(got) == msg_dict(want)
+        assert msg_dict(got)["meta"]["routing"]["eg"] == 1
+
+        for routing, reward in [(0, 1.0)] * 3 + [(1, 0.25)]:
+            fb = pb.Feedback()
+            fb.request.CopyFrom(req)
+            fb.response.meta.routing["eg"] = routing
+            fb.reward = reward
+            feedback_stub(ech)(fb, timeout=10)
+            feedback_stub(pch)(fb, timeout=30)
+
+        got = predict_stub(ech)(req, timeout=10)
+        want = predict_stub(pch)(req, timeout=30)
+    gd, wd = msg_dict(got), msg_dict(want)
+    assert gd == wd
+    assert gd["meta"]["routing"]["eg"] == 0
+    assert gd["meta"]["tags"]["branch_means"] == [1.0, 0.25]
+
