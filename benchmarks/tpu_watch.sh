@@ -9,10 +9,14 @@ while :; do
   plat=$(timeout 90 python -c 'import jax; print(jax.devices()[0].platform)' 2>/dev/null)
   if [ "$plat" = "tpu" ]; then
     echo "$(date -Is) tunnel up — running sweep" >> benchmarks/tpu_watch.log
+    # results jsonl is append-only across runs: count 'done' lines before and
+    # after so a stale 'done' from an earlier sweep can't fake success
+    done_before=$(grep -c '"bench": "done"' benchmarks/tpu_sweep_results.jsonl 2>/dev/null || echo 0)
     timeout 3600 python benchmarks/tpu_sweep.py >> benchmarks/tpu_watch.log 2>&1
     rc=$?
     echo "$(date -Is) sweep exit rc=$rc" >> benchmarks/tpu_watch.log
-    if [ $rc -eq 0 ] && grep -q '"bench": "done"' benchmarks/tpu_sweep_results.jsonl 2>/dev/null; then
+    done_after=$(grep -c '"bench": "done"' benchmarks/tpu_sweep_results.jsonl 2>/dev/null || echo 0)
+    if [ $rc -eq 0 ] && [ "$done_after" -gt "$done_before" ]; then
       exit 0
     fi
   else

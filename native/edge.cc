@@ -416,6 +416,57 @@ bool json_parse(const char* data, size_t len, JDoc& doc) {
 
 double jnum(const JValue& v) { return strtod(std::string(v.sv).c_str(), nullptr); }
 
+// Python-engine parity for meta.routing values: Meta.from_dict applies
+// int(v), which truncates floats, parses integer strings (surrounding
+// whitespace, optional sign, underscores between digits), and maps
+// true/false to 1/0 — and raises on anything else (so the engine 400s
+// MICROSERVICE_BAD_DATA). Values int() accepts but that name no real
+// branch fail later in feedback_walk as BAD_ROUTING, exactly like the
+// engine; out-of-int-range magnitudes are clamped (never a valid branch,
+// so the response is the same BAD_ROUTING either way). Known divergence:
+// python int() also accepts non-ASCII unicode digits; those 400 here.
+bool routing_value_to_int(const JValue& v, int& out) {
+  if (v.type == JValue::Num) {
+    double d = jnum(v);  // int(1.9) == 1 (truncation)
+    if (d != d || d == __builtin_inf() || d == -__builtin_inf())
+      return false;  // int(inf/nan) raises in python
+    if (d >= 2147483647.0) { out = 2147483647; return true; }
+    if (d <= -2147483648.0) { out = -2147483647 - 1; return true; }
+    out = (int)d;
+    return true;
+  }
+  if (v.type == JValue::Bool) {
+    out = v.b ? 1 : 0;
+    return true;
+  }
+  if (v.type == JValue::Str) {
+    const char* p = v.sv.data();
+    const char* end = p + v.sv.size();
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+    while (end > p && (end[-1] == ' ' || end[-1] == '\t' || end[-1] == '\n' ||
+                       end[-1] == '\r')) --end;
+    bool neg = false;
+    if (p < end && (*p == '+' || *p == '-')) neg = (*p++ == '-');
+    if (p == end) return false;
+    long long val = 0;
+    bool prev_digit = false;
+    for (; p < end; ++p) {
+      if (*p == '_') {  // int("1_0") == 10; "_1"/"1__0"/"1_" raise
+        if (!prev_digit || p + 1 == end || p[1] == '_') return false;
+        prev_digit = false;
+        continue;
+      }
+      if (*p < '0' || *p > '9') return false;  // int("1.5") raises in python
+      prev_digit = true;
+      if (val <= 2147483647LL) val = val * 10 + (*p - '0');
+    }
+    if (val > 2147483647LL) val = 2147483647LL;  // clamp -> BAD_ROUTING later
+    out = (int)(neg ? -val : val);
+    return true;
+  }
+  return false;  // null / arrays / objects: int(v) raises
+}
+
 // ---------------------------------------------------------------------------
 // Edge program: the natively-executable graph.
 // ---------------------------------------------------------------------------
@@ -1681,15 +1732,15 @@ struct Server {
                   for (int i = 0; i < routing->n_children; ++i) {
                     const auto& m = doc.obj_members[routing->first_child + i];
                     const JValue& v = doc.nodes[m.second];
-                    if (v.type != JValue::Num) {
-                      // Meta.from_dict int(v) raises -> the engine 400s;
-                      // silently coercing would train the wrong arm
+                    int branch;
+                    if (!routing_value_to_int(v, branch)) {
+                      // Meta.from_dict int(v) raises on these -> engine 400s
                       respond_error(c, 400, "MICROSERVICE_BAD_DATA",
                                     "routing values must be integers");
                       metrics.observe_api("feedback", 400, 1e-9 * (now_ns() - t0));
                       return;
                     }
-                    routing_entries.push_back({m.first, (int)jnum(v)});
+                    routing_entries.push_back({m.first, branch});
                   }
     }
     if (!feedback_walk(prog.root, routing_entries, reward)) {

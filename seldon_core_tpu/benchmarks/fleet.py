@@ -121,10 +121,20 @@ def run_local_fleet(
 
 
 # ---------------------------------------------------------------- workers
-def worker_serve(listen_port: int, host: str = "0.0.0.0", once: bool = False) -> None:
+def worker_serve(listen_port: int, host: str = "127.0.0.1", once: bool = False,
+                 token: Optional[str] = None) -> None:
     """Slave loop: accept a connection, read one JSON job (newline-framed),
     run it, write the JSON report back. One job at a time — load generation
-    wants the whole host."""
+    wants the whole host.
+
+    Binds loopback by default; a worker exposed beyond localhost would let
+    any TCP peer direct sustained load at an arbitrary host:port, so
+    non-loopback binds require ``token`` and reject jobs whose envelope
+    doesn't carry the matching ``token`` field."""
+    if token is None and host not in ("127.0.0.1", "localhost", "::1"):
+        raise ValueError(
+            f"refusing to bind {host} without --token: an open worker is a "
+            "traffic-amplification vector")
     srv = socket.socket()
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     srv.bind((host, listen_port))
@@ -140,6 +150,11 @@ def worker_serve(listen_port: int, host: str = "0.0.0.0", once: bool = False) ->
             line = f.readline()
             if line:
                 job = json.loads(line)
+                if token is not None and job.get("token") != token:
+                    f.write(json.dumps({"error": "bad token"}).encode() + b"\n")
+                    f.flush()
+                    continue
+                job.pop("token", None)
                 try:
                     conn.settimeout(float(job.get("duration", 10.0)) + 60.0)
                     report = run_one(job)
@@ -161,8 +176,11 @@ def worker_serve(listen_port: int, host: str = "0.0.0.0", once: bool = False) ->
 
 def run_distributed(workers: List[str], job: Dict[str, Any],
                     timeout_s: Optional[float] = None,
-                    per_worker: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
+                    per_worker: Optional[List[Dict[str, Any]]] = None,
+                    token: Optional[str] = None) -> Dict[str, Any]:
     """Master: ship the job to every worker (host:port), merge the reports."""
+    if token is not None:
+        job = dict(job, token=token)
     if timeout_s is None:
         timeout_s = float(job.get("duration", 10.0)) + float(job.get("warmup", 1.0)) + 30.0
     reports: List[Optional[Dict[str, Any]]] = [None] * len(workers)

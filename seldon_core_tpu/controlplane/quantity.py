@@ -14,17 +14,17 @@ from __future__ import annotations
 import re
 from typing import Union
 
-# k8s suffix grammar: decimal SI (k, M, G, ...), binary (Ki, Mi, ...), and
-# the milli suffix m. Plain scientific notation (e.g. "1e3") is also legal.
+# k8s suffix grammar: decimal SI (n, u, m, k, M, G, ...), binary
+# (Ki, Mi, ...). Plain scientific notation (e.g. "1e3") is also legal.
 _SUFFIXES = {
-    "m": 1e-3,
+    "n": 1e-9, "u": 1e-6, "m": 1e-3,
     "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
     "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
 }
 
 _QUANTITY_RE = re.compile(
     r"^(?P<num>[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?)"
-    r"(?P<suffix>m|k|Ki|[MGTPE]i?)?$"
+    r"(?P<suffix>n|u|m|k|Ki|[MGTPE]i?)?$"
 )
 
 

@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 from collections import OrderedDict
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -121,6 +122,7 @@ class LLMServer(SeldonComponent):
         sequence_parallel: int = 0,
         quantize: str = "",
         prefix_cache_size: int = 0,
+        prefix_cache_bytes: int = 0,
         seed: int = 0,
         **kwargs: Any,
     ):
@@ -147,8 +149,15 @@ class LLMServer(SeldonComponent):
         # of the longest previously-prefilled token prefix (shared system
         # prompts prefill once); entries are LRU-evicted past this size.
         # Safe to share: jax arrays are immutable, decode never mutates them.
+        # Each entry pins full per-layer KV caches of max_len, so the count
+        # bound alone can hold multi-GB of HBM — prefix_cache_bytes (default
+        # 512 MB whenever the cache is enabled) bounds the total pinned bytes.
         self.prefix_cache_size = int(prefix_cache_size)
+        self.prefix_cache_bytes = int(prefix_cache_bytes) or (
+            512 * 1024 * 1024 if self.prefix_cache_size else 0)
         self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._prefix_bytes = 0
+        self._prefix_lock = threading.Lock()
         self._prefix_hits = 0
         self.seed = int(seed)
         self.ready = False
@@ -322,27 +331,58 @@ class LLMServer(SeldonComponent):
         self._prefill_cache[key] = extend
         return extend
 
+    @staticmethod
+    def _entry_nbytes(caches, last_logits) -> int:
+        n = int(getattr(last_logits, "nbytes", 0))
+        for layer in caches:
+            for arr in layer:
+                n += int(getattr(arr, "nbytes", 0))
+        return n
+
     def _prefix_lookup(self, tokens: List[int], max_len: int):
         """Longest cached prefix of ``tokens`` with a compatible cache size;
         returns (prefix_len, caches, last_logits) or None. Exact full-prompt
         hits return the stored logits so prefill is skipped entirely."""
-        best = None
-        for key, (entry_max_len, caches, last_logits) in self._prefix_cache.items():
-            k = len(key)
-            if entry_max_len != max_len or k > len(tokens):
-                continue
-            if list(key) == tokens[:k] and (best is None or k > best[0]):
-                best = (k, caches, last_logits)
-        if best is not None:
-            self._prefix_cache.move_to_end(tuple(tokens[: best[0]]))
-        return best
+        with self._prefix_lock:
+            best = None
+            for key, (entry_max_len, caches, last_logits, _nb) in self._prefix_cache.items():
+                k = len(key)
+                if entry_max_len != max_len or k > len(tokens):
+                    continue
+                if list(key) == tokens[:k] and (best is None or k > best[0]):
+                    best = (k, caches, last_logits)
+            if best is not None:
+                self._prefix_cache.move_to_end(tuple(tokens[: best[0]]))
+            return best
 
     def _prefix_store(self, tokens: List[int], max_len: int, caches, last_logits):
         key = tuple(tokens)
-        self._prefix_cache[key] = (max_len, caches, last_logits)
-        self._prefix_cache.move_to_end(key)
-        while len(self._prefix_cache) > self.prefix_cache_size:
-            self._prefix_cache.popitem(last=False)
+        nbytes = self._entry_nbytes(caches, last_logits)
+        if self.prefix_cache_bytes and nbytes > self.prefix_cache_bytes:
+            # A single over-budget entry would evict everything else. Warn
+            # (once) instead of silently never populating: a large-model
+            # config can exceed the default budget on every entry, which
+            # would otherwise look like a mysterious 0% hit rate.
+            if not getattr(self, "_prefix_overbudget_warned", False):
+                self._prefix_overbudget_warned = True
+                logger.warning(
+                    "prefix cache entry (%d bytes) exceeds prefix_cache_bytes "
+                    "(%d); nothing will be cached — raise prefix_cache_bytes "
+                    "for this model size", nbytes, self.prefix_cache_bytes)
+            return
+        with self._prefix_lock:
+            old = self._prefix_cache.pop(key, None)
+            if old is not None:
+                self._prefix_bytes -= old[3]
+            self._prefix_cache[key] = (max_len, caches, last_logits, nbytes)
+            self._prefix_bytes += nbytes
+            while self._prefix_cache and (
+                len(self._prefix_cache) > self.prefix_cache_size
+                or (self.prefix_cache_bytes
+                    and self._prefix_bytes > self.prefix_cache_bytes)
+            ):
+                _, (_, _, _, nb) = self._prefix_cache.popitem(last=False)
+                self._prefix_bytes -= nb
 
     def _get_prefill(self, b: int, plen: int, max_len: int):
         key = (b, plen, max_len)

@@ -358,7 +358,7 @@ def run_analytics(args: argparse.Namespace) -> None:
 def run_loadtest_worker(args: argparse.Namespace) -> None:
     from seldon_core_tpu.benchmarks.fleet import worker_serve
 
-    worker_serve(args.listen, host=args.host, once=args.once)
+    worker_serve(args.listen, host=args.host, once=args.once, token=args.token)
 
 
 def run_loadtest_fleet(args: argparse.Namespace) -> None:
@@ -394,7 +394,7 @@ def run_loadtest_fleet(args: argparse.Namespace) -> None:
         "path": args.path,
     }
     if workers:
-        report = run_distributed(workers, job, per_worker=per_worker)
+        report = run_distributed(workers, job, per_worker=per_worker, token=args.token)
     else:
         report = run_local_fleet(job, n_workers, per_worker=per_worker)
     out = json.dumps(report, indent=2)
@@ -531,7 +531,10 @@ def main(argv: Optional[list] = None) -> None:
 
     ltw = sub.add_parser("loadtest-worker", help="fleet slave: run loadgen jobs sent over TCP")
     ltw.add_argument("--listen", type=int, required=True)
-    ltw.add_argument("--host", default="0.0.0.0")
+    ltw.add_argument("--host", default="127.0.0.1",
+                     help="bind address; non-loopback requires --token")
+    ltw.add_argument("--token", default=None,
+                     help="shared secret jobs must carry (required off-loopback)")
     ltw.add_argument("--once", action="store_true")
     ltw.set_defaults(func=run_loadtest_worker)
 
@@ -554,6 +557,8 @@ def main(argv: Optional[list] = None) -> None:
                           "drawn from the feature ranges (REST only)")
     ltf.add_argument("--batch", type=int, default=1, help="rows per contract payload")
     ltf.add_argument("--path", default=None)
+    ltf.add_argument("--token", default=None,
+                     help="shared secret for remote workers bound off-loopback")
     ltf.add_argument("--report", default=None, help="write merged JSON report here")
     ltf.set_defaults(func=run_loadtest_fleet)
 

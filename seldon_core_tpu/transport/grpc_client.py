@@ -17,7 +17,12 @@ from seldon_core_tpu.contracts.payload import Feedback, SeldonMessage, SeldonMes
 from seldon_core_tpu.transport import proto_convert as pc
 from seldon_core_tpu.transport.proto import prediction_pb2 as pb
 
-_channels: Dict[Tuple[str, tuple], grpc.Channel] = {}
+# Cache entries hold (channel, credentials): keeping a strong reference to
+# the credentials object pins its id() for the life of the entry, so a
+# recycled id can never alias a dead credential's cached channel (the cache
+# would otherwise hand a channel built with different TLS material to a new
+# credentials object allocated at the same address).
+_channels: Dict[Tuple[str, tuple, Optional[int]], Tuple[grpc.Channel, Any]] = {}
 _lock = threading.Lock()
 
 # method -> (service owning it for the Generic path, request serializer, from-dataclass)
@@ -59,17 +64,18 @@ def get_channel(
     credentials: Optional[grpc.ChannelCredentials] = None,
 ) -> grpc.Channel:
     # key on the credentials object identity: two clients with different TLS
-    # material to the same target must not share a channel
+    # material to the same target must not share a channel. The entry pins the
+    # credentials object so its id() stays unique while the key is live.
     key = (target, tuple(options or ()), id(credentials) if credentials is not None else None)
     with _lock:
-        ch = _channels.get(key)
-        if ch is None:
+        entry = _channels.get(key)
+        if entry is None:
             if credentials is not None:
                 ch = grpc.secure_channel(target, credentials, options=options)
             else:
                 ch = grpc.insecure_channel(target, options=options)
-            _channels[key] = ch
-        return ch
+            _channels[key] = entry = (ch, credentials)
+        return entry[0]
 
 
 def _to_proto(msg: Any):

@@ -449,6 +449,51 @@ def test_native_epsilon_greedy_parity_deterministic(edge):
     assert b'bandit_branch_pulls_total{router="eg",branch="1"} 1' in text
 
 
+def test_feedback_routing_value_coercion_parity(edge):
+    """Meta.from_dict applies int(v) to routing values, so the Python engine
+    accepts numeric strings and booleans; the native edge must coerce the
+    same set and 400 the same set (non-integer strings, null, arrays)."""
+    import asyncio
+
+    from seldon_core_tpu.contracts.payload import Feedback
+
+    engine = GraphEngine(PredictorSpec.from_dict(EG_EXPLOIT))
+    port = edge("eg_exploit", EG_EXPLOIT)
+    req = {"data": {"ndarray": [[1.0, 2.0]]}}
+
+    # "2000000000" fits int; 1e300 / "9999999999" clamp to INT_MAX natively
+    # and int() fine in python — both sides then 400 BAD_ROUTING (branch
+    # outside children), asserted below via the out-of-range check
+    for routing_val in ("1", " 1 ", "+1", True, False, 1.9):
+        fb = {"request": req, "response": {"meta": {"routing": {"eg": routing_val}}},
+              "reward": 1.0}
+        # python engine accepts (int(v) succeeds)
+        asyncio.run(engine.send_feedback(Feedback.from_dict(json.loads(json.dumps(fb)))))
+        status, body = post(port, "/api/v0.1/feedback", fb)
+        assert status == 200 and body == {"meta": {}}, (routing_val, body)
+
+    for routing_val in ("1.5", "x", None, [1], {"a": 1}, "", "1__0", "_1", "1_"):
+        fb = {"request": req, "response": {"meta": {"routing": {"eg": routing_val}}},
+              "reward": 1.0}
+        with pytest.raises(Exception):
+            asyncio.run(engine.send_feedback(
+                Feedback.from_dict(json.loads(json.dumps(fb)))))
+        status, body = post(port, "/api/v0.1/feedback", fb)
+        assert status == 400, (routing_val, body)
+
+    # int()-acceptable but out of any branch range: both sides 400 BAD_ROUTING
+    # (1e300 would be UB in a raw double->int cast; the edge clamps instead)
+    for routing_val in (1e300, -1e300, "2000000000", "9999999999999", 2**31, "1_0"):
+        fb = {"request": req, "response": {"meta": {"routing": {"eg": routing_val}}},
+              "reward": 1.0}
+        with pytest.raises(Exception):
+            asyncio.run(engine.send_feedback(
+                Feedback.from_dict(json.loads(json.dumps(fb)))))
+        status, body = post(port, "/api/v0.1/feedback", fb)
+        assert status == 400 and body["status"]["reason"] == "BAD_ROUTING", \
+            (routing_val, body)
+
+
 def test_native_thompson_learns(edge):
     """Unseeded Thompson: route is stochastic, so assert distributional
     behavior — after heavy one-sided feedback the posterior argmax must
