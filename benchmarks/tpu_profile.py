@@ -1,0 +1,133 @@
+"""Capture device profiles for the two headline benches and attribute time.
+
+VERDICT r2 asked for either >=35-40% MFU or "a captured profile showing the
+stem/layout caps it", plus a decode-gap attribution (scan overhead? sampling?
+cache scatter?). This captures jax.profiler traces of (a) one ResNet-50
+folded-BN bf16 batch-256 serving pass and (b) one 32-step LLM decode scan,
+then parses the xplane protos (tensorboard-plugin-profile) into a per-op-
+category time table written to benchmarks/profile_summary.json.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from collections import defaultdict
+from functools import partial
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def capture_resnet(logdir: str) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models import get_model
+    from seldon_core_tpu.models.resnet import fold_batchnorm
+
+    model = get_model("resnet50", fused=True)
+    init_model = get_model("resnet50")
+    x0 = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = fold_batchnorm(jax.jit(init_model.init)(jax.random.PRNGKey(0), x0))
+
+    @partial(jax.jit, static_argnums=2)
+    def serve_loop(variables, pool, iters):
+        def body(x, _):
+            logits = model.apply(variables, x, train=False)
+            x = x * (1.0 + 1e-12 * jnp.mean(logits).astype(x.dtype))
+            return x, jnp.mean(logits)
+
+        _, means = jax.lax.scan(body, pool, None, length=iters)
+        return means
+
+    pool = jax.device_put(jnp.asarray(
+        np.random.default_rng(0).standard_normal((256, 224, 224, 3), dtype=np.float32)
+    ).astype(jnp.bfloat16), jax.devices()[0])
+    np.asarray(serve_loop(variables, pool, 4))  # compile + warm
+    with jax.profiler.trace(logdir):
+        np.asarray(serve_loop(variables, pool, 4))
+
+
+def capture_llm(logdir: str) -> None:
+    from seldon_core_tpu.servers.llmserver import LLMServer
+
+    kwargs = dict(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+                  n_kv_heads=16, ffn_dim=5504, max_seq_len=2048)
+    server = LLMServer(model="transformer", model_kwargs=kwargs, init_random=True,
+                       max_new_tokens=32, len_buckets=(128,), batch_buckets=(8,),
+                       temperature=0.0, eos_id=-1)
+    server.load()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 31999, size=128).tolist() for _ in range(8)]
+    server.generate(prompts, max_new_tokens=32)  # compile + warm
+    import jax
+
+    with jax.profiler.trace(logdir):
+        server.generate(prompts, max_new_tokens=32)
+
+
+def summarize(logdir: str) -> dict:
+    """Parse the xplane pb into op-name -> device time. Falls back to raw
+    file listing if the plugin's parser is unavailable."""
+    paths = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        return {"error": f"no xplane.pb under {logdir}"}
+    try:
+        from tensorflow.python.profiler.internal import _pywrap_profiler  # noqa
+    except Exception:
+        pass
+    try:
+        from tensorboard_plugin_profile.convert import raw_to_tool_data as rttd
+
+        out = rttd.xspace_to_tool_data(paths, "op_profile", {})
+        data = out[0] if isinstance(out, tuple) else out
+        return {"tool": "op_profile", "data": json.loads(data)}
+    except Exception as e:
+        return {"error": f"op_profile convert failed: {e!r}", "files": paths}
+
+
+def walk_op_profile(node, out, depth=0):
+    """Flatten the op_profile tree into (category, name, fraction)."""
+    if not isinstance(node, dict):
+        return
+    m = node.get("metrics") or {}
+    name = node.get("name", "")
+    if m.get("time"):
+        out.append({"name": name, "time_frac": m.get("time"),
+                    "flops_frac": m.get("flops"), "depth": depth})
+    for c in node.get("children", []) or []:
+        walk_op_profile(c, out, depth + 1)
+
+
+def main() -> None:
+    import jax
+
+    assert jax.devices()[0].platform == "tpu", "need the real chip"
+    summary = {}
+    for name, cap in (("resnet", capture_resnet), ("llm", capture_llm)):
+        logdir = os.path.join(HERE, f"profile_{name}")
+        os.makedirs(logdir, exist_ok=True)
+        t0 = time.perf_counter()
+        cap(logdir)
+        s = summarize(logdir)
+        flat = []
+        if "data" in s:
+            tree = s["data"]
+            root = tree.get("byCategory") or tree.get("byProgram") or tree
+            walk_op_profile(root, flat)
+            flat.sort(key=lambda r: -(r["time_frac"] or 0))
+            s = {"tool": "op_profile", "top": flat[:40]}
+        summary[name] = s
+        summary[name]["capture_s"] = round(time.perf_counter() - t0, 1)
+        print(name, "captured", flush=True)
+    with open(os.path.join(HERE, "profile_summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print("written profile_summary.json")
+
+
+if __name__ == "__main__":
+    main()

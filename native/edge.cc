@@ -36,6 +36,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <functional>
 #include <memory>
 #include <string>
 #include <string_view>
@@ -471,7 +472,7 @@ bool routing_value_to_int(const JValue& v, int& out) {
 // Edge program: the natively-executable graph.
 // ---------------------------------------------------------------------------
 
-enum class Kind { SimpleModel, SimpleRouter, RandomABTest, AverageCombiner,
+enum class Kind { DeviceModel, SimpleModel, SimpleRouter, RandomABTest, AverageCombiner,
                   EpsilonGreedy, ThompsonSampling };
 
 inline bool is_bandit(Kind k) {
@@ -482,6 +483,10 @@ struct Unit {
   std::string name;
   Kind kind;
   std::vector<int> children;
+  // DEVICE_MODEL: real model executed by the engine process's ModelExecutor
+  // over the ring (transport/ipc.py kind 2); the edge ships only the tensor.
+  int model_id = -1;
+  std::string class_name;  // requestPath value, e.g. "JAXServer"
   double ratioA = 0.5;
   int n_branches = 2;
   // bandit parameters + per-process learned state (analytics/routers.py
@@ -507,10 +512,12 @@ struct Program {
   std::vector<Unit> units;
   int root = -1;
   bool native = false;  // false => every request goes over the ring
+  bool has_device = false;  // any DEVICE_MODEL unit (needs the ring too)
 };
 
 const char* kind_class(Kind k) {
   switch (k) {
+    case Kind::DeviceModel: return "DeviceModel";  // overridden by class_name
     case Kind::SimpleModel: return "SimpleModel";
     case Kind::SimpleRouter: return "SimpleRouter";
     case Kind::RandomABTest: return "RandomABTest";
@@ -552,7 +559,14 @@ bool load_program(const char* path, Program& prog) {
     else if (kind == "AVERAGE_COMBINER") unit.kind = Kind::AverageCombiner;
     else if (kind == "EPSILON_GREEDY") unit.kind = Kind::EpsilonGreedy;
     else if (kind == "THOMPSON_SAMPLING") unit.kind = Kind::ThompsonSampling;
+    else if (kind == "DEVICE_MODEL") {
+      unit.kind = Kind::DeviceModel;
+      prog.has_device = true;
+    }
     else return false;
+    if (auto* v = doc.get(u, "modelId")) unit.model_id = (int)jnum(*v);
+    if (auto* v = doc.get(u, "className")) unit.class_name = std::string(v->sv);
+    if (unit.kind == Kind::DeviceModel && unit.model_id < 0) return false;
     if (auto* v = doc.get(u, "ratioA")) unit.ratioA = jnum(*v);
     if (auto* v = doc.get(u, "nBranches")) unit.n_branches = (int)jnum(*v);
     if (auto* v = doc.get(u, "epsilon")) unit.epsilon = jnum(*v);
@@ -732,11 +746,19 @@ struct EdgeError {
   std::string info;
 };
 
-// Recursive eval; returns flow-final payload owner kind.
+// Recursive eval; returns flow-final payload owner kind. Never sees
+// DeviceModel units — those graphs run eval_device (the handler branches
+// on prog.has_device before reaching here).
 bool eval_unit(const Program& prog, int idx, Rng& rng, Payload in, ExecOut& out,
                Payload& result, Kind& owner) {
   const Unit& u = prog.units[idx];
   switch (u.kind) {
+    case Kind::DeviceModel: {
+      out.err_code = 500;
+      out.err_reason = "INTERNAL_ERROR";
+      out.err_info = "DeviceModel unit reached the stub evaluator";
+      return false;
+    }
     case Kind::SimpleModel: {
       Payload mine;
       if (in.kind == PKind::Str || in.kind == PKind::Bin) {
@@ -852,6 +874,240 @@ bool eval_unit(const Program& prog, int idx, Rng& rng, Payload in, ExecOut& out,
       out.path.push_back({u.name, kind_class(u.kind)});
       result = merged;
       owner = Kind::AverageCombiner;
+      return true;
+    }
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------------------
+// Device-graph execution: graphs mixing builtin units with DEVICE_MODEL
+// leaves. The edge evaluates routing/combining natively and ships each
+// model leaf's input tensor to the engine process (ring kind 2); payload
+// values flow as real numbers (the stub path above never materialises them).
+// ---------------------------------------------------------------------------
+
+// bf16-era float32 constants of the SimpleModel stub, as python floats
+constexpr double kStubVals[3] = {(double)0.1f, (double)0.9f, (double)0.5f};
+
+struct DVal {
+  enum T { Resolved, Site, Avg } t = Resolved;
+  std::vector<double> vals;
+  std::vector<uint32_t> dims;
+  uint8_t dtype = 0;  // 0=f32, 1=f64 — np.mean parity needs the math dtype
+  int site = -1;      // t==Site: index into DevExec::sites
+  std::vector<DVal> ch;  // t==Avg
+};
+
+struct DevSite {
+  int unit_idx = -1;
+  uint32_t req_id = 0;
+  bool done = false;
+  // request tensor (shipped) and response tensor (filled by drain)
+  std::vector<uint32_t> req_dims;
+  std::vector<double> req_vals;
+  std::vector<uint32_t> dims;
+  std::vector<double> vals;
+  uint8_t dtype = 0;
+  std::string fragment;  // executor JSON: {"names":[...],"tags":{},"metrics":[...]}
+};
+
+// Per-traversal-order metric source: a builtin stub visit or a device site.
+struct MetricSrc {
+  int site = -1;  // -1 => builtin SimpleModel constants
+};
+
+struct DevExec {
+  int conn_fd = -1;
+  uint32_t conn_gen = 0;
+  uint64_t t0 = 0;
+  std::string body;  // request copy: doc's spans point into this
+  JDoc doc;          // parsed ONCE over body; survives the park
+  ExecOut ex;
+  DVal result;
+  std::vector<DevSite> sites;
+  std::vector<MetricSrc> metric_srcs;  // traversal order
+  int outstanding = 0;
+  Kind owner = Kind::SimpleModel;
+  int owner_site = -1;   // owner==DeviceModel: which site names the payload
+  PKind resp_kind = PKind::NDArray;
+};
+
+// Recursive eval for device graphs. Routing/bandit logic deliberately
+// mirrors eval_unit above (the stub path); divergence between the two is
+// covered by the randomized parity fuzz in tests/test_edge.py.
+bool eval_device(const Program& prog, int idx, Rng& rng, const DVal& in,
+                 ExecOut& out, std::vector<DevSite>& sites,
+                 std::vector<MetricSrc>& metric_srcs, DVal& result,
+                 Kind& owner, int& owner_site) {
+  const Unit& u = prog.units[idx];
+  switch (u.kind) {
+    case Kind::DeviceModel: {
+      DevSite site;
+      site.unit_idx = idx;
+      site.req_dims = in.dims;
+      site.req_vals = in.vals;
+      sites.push_back(std::move(site));
+      metric_srcs.push_back({(int)sites.size() - 1});
+      result = DVal{};
+      result.t = DVal::Site;
+      result.site = (int)sites.size() - 1;
+      owner = Kind::DeviceModel;
+      owner_site = result.site;
+      out.path.push_back({u.name, u.class_name.c_str()});
+      return true;
+    }
+    case Kind::SimpleModel: {
+      int64_t rows = in.dims.size() >= 2 ? in.dims[0] : 1;
+      DVal mine;
+      mine.dims = {(uint32_t)rows, 3};
+      mine.vals.reserve(rows * 3);
+      for (int64_t r = 0; r < rows; ++r)
+        for (double v : kStubVals) mine.vals.push_back(v);
+      ++out.model_visits;
+      metric_srcs.push_back({-1});
+      Kind sub_owner = Kind::SimpleModel;
+      int sub_site = -1;
+      DVal final_out = mine;
+      if (!u.children.empty()) {
+        if (!eval_device(prog, u.children[0], rng, mine, out, sites,
+                         metric_srcs, final_out, sub_owner, sub_site))
+          return false;
+      }
+      out.path.push_back({u.name, kind_class(u.kind)});
+      result = std::move(final_out);
+      owner = u.children.empty() ? Kind::SimpleModel : sub_owner;
+      owner_site = u.children.empty() ? -1 : sub_site;
+      return true;
+    }
+    case Kind::SimpleRouter:
+    case Kind::RandomABTest:
+    case Kind::EpsilonGreedy:
+    case Kind::ThompsonSampling: {
+      int branch = 0;
+      if (u.kind == Kind::RandomABTest) {
+        if (u.n_branches == 2)
+          branch = rng.uniform() < u.ratioA ? 0 : 1;
+        else
+          branch = (int)(rng.uniform() * u.n_branches) % u.n_branches;
+      } else if (u.kind == Kind::EpsilonGreedy) {
+        uint64_t total = 0;
+        for (uint64_t p : u.pulls) total += p;
+        if (rng.uniform() < u.epsilon) {
+          branch = (int)(rng.next() % (uint64_t)u.n_branches);
+        } else if (total == 0) {
+          branch = u.best_branch;
+        } else {
+          double best = -1.0;
+          for (int i = 0; i < u.n_branches; ++i) {
+            double mean = u.reward_sum[i] / (double)(u.pulls[i] ? u.pulls[i] : 1);
+            if (mean > best) {
+              best = mean;
+              branch = i;
+            }
+          }
+        }
+      } else if (u.kind == Kind::ThompsonSampling) {
+        double best = -1.0;
+        for (int i = 0; i < u.n_branches; ++i) {
+          double theta = rng.beta(u.alpha0 + u.reward_sum[i], u.beta0 + u.fail_sum[i]);
+          if (theta > best) {
+            best = theta;
+            branch = i;
+          }
+        }
+      }
+      if (is_bandit(u.kind)) {
+        std::vector<double> means(u.n_branches);
+        for (int i = 0; i < u.n_branches; ++i)
+          means[i] = u.reward_sum[i] / (double)(u.pulls[i] ? u.pulls[i] : 1);
+        out.bandit_tags.push_back({idx, std::move(means)});
+      }
+      if (branch >= (int)u.children.size()) {
+        out.err_code = 500;
+        out.err_reason = "BAD_ROUTING";
+        out.err_info = "router returned branch outside children";
+        return false;
+      }
+      out.routing.push_back({u.name, branch});
+      if (!eval_device(prog, u.children[branch], rng, in, out, sites,
+                       metric_srcs, result, owner, owner_site))
+        return false;
+      out.path.push_back({u.name, kind_class(u.kind)});
+      return true;
+    }
+    case Kind::AverageCombiner: {
+      DVal merged;
+      merged.t = DVal::Avg;
+      Kind sub_owner;
+      int sub_site;
+      for (size_t i = 0; i < u.children.size(); ++i) {
+        DVal child_out;
+        if (!eval_device(prog, u.children[i], rng, in, out, sites,
+                         metric_srcs, child_out, sub_owner, sub_site))
+          return false;
+        merged.ch.push_back(std::move(child_out));
+      }
+      if (u.children.empty()) merged = in;
+      out.path.push_back({u.name, kind_class(u.kind)});
+      result = std::move(merged);
+      owner = Kind::AverageCombiner;
+      owner_site = -1;
+      return true;
+    }
+  }
+  return false;
+}
+
+// Resolve the dataflow tree once every site's response landed. np.mean
+// parity: all-f32 children accumulate in f32, any f64 promotes the math.
+bool resolve_dval(const DVal& v, const std::vector<DevSite>& sites,
+                  std::vector<double>& vals, std::vector<uint32_t>& dims,
+                  uint8_t& dtype, std::string& err) {
+  switch (v.t) {
+    case DVal::Resolved:
+      vals = v.vals;
+      dims = v.dims;
+      dtype = v.dtype;
+      return true;
+    case DVal::Site:
+      vals = sites[v.site].vals;
+      dims = sites[v.site].dims;
+      dtype = sites[v.site].dtype;
+      return true;
+    case DVal::Avg: {
+      if (v.ch.empty()) {
+        err = "AverageCombiner requires children";
+        return false;
+      }
+      std::vector<std::vector<double>> child_vals(v.ch.size());
+      uint8_t promote = 0;
+      for (size_t i = 0; i < v.ch.size(); ++i) {
+        std::vector<uint32_t> cdims;
+        uint8_t cdtype;
+        if (!resolve_dval(v.ch[i], sites, child_vals[i], cdims, cdtype, err))
+          return false;
+        if (i == 0) dims = cdims;
+        else if (cdims != dims) {
+          err = "AverageCombiner inputs must share a shape";
+          return false;
+        }
+        if (cdtype) promote = 1;
+      }
+      dtype = promote;
+      size_t n = child_vals[0].size();
+      vals.assign(n, 0.0);
+      for (size_t e = 0; e < n; ++e) {
+        if (promote) {
+          double acc = 0.0;
+          for (auto& cv : child_vals) acc += cv[e];
+          vals[e] = acc / (double)child_vals.size();
+        } else {
+          float acc = 0.0f;
+          for (auto& cv : child_vals) acc += (float)cv[e];
+          vals[e] = (double)(acc / (float)child_vals.size());
+        }
+      }
       return true;
     }
   }
@@ -1307,6 +1563,8 @@ struct Server {
   uint32_t ring_slot = 0;
   uint32_t next_req_id = 1;
   std::unordered_map<uint32_t, RingPending> pending;
+  // device-graph requests: one entry per outstanding model call
+  std::unordered_map<uint32_t, std::pair<DevExec*, int>> pending_dev;
   uint16_t ring_worker_id = 0;
   std::vector<char> ring_buf;  // reused drain buffer (slot-sized)
   static constexpr uint64_t kRingTimeoutNs = 30ull * 1000000000ull;
@@ -1369,6 +1627,12 @@ struct Server {
     }
     if (!prog.native) {
       forward_ring(c, 0, body, t0);
+      return;
+    }
+    if (prog.has_device) {
+      // device graphs own their parse: the doc must outlive the park, so it
+      // is built once over the DevExec's body copy (no re-parse at finish)
+      handle_predictions_device(c, body, t0);
       return;
     }
     JDoc doc;
@@ -1783,6 +2047,536 @@ struct Server {
     arm_timer();
   }
 
+  // ---- device graphs: parse numeric payload, eval, ship model calls ----
+  void handle_predictions_device(Conn& c, std::string_view body, uint64_t t0) {
+    auto* st = new DevExec();
+    st->body.assign(body.data(), body.size());
+    JDoc& doc = st->doc;
+    if (!json_parse(st->body.data(), st->body.size(), doc)) {
+      std::string info =
+          std::string("Invalid JSON body: ") + (doc.err ? doc.err : "parse error");
+      respond_error(c, 400, "MICROSERVICE_BAD_DATA", info);
+      metrics.observe_api("predictions", 400, 1e-9 * (now_ns() - t0));
+      delete st;
+      return;
+    }
+    const JValue& root = doc.nodes[0];
+    if (root.type != JValue::Obj) {
+      respond_error(c, 400, "MICROSERVICE_BAD_DATA", "request must be a JSON object");
+      metrics.observe_api("predictions", 400, 1e-9 * (now_ns() - t0));
+      delete st;
+      return;
+    }
+    const JValue* data = doc.get(root, "data");
+    const JValue* tensor = nullptr;
+    PKind pkind = PKind::None;
+    if (data && data->type == JValue::Obj) {
+      if (doc.get(*data, "ndarray")) pkind = PKind::NDArray;
+      else if ((tensor = doc.get(*data, "tensor"))) pkind = PKind::Tensor;
+    } else if (doc.get(root, "strData") || doc.get(root, "binData") ||
+               doc.get(root, "jsonData")) {
+      pkind = PKind::Str;  // any non-numeric payload: full-graph ring below
+    }
+    // Exotic payloads (echo semantics, jsonData, request names feeding a
+    // component, ragged/deep arrays, odd tensors) ride the full-graph ring:
+    // the Python engine is the semantics oracle off the numeric hot path.
+    if (pkind != PKind::NDArray && pkind != PKind::Tensor) {
+      delete st;
+      return forward_ring(c, 0, body, t0);
+    }
+    if (data && doc.get(*data, "names")) {
+      delete st;
+      return forward_ring(c, 0, body, t0);
+    }
+
+    DVal input;
+    input.dtype = 1;  // request JSON numbers are python floats
+    if (pkind == PKind::NDArray) {
+      const JValue* nd = doc.get(*data, "ndarray");
+      if (nd->type != JValue::Arr) {
+        respond_error(c, 400, "MICROSERVICE_BAD_DATA", "ndarray must be an array");
+        metrics.observe_api("predictions", 400, 1e-9 * (now_ns() - t0));
+        delete st;
+        return;
+      }
+      bool two_d = nd->n_children > 0 && doc.item(*nd, 0)->type == JValue::Arr;
+      if (!two_d) {
+        input.dims = {(uint32_t)nd->n_children};
+        for (int i = 0; i < nd->n_children; ++i) {
+          const JValue* e = doc.item(*nd, i);
+          if (e->type != JValue::Num) { delete st; return forward_ring(c, 0, body, t0); }
+          input.vals.push_back(jnum(*e));
+        }
+      } else {
+        int rows = nd->n_children;
+        int cols = doc.item(*nd, 0)->n_children;
+        input.dims = {(uint32_t)rows, (uint32_t)cols};
+        input.vals.reserve((size_t)rows * cols);
+        for (int r = 0; r < rows; ++r) {
+          const JValue* row = doc.item(*nd, r);
+          if (row->type != JValue::Arr || row->n_children != cols)
+            { delete st; return forward_ring(c, 0, body, t0); }
+          for (int i = 0; i < cols; ++i) {
+            const JValue* e = doc.item(*row, i);
+            if (e->type != JValue::Num) { delete st; return forward_ring(c, 0, body, t0); }
+            input.vals.push_back(jnum(*e));
+          }
+        }
+      }
+    } else {
+      const JValue* shape = doc.get(*tensor, "shape");
+      const JValue* values = doc.get(*tensor, "values");
+      if (!shape || shape->type != JValue::Arr || shape->n_children < 1 ||
+          shape->n_children > 8 || !values)
+        { delete st; return forward_ring(c, 0, body, t0); }
+      uint64_t prod = 1;
+      for (int i = 0; i < shape->n_children; ++i) {
+        double d = jnum(*doc.item(*shape, i));
+        if (d < 1 || d != (double)(uint32_t)d) { delete st; return forward_ring(c, 0, body, t0); }
+        input.dims.push_back((uint32_t)d);
+        prod *= (uint64_t)d;
+      }
+      if (prod != (uint64_t)values->n_children) { delete st; return forward_ring(c, 0, body, t0); }
+      input.vals.reserve(values->n_children);
+      for (int i = 0; i < values->n_children; ++i) {
+        const JValue* e = doc.item(*values, i);
+        if (e->type != JValue::Num) { delete st; return forward_ring(c, 0, body, t0); }
+        input.vals.push_back(jnum(*e));
+      }
+    }
+
+    Kind owner = Kind::SimpleModel;
+    int owner_site = -1;
+    DVal result;
+    if (!eval_device(prog, prog.root, rng, input, st->ex, st->sites,
+                     st->metric_srcs, result, owner, owner_site)) {
+      respond_error(c, st->ex.err_code, st->ex.err_reason, st->ex.err_info);
+      metrics.observe_api("predictions", st->ex.err_code, 1e-9 * (now_ns() - t0));
+      delete st;
+      return;
+    }
+    st->result = std::move(result);
+    st->owner = owner;
+    st->owner_site = owner_site;
+    st->resp_kind = pkind;
+
+    if (st->sites.empty()) {
+      // the route never reached a device model: finish synchronously
+      std::vector<double> vals;
+      std::vector<uint32_t> dims;
+      uint8_t dt;
+      std::string err;
+      if (!resolve_dval(st->result, st->sites, vals, dims, dt, err)) {
+        respond_error(c, 500, "INTERNAL_ERROR", err);
+        metrics.observe_api("predictions", 500, 1e-9 * (now_ns() - t0));
+      } else {
+        build_device_response(c, doc, *st, vals, dims);
+        metrics.observe_api("predictions", 200, 1e-9 * (now_ns() - t0));
+      }
+      delete st;
+      return;
+    }
+
+    if (!req_ring || !resp_ring) {
+      respond_error(c, 500, "INTERNAL_ERROR", "device models need the engine ring");
+      metrics.observe_api("predictions", 500, 1e-9 * (now_ns() - t0));
+      delete st;
+      return;
+    }
+    for (size_t s = 0; s < st->sites.size(); ++s) {
+      DevSite& site = st->sites[s];
+      site.req_id = next_req_id++;
+      const Unit& u = prog.units[site.unit_idx];
+      size_t ndim = site.req_dims.size();
+      std::vector<char> frame(10 + 4 * ndim + 8 * site.req_vals.size());
+      memcpy(frame.data(), &ring_worker_id, 2);
+      memcpy(frame.data() + 2, &site.req_id, 4);
+      frame[6] = 2;  // KIND_MODEL
+      uint16_t mid = (uint16_t)u.model_id;
+      memcpy(frame.data() + 7, &mid, 2);
+      frame[9] = (char)(uint8_t)ndim;
+      memcpy(frame.data() + 10, site.req_dims.data(), 4 * ndim);
+      memcpy(frame.data() + 10 + 4 * ndim, site.req_vals.data(),
+             8 * site.req_vals.size());
+      int rc = scr_push(req_ring, frame.data(), (uint32_t)frame.size());
+      if (rc != 0) {
+        for (size_t k = 0; k < s; ++k) pending_dev.erase(st->sites[k].req_id);
+        respond_error(c, rc == -2 ? 413 : 503,
+                      rc == -2 ? "PAYLOAD_TOO_LARGE" : "ENGINE_BUSY",
+                      rc == -2 ? "tensor larger than ring slot"
+                               : "engine request ring full");
+        metrics.observe_api("predictions", rc == -2 ? 413 : 503,
+                            1e-9 * (now_ns() - t0));
+        delete st;
+        return;
+      }
+      pending_dev[site.req_id] = {st, (int)s};
+      site.req_vals.clear();
+      site.req_vals.shrink_to_fit();
+    }
+    st->conn_fd = c.fd;
+    st->conn_gen = c.gen;
+    st->t0 = t0;
+    st->outstanding = (int)st->sites.size();
+    c.waiting_ring = true;
+    arm_timer();
+  }
+
+  void drop_dev_exec(DevExec* st) {
+    for (auto& site : st->sites) pending_dev.erase(site.req_id);
+    delete st;
+  }
+
+  // Build + send the 200 response for a device-graph request. `doc` is the
+  // parsed request (either the live request or a re-parse of st->body).
+  void build_device_response(Conn& c, JDoc& doc, DevExec& st,
+                             const std::vector<double>& vals,
+                             const std::vector<uint32_t>& dims) {
+    ExecOut& ex = st.ex;
+    const JValue& root = doc.nodes[0];
+    const JValue* meta = doc.get(root, "meta");
+    std::string_view req_puid;
+    const JValue* req_tags = nullptr;
+    const JValue* req_routing = nullptr;
+    const JValue* req_path = nullptr;
+    const JValue* req_metrics = nullptr;
+    if (meta && meta->type == JValue::Obj) {
+      if (auto* v = doc.get(*meta, "puid")) req_puid = v->sv;
+      if (auto* v = doc.get(*meta, "tags")) req_tags = v;
+      if (auto* v = doc.get(*meta, "routing")) req_routing = v;
+      if (auto* v = doc.get(*meta, "requestPath")) req_path = v;
+      if (auto* v = doc.get(*meta, "metrics")) req_metrics = v;
+    }
+    // executor fragments: parse names/tags/metrics spans per done site
+    std::vector<JDoc> frag_docs(st.sites.size());
+    std::vector<const JValue*> frag_names(st.sites.size(), nullptr);
+    std::vector<const JValue*> frag_tags(st.sites.size(), nullptr);
+    std::vector<const JValue*> frag_metrics(st.sites.size(), nullptr);
+    for (size_t i = 0; i < st.sites.size(); ++i) {
+      const std::string& frag = st.sites[i].fragment;
+      if (frag.empty()) continue;
+      if (!json_parse(frag.data(), frag.size(), frag_docs[i])) continue;
+      const JValue& froot = frag_docs[i].nodes[0];
+      if (froot.type != JValue::Obj) continue;
+      frag_names[i] = frag_docs[i].get(froot, "names");
+      frag_tags[i] = frag_docs[i].get(froot, "tags");
+      frag_metrics[i] = frag_docs[i].get(froot, "metrics");
+    }
+
+    char puid[33];
+    if (req_puid.empty()) rng.puid_hex(puid);
+    Buf body_buf;
+    body_buf.append("{\"meta\": {\"puid\": \"");
+    if (req_puid.empty()) body_buf.append(puid, 32);
+    else body_buf.append(req_puid);
+    body_buf.push('"');
+
+    // ---- tags: device fragments + bandit fragment + request echo.
+    // Precedence mirrors the stub path's fuzz-verified rules: the request's
+    // value wins on a key collision; among device sites, first wins.
+    bool have_bandit = !ex.bandit_tags.empty();
+    bool have_dev_tags = false;
+    for (auto* t : frag_tags)
+      if (t && t->n_children > 0) have_dev_tags = true;
+    if (req_tags && req_tags->type != JValue::Obj) {
+      if (req_tags->n_children > 0) {
+        body_buf.append(", \"tags\": ");
+        body_buf.append(req_tags->raw);
+      }
+    } else if (have_bandit || have_dev_tags ||
+               (req_tags && req_tags->n_children > 0)) {
+      body_buf.append(", \"tags\": {");
+      bool first = true;
+      auto req_tag_value = [&](std::string_view key) -> const JValue* {
+        if (!req_tags) return nullptr;
+        for (int i = 0; i < req_tags->n_children; ++i) {
+          const auto& m = doc.obj_members[req_tags->first_child + i];
+          if (m.first == key) return &doc.nodes[m.second];
+        }
+        return nullptr;
+      };
+      std::vector<std::string_view> emitted;
+      auto already = [&](std::string_view key) {
+        for (auto& k : emitted)
+          if (k == key) return true;
+        return false;
+      };
+      if (have_bandit) {
+        const Unit& bu = prog.units[ex.bandit_tags[0].first];
+        body_buf.append("\"bandit\": ");
+        if (auto* v = req_tag_value("bandit")) body_buf.append(v->raw);
+        else {
+          body_buf.push('"');
+          body_buf.append(kind_class(bu.kind));
+          body_buf.push('"');
+        }
+        body_buf.append(", \"branch_means\": ");
+        if (auto* v = req_tag_value("branch_means")) body_buf.append(v->raw);
+        else {
+          body_buf.push('[');
+          const auto& means = ex.bandit_tags[0].second;
+          for (size_t i = 0; i < means.size(); ++i) {
+            if (i) body_buf.append(", ");
+            body_buf.append_double(nearbyint(means[i] * 1e6) / 1e6);
+          }
+          body_buf.push(']');
+        }
+        emitted.push_back("bandit");
+        emitted.push_back("branch_means");
+        first = false;
+      }
+      for (size_t s = 0; s < st.sites.size(); ++s) {
+        if (!frag_tags[s]) continue;
+        for (int i = 0; i < frag_tags[s]->n_children; ++i) {
+          const auto& m = frag_docs[s].obj_members[frag_tags[s]->first_child + i];
+          if (already(m.first)) continue;
+          if (!first) body_buf.append(", ");
+          first = false;
+          body_buf.push('"');
+          body_buf.append(m.first);
+          body_buf.append("\": ");
+          if (auto* v = req_tag_value(m.first)) body_buf.append(v->raw);
+          else body_buf.append(frag_docs[s].nodes[m.second].raw);
+          emitted.push_back(m.first);
+        }
+      }
+      if (req_tags) {
+        for (int i = 0; i < req_tags->n_children; ++i) {
+          const auto& m = doc.obj_members[req_tags->first_child + i];
+          if (already(m.first)) continue;
+          if (!first) body_buf.append(", ");
+          first = false;
+          body_buf.push('"');
+          body_buf.append(m.first);
+          body_buf.append("\": ");
+          body_buf.append(doc.nodes[m.second].raw);
+        }
+      }
+      body_buf.push('}');
+    }
+
+    // ---- routing (same as stub path) ----
+    if (!ex.routing.empty() || (req_routing && req_routing->n_children > 0)) {
+      body_buf.append(", \"routing\": {");
+      bool first = true;
+      for (auto& [name, branch] : ex.routing) {
+        if (!first) body_buf.append(", ");
+        first = false;
+        body_buf.push('"');
+        body_buf.append(name);
+        body_buf.append("\": ");
+        body_buf.append_i64(branch);
+      }
+      if (req_routing) {
+        for (int i = 0; i < req_routing->n_children; ++i) {
+          const auto& m = doc.obj_members[req_routing->first_child + i];
+          bool dup = false;
+          for (auto& [name, _] : ex.routing)
+            if (name == m.first) dup = true;
+          if (dup) continue;
+          if (!first) body_buf.append(", ");
+          first = false;
+          body_buf.push('"');
+          body_buf.append(m.first);
+          body_buf.append("\": ");
+          body_buf.append(doc.nodes[m.second].raw);
+        }
+      }
+      body_buf.push('}');
+    }
+
+    // ---- requestPath ----
+    body_buf.append(", \"requestPath\": {");
+    {
+      bool first = true;
+      if (req_path) {
+        for (int i = 0; i < req_path->n_children; ++i) {
+          const auto& m = doc.obj_members[req_path->first_child + i];
+          bool dup = false;
+          for (auto& [name, _] : ex.path)
+            if (name == m.first) dup = true;
+          if (dup) continue;
+          if (!first) body_buf.append(", ");
+          first = false;
+          body_buf.push('"');
+          body_buf.append(m.first);
+          body_buf.append("\": ");
+          body_buf.append(doc.nodes[m.second].raw);
+        }
+      }
+      for (auto& [name, cls] : ex.path) {
+        if (!first) body_buf.append(", ");
+        first = false;
+        body_buf.push('"');
+        body_buf.append(name);
+        body_buf.append("\": \"");
+        body_buf.append(cls);
+        body_buf.push('"');
+      }
+    }
+    body_buf.push('}');
+
+    // ---- metrics: owner's source first, then request-carried, then the
+    // remaining executed sources in traversal order (engine merge order) ----
+    {
+      static const char* kModelMetrics =
+          "{\"key\": \"mycounter\", \"type\": \"COUNTER\", \"value\": 1.0}, "
+          "{\"key\": \"mygauge\", \"type\": \"GAUGE\", \"value\": 100.0}, "
+          "{\"key\": \"mytimer\", \"type\": \"TIMER\", \"value\": 20.6}";
+      bool any_dev_metrics = false;
+      for (auto* m : frag_metrics)
+        if (m && m->n_children > 0) any_dev_metrics = true;
+      bool have_any = ex.model_visits > 0 || any_dev_metrics ||
+                      (req_metrics && req_metrics->n_children > 0);
+      if (have_any) {
+        body_buf.append(", \"metrics\": [");
+        bool first = true;
+        auto emit_site = [&](int site) {
+          if (!frag_metrics[site] || frag_metrics[site]->n_children == 0) return;
+          for (int i = 0; i < frag_metrics[site]->n_children; ++i) {
+            if (!first) body_buf.append(", ");
+            first = false;
+            body_buf.append(
+                frag_docs[site].item(*frag_metrics[site], i)->raw);
+          }
+        };
+        auto emit_builtin = [&]() {
+          if (!first) body_buf.append(", ");
+          first = false;
+          body_buf.append(kModelMetrics);
+        };
+        // owner-first
+        int owner_src = -2;  // -2 none, -1 builtin, >=0 site
+        if (st.owner == Kind::DeviceModel && st.owner_site >= 0)
+          owner_src = st.owner_site;
+        else if (st.owner == Kind::SimpleModel && ex.model_visits > 0)
+          owner_src = -1;
+        bool builtin_owner_used = false;
+        if (owner_src == -1) {
+          emit_builtin();
+          builtin_owner_used = true;
+        } else if (owner_src >= 0) {
+          emit_site(owner_src);
+        }
+        if (req_metrics)
+          for (int i = 0; i < req_metrics->n_children; ++i) {
+            if (!first) body_buf.append(", ");
+            first = false;
+            body_buf.append(doc.item(*req_metrics, i)->raw);
+          }
+        bool builtin_skipped_once = false;
+        for (auto& src : st.metric_srcs) {
+          if (src.site == owner_src && src.site >= 0) continue;
+          if (src.site == -1 && builtin_owner_used && !builtin_skipped_once) {
+            builtin_skipped_once = true;  // the owner consumed one visit
+            continue;
+          }
+          if (src.site == -1) emit_builtin();
+          else emit_site(src.site);
+        }
+        body_buf.push(']');
+      }
+    }
+    body_buf.push('}');
+
+    // ---- data payload: real values ----
+    body_buf.append(", \"data\": {");
+    bool wrote_names = false;
+    if (st.owner == Kind::DeviceModel && st.owner_site >= 0) {
+      if (frag_names[st.owner_site]) {
+        body_buf.append("\"names\": ");
+        body_buf.append(frag_names[st.owner_site]->raw);
+        wrote_names = true;
+      }
+    } else if (st.owner == Kind::AverageCombiner) {
+      if (dims.size() > 1) {
+        body_buf.append("\"names\": [");
+        for (uint32_t i = 0; i < dims[1]; ++i) {
+          if (i) body_buf.append(", ");
+          body_buf.append("\"t:");
+          body_buf.append_i64(i);
+          body_buf.push('"');
+        }
+        body_buf.push(']');
+        wrote_names = true;
+      }
+    } else {
+      body_buf.append("\"names\": [\"class0\", \"class1\", \"class2\"]");
+      wrote_names = true;
+    }
+    if (wrote_names) body_buf.append(", ");
+    if (st.resp_kind == PKind::NDArray) {
+      // nested arrays by dims (row-major)
+      body_buf.append("\"ndarray\": ");
+      size_t pos = 0;
+      std::function<void(size_t)> emit_nd = [&](size_t d) {
+        if (d == dims.size()) {
+          body_buf.append_double(vals[pos++]);
+          return;
+        }
+        body_buf.push('[');
+        for (uint32_t i = 0; i < dims[d]; ++i) {
+          if (i) body_buf.append(", ");
+          emit_nd(d + 1);
+        }
+        body_buf.push(']');
+      };
+      if (dims.empty()) body_buf.append("[]");
+      else emit_nd(0);
+      body_buf.push('}');
+    } else {
+      body_buf.append("\"tensor\": {\"shape\": [");
+      for (size_t i = 0; i < dims.size(); ++i) {
+        if (i) body_buf.append(", ");
+        body_buf.append_i64((int64_t)dims[i]);
+      }
+      body_buf.append("], \"values\": [");
+      for (size_t i = 0; i < vals.size(); ++i) {
+        if (i) body_buf.append(", ");
+        body_buf.append_double(vals[i]);
+      }
+      body_buf.append("]}}");
+    }
+    body_buf.push('}');
+
+    http_head(c.outbuf, 200, "OK", body_buf.size(),
+              "application/json; charset=utf-8", c.want_close);
+    c.outbuf.append(body_buf.data(), body_buf.size());
+    metrics.mycounter += ex.model_visits;
+    if (ex.model_visits) {
+      metrics.mygauge = 100.0;
+      for (int i = 0; i < ex.model_visits; ++i)
+        metrics.mytimer.observe(20.6 / 1000.0);
+      metrics.custom_seen += ex.model_visits;
+    }
+  }
+
+  // All sites landed: resolve the dataflow over st->doc (parsed once at
+  // admission; its spans point into st->body) and respond.
+  void finish_device(DevExec* st) {
+    Conn& c = conn(st->conn_fd);
+    bool conn_ok = c.fd == st->conn_fd && c.gen == st->conn_gen;
+    if (!conn_ok) {
+      delete st;
+      return;
+    }
+    c.waiting_ring = false;
+    std::vector<double> vals;
+    std::vector<uint32_t> dims;
+    uint8_t dt;
+    std::string err;
+    if (!resolve_dval(st->result, st->sites, vals, dims, dt, err)) {
+      respond_error(c, 500, "INTERNAL_ERROR", err);
+      metrics.observe_api("predictions", 500, 1e-9 * (now_ns() - st->t0));
+    } else {
+      build_device_response(c, st->doc, *st, vals, dims);
+      metrics.observe_api("predictions", 200, 1e-9 * (now_ns() - st->t0));
+    }
+    flush_out(c);
+    if (c.fd >= 0 && c.in.size() > 0) process_in(c);
+    delete st;
+  }
+
   void arm_timer() {
     if (timer_armed) return;
     itimerspec its{};
@@ -1809,7 +2603,80 @@ struct Server {
       memcpy(&req_id, ring_buf.data(), 4);
       uint8_t status = (uint8_t)ring_buf[4];
       auto it = pending.find(req_id);
-      if (it == pending.end()) continue;
+      if (it == pending.end()) {
+        auto dit = pending_dev.find(req_id);
+        if (dit == pending_dev.end()) continue;
+        DevExec* st = dit->second.first;
+        int sidx = dit->second.second;
+        pending_dev.erase(dit);
+        if (status != 0) {
+          // engine Status body: surface its code, fail the whole request
+          std::string_view ebody{ring_buf.data() + 5, (size_t)len - 5};
+          Conn& c = conn(st->conn_fd);
+          if (c.fd == st->conn_fd && c.gen == st->conn_gen) {
+            c.waiting_ring = false;
+            int http_code = 500;
+            JDoc edoc;
+            if (json_parse(ebody.data(), ebody.size(), edoc) &&
+                edoc.nodes[0].type == JValue::Obj) {
+              if (auto* est = edoc.get(edoc.nodes[0], "status"))
+                if (auto* code = edoc.get(*est, "code")) {
+                  int parsed = (int)jnum(*code);
+                  if (parsed >= 400 && parsed < 600) http_code = parsed;
+                }
+            }
+            const char* text = http_code == 400 ? "Bad Request"
+                               : http_code == 503 ? "Service Unavailable"
+                                                  : "Internal Server Error";
+            respond(c, http_code, text, ebody);
+            metrics.observe_api("predictions", http_code,
+                                1e-9 * (now_ns() - st->t0));
+            flush_out(c);
+            if (c.fd >= 0 && c.in.size() > 0) process_in(c);
+          }
+          drop_dev_exec(st);
+          continue;
+        }
+        // ok frame: u8 dtype | u8 ndim | u32 dims[] | u32 json_len | json | f64
+        DevSite& site = st->sites[sidx];
+        bool ok = len >= 7;
+        size_t off = 0, n_elems = 1, json_len = 0;
+        if (ok) {
+          site.dtype = (uint8_t)ring_buf[5];
+          uint8_t ndim = (uint8_t)ring_buf[6];
+          off = 7 + 4ull * ndim;
+          ok = ndim <= 8 && (size_t)len >= off + 4;
+          if (ok) {
+            site.dims.resize(ndim);
+            memcpy(site.dims.data(), ring_buf.data() + 7, 4ull * ndim);
+            for (uint32_t d : site.dims) n_elems *= d;
+            uint32_t jl;
+            memcpy(&jl, ring_buf.data() + off, 4);
+            json_len = jl;
+            off += 4;
+            ok = (size_t)len >= off + json_len + 8 * n_elems;
+          }
+        }
+        if (!ok) {
+          Conn& c = conn(st->conn_fd);
+          if (c.fd == st->conn_fd && c.gen == st->conn_gen) {
+            c.waiting_ring = false;
+            respond_error(c, 500, "INTERNAL_ERROR", "malformed device response");
+            metrics.observe_api("predictions", 500, 1e-9 * (now_ns() - st->t0));
+            flush_out(c);
+            if (c.fd >= 0 && c.in.size() > 0) process_in(c);
+          }
+          drop_dev_exec(st);
+          continue;
+        }
+        site.fragment.assign(ring_buf.data() + off, json_len);
+        off += json_len;
+        site.vals.resize(n_elems);
+        memcpy(site.vals.data(), ring_buf.data() + off, 8 * n_elems);
+        site.done = true;
+        if (--st->outstanding == 0) finish_device(st);
+        continue;
+      }
       RingPending rp = it->second;
       pending.erase(it);
       Conn& c = conn(rp.conn_fd);
@@ -1862,7 +2729,30 @@ struct Server {
         flush_out(c);
       }
     }
-    if (pending.empty()) disarm_timer();
+    {
+      // device requests time out as a unit (dedupe multi-site execs first)
+      std::vector<DevExec*> expired;
+      for (auto& [rid, entry] : pending_dev) {
+        DevExec* st = entry.first;
+        if (now - st->t0 < kRingTimeoutNs) continue;
+        bool seen = false;
+        for (auto* e : expired)
+          if (e == st) seen = true;
+        if (!seen) expired.push_back(st);
+      }
+      for (DevExec* st : expired) {
+        Conn& c = conn(st->conn_fd);
+        if (c.fd == st->conn_fd && c.gen == st->conn_gen) {
+          c.waiting_ring = false;
+          respond_error(c, 504, "ENGINE_TIMEOUT",
+                        "engine did not answer within deadline");
+          metrics.observe_api("predictions", 504, 1e-9 * (now - st->t0));
+          flush_out(c);
+        }
+        drop_dev_exec(st);
+      }
+    }
+    if (pending.empty() && pending_dev.empty()) disarm_timer();
   }
 
   // ---- request routing ----
@@ -2243,7 +3133,9 @@ struct Server {
       metrics.observe_api(method, 503, 1e-9 * (now_ns() - t0));
       return;
     }
-    if (!prog.native) {
+    if (!prog.native || (prog.has_device && !is_feedback)) {
+      // device-graph predictions are REST-native only for now; the engine
+      // process serves gRPC (feedback stays native — bandit state lives here)
       grpc_trailers_error(c, sid, 12,
                           "gRPC for non-native graphs is served by the engine process");
       metrics.observe_api(method, 501, 1e-9 * (now_ns() - t0));

@@ -13,9 +13,13 @@
 // fetch_add on dequeue_pos. Lock-free, FIFO per producer, safe across
 // processes (std::atomic<uint64_t> on x86-64/aarch64 over shared mmap).
 //
-// Layout in the mapped file:
-//   [Header][Cell 0][Cell 1]...[Cell capacity-1]
-//   Cell = { atomic<uint64> seq; uint32 len; uint8 data[slot_size]; }
+// Layout in the mapped file (v2):
+//   [Header][CellHeader 0..capacity-1 (64B each, contiguous)][slot 0..capacity-1]
+// Cell headers are packed together rather than strided through the data
+// region: creation then touches capacity*64B instead of one page per slot —
+// on block storage where a fresh MAP_SHARED page fault costs ~10ms, the old
+// strided layout took ~16s to create a 1GB ring (measured; see git history).
+// Polling also scans a compact array instead of page-sized strides.
 
 #include <atomic>
 #include <cerrno>
@@ -30,41 +34,40 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x53454c52494e4731ull;  // "SELRING1"
+constexpr uint64_t kMagic = 0x53454c52494e4732ull;  // "SELRING2"
 
 struct Header {
   std::atomic<uint64_t> magic;  // written last (release) so attachers see a
                                 // fully initialised header (acquire)
   uint64_t capacity;   // power of two
   uint64_t slot_size;  // payload bytes per cell
-  uint64_t cell_stride;
+  uint64_t slot_stride;  // slot_size rounded to 64B
   alignas(64) std::atomic<uint64_t> enqueue_pos;
   alignas(64) std::atomic<uint64_t> dequeue_pos;
 };
 
-struct CellHeader {
+struct alignas(64) CellHeader {  // one cache line per cell, packed array
   std::atomic<uint64_t> seq;
   uint32_t len;
-  // payload follows
 };
 
 struct Ring {
   Header* header;
-  uint8_t* cells;
+  CellHeader* cells;  // contiguous array [capacity]
+  uint8_t* slots;     // data region, slot_stride apart
   size_t map_len;
 };
 
 inline CellHeader* cell_at(const Ring* r, uint64_t idx) {
-  return reinterpret_cast<CellHeader*>(
-      r->cells + (idx & (r->header->capacity - 1)) * r->header->cell_stride);
+  return r->cells + (idx & (r->header->capacity - 1));
 }
 
-inline uint8_t* cell_data(CellHeader* c) {
-  return reinterpret_cast<uint8_t*>(c) + sizeof(CellHeader);
+inline uint8_t* cell_data(const Ring* r, uint64_t idx) {
+  return r->slots + (idx & (r->header->capacity - 1)) * r->header->slot_stride;
 }
 
-size_t total_size(uint64_t capacity, uint64_t cell_stride) {
-  return sizeof(Header) + capacity * cell_stride;
+size_t total_size(uint64_t capacity, uint64_t slot_stride) {
+  return sizeof(Header) + capacity * sizeof(CellHeader) + capacity * slot_stride;
 }
 
 }  // namespace
@@ -78,8 +81,7 @@ extern "C" {
 // Returns an opaque handle or nullptr.
 void* scr_create(const char* path, uint64_t capacity, uint64_t slot_size) {
   if (capacity == 0 || (capacity & (capacity - 1)) != 0) return nullptr;
-  uint64_t stride = sizeof(CellHeader) + slot_size;
-  stride = (stride + 63) & ~63ull;  // 64B-align cells
+  uint64_t stride = (slot_size + 63) & ~63ull;  // 64B-align slots
   size_t len = total_size(capacity, stride);
 
   char tmp[4096];
@@ -102,11 +104,13 @@ void* scr_create(const char* path, uint64_t capacity, uint64_t slot_size) {
   auto* h = static_cast<Header*>(mem);
   h->capacity = capacity;
   h->slot_size = slot_size;
-  h->cell_stride = stride;
+  h->slot_stride = stride;
   h->enqueue_pos.store(0, std::memory_order_relaxed);
   h->dequeue_pos.store(0, std::memory_order_relaxed);
 
-  auto* ring = new Ring{h, static_cast<uint8_t*>(mem) + sizeof(Header), len};
+  auto* cells = reinterpret_cast<CellHeader*>(static_cast<uint8_t*>(mem) + sizeof(Header));
+  auto* ring = new Ring{h, cells,
+                        reinterpret_cast<uint8_t*>(cells + capacity), len};
   for (uint64_t i = 0; i < capacity; ++i) {
     cell_at(ring, i)->seq.store(i, std::memory_order_relaxed);
     cell_at(ring, i)->len = 0;
@@ -136,11 +140,12 @@ void* scr_attach(const char* path) {
   if (mem == MAP_FAILED) return nullptr;
   auto* h = static_cast<Header*>(mem);
   if (h->magic.load(std::memory_order_acquire) != kMagic ||
-      static_cast<size_t>(st.st_size) < total_size(h->capacity, h->cell_stride)) {
+      static_cast<size_t>(st.st_size) < total_size(h->capacity, h->slot_stride)) {
     ::munmap(mem, static_cast<size_t>(st.st_size));
     return nullptr;
   }
-  return new Ring{h, static_cast<uint8_t*>(mem) + sizeof(Header),
+  auto* cells = reinterpret_cast<CellHeader*>(static_cast<uint8_t*>(mem) + sizeof(Header));
+  return new Ring{h, cells, reinterpret_cast<uint8_t*>(cells + h->capacity),
                   static_cast<size_t>(st.st_size)};
 }
 
@@ -184,7 +189,7 @@ int scr_push(void* handle, const void* data, uint32_t len) {
     }
   }
   cell->len = len;
-  std::memcpy(cell_data(cell), data, len);
+  std::memcpy(cell_data(r, pos), data, len);
   cell->seq.store(pos + 1, std::memory_order_release);
   return 0;
 }
@@ -213,7 +218,7 @@ int scr_pop(void* handle, void* out, uint32_t out_cap) {
     }
   }
   uint32_t len = cell->len;
-  std::memcpy(out, cell_data(cell), len);
+  std::memcpy(out, cell_data(r, pos), len);
   cell->seq.store(pos + h->capacity, std::memory_order_release);
   return static_cast<int>(len);
 }

@@ -120,11 +120,14 @@ class SharedRing:
     def pop(self) -> Optional[bytes]:
         """One payload or None when empty."""
         rc = self._lib.scr_pop(self._h, self._popbuf, self.slot_size)
+        if rc >= 0:
+            # string_at copies exactly rc bytes; _popbuf.raw[:rc] would
+            # materialise the full slot (1MB) per pop — measured as ~2/3 of
+            # the engine's CPU at 7k rps
+            return ctypes.string_at(self._popbuf, rc)
         if rc == -1:
             return None
-        if rc < 0:
-            raise RuntimeError(f"ring pop error {rc}")
-        return self._popbuf.raw[:rc]
+        raise RuntimeError(f"ring pop error {rc}")
 
     def pop_batch(self, max_items: int, wait_s: float = 0.0, spin_s: float = 0.0002):
         """Drain up to max_items; optionally wait up to wait_s for the first."""

@@ -35,7 +35,8 @@ logger = logging.getLogger(__name__)
 
 
 class _Slot:
-    __slots__ = ("future", "tokens", "true_len", "n_new", "max_new", "active")
+    __slots__ = ("future", "tokens", "true_len", "n_new", "max_new", "active",
+                 "on_token")
 
     def __init__(self):
         self.active = False
@@ -44,6 +45,64 @@ class _Slot:
         self.true_len = 0
         self.n_new = 0
         self.max_new = 0
+        self.on_token: Optional[Any] = None
+
+
+class BatcherService:
+    """Owns a ContinuousBatcher on a dedicated event-loop thread so every
+    transport can reach ONE shared batch: async REST handlers await
+    ``submit``, the sync gRPC servicer blocks on ``submit_sync`` — either
+    way the request joins the in-flight decode batch instead of running its
+    own ``generate()``. Created lazily per component by
+    ``get_batcher_service`` (keyed on the component, so REST and gRPC in one
+    process share slots)."""
+
+    def __init__(self, server: "LLMServer", max_slots: int = 4):
+        import threading
+
+        self._loop = asyncio.new_event_loop()
+        threading.Thread(target=self._loop.run_forever, name="batcher-loop",
+                         daemon=True).start()
+
+        async def make():
+            return ContinuousBatcher(server, max_slots=max_slots)
+
+        self.batcher = asyncio.run_coroutine_threadsafe(make(), self._loop).result()
+        self.submitted = 0
+
+    def submit_sync(self, prompt: Any, max_new_tokens: Optional[int] = None,
+                    timeout_s: float = 600.0) -> List[int]:
+        self.submitted += 1
+        return asyncio.run_coroutine_threadsafe(
+            self.batcher.submit(prompt, max_new_tokens), self._loop
+        ).result(timeout_s)
+
+    async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None,
+                     on_token: Optional[Any] = None) -> List[int]:
+        self.submitted += 1
+        cfut = asyncio.run_coroutine_threadsafe(
+            self.batcher.submit(prompt, max_new_tokens, on_token=on_token),
+            self._loop)
+        return await asyncio.wrap_future(cfut)
+
+    def close(self) -> None:
+        asyncio.run_coroutine_threadsafe(self.batcher.close(), self._loop).result(30)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+def get_batcher_service(component: Any) -> Optional[BatcherService]:
+    """The component's shared BatcherService, created on first use when the
+    component opted in (``continuous_batching`` slots > 0) and exposes the
+    LLM generate surface; None otherwise."""
+    svc = getattr(component, "_batcher_service", None)
+    if svc is not None:
+        return svc  # reuse even when batching is off (streaming's 1-slot svc)
+    slots = int(getattr(component, "continuous_batching", 0) or 0)
+    if slots <= 0 or not hasattr(component, "generate"):
+        return None
+    svc = BatcherService(component, max_slots=slots)
+    component._batcher_service = svc
+    return svc
 
 
 class ContinuousBatcher:
@@ -114,8 +173,14 @@ class ContinuousBatcher:
         self._temp = jnp.asarray(server.temperature, jnp.float32)
 
     # ------------------------------------------------------------------
-    async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None) -> List[int]:
-        """prompt: str or token sequence. Resolves to generated token ids."""
+    async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None,
+                     on_token: Optional[Any] = None) -> List[int]:
+        """prompt: str or token sequence. Resolves to generated token ids.
+
+        ``on_token(tok)`` (optional) fires for every generated token as it is
+        decoded and ``on_token(None)`` once at completion — from a worker
+        thread, so the callback must be thread-safe (streaming transports
+        bridge it onto their loop with call_soon_threadsafe)."""
         if self._closed:
             raise RuntimeError("batcher closed")
         if isinstance(prompt, str):
@@ -126,7 +191,8 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         self._loop = asyncio.get_running_loop()
         fut: asyncio.Future = self._loop.create_future()
-        self._pending.append((ids, int(max_new_tokens or self.server.max_new_tokens), fut))
+        self._pending.append(
+            (ids, int(max_new_tokens or self.server.max_new_tokens), fut, on_token))
         self._ensure_running()
         self._wakeup.set()
         return await fut
@@ -156,7 +222,8 @@ class ContinuousBatcher:
             await self._task
 
     # ------------------------------------------------------------------
-    def _admit(self, ids: List[int], max_new: int, fut: asyncio.Future) -> bool:
+    def _admit(self, ids: List[int], max_new: int, fut: asyncio.Future,
+               on_token: Optional[Any] = None) -> bool:
         import jax.numpy as jnp
 
         from seldon_core_tpu.models.transformer import PAD_POS
@@ -201,8 +268,11 @@ class ContinuousBatcher:
         slot.max_new = max_new
         slot.n_new = 1
         slot.tokens = [first]
+        slot.on_token = on_token
         self._last_tok[free] = first
         self._next_pos[free] = L
+        if on_token is not None and first != self.eos_id:
+            on_token(first)
         if first == self.eos_id or max_new <= 1:
             self._finish(free)
         return True
@@ -212,10 +282,13 @@ class ContinuousBatcher:
         toks = slot.tokens
         if self.eos_id in toks:
             toks = toks[: toks.index(self.eos_id)]
+        if slot.on_token is not None:
+            slot.on_token(None)  # stream end sentinel
         if slot.future is not None:
             self._resolve(slot.future, result=toks)
         slot.active = False
         slot.future = None
+        slot.on_token = None
 
     def _step(self):
         import jax
@@ -239,6 +312,8 @@ class ContinuousBatcher:
             slot.n_new += 1
             self._last_tok[i] = tok
             self._next_pos[i] += 1
+            if slot.on_token is not None and tok != self.eos_id:
+                slot.on_token(tok)
             if tok == self.eos_id or slot.n_new >= slot.max_new or int(self._next_pos[i]) >= self.max_len:
                 self._finish(i)
 
@@ -250,8 +325,9 @@ class ContinuousBatcher:
                 # device work runs in a worker thread so the event loop (and
                 # co-hosted HTTP handlers) stays responsive during decode
                 while self._pending:
-                    ids, max_new, fut = self._pending[0]
-                    if not await asyncio.to_thread(self._admit, ids, max_new, fut):
+                    ids, max_new, fut, on_token = self._pending[0]
+                    if not await asyncio.to_thread(self._admit, ids, max_new, fut,
+                                                   on_token):
                         break  # no free slot — decode until one frees up
                     self._pending.popleft()
                 if any(s.active for s in self._slots):
@@ -270,11 +346,23 @@ class ContinuousBatcher:
             # instead of leaving their futures hanging
             logger.exception("batcher loop died: %s", e)
             for slot in self._slots:
-                if slot.active and slot.future is not None:
-                    self._resolve(slot.future, exc=e)
+                if slot.active:
+                    if slot.on_token is not None:
+                        try:
+                            slot.on_token(None)  # unblock streaming consumers
+                        except Exception:
+                            pass
+                        slot.on_token = None
+                    if slot.future is not None:
+                        self._resolve(slot.future, exc=e)
                     slot.active = False
                     slot.future = None
             while self._pending:
-                _, _, fut = self._pending.popleft()
+                _, _, fut, on_token = self._pending.popleft()
+                if on_token is not None:
+                    try:
+                        on_token(None)
+                    except Exception:
+                        pass
                 self._resolve(fut, exc=e)
             raise

@@ -63,16 +63,54 @@ def build_edge_binaries() -> bool:
 
 
 def compile_edge_program(
-    spec: PredictorSpec, deployment: str = "", predictor: str = ""
+    spec: PredictorSpec,
+    deployment: str = "",
+    predictor: str = "",
+    device_components: Optional[Dict[str, Any]] = None,
 ) -> Optional[Dict[str, Any]]:
     """Return the native edge program for this graph, or None if any unit
-    cannot execute natively (the edge then runs in ring-fallback mode)."""
+    cannot execute natively (the edge then runs in ring-fallback mode).
+
+    ``device_components`` (unit name -> live SeldonComponent) additionally
+    compiles leaf MODEL units backed by real in-process models (JAXServer,
+    sklearn, user components) to DEVICE_MODEL nodes: the edge executes the
+    graph natively and ships only the packed tensor over the ring to the
+    engine process's ModelExecutor (transport/ipc.py kind 2), which owns the
+    device and micro-batches concurrent calls. Eligibility per unit: MODEL
+    type, no children, a plain ``predict`` (components overriding
+    ``predict_raw`` need the full SeldonMessage and fall back)."""
     units: List[Dict[str, Any]] = []
+    device_models: List[str] = []
+
+    def compile_device_unit(unit: PredictiveUnit) -> Optional[int]:
+        from seldon_core_tpu.components.component import has_raw
+        from seldon_core_tpu.contracts.graph import UnitType
+
+        if not device_components or unit.name not in device_components:
+            return None
+        if unit.children:
+            return None  # a device model's output feeding a chain stays Python
+        if unit.type not in (None, UnitType.MODEL):
+            return None
+        component = device_components[unit.name]
+        if component is None or has_raw(component, "predict"):
+            return None
+        if getattr(component, "is_async", False):
+            return None
+        units.append({
+            "name": unit.name,
+            "kind": "DEVICE_MODEL",
+            "children": [],
+            "modelId": len(device_models),
+            "className": type(component).__name__,
+        })
+        device_models.append(unit.name)
+        return len(units) - 1
 
     def compile_unit(unit: PredictiveUnit) -> Optional[int]:
         kind = _NATIVE_KINDS.get(unit.implementation)
         if kind is None:
-            return None
+            return compile_device_unit(unit)
         params = unit.parameters_dict()
         if kind in ("RANDOM_ABTEST", "EPSILON_GREEDY", "THOMPSON_SAMPLING") and (
             params.get("seed") is not None
@@ -126,13 +164,16 @@ def compile_edge_program(
     root = compile_unit(spec.graph)
     if root is None:
         return None
-    return {
+    program = {
         "deployment": deployment,
         "predictor": predictor or spec.name,
         "native": True,
         "units": units,
         "root": root,
     }
+    if device_models:
+        program["deviceModels"] = device_models
+    return program
 
 
 def fallback_program(spec: PredictorSpec, deployment: str = "", predictor: str = "") -> Dict[str, Any]:

@@ -46,6 +46,7 @@ class JAXServer(SeldonComponent):
         strict_sharding: bool = False,
         tensor_parallel: int = 0,
         quantize: str = "",
+        param_dtype: str = "",
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -62,6 +63,11 @@ class JAXServer(SeldonComponent):
         # "int8": weight-only PTQ — weights live in HBM as int8, dequant
         # fuses into the matmuls (ops/quantize.py)
         self.quantize = str(quantize or "")
+        # Param-dtype cast at load ("auto" = module compute dtype). Off by
+        # default: the on-chip A/B showed pre-cast bf16 params decode SLOWER
+        # (XLA hoists the convert; see benchmarks/DECODE_NOTES.md). The knob
+        # stays for HBM-residency-bound configs.
+        self.param_dtype = param_dtype
         self.batch_buckets = tuple(batch_buckets) if batch_buckets else DEFAULT_BUCKETS
         self.ready = False
         self._apply = None
@@ -101,6 +107,13 @@ class JAXServer(SeldonComponent):
             self.mesh = serving_mesh(model_parallel=self.tensor_parallel)
 
         params = self._load_params(path)
+        module_dtype = getattr(module, "dtype", None)
+        if module_dtype is not None:
+            from seldon_core_tpu.servers.llmserver import _cast_params
+
+            params = _cast_params(
+                params, self._config.get("param_dtype", self.param_dtype), module_dtype
+            )
         apply_kwargs = self._config.get("apply_kwargs", {})
 
         def apply_fn(params, x):

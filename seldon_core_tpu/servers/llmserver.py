@@ -83,6 +83,28 @@ class HFTokenizer:
         return self._tok.decode([int(i) for i in ids])
 
 
+def _cast_params(params, param_dtype: str, module_dtype) -> Any:
+    """Cast float32 param leaves to the serving dtype ("auto" = the module's
+    compute dtype). The module casts weights to its compute dtype inside
+    every matmul anyway; pre-casting stores them that way in HBM, halving
+    weight-streaming bytes for bf16 models (benchmarks/DECODE_NOTES.md)."""
+    if not param_dtype:
+        return params
+    import jax
+    import jax.numpy as jnp
+
+    target = jnp.dtype(module_dtype) if param_dtype == "auto" else jnp.dtype(param_dtype)
+    if target == jnp.float32:
+        return params
+
+    def cast(leaf):
+        if hasattr(leaf, "dtype") and leaf.dtype == jnp.float32:
+            return leaf.astype(target)
+        return leaf
+
+    return jax.tree.map(cast, params)
+
+
 def _bucket(n: int, buckets: Sequence[int]) -> int:
     """Smallest bucket >= n; beyond the largest bucket, round up to a multiple
     of it (bounded compile count) instead of silently truncating the prompt —
@@ -121,6 +143,8 @@ class LLMServer(SeldonComponent):
         tensor_parallel: int = 0,
         sequence_parallel: int = 0,
         quantize: str = "",
+        param_dtype: str = "",
+        continuous_batching: int = 0,
         prefix_cache_size: int = 0,
         prefix_cache_bytes: int = 0,
         seed: int = 0,
@@ -145,6 +169,20 @@ class LLMServer(SeldonComponent):
         # "int8": weight-only PTQ (ops/quantize.py) — the KV cache and
         # activations stay in the model dtype; only weights go int8 in HBM
         self.quantize = str(quantize or "")
+        # Flax init leaves params f32 even for bf16-compute modules. An
+        # interleaved A/B on the real chip showed pre-casting to bf16 is
+        # SLOWER here (XLA hoists the f32->bf16 convert out of the decode
+        # scan, so storage dtype costs nothing per step, and bf16-stored
+        # weights landed in worse layouts) — benchmarks/DECODE_NOTES.md.
+        # Default is therefore no cast; "auto" casts to the module compute
+        # dtype, or pass an explicit dtype, for configs where HBM residency
+        # matters more than step time.
+        self.param_dtype = param_dtype
+        # >0: serving transports route single-prompt /v1/generate (REST) and
+        # jsonData {"prompt": ...} predicts (gRPC) through a shared
+        # ContinuousBatcher with this many slots (runtime/batcher.py), so
+        # concurrent clients join one in-flight decode batch.
+        self.continuous_batching = int(continuous_batching)
         # Prefix caching (opt-in): single-prompt requests reuse the KV cache
         # of the longest previously-prefilled token prefix (shared system
         # prompts prefill once); entries are LRU-evicted past this size.
@@ -201,6 +239,8 @@ class LLMServer(SeldonComponent):
             params = jax.jit(self._module.init)(
                 jax.random.PRNGKey(self.seed), jnp.zeros((1, 8), jnp.int32)
             )
+
+        params = _cast_params(params, self.param_dtype, self._cfg.dtype)
 
         if self.mesh is None and (self.tensor_parallel > 1 or self.sequence_parallel > 1):
             from seldon_core_tpu.parallel.mesh import make_mesh

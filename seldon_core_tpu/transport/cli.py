@@ -217,6 +217,7 @@ def run_edge(args: argparse.Namespace) -> None:
         json.dump(engine_spec(), f)
 
     if program is not None:
+        # pure-builtin graph: the edge process needs no Python at all
         prog_path = write_program(program, os.path.join(tmp, "program.json"))
         logger.info("graph compiled natively; edge serving on port %d", port)
         os.execv(
@@ -227,21 +228,59 @@ def run_edge(args: argparse.Namespace) -> None:
             ],
         )
 
-    # Fallback: Python engine behind the ring, edge as frontend.
+    # The graph needs Python — build the engine, then try the DEVICE_MODEL
+    # compile: graphs of builtins + real model leaves still execute natively
+    # in the edge, which ships only packed tensors (ring kind 2) to this
+    # process's ModelExecutor. Anything else (remote nodes, seeded routers,
+    # custom transformers) keeps full-graph ring fallback (kind 0).
     import asyncio
 
+    from seldon_core_tpu.contracts.graph import UnitType
     from seldon_core_tpu.runtime.engine import GraphEngine
-    from seldon_core_tpu.transport.ipc import IPCEngineServer, cleanup_rings
-
-    prog_path = write_program(
-        fallback_program(spec, deployment=deployment), os.path.join(tmp, "program.json")
+    from seldon_core_tpu.runtime.remote import RemoteComponent
+    from seldon_core_tpu.transport.ipc import (
+        IPCEngineServer,
+        ModelExecutor,
+        cleanup_rings,
+        default_ring_dir,
     )
+
     engine = GraphEngine(spec, annotations=load_annotations())
-    base = args.ipc_base or os.path.join(tmp, "ring")
+    eligible = {
+        st.unit.name: st.component
+        for st in engine.state.walk()
+        if st.component is not None
+        and not st.children
+        and st.unit.type in (None, UnitType.MODEL)
+        and not isinstance(st.component, RemoteComponent)
+    }
+    program = compile_edge_program(spec, deployment=deployment,
+                                   device_components=eligible)
+    executor = None
+    if program is not None and program.get("deviceModels"):
+        executor = ModelExecutor(
+            [eligible[name] for name in program["deviceModels"]])
+        logger.info("warming device-model compile caches (all batch buckets)")
+        executor.warm()
+        prog_path = write_program(program, os.path.join(tmp, "program.json"))
+        logger.info(
+            "graph compiled natively with %d device model(s): %s",
+            len(program["deviceModels"]), ", ".join(program["deviceModels"]),
+        )
+    else:
+        prog_path = write_program(
+            fallback_program(spec, deployment=deployment),
+            os.path.join(tmp, "program.json"),
+        )
+    # rings live on tmpfs (default_ring_dir docstring: disk-backed MAP_SHARED
+    # pays a journal fault per cleaned page — ~20x ping-pong latency)
+    ring_dir = None if args.ipc_base else default_ring_dir()
+    base = args.ipc_base or os.path.join(ring_dir, "ring")
     # One edge process per worker, each with its own response ring (an edge's
     # internal fork cannot be used here: forked loops would race on one ring).
     n_workers = max(1, args.workers)
-    server = IPCEngineServer(engine, base, n_workers=n_workers)
+    server = IPCEngineServer(engine, base, n_workers=n_workers,
+                             model_executor=executor)
     edges = [
         subprocess.Popen(
             [
@@ -272,6 +311,10 @@ def run_edge(args: argparse.Namespace) -> None:
             if e.poll() is None:
                 e.terminate()
         cleanup_rings(base, n_workers)
+        if ring_dir is not None:
+            import shutil
+
+            shutil.rmtree(ring_dir, ignore_errors=True)
 
 
 def run_loadtest_native(args: argparse.Namespace) -> None:

@@ -9,10 +9,25 @@ layout, since exactly one process should own the TPU chip while N CPU-bound
 frontends decode payloads.
 
 Frame format (bytes, little-endian):
-    u16 worker_id | u32 request_id | u8 kind | JSON payload
-kind: 0 = predict(SeldonMessage), 1 = feedback(Feedback).
+    u16 worker_id | u32 request_id | u8 kind | payload
+kind: 0 = predict(SeldonMessage JSON), 1 = feedback(Feedback JSON),
+      2 = device-model call (binary tensor, no JSON):
+          u16 model_id | u8 ndim | u32 dims[ndim] | f64 data.
 Responses travel back on a per-worker ring as
-    u32 request_id | u8 status | JSON payload   (status 0 = ok, 1 = error)
+    u32 request_id | u8 status | body
+status 0 JSON kinds: JSON payload. status 0 model kind:
+    u8 dtype (0=f32,1=f64 — the model's output dtype, data itself is f64)
+    | u8 ndim | u32 dims[ndim] | u32 json_len
+    | json ({"names": [...], "tags": {...}, "metrics": [...]}) | f64 data.
+status 1 (any kind): JSON Status body.
+
+The kind-2 path is how the native edge serves graphs with real models at
+native speed (runtime/edgeprogram.py DEVICE_MODEL): the edge executes the
+graph — routing, combining, meta — in C++ and ships ONLY the tensor here;
+this process owns the device and micro-batches concurrent requests into one
+jitted call (requests for the same model with the same feature shape are
+stacked along axis 0 — the serving-side continuous batching the reference's
+replica fan-out can't do).
 """
 
 from __future__ import annotations
@@ -22,8 +37,11 @@ import json
 import logging
 import os
 import struct
+import threading
 import time
 from typing import Any, Dict, Optional
+
+import numpy as np
 
 from seldon_core_tpu.contracts.payload import Feedback, SeldonError, SeldonMessage
 from seldon_core_tpu.native import PayloadTooLarge, SharedRing
@@ -32,9 +50,216 @@ logger = logging.getLogger(__name__)
 
 _REQ_HEADER = struct.Struct("<HIB")
 _RESP_HEADER = struct.Struct("<IB")
+_MODEL_REQ = struct.Struct("<HB")  # model_id, ndim (dims follow as u32 each)
 
 KIND_PREDICT = 0
 KIND_FEEDBACK = 1
+KIND_MODEL = 2
+
+
+class ModelExecutor:
+    """Executes kind-2 device-model frames for the native edge.
+
+    Holds the graph's resolvable model components (modelId order from
+    compile_edge_program). Frames arriving in one drain batch for the same
+    model with the same feature shape are stacked into ONE predict call —
+    the device sees large batches even when every client sends batch-1."""
+
+    def __init__(self, models):
+        self.models = list(models)
+        self.batched_calls = 0
+        self.batched_rows = 0
+        # cap stacking at the largest compiled bucket so a burst can never
+        # trigger an unseen-batch-shape XLA compile mid-traffic
+        self.max_rows = [
+            int(max(getattr(m, "batch_buckets", ()) or (256,))) for m in self.models
+        ]
+        # Response meta fragments (names/tags/metrics JSON) depend only on
+        # the output shape for components that don't override tags()/
+        # metrics() — cache the encoded bytes per (model, ndim, cols)
+        # instead of re-deriving + json.dumps-ing on every request.
+        from seldon_core_tpu.components.component import _has_impl
+
+        self._frag_static = [
+            not (_has_impl(m, "tags") or _has_impl(m, "metrics"))
+            for m in self.models
+        ]
+        self._frag_cache: Dict[tuple, bytes] = {}
+
+    def warm(self) -> None:
+        """Compile every (bucket, feature-shape) pair up front. Without this
+        a load burst walks the bucket ladder one compile at a time while
+        requests queue behind each compile (measured: a 10s load window
+        collapsed to ~94 rps from compile storms)."""
+        for i, component in enumerate(self.models):
+            shape = None
+            cfg = getattr(component, "_config", None)
+            if isinstance(cfg, dict):
+                shape = cfg.get("input_shape")
+            if shape is None:
+                continue
+            dtype = np.dtype(getattr(component, "input_dtype", "float32"))
+            for b in sorted(set(getattr(component, "batch_buckets", ()) or (1,))):
+                if b > self.max_rows[i]:
+                    continue
+                try:
+                    component.predict(np.zeros((b, *shape), dtype), [], meta={})
+                except Exception:
+                    logger.exception("warmup failed for model %d bucket %d", i, b)
+                    break
+
+    # ---- frame codecs -------------------------------------------------
+    @staticmethod
+    def parse_frame(payload: bytes):
+        model_id, ndim = _MODEL_REQ.unpack_from(payload)
+        dims = struct.unpack_from(f"<{ndim}I", payload, _MODEL_REQ.size)
+        off = _MODEL_REQ.size + 4 * ndim
+        n = 1
+        for d in dims:
+            n *= d
+        arr = np.frombuffer(payload, dtype="<f8", count=n, offset=off).reshape(dims)
+        return model_id, arr
+
+    @staticmethod
+    def _ok_response(req_id: int, arr: np.ndarray, frag: bytes) -> bytes:
+        dtype_code = 1 if arr.dtype == np.float64 else 0
+        out = arr.astype("<f8", copy=False)
+        head = _RESP_HEADER.pack(req_id, 0) + bytes([dtype_code, out.ndim])
+        head += struct.pack(f"<{out.ndim}I", *out.shape)
+        head += struct.pack("<I", len(frag)) + frag
+        return head + out.tobytes()
+
+    def _fragment_for(self, model_id: int, component, result: np.ndarray) -> bytes:
+        key = (model_id, result.ndim,
+               int(result.shape[1]) if result.ndim > 1 else -1)
+        if self._frag_static[model_id]:
+            cached = self._frag_cache.get(key)
+            if cached is not None:
+                return cached
+        from seldon_core_tpu.components.component import (
+            client_class_names,
+            client_custom_metrics,
+            client_custom_tags,
+        )
+
+        fragment: Dict[str, Any] = {}
+        names = client_class_names(component, result)
+        if names:
+            fragment["names"] = list(names)
+        tags = client_custom_tags(component)
+        if tags:
+            fragment["tags"] = tags
+        metrics = client_custom_metrics(component)
+        if metrics:
+            fragment["metrics"] = metrics
+        frag = json.dumps(fragment).encode() if fragment else b""
+        if self._frag_static[model_id]:
+            self._frag_cache[key] = frag
+        return frag
+
+    @staticmethod
+    def _err_response(req_id: int, info: str, reason: str, code: int = 500) -> bytes:
+        return _RESP_HEADER.pack(req_id, 1) + _error_body(info, reason, code)
+
+    # ---- execution ----------------------------------------------------
+    def _predict_frames(self, model_id: int, frames) -> Dict[tuple, bytes]:
+        """frames: [((worker_id, req_id), arr)]; one stacked predict when
+        shapes allow. Keys are (worker, req) pairs throughout: req_ids are
+        per-edge-worker counters, so with multiple edge workers the bare
+        req_id collides across workers."""
+        out: Dict[tuple, bytes] = {}
+        if model_id >= len(self.models):
+            for key, _ in frames:
+                out[key] = self._err_response(
+                    key[1], f"unknown device model {model_id}", "BAD_GRAPH")
+            return out
+        component = self.models[model_id]
+
+        def finish(key: tuple, result: np.ndarray) -> None:
+            if not (isinstance(result, np.ndarray)
+                    and (np.issubdtype(result.dtype, np.number)
+                         or result.dtype == np.bool_)):
+                out[key] = self._err_response(
+                    key[1],
+                    "device model returned a non-numeric payload",
+                    "ENGINE_ERROR")
+                return
+            out[key] = self._ok_response(
+                key[1], result, self._fragment_for(model_id, component, result))
+
+        # stack 2-D frames with equal feature shape into one call, chunked at
+        # the largest compiled bucket (stacking must never out-shape the
+        # warmed compile cache)
+        max_rows = self.max_rows[model_id]
+        stackable = [(r, a) for r, a in frames if a.ndim >= 2]
+        solo = [(r, a) for r, a in frames if a.ndim < 2]
+        by_shape: Dict[tuple, list] = {}
+        for r, a in stackable:
+            by_shape.setdefault(a.shape[1:], []).append((r, a))
+        chunked = []
+        for shape, group in by_shape.items():
+            chunk: list = []
+            rows = 0
+            for r, a in group:
+                if chunk and rows + a.shape[0] > max_rows:
+                    chunked.append((shape, chunk))
+                    chunk, rows = [], 0
+                chunk.append((r, a))
+                rows += a.shape[0]
+            if chunk:
+                chunked.append((shape, chunk))
+        for shape, group in chunked:
+            try:
+                if len(group) == 1:
+                    key, arr = group[0]
+                    finish(key, np.asarray(
+                        component.predict(arr, [], meta={})))
+                else:
+                    stacked = np.concatenate([a for _, a in group], axis=0)
+                    result = np.asarray(component.predict(stacked, [], meta={}))
+                    if result.shape[:1] != stacked.shape[:1]:
+                        raise SeldonError(
+                            "device model output rows do not match stacked "
+                            "input rows; cannot split a micro-batch")
+                    self.batched_calls += 1
+                    self.batched_rows += stacked.shape[0]
+                    offset = 0
+                    for key, a in group:
+                        finish(key, result[offset:offset + a.shape[0]])
+                        offset += a.shape[0]
+            except Exception as e:
+                for key, _ in group:
+                    out[key] = self._err_response(
+                        key[1], str(e),
+                        getattr(e, "reason", "ENGINE_ERROR"),
+                        int(getattr(e, "status_code", 500)))
+        for key, arr in solo:
+            try:
+                finish(key, np.asarray(component.predict(arr, [], meta={})))
+            except Exception as e:
+                out[key] = self._err_response(
+                    key[1], str(e),
+                    getattr(e, "reason", "ENGINE_ERROR"),
+                    int(getattr(e, "status_code", 500)))
+        return out
+
+    def execute(self, frames) -> Dict[int, Dict[int, bytes]]:
+        """frames: [(worker_id, req_id, payload_bytes)] →
+        {worker_id: {req_id: response_bytes}}."""
+        parsed: Dict[int, list] = {}
+        responses: Dict[int, Dict[int, bytes]] = {}
+        for worker_id, req_id, payload in frames:
+            try:
+                model_id, arr = self.parse_frame(payload)
+            except Exception:
+                responses.setdefault(worker_id, {})[req_id] = self._err_response(
+                    req_id, "malformed device-model frame", "MICROSERVICE_BAD_DATA", 400)
+                continue
+            parsed.setdefault(model_id, []).append(((worker_id, req_id), arr))
+        for model_id, group in parsed.items():
+            for (worker_id, req_id), resp in self._predict_frames(model_id, group).items():
+                responses.setdefault(worker_id, {})[req_id] = resp
+        return responses
 
 
 def _error_body(info: str, reason: str, code: int = 500) -> bytes:
@@ -43,6 +268,19 @@ def _error_body(info: str, reason: str, code: int = 500) -> bytes:
     return json.dumps(
         {"status": {"code": code, "info": info, "reason": reason, "status": "FAILURE"}}
     ).encode()
+
+
+def default_ring_dir(prefix: str = "seldon-ring-") -> str:
+    """Ring files MUST live on tmpfs: a MAP_SHARED mapping over a disk-backed
+    file re-faults through the filesystem (journal block allocation) every
+    time writeback cleans a dirtied page — measured 8.8ms ping-pong RTT on
+    /tmp (ext4) vs 0.45ms on /dev/shm for the identical ring."""
+    import tempfile
+
+    shm = "/dev/shm"
+    if os.path.isdir(shm) and os.access(shm, os.W_OK):
+        return tempfile.mkdtemp(prefix=prefix, dir=shm)
+    return tempfile.mkdtemp(prefix=prefix)
 
 
 def request_ring_path(base: str) -> str:
@@ -64,10 +302,12 @@ class IPCEngineServer:
         capacity: int = 1024,
         slot_size: int = 1 << 20,
         batch: int = 64,
+        model_executor: Optional[ModelExecutor] = None,
     ):
         self.engine = engine
         self.base_path = base_path
         self.batch = batch
+        self.model_executor = model_executor
         # sweep temp files orphaned by a previous creator killed mid-create;
         # glob per exact ring path so a sibling base sharing this prefix
         # (e.g. "<base>2") is never touched mid-create
@@ -94,11 +334,96 @@ class IPCEngineServer:
         self._stop = False
 
     async def serve_forever(self, poll_wait_s: float = 0.05) -> None:
-        while not self._stop:
-            frames = await asyncio.to_thread(self.req_ring.pop_batch, self.batch, poll_wait_s)
-            if not frames:
+        """Drain loop. The hot path (kind-2 model frames) runs entirely on a
+        dedicated thread — pop, stacked predict, response push — with zero
+        event-loop hops; only JSON graph frames (kind 0/1) cross into the
+        asyncio engine. (asyncio.to_thread cost ~1ms of scheduling per hop at
+        exactly the moment throughput mattered.)"""
+        loop = asyncio.get_running_loop()
+        done = asyncio.Event()
+        trace = bool(os.environ.get("SELDON_IPC_TRACE"))
+
+        from collections import deque
+
+        # Backpressure for JSON graph frames: cap in-flight engine coroutines
+        # so a burst fills the ring (edge answers 503 ENGINE_BUSY) instead of
+        # growing the event-loop queue without bound.
+        inflight: Any = deque()
+        max_inflight = max(4 * self.batch, 64)
+
+        def drain() -> None:
+            try:
+                while not self._stop:
+                    t0 = time.perf_counter()
+                    frames = self.req_ring.pop_batch(self.batch, poll_wait_s)
+                    if not frames:
+                        continue
+                    t1 = time.perf_counter()
+                    model_frames = []
+                    for f in frames:
+                        try:
+                            worker_id, req_id, kind = _REQ_HEADER.unpack_from(f)
+                        except struct.error:
+                            logger.error(
+                                "dropping malformed IPC frame (%d bytes)", len(f))
+                            continue
+                        if kind == KIND_MODEL and self.model_executor is not None:
+                            model_frames.append(
+                                (worker_id, req_id, f[_REQ_HEADER.size:]))
+                        else:
+                            while inflight and inflight[0].done():
+                                inflight.popleft()
+                            if len(inflight) >= max_inflight:
+                                inflight.popleft().result()  # block: backpressure
+                            inflight.append(
+                                asyncio.run_coroutine_threadsafe(self._handle(f), loop))
+                    if model_frames:
+                        self._handle_models_sync(model_frames)
+                    if trace:
+                        print(
+                            f"ipc cycle: pop={1e3*(t1-t0):.2f}ms "
+                            f"n={len(frames)} "
+                            f"handle={1e3*(time.perf_counter()-t1):.2f}ms",
+                            file=__import__('sys').stderr, flush=True)
+            finally:
+                loop.call_soon_threadsafe(done.set)
+
+        threading.Thread(target=drain, name="ipc-drain", daemon=True).start()
+        await done.wait()
+
+    def _handle_models_sync(self, model_frames) -> None:
+        try:
+            responses = self.model_executor.execute(model_frames)
+        except Exception:
+            logger.exception("model executor batch failed")
+            responses = {}
+            for w, r, _ in model_frames:
+                responses.setdefault(w, {})[r] = ModelExecutor._err_response(
+                    r, "model executor crashed", "ENGINE_ERROR")
+        for worker_id, by_req in responses.items():
+            ring = self.resp_rings.get(worker_id)
+            if ring is None:
+                logger.error("device responses for unknown worker %d dropped",
+                             worker_id)
                 continue
-            await asyncio.gather(*[self._handle(f) for f in frames])
+            for resp in by_req.values():
+                try:
+                    ring.push_wait(resp, 5.0)
+                except PayloadTooLarge:
+                    req_id = _RESP_HEADER.unpack_from(resp)[0]
+                    err = ModelExecutor._err_response(
+                        req_id,
+                        f"device response too large for IPC slot "
+                        f"({len(resp)} bytes)",
+                        "RESPONSE_TOO_LARGE")
+                    try:
+                        ring.push_wait(err, 5.0)
+                    except Exception:
+                        logger.exception("dropping oversized device response")
+                except Exception:
+                    logger.exception(
+                        "dropping device response for stalled worker %d",
+                        worker_id)
 
     def stop(self) -> None:
         self._stop = True

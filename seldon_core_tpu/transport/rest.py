@@ -165,7 +165,143 @@ def make_component_app(
     app.router.add_get("/seldon.json", openapi)
     app.router.add_get("/metrics", prom)
     app.router.add_get("/prometheus", prom)
+
+    if hasattr(component, "generate"):
+        _add_generate_routes(app, component, metrics)
     return app
+
+
+def _add_generate_routes(app: web.Application, component: Any,
+                         metrics: MetricsRegistry) -> None:
+    """LLM generation endpoint (POST /v1/generate). Body:
+      {"prompt": str|[ids], "max_new_tokens": N, "stream": bool}  — single
+          prompt; with the component's continuous_batching on, concurrent
+          requests JOIN the in-flight decode batch (runtime/batcher.py)
+          instead of each running a private generate(); "stream": true
+          sends tokens as SSE events as they decode.
+      {"prompts": [...], ...} — explicit batch, served by one generate().
+    No reference counterpart (its servers are request/response classifiers);
+    this is the BASELINE.json LLM stretch surface."""
+    from seldon_core_tpu.runtime.batcher import get_batcher_service
+
+    async def generate(request: web.Request) -> web.Response:
+        t0 = time.perf_counter()
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise SeldonError("body must be a JSON object", status_code=400)
+            max_new = body.get("max_new_tokens")
+            if "prompts" in body:
+                out = await asyncio.to_thread(
+                    component.generate, body["prompts"], max_new_tokens=max_new,
+                    temperature=body.get("temperature"), seed=body.get("seed"))
+                metrics.observe_api_call("generate", "200", time.perf_counter() - t0)
+                return web.json_response(out)
+            prompt = body.get("prompt")
+            if prompt is None:
+                raise SeldonError("body needs 'prompt' or 'prompts'", status_code=400)
+            # Per-request sampling params can't join a shared batch (the
+            # batcher decodes every slot with the server's temperature/rng),
+            # so requests carrying them get a private generate() — same
+            # output as with batching disabled, never silently different.
+            custom_sampling = ("temperature" in body or "seed" in body)
+            svc = None if custom_sampling else get_batcher_service(component)
+            stream = bool(body.get("stream"))
+            decode = getattr(component, "_tokenizer", None)
+
+            if not stream:
+                if svc is not None:
+                    toks = await svc.submit(prompt, max_new)
+                else:
+                    out = await asyncio.to_thread(
+                        component.generate, [prompt], max_new_tokens=max_new,
+                        temperature=body.get("temperature"), seed=body.get("seed"))
+                    metrics.observe_api_call("generate", "200",
+                                             time.perf_counter() - t0)
+                    return web.json_response(
+                        {"tokens": out["tokens"][0], "text": out["texts"][0]})
+                text = decode.decode(toks) if (decode is not None
+                                               and isinstance(prompt, str)) else None
+                metrics.observe_api_call("generate", "200", time.perf_counter() - t0)
+                return web.json_response({"tokens": toks, "text": text})
+
+            if custom_sampling:
+                raise SeldonError(
+                    "streaming with per-request temperature/seed is not "
+                    "supported; set them on the server", status_code=400)
+
+            # SSE streaming: one event per token as the shared batch decodes
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache"})
+            await resp.prepare(request)
+            loop = asyncio.get_running_loop()
+            q: asyncio.Queue = asyncio.Queue()
+
+            def on_token(tok):
+                loop.call_soon_threadsafe(q.put_nowait, tok)
+
+            if svc is None:
+                # no batcher configured: stream via a shared 1-slot service
+                from seldon_core_tpu.runtime.batcher import BatcherService
+
+                svc = BatcherService(component, max_slots=1)
+                component._batcher_service = svc
+            fut = asyncio.ensure_future(svc.submit(prompt, max_new,
+                                                   on_token=on_token))
+            try:
+                # Wait on the queue AND the future: a submit that fails before
+                # any token (closed batcher, bad prompt) never sends the None
+                # sentinel, and waiting only on the queue would hang the
+                # connection forever.
+                while True:
+                    getter = asyncio.ensure_future(q.get())
+                    done, _ = await asyncio.wait(
+                        {getter, fut}, return_when=asyncio.FIRST_COMPLETED)
+                    if getter in done:
+                        tok = getter.result()
+                    else:
+                        getter.cancel()
+                        tok = q.get_nowait() if not q.empty() else None
+                    if tok is None:
+                        break
+                    piece = (decode.decode([tok]) if decode is not None
+                             and isinstance(prompt, str) else None)
+                    await resp.write(
+                        f"data: {json.dumps({'token': tok, 'text': piece})}\n\n".encode())
+                toks = await fut
+                text = decode.decode(toks) if (decode is not None
+                                               and isinstance(prompt, str)) else None
+                await resp.write(
+                    f"data: {json.dumps({'done': True, 'tokens': toks, 'text': text})}\n\n".encode())
+                await resp.write_eof()
+                metrics.observe_api_call("generate", "200", time.perf_counter() - t0)
+                return resp
+            except (ConnectionError, ConnectionResetError, asyncio.CancelledError):
+                # client went away mid-stream: stop awaiting (the admitted
+                # slot still decodes out its bounded max_new tokens)
+                fut.cancel()
+                raise
+            except Exception as e:
+                # response already prepared: a fresh error response can't be
+                # sent; log via metrics, surface what we can, stop decoding
+                fut.cancel()
+                metrics.observe_api_call(
+                    "generate", str(getattr(e, "status_code", 500)),
+                    time.perf_counter() - t0)
+                try:
+                    await resp.write(
+                        f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+                    await resp.write_eof()
+                except Exception:
+                    pass
+                return resp
+        except Exception as e:
+            code = str(getattr(e, "status_code", 500))
+            metrics.observe_api_call("generate", code, time.perf_counter() - t0)
+            return error_response(e)
+
+    app.router.add_post("/v1/generate", generate)
 
 
 # ---------------------------------------------------------------------------

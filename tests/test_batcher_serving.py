@@ -1,0 +1,196 @@
+"""ContinuousBatcher behind real transports (VERDICT r2 item 3): concurrent
+REST /v1/generate and gRPC jsonData predicts must JOIN the shared in-flight
+decode batch and still return token-parity with solo decode."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+from seldon_core_tpu.servers.llmserver import LLMServer
+
+KW = dict(vocab_size=96, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+          ffn_dim=64, max_seq_len=96)
+
+
+def make_server(**extra) -> LLMServer:
+    s = LLMServer(model="transformer", model_kwargs=KW, init_random=True,
+                  max_new_tokens=6, len_buckets=(16,), batch_buckets=(1, 4),
+                  temperature=0.0, eos_id=-1, seed=3, **extra)
+    s.load()
+    return s
+
+
+PROMPTS = [f"prompt number {i} with some text" for i in range(8)]
+
+
+@pytest.fixture(scope="module")
+def solo_tokens():
+    solo = make_server()
+    return [solo.generate([p])["tokens"][0] for p in PROMPTS]
+
+
+@pytest.fixture(scope="module")
+def batched_component():
+    return make_server(continuous_batching=3)
+
+
+@pytest.fixture()
+def rest_client(event_loop_policy, batched_component):
+    # aiohttp test utilities need a running loop per test; build a tiny
+    # threaded server instead so plain requests can hit it concurrently.
+    from seldon_core_tpu.transport.rest import make_component_app
+
+    app = make_component_app(batched_component)
+    runner = web.AppRunner(app)
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(runner.setup())
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        run.port = s.getsockname()[1]
+        site = web.SockSite(runner, s)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    started = threading.Event()
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    yield run.port
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture(scope="module")
+def event_loop_policy():
+    return None
+
+
+def _post(port, path, body, timeout=120.0, stream=False):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    if stream:
+        return resp
+    return json.loads(resp.read())
+
+
+def test_concurrent_rest_generate_token_parity(rest_client, batched_component,
+                                               solo_tokens):
+    """8 concurrent clients, 3 batcher slots: every client's tokens equal its
+    solo-decode tokens, and the shared batcher actually served them."""
+    port = rest_client
+    before = batched_component._batcher_service.submitted \
+        if getattr(batched_component, "_batcher_service", None) else 0
+    results = [None] * len(PROMPTS)
+
+    def work(i):
+        results[i] = _post(port, "/v1/generate", {"prompt": PROMPTS[i]})
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(len(PROMPTS))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, r in enumerate(results):
+        assert r["tokens"] == solo_tokens[i], i
+        assert isinstance(r["text"], str)
+    svc = batched_component._batcher_service
+    assert svc.submitted - before == len(PROMPTS)
+
+
+def test_rest_generate_batch_path(rest_client, solo_tokens):
+    out = _post(rest_client, "/v1/generate", {"prompts": PROMPTS[:2]})
+    assert out["tokens"] == [solo_tokens[0], solo_tokens[1]]
+
+
+def test_rest_generate_stream(rest_client, solo_tokens):
+    resp = _post(rest_client, "/v1/generate",
+                 {"prompt": PROMPTS[0], "stream": True}, stream=True)
+    events = []
+    for raw in resp:
+        raw = raw.decode().strip()
+        if raw.startswith("data: "):
+            events.append(json.loads(raw[6:]))
+    assert events[-1].get("done") is True
+    streamed = [e["token"] for e in events[:-1]]
+    assert streamed == solo_tokens[0]
+    assert events[-1]["tokens"] == solo_tokens[0]
+
+
+def test_grpc_jsondata_prompt_joins_batch(batched_component, solo_tokens):
+    import grpc
+
+    from seldon_core_tpu.transport import grpc_client
+    from seldon_core_tpu.contracts.payload import SeldonMessage
+    from seldon_core_tpu.transport.grpc_server import make_component_server
+
+    server = make_component_server(batched_component, host="127.0.0.1", port=None)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        before = batched_component._batcher_service.submitted
+        results = [None] * 4
+
+        def work(i):
+            out = grpc_client.call_sync(
+                f"127.0.0.1:{port}", "Predict",
+                SeldonMessage.from_dict({"jsonData": {"prompt": PROMPTS[i]}}),
+                timeout_s=120.0)
+            results[i] = out.json_data
+
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, r in enumerate(results):
+            assert r["tokens"] == solo_tokens[i], i
+        assert batched_component._batcher_service.submitted - before == 4
+    finally:
+        server.stop(None)
+
+
+def test_generate_without_batcher_still_serves(solo_tokens):
+    """continuous_batching=0: /v1/generate falls back to a private
+    generate() — same tokens, no shared service created by the plain path."""
+    from seldon_core_tpu.transport.rest import make_component_app
+
+    comp = make_server()  # no continuous_batching
+    app = make_component_app(comp)
+    loop = asyncio.new_event_loop()
+    runner = web.AppRunner(app)
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(runner.setup())
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        run.port = s.getsockname()[1]
+        loop.run_until_complete(web.SockSite(runner, s).start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    try:
+        out = _post(run.port, "/v1/generate", {"prompt": PROMPTS[0]})
+        assert out["tokens"] == solo_tokens[0]
+        assert getattr(comp, "_batcher_service", None) is None
+    finally:
+        loop.call_soon_threadsafe(loop.stop)

@@ -182,3 +182,46 @@ def test_ipc_oversized_response_returns_error_and_server_survives(tmp_path):
         client.close()
         stop.set()
         proc.join(timeout=30)
+
+
+def test_model_executor_same_req_id_different_workers():
+    """req_ids are per-edge-worker counters: frames from two workers with the
+    SAME req_id must each get their own (correct) response — keying by bare
+    req_id would drop or misroute one of them."""
+    import struct
+
+    import numpy as np
+
+    from seldon_core_tpu.components.component import SeldonComponent
+    from seldon_core_tpu.transport.ipc import ModelExecutor, _RESP_HEADER
+
+    class Doubler(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.asarray(X, np.float64) * 2.0
+
+    class Tripler(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.asarray(X, np.float64) * 3.0
+
+    ex = ModelExecutor([Doubler(), Tripler()])
+
+    def frame(model_id, value):
+        data = np.array([[value]], dtype="<f8")
+        return (struct.pack("<HB", model_id, 2) + struct.pack("<2I", 1, 1)
+                + data.tobytes())
+
+    # worker 0 req 7 -> model 0 (x2); worker 1 req 7 -> model 1 (x3)
+    responses = ex.execute([(0, 7, frame(0, 10.0)), (1, 7, frame(1, 10.0))])
+    assert set(responses.keys()) == {0, 1}
+
+    def value_of(resp: bytes) -> float:
+        req_id, status = _RESP_HEADER.unpack_from(resp)
+        assert status == 0 and req_id == 7
+        ndim = resp[6]
+        off = 7 + 4 * ndim
+        (json_len,) = struct.unpack_from("<I", resp, off)
+        off += 4 + json_len
+        return float(np.frombuffer(resp, "<f8", count=1, offset=off)[0])
+
+    assert value_of(responses[0][7]) == 20.0
+    assert value_of(responses[1][7]) == 30.0
