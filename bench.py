@@ -87,8 +87,11 @@ def main() -> None:
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    batch = 256 if on_tpu else 8
-    iters = 25 if on_tpu else 2
+    # b128 measured fastest on-chip (12,163 img/s vs 11,541 at b256 —
+    # benchmarks/tpu_sweep_results.jsonl latency sweep) and serves a 10.5ms
+    # batch latency instead of 22ms
+    batch = 128 if on_tpu else 8
+    iters = 50 if on_tpu else 2
 
     # Inference-optimized serving config (benchmarks/MFU_NOTES.md):
     # BN folded into the convs (fold_batchnorm — bit-exact, removes every

@@ -2521,7 +2521,9 @@ struct Server {
         }
         body_buf.push(']');
       };
-      if (dims.empty()) body_buf.append("[]");
+      // 0-d result (scalar predict): emit_nd(0) writes the bare number,
+      // matching the engine's tolist() of a 0-d array
+      if (vals.empty()) body_buf.append("[]");
       else emit_nd(0);
       body_buf.push('}');
     } else {

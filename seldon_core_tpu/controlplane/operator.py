@@ -117,26 +117,55 @@ class KubectlCluster:
     def __init__(self, kubectl: str = "kubectl"):
         self.kubectl = kubectl
 
+    def _resource_version(self, manifest: Dict[str, Any]) -> Optional[str]:
+        """resourceVersion of the live object, None when it does not exist.
+        --ignore-not-found separates 'absent' (rc 0, empty output) from a
+        real get failure (rc != 0 — apiserver timeout, RBAC), which raises:
+        a transient error must not misreport an update as a creation."""
+        meta = manifest.get("metadata", {})
+        args = [self.kubectl, "get", manifest.get("kind", "").lower(),
+                meta.get("name", ""), "--ignore-not-found",
+                "-o", "jsonpath={.metadata.resourceVersion}"]
+        if meta.get("namespace"):
+            # no -n when the manifest omits it: apply uses the context
+            # default namespace, and get must look in the same place
+            args += ["-n", meta["namespace"]]
+        res = subprocess.run(args, capture_output=True)
+        if res.returncode != 0:
+            raise RuntimeError(f"kubectl get failed: {res.stderr.decode()}")
+        rv = res.stdout.decode().strip()
+        return rv or None
+
     def apply(self, manifest: Dict[str, Any]) -> str:
+        # created/updated/unchanged from machine-stable signals only
+        # (exit codes, -o json, resourceVersion) — kubectl's human apply
+        # message ("configured"/"unchanged") is not a stable interface.
+        rv_before = self._resource_version(manifest)
         res = subprocess.run(
-            [self.kubectl, "apply", "-f", "-"],
+            [self.kubectl, "apply", "-f", "-", "-o", "json"],
             input=json.dumps(manifest).encode(),
             capture_output=True,
         )
         if res.returncode != 0:
             raise RuntimeError(f"kubectl apply failed: {res.stderr.decode()}")
-        out = res.stdout.decode()
-        if "created" in out:
+        try:
+            rv_after = json.loads(res.stdout.decode()).get(
+                "metadata", {}).get("resourceVersion")
+        except (ValueError, AttributeError) as e:
+            raise RuntimeError(f"kubectl apply returned non-JSON output: {e}")
+        if rv_before is None:
             return "created"
-        return "unchanged" if "unchanged" in out else "updated"
+        return "unchanged" if rv_after == rv_before else "updated"
 
     def delete(self, kind: str, namespace: str, name: str) -> bool:
+        # -o name prints one line per deleted object (machine format);
+        # --ignore-not-found + empty output = nothing existed
         res = subprocess.run(
             [self.kubectl, "delete", kind.lower(), name, "-n", namespace,
-             "--ignore-not-found"],
+             "--ignore-not-found", "-o", "name"],
             capture_output=True,
         )
-        return res.returncode == 0 and b"deleted" in res.stdout
+        return res.returncode == 0 and bool(res.stdout.strip())
 
     def list(self, label: Optional[str] = None, value: Optional[str] = None) -> List[Dict[str, Any]]:
         items: List[Dict[str, Any]] = []

@@ -58,31 +58,57 @@ def logical_axis_tree(module, example_input):
 
 def shard_params(params: Any, mesh, logical_specs: Any, rules=DEFAULT_LOGICAL_RULES):
     """device_put the param pytree with NamedShardings from logical specs.
-    Params without a spec (or when logical_specs is None) are replicated."""
+    Params without a spec (or when logical_specs is None) are replicated.
+
+    Int8-quantized leaves (ops.quantize.QuantizedTensor) shard too: the
+    weight's logical spec applies to ``q`` unchanged (same shape as the
+    original float leaf), and the per-output-channel ``scale`` [C] takes the
+    spec's LAST axis (the channel dim it broadcasts over) — so int8 serving
+    composes with tensor parallelism instead of excluding it."""
     import jax
     from flax.linen import partitioning as nn_partitioning
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from seldon_core_tpu.ops.quantize import QuantizedTensor
+
     rules = _rules_for_mesh(mesh, rules)
     replicated = NamedSharding(mesh, P())
+
+    def is_q(x) -> bool:
+        return isinstance(x, QuantizedTensor)
 
     if logical_specs is None:
         return jax.device_put(params, replicated)
 
-    def to_sharding(spec):
-        mesh_spec = nn_partitioning.logical_to_mesh_axes(spec, rules=rules)
-        return NamedSharding(mesh, P(*mesh_spec))
+    def to_mesh_spec(spec):
+        return nn_partitioning.logical_to_mesh_axes(spec, rules=rules)
 
-    flat_p, treedef_p = jax.tree.flatten(params)
-    specs_for_params = _align_specs(params, logical_specs)
+    def to_sharding(spec):
+        return NamedSharding(mesh, P(*to_mesh_spec(spec)))
+
+    flat_p, treedef_p = jax.tree.flatten(params, is_leaf=is_q)
+    specs_for_params = _align_specs(params, logical_specs, extra_leaf=is_q)
     flat_s, _ = jax.tree.flatten(specs_for_params, is_leaf=lambda x: x is None or _is_spec(x))
     if len(flat_s) != len(flat_p):
         logger.warning("param/spec tree mismatch (%d vs %d); replicating params", len(flat_p), len(flat_s))
         return jax.device_put(params, replicated)
-    out = [
-        jax.device_put(p, to_sharding(s) if s is not None else replicated)
-        for p, s in zip(flat_p, flat_s)
-    ]
+    out = []
+    for p, s in zip(flat_p, flat_s):
+        if is_q(p):
+            if s is not None:
+                mesh_spec = list(to_mesh_spec(s))
+                wsh = NamedSharding(mesh, P(*mesh_spec))
+                last = mesh_spec[-1] if mesh_spec else None
+                ssh = NamedSharding(mesh, P(last))
+            else:
+                wsh = ssh = replicated
+            out.append(QuantizedTensor(
+                q=jax.device_put(p.q, wsh),
+                scale=jax.device_put(p.scale, ssh),
+                orig_dtype=p.orig_dtype,
+            ))
+        else:
+            out.append(jax.device_put(p, to_sharding(s) if s is not None else replicated))
     return jax.tree.unflatten(treedef_p, out)
 
 
@@ -92,10 +118,11 @@ def _is_spec(x) -> bool:
     return isinstance(x, (tuple, PartitionSpec))
 
 
-def _align_specs(params: Any, logical_specs: Any):
+def _align_specs(params: Any, logical_specs: Any, extra_leaf=None):
     """The params tree may contain collections (params/batch_stats) while the
     axes tree covers only 'params'. Walk params and pull matching specs, None
-    where absent."""
+    where absent. ``extra_leaf`` marks additional leaf types (quantized
+    tensors) so the walk doesn't descend into them."""
     import jax
 
     spec_map = {}
@@ -114,7 +141,7 @@ def _align_specs(params: Any, logical_specs: Any):
             return spec_map[key[1:]]
         return None
 
-    return jax.tree_util.tree_map_with_path(lookup, params)
+    return jax.tree_util.tree_map_with_path(lookup, params, is_leaf=extra_leaf)
 
 
 def sharding_report(params: Any) -> dict:

@@ -90,18 +90,49 @@ class BatcherService:
         self._loop.call_soon_threadsafe(self._loop.stop)
 
 
+_service_init_lock: Any = None
+
+
+def _init_lock():
+    global _service_init_lock
+    if _service_init_lock is None:
+        import threading
+
+        _service_init_lock = threading.Lock()
+    return _service_init_lock
+
+
 def get_batcher_service(component: Any) -> Optional[BatcherService]:
     """The component's shared BatcherService, created on first use when the
     component opted in (``continuous_batching`` slots > 0) and exposes the
-    LLM generate surface; None otherwise."""
+    LLM generate surface; None otherwise. Creation is locked: the first REST
+    request (event loop) and first gRPC request (worker thread) can race,
+    and two batchers would each allocate slot caches and step the device."""
     svc = getattr(component, "_batcher_service", None)
     if svc is not None:
         return svc  # reuse even when batching is off (streaming's 1-slot svc)
     slots = int(getattr(component, "continuous_batching", 0) or 0)
     if slots <= 0 or not hasattr(component, "generate"):
         return None
-    svc = BatcherService(component, max_slots=slots)
-    component._batcher_service = svc
+    with _init_lock():
+        svc = getattr(component, "_batcher_service", None)
+        if svc is None:
+            svc = BatcherService(component, max_slots=slots)
+            component._batcher_service = svc
+    return svc
+
+
+def ensure_stream_service(component: Any) -> BatcherService:
+    """Streaming without continuous batching: one shared 1-slot service per
+    component (same double-checked lock; never one per request)."""
+    svc = get_batcher_service(component)
+    if svc is not None:
+        return svc
+    with _init_lock():
+        svc = getattr(component, "_batcher_service", None)
+        if svc is None:
+            svc = BatcherService(component, max_slots=1)
+            component._batcher_service = svc
     return svc
 
 

@@ -83,7 +83,7 @@ def compile_edge_program(
     device_models: List[str] = []
 
     def compile_device_unit(unit: PredictiveUnit) -> Optional[int]:
-        from seldon_core_tpu.components.component import has_raw
+        from seldon_core_tpu.components.component import _has_impl, has_raw
         from seldon_core_tpu.contracts.graph import UnitType
 
         if not device_components or unit.name not in device_components:
@@ -94,6 +94,10 @@ def compile_edge_program(
             return None
         component = device_components[unit.name]
         if component is None or has_raw(component, "predict"):
+            return None
+        if _has_impl(component, "send_feedback") or has_raw(component, "send_feedback"):
+            # native feedback handling is bandit-only; a model that learns
+            # from feedback must keep the Python engine in the loop
             return None
         if getattr(component, "is_async", False):
             return None

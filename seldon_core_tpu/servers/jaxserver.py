@@ -107,12 +107,15 @@ class JAXServer(SeldonComponent):
             self.mesh = serving_mesh(model_parallel=self.tensor_parallel)
 
         params = self._load_params(path)
+        param_dtype = self._config.get("param_dtype", self.param_dtype)
         module_dtype = getattr(module, "dtype", None)
-        if module_dtype is not None:
+        if param_dtype and (param_dtype != "auto" or module_dtype is not None):
+            # only "auto" needs the module's compute dtype; an explicit
+            # param_dtype casts regardless of whether the module exposes one
             from seldon_core_tpu.servers.llmserver import _cast_params
 
             params = _cast_params(
-                params, self._config.get("param_dtype", self.param_dtype), module_dtype
+                params, param_dtype, module_dtype or "float32"
             )
         apply_kwargs = self._config.get("apply_kwargs", {})
 
@@ -126,12 +129,9 @@ class JAXServer(SeldonComponent):
         if quantize:
             if quantize != "int8":
                 raise SeldonError(f"unsupported quantize={quantize!r} (int8 only)", status_code=500)
-            if self.mesh is not None or self.tensor_parallel > 1:
-                raise SeldonError(
-                    "quantize=int8 with a mesh is not supported yet "
-                    "(quantized leaves don't carry logical axis names)",
-                    status_code=500,
-                )
+            # Composes with a mesh: shard_params places q under the weight's
+            # logical spec and scale under its channel (last) axis, so int8
+            # and tensor parallelism are no longer mutually exclusive.
             from seldon_core_tpu.ops.quantize import dequantize_params, quantize_params
 
             params = quantize_params(params)

@@ -256,24 +256,23 @@ class LLMServer(SeldonComponent):
                 )
             self.mesh = make_mesh({"data": -1, "seq": sp, "model": tp})
 
+        # quantize BEFORE sharding: shard_params understands QuantizedTensor
+        # leaves (q under the weight's logical spec, scale under the channel
+        # axis), so int8 + tensor parallelism compose.
+        self._dequant = lambda p: p
+        if self.quantize:
+            if self.quantize != "int8":
+                raise SeldonError(f"unsupported quantize={self.quantize!r} (int8 only)", status_code=500)
+            from seldon_core_tpu.ops.quantize import dequantize_params, quantize_params
+
+            params = quantize_params(params)
+            self._dequant = dequantize_params
+
         if self.mesh is not None:
             from seldon_core_tpu.parallel.sharding import logical_axis_tree, shard_params
 
             logical = logical_axis_tree(self._module, jax.ShapeDtypeStruct((1, 8), jnp.int32))
             params = shard_params(params, self.mesh, logical)
-
-        self._dequant = lambda p: p
-        if self.quantize:
-            if self.quantize != "int8":
-                raise SeldonError(f"unsupported quantize={self.quantize!r} (int8 only)", status_code=500)
-            if self.mesh is not None:
-                raise SeldonError(
-                    "quantize=int8 with a mesh is not supported yet", status_code=500
-                )
-            from seldon_core_tpu.ops.quantize import dequantize_params, quantize_params
-
-            params = quantize_params(params)
-            self._dequant = dequantize_params
         self._params = params
 
         if self.tokenizer_name == "bytes":

@@ -391,6 +391,22 @@ def run_convert(args: argparse.Namespace) -> None:
     print(out)
 
 
+def run_render_chart(args: argparse.Namespace) -> None:
+    """Render a deploy/charts chart without the helm binary (the in-repo
+    subset renderer; `helm template` produces the same output)."""
+    from seldon_core_tpu.controlplane.charts import render_chart
+
+    values = {}
+    if args.values:
+        import yaml
+
+        with open(args.values) as f:
+            values = yaml.safe_load(f) or {}
+    for name, text in render_chart(args.chart, values, namespace=args.namespace):
+        print(f"---\n# Source: {os.path.basename(args.chart)}/templates/{name}")
+        print(text)
+
+
 def run_analytics(args: argparse.Namespace) -> None:
     from seldon_core_tpu.observability.dashboards import write_artifacts
 
@@ -571,6 +587,12 @@ def main(argv: Optional[list] = None) -> None:
     ltn.add_argument("--label", default="rest")
     ltn.add_argument("--report", default=None, help="write JSON report to this file")
     ltn.set_defaults(func=run_loadtest_native)
+
+    rc = sub.add_parser("render-chart", help="render a deploy/charts helm chart (no helm needed)")
+    rc.add_argument("chart", help="chart directory, e.g. deploy/charts/seldon-mab")
+    rc.add_argument("--values", default=None, help="values override YAML file")
+    rc.add_argument("--namespace", default="seldon-system")
+    rc.set_defaults(func=run_render_chart)
 
     ltw = sub.add_parser("loadtest-worker", help="fleet slave: run loadgen jobs sent over TCP")
     ltw.add_argument("--listen", type=int, required=True)

@@ -243,10 +243,9 @@ def _add_generate_routes(app: web.Application, component: Any,
 
             if svc is None:
                 # no batcher configured: stream via a shared 1-slot service
-                from seldon_core_tpu.runtime.batcher import BatcherService
+                from seldon_core_tpu.runtime.batcher import ensure_stream_service
 
-                svc = BatcherService(component, max_slots=1)
-                component._batcher_service = svc
+                svc = await asyncio.to_thread(ensure_stream_service, component)
             fut = asyncio.ensure_future(svc.submit(prompt, max_new,
                                                    on_token=on_token))
             try:

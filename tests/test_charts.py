@@ -1,0 +1,127 @@
+"""Chart drift tests (VERDICT r2 item 5): the helm charts, the raw
+manifests, and the example CRs must describe the SAME objects — a helm
+user and a kubectl-apply user can never see different installs."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+from seldon_core_tpu.controlplane.charts import (  # noqa: E402
+    CHARTS_DIR,
+    render_chart,
+    render_chart_docs,
+    render_template,
+)
+
+DEPLOY = os.path.dirname(CHARTS_DIR)
+
+
+def test_operator_chart_defaults_match_raw_manifests():
+    docs = render_chart_docs(os.path.join(CHARTS_DIR, "seldon-core-tpu-operator"))
+    with open(os.path.join(DEPLOY, "operator.yaml")) as f:
+        raw = [d for d in yaml.safe_load_all(f) if d is not None]
+    assert docs == raw
+
+
+def test_operator_chart_crd_is_verbatim_copy():
+    with open(os.path.join(CHARTS_DIR, "seldon-core-tpu-operator", "crds", "crd.yaml")) as f:
+        chart_crd = f.read()
+    with open(os.path.join(DEPLOY, "crd.yaml")) as f:
+        raw_crd = f.read()
+    assert chart_crd == raw_crd
+
+
+@pytest.mark.parametrize("chart,example", [
+    ("seldon-single-model", "single-model.json"),
+    ("seldon-abtest", "abtest.json"),
+    ("seldon-mab", "mab.json"),
+])
+def test_topology_chart_defaults_match_example_cr(chart, example):
+    docs = render_chart_docs(os.path.join(CHARTS_DIR, chart))
+    with open(os.path.join(DEPLOY, "examples", example)) as f:
+        want = json.load(f)
+    assert docs == [want]
+
+
+def test_topology_chart_values_flow_and_validate():
+    """Overridden values land in the CR and the result passes the same
+    validation the operator applies."""
+    from seldon_core_tpu.contracts.graph import SeldonDeploymentSpec
+    from seldon_core_tpu.controlplane.validate import require_valid
+
+    docs = render_chart_docs(
+        os.path.join(CHARTS_DIR, "seldon-mab"),
+        values={"name": "bandit2", "epsilon": "0.05", "replicas": 3,
+                "modelA": {"uri": "gs://b/a2"}})
+    cr = docs[0]
+    assert cr["metadata"]["name"] == "bandit2"
+    assert cr["spec"]["predictors"][0]["replicas"] == 3
+    graph = cr["spec"]["predictors"][0]["graph"]
+    assert graph["parameters"][1]["value"] == "0.05"
+    assert graph["children"][0]["modelUri"] == "gs://b/a2"
+    assert graph["children"][1]["modelUri"] == "gs://my-bucket/model-b"
+    sdep = SeldonDeploymentSpec.from_dict(cr)
+    require_valid(sdep)
+
+
+def test_operator_chart_istio_toggle():
+    docs_on = render_chart_docs(os.path.join(CHARTS_DIR, "seldon-core-tpu-operator"))
+    docs_off = render_chart_docs(
+        os.path.join(CHARTS_DIR, "seldon-core-tpu-operator"),
+        values={"istio": {"enabled": False}})
+    role_on = next(d for d in docs_on if d["kind"] == "ClusterRole")
+    role_off = next(d for d in docs_off if d["kind"] == "ClusterRole")
+    groups_on = {r["apiGroups"][0] for r in role_on["rules"]}
+    groups_off = {r["apiGroups"][0] for r in role_off["rules"]}
+    assert "networking.istio.io" in groups_on
+    assert "networking.istio.io" not in groups_off
+    # kustomize istio-off overlay removes the same (last) rule
+    assert role_on["rules"][4]["apiGroups"] == ["networking.istio.io"]
+
+
+def test_operator_chart_engine_values():
+    docs = render_chart_docs(
+        os.path.join(CHARTS_DIR, "seldon-core-tpu-operator"),
+        values={"namespace": "ml", "engine": {"image": "r/engine:v9",
+                                              "httpPort": 9000}})
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    assert dep["metadata"]["namespace"] == "ml"
+    env = {e["name"]: e["value"]
+           for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["ENGINE_CONTAINER_IMAGE_AND_VERSION"] == "r/engine:v9"
+    assert env["ENGINE_SERVER_PORT"] == "9000"
+    assert env["ENGINE_SERVER_GRPC_PORT"] == "5001"
+    sa = next(d for d in docs if d["kind"] == "ServiceAccount")
+    assert sa["metadata"]["namespace"] == "ml"
+
+
+def test_renderer_subset_semantics():
+    ctx = {"Values": {"a": {"b": "x"}, "flag": False, "n": 3},
+           "Release": {"Namespace": "ns"}}
+    assert render_template("v={{ .Values.a.b }}", ctx) == "v=x"
+    assert render_template('{{ .Values.missing | default "d" }}', ctx) == "d"
+    assert render_template("{{ .Values.n | quote }}", ctx) == '"3"'
+    out = render_template(
+        "a\n{{- if .Values.flag }}\nyes\n{{- else }}\nno\n{{- end }}\nb", ctx)
+    assert out == "a\nno\nb"
+    with pytest.raises(ValueError):
+        render_template("{{ .Values.x | exotic }}", {"Values": {"x": 1}})
+
+
+def test_kustomize_base_points_at_raw_manifests():
+    base = os.path.join(DEPLOY, "kustomize", "seldon-core-tpu-operator", "base",
+                        "kustomization.yaml")
+    with open(base) as f:
+        kust = yaml.safe_load(f)
+    for rel in kust["resources"]:
+        assert os.path.exists(os.path.join(os.path.dirname(base), rel)), rel
+
+
+def test_chart_render_cli_lists_all_templates():
+    rendered = render_chart(os.path.join(CHARTS_DIR, "seldon-core-tpu-operator"))
+    assert [name for name, _ in rendered] == ["operator.yaml"]

@@ -107,9 +107,10 @@ def test_jaxserver_int8_through_engine(tmp_path):
     probs = np.asarray(out["data"]["tensor"]["values"]).reshape(2, 3)
     np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-3)
 
-    # unsupported combos fail clean
-    with pytest.raises(SeldonError, match="mesh"):
-        JAXServer(model_uri=ckpt, quantize="int8", tensor_parallel=2).load()
+    # int8 composes with a mesh now (the old exclusion is lifted; an
+    # axis-less model like this MLP just replicates) — only bad quantize
+    # values fail
+    JAXServer(model_uri=ckpt, quantize="int8", tensor_parallel=2).load()
     with pytest.raises(SeldonError, match="int8 only"):
         JAXServer(model_uri=ckpt, quantize="int4").load()
 
@@ -162,3 +163,75 @@ def test_llmserver_int8_generates():
     logits_q, _ = pf_q(quant._params, tokens, positions)
     err = np.abs(np.asarray(logits_q, np.float32) - np.asarray(logits_f, np.float32))
     assert err.max() < 0.15, err.max()
+
+
+def test_shard_params_quantized_leaves(eight_devices):
+    """int8 + TP compose (VERDICT r2 item 4): shard_params places q under
+    the weight's logical spec and scale [C] under the channel (last) axis,
+    and dequantizing the sharded tree reproduces the unsharded dequant."""
+    import jax
+
+    from seldon_core_tpu.ops.quantize import QuantizedTensor as QT
+    from seldon_core_tpu.parallel.mesh import make_mesh
+    from seldon_core_tpu.parallel.sharding import shard_params
+
+    mesh = make_mesh({"data": 2, "model": 4})
+    rng = np.random.default_rng(0)
+    params = {"params": {
+        "w_col": rng.standard_normal((16, 8)).astype(np.float32),  # shard C
+        "w_row": rng.standard_normal((8, 16)).astype(np.float32),  # shard rows
+        "bias": rng.standard_normal((8,)).astype(np.float32),      # passthrough
+    }}
+    logical = {"w_col": ("embed", "mlp"), "w_row": ("mlp", "embed"),
+               "bias": ("embed",)}
+    # default rules map 'mlp'->'model', 'embed'->None (replicated)
+    qp = quantize_params(params)
+    sharded = shard_params(qp, mesh, {"params": logical})
+
+    w_col = sharded["params"]["w_col"]
+    w_row = sharded["params"]["w_row"]
+    assert isinstance(w_col, QT) and isinstance(w_row, QT)
+    # w_col: channel dim sharded over 'model' -> q shard [16, 2], scale [2]
+    assert w_col.q.sharding.shard_shape(w_col.q.shape) == (16, 2)
+    assert w_col.scale.sharding.shard_shape(w_col.scale.shape) == (2,)
+    # w_row: leading dim sharded -> scale replicated (channel dim unsharded)
+    assert w_row.q.sharding.shard_shape(w_row.q.shape) == (2, 16)
+    assert w_row.scale.sharding.shard_shape(w_row.scale.shape) == (16,)
+
+    back = dequantize_params(sharded)
+    want = dequantize_params(qp)
+    for k in ("w_col", "w_row"):
+        np.testing.assert_allclose(np.asarray(back["params"][k]),
+                                   np.asarray(want["params"][k]), rtol=0, atol=0)
+
+
+def test_llmserver_int8_with_mesh_generates(eight_devices):
+    """int8 LLM decode under a ('data','seq','model') mesh: loads, shards
+    quantized leaves, and generates greedily with bounded drift vs the
+    unsharded int8 path."""
+    from seldon_core_tpu.servers.llmserver import LLMServer
+
+    base = LLMServer(model="llama-tiny", init_random=True, max_new_tokens=4,
+                     len_buckets=(16,), batch_buckets=(1,), temperature=0.0,
+                     seed=5, quantize="int8")
+    base.load()
+    tp = LLMServer(model="llama-tiny", init_random=True, max_new_tokens=4,
+                   len_buckets=(16,), batch_buckets=(1,), temperature=0.0,
+                   seed=5, quantize="int8", tensor_parallel=2)
+    tp.load()
+    assert dict(tp.mesh.shape).get("model") == 2
+
+    prompt = [5, 9, 17, 33, 2, 7]
+    out_base = base.generate([prompt], max_new_tokens=4)["tokens"][0]
+    out_tp = tp.generate([prompt], max_new_tokens=4)["tokens"][0]
+    # same compiled math up to GSPMD reduction order; greedy tokens of a
+    # random-init model can tie-break differently, so compare logits
+    import jax.numpy as jnp
+
+    tokens = jnp.asarray([prompt], jnp.int32)
+    positions = jnp.arange(len(prompt))[None, :]
+    lf, _ = base._get_prefill(1, len(prompt), 16)(base._params, tokens, positions)
+    lq, _ = tp._get_prefill(1, len(prompt), 16)(tp._params, tokens, positions)
+    err = np.abs(np.asarray(lq, np.float32) - np.asarray(lf, np.float32))
+    assert err.max() < 1e-3, err.max()
+    assert len(out_base) <= 4 and len(out_tp) <= 4
