@@ -354,13 +354,15 @@ def bench_device(duration: float, workers: int = 1) -> dict:
     with open(spec_path, "w") as f:
         json.dump(spec, f)
     port = free_port()
+    grpc_port = free_port()
     code = (
         "import sys; sys.path.insert(0, {repo!r})\n"
         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
         "from seldon_core_tpu.transport.cli import main\n"
         "main(['edge', '--spec', {spec!r}, '--port', {port!r}, "
-        "'--workers', {workers!r}])\n"
-    ).format(repo=REPO, spec=spec_path, port=str(port), workers=str(workers))
+        "'--grpc-port', {gport!r}, '--workers', {workers!r}])\n"
+    ).format(repo=REPO, spec=spec_path, port=str(port), gport=str(grpc_port),
+             workers=str(workers))
     stderr_log = os.path.join("/tmp", f"device_bench_{os.getpid()}.err")
     import glob
 
@@ -379,6 +381,9 @@ def bench_device(duration: float, workers: int = 1) -> dict:
             raise RuntimeError(f"{e}; wrapper stderr: {tail}") from e
         runs = [run_loadgen(port, c, duration, f"device-mlp-{c}c")
                 for c in (16, 64, 256)]
+        grpc_runs = [run_loadgen(grpc_port, c, duration,
+                                 f"device-mlp-grpc-{c}c", grpc=True)
+                     for c in (16, 64, 128)]
     finally:
         import signal
 
@@ -402,18 +407,24 @@ def bench_device(duration: float, workers: int = 1) -> dict:
         os.unlink(spec_path)
         os.unlink(stderr_log)
     best = max(runs, key=lambda r: r["throughput_rps"])
+    best_grpc = max(grpc_runs, key=lambda r: r["throughput_rps"])
     return {
-        "metric": "single-JAX-model graph REST throughput (native edge "
+        "metric": "single-JAX-model graph throughput (native edge "
                   "DEVICE_MODEL -> packed-tensor ring -> ModelExecutor "
                   "micro-batched jit; MLP 4->128->128->3)",
         "best": best,
         "runs": runs,
+        "grpc_best": best_grpc,
+        "grpc_runs": grpc_runs,
         "workers": workers,
         "baseline_rps": REST_BASELINE_RPS,
         "vs_baseline": round(best["throughput_rps"] / REST_BASELINE_RPS, 4),
+        "grpc_baseline_rps": GRPC_BASELINE_RPS,
+        "grpc_vs_baseline": round(
+            best_grpc["throughput_rps"] / GRPC_BASELINE_RPS, 4),
         "note": "engine forced to CPU (tunnel-independent); every request "
-                "runs the real model — the reference's 12,089 rps baseline "
-                "serves an in-engine stub",
+                "runs the real model — the reference's 12,089/28,256 rps "
+                "baselines serve an in-engine stub",
     }
 
 

@@ -881,6 +881,248 @@ bool eval_unit(const Program& prog, int idx, Rng& rng, Payload in, ExecOut& out,
 }
 
 // ---------------------------------------------------------------------------
+// Protobuf wire helpers (hand-rolled; schema = proto/prediction.proto)
+// ---------------------------------------------------------------------------
+
+struct PbReader {
+  const uint8_t* p;
+  const uint8_t* end;
+
+  bool varint(uint64_t& out) {
+    out = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      out |= (uint64_t)(b & 0x7f) << shift;
+      if (!(b & 0x80)) return true;
+      shift += 7;
+      if (shift > 63) return false;
+    }
+    return false;
+  }
+  bool tag(uint32_t& field, uint32_t& wire) {
+    if (p >= end) return false;
+    uint64_t t;
+    if (!varint(t)) return false;
+    field = (uint32_t)(t >> 3);
+    wire = (uint32_t)(t & 7);
+    return true;
+  }
+  bool len_span(std::string_view& out) {
+    uint64_t len;
+    if (!varint(len)) return false;
+    if ((uint64_t)(end - p) < len) return false;
+    out = {(const char*)p, (size_t)len};
+    p += len;
+    return true;
+  }
+  bool skip(uint32_t wire) {
+    uint64_t tmp;
+    std::string_view sv;
+    switch (wire) {
+      case 0: return varint(tmp);
+      case 1: if (end - p < 8) return false; p += 8; return true;
+      case 2: return len_span(sv);
+      case 5: if (end - p < 4) return false; p += 4; return true;
+      default: return false;
+    }
+  }
+};
+
+struct PbWriter {
+  Buf& b;
+  void varint(uint64_t v) {
+    while (v >= 0x80) {
+      b.push((char)(v | 0x80));
+      v >>= 7;
+    }
+    b.push((char)v);
+  }
+  void tag(uint32_t field, uint32_t wire) { varint((uint64_t)field << 3 | wire); }
+  void str(uint32_t field, std::string_view s) {
+    tag(field, 2);
+    varint(s.size());
+    b.append(s);
+  }
+  void fixed32(uint32_t field, float v) {
+    tag(field, 5);
+    b.append((const char*)&v, 4);
+  }
+  void fixed64_raw(double v) { b.append((const char*)&v, 8); }
+};
+
+// Parsed gRPC SeldonMessage request (spans into the request buffer).
+struct PbSeldonMsg {
+  Payload in;
+  std::string_view puid;
+  std::vector<std::string_view> meta_echo;  // raw Meta fields 2/3/4/5 (tag+len+payload)
+  std::vector<std::string_view> req_metrics_raw;  // Meta field 5 entries
+  int64_t tensor_prod = -1, tensor_nvals = -1;
+  // device graphs: actual tensor contents (want_values) + names presence
+  bool want_values = false;
+  bool has_names = false;
+  std::vector<uint32_t> dims;
+  std::vector<double> vals;
+  const char* err = nullptr;
+};
+
+// Parse a Meta submessage (echo spans + puid).
+bool pb_parse_meta(std::string_view span, PbSeldonMsg& out) {
+  PbReader r{(const uint8_t*)span.data(), (const uint8_t*)span.data() + span.size()};
+  while (r.p < r.end) {
+    const uint8_t* field_start = r.p;
+    uint32_t field, wire;
+    if (!r.tag(field, wire)) return false;
+    if (field == 1 && wire == 2) {
+      if (!r.len_span(out.puid)) return false;
+    } else if ((field >= 2 && field <= 5) && wire == 2) {
+      std::string_view sv;
+      if (!r.len_span(sv)) return false;
+      std::string_view full{(const char*)field_start, (size_t)(r.p - field_start)};
+      if (field == 5) out.req_metrics_raw.push_back(full);
+      else out.meta_echo.push_back(full);
+    } else {
+      if (!r.skip(wire)) return false;
+    }
+  }
+  return true;
+}
+
+// ListValue rows: count of top-level Value elements; 2-D iff first is a list.
+bool pb_listvalue_rows(std::string_view span, int64_t& rows) {
+  PbReader r{(const uint8_t*)span.data(), (const uint8_t*)span.data() + span.size()};
+  int64_t count = 0;
+  bool first_is_list = false;
+  while (r.p < r.end) {
+    uint32_t field, wire;
+    if (!r.tag(field, wire)) return false;
+    if (field == 1 && wire == 2) {
+      std::string_view value_span;
+      if (!r.len_span(value_span)) return false;
+      if (count == 0) {
+        PbReader vr{(const uint8_t*)value_span.data(),
+                    (const uint8_t*)value_span.data() + value_span.size()};
+        uint32_t vf, vw;
+        if (vr.tag(vf, vw)) first_is_list = (vf == 6);
+      }
+      ++count;
+    } else if (!r.skip(wire)) {
+      return false;
+    }
+  }
+  rows = first_is_list ? count : (count > 0 ? 1 : 0);
+  return true;
+}
+
+bool pb_parse_tensor(std::string_view span, PbSeldonMsg& out) {
+  PbReader r{(const uint8_t*)span.data(), (const uint8_t*)span.data() + span.size()};
+  int64_t prod = 1, rows = 1, nvals = 0, ndims = 0;
+  while (r.p < r.end) {
+    uint32_t field, wire;
+    if (!r.tag(field, wire)) return false;
+    if (field == 1 && wire == 2) {  // packed shape
+      std::string_view sv;
+      if (!r.len_span(sv)) return false;
+      PbReader sr{(const uint8_t*)sv.data(), (const uint8_t*)sv.data() + sv.size()};
+      uint64_t d;
+      while (sr.p < sr.end && sr.varint(d)) {
+        if (ndims == 0) rows = (int64_t)d;
+        prod *= (int64_t)d;
+        ++ndims;
+        if (out.want_values) out.dims.push_back((uint32_t)d);
+      }
+    } else if (field == 1 && wire == 0) {  // unpacked shape element
+      uint64_t d;
+      if (!r.varint(d)) return false;
+      if (ndims == 0) rows = (int64_t)d;
+      prod *= (int64_t)d;
+      ++ndims;
+      if (out.want_values) out.dims.push_back((uint32_t)d);
+    } else if (field == 2 && wire == 2) {  // packed doubles
+      std::string_view sv;
+      if (!r.len_span(sv)) return false;
+      nvals += (int64_t)(sv.size() / 8);
+      if (out.want_values) {
+        size_t n = sv.size() / 8;
+        size_t base = out.vals.size();
+        out.vals.resize(base + n);
+        memcpy(out.vals.data() + base, sv.data(), n * 8);
+      }
+    } else if (field == 2 && wire == 1) {
+      if (out.want_values) {
+        if (r.end - r.p < 8) return false;
+        double v;
+        memcpy(&v, r.p, 8);
+        out.vals.push_back(v);
+      }
+      if (!r.skip(wire)) return false;
+      ++nvals;
+    } else if (!r.skip(wire)) {
+      return false;
+    }
+  }
+  if (ndims == 0) {
+    prod = nvals;
+    rows = 1;
+  }
+  out.tensor_prod = prod;
+  out.tensor_nvals = nvals;
+  out.in.kind = PKind::Tensor;
+  out.in.rows = ndims >= 2 ? rows : 1;
+  return true;
+}
+
+bool pb_parse_seldon_message(std::string_view msg, PbSeldonMsg& out) {
+  PbReader r{(const uint8_t*)msg.data(), (const uint8_t*)msg.data() + msg.size()};
+  while (r.p < r.end) {
+    uint32_t field, wire;
+    if (!r.tag(field, wire)) return false;
+    if (field == 2 && wire == 2) {  // meta
+      std::string_view sv;
+      if (!r.len_span(sv)) return false;
+      if (!pb_parse_meta(sv, out)) return false;
+    } else if (field == 3 && wire == 2) {  // DefaultData
+      std::string_view data_span;
+      if (!r.len_span(data_span)) return false;
+      PbReader dr{(const uint8_t*)data_span.data(),
+                  (const uint8_t*)data_span.data() + data_span.size()};
+      while (dr.p < dr.end) {
+        uint32_t df, dw;
+        if (!dr.tag(df, dw)) return false;
+        if (df == 1 && dw == 2) {  // names (device graphs fall back on these)
+          out.has_names = true;
+          if (!dr.skip(dw)) return false;
+        } else if (df == 2 && dw == 2) {
+          std::string_view tspan;
+          if (!dr.len_span(tspan)) return false;
+          if (!pb_parse_tensor(tspan, out)) return false;
+        } else if (df == 3 && dw == 2) {
+          std::string_view nd;
+          if (!dr.len_span(nd)) return false;
+          out.in.kind = PKind::NDArray;
+          if (!pb_listvalue_rows(nd, out.in.rows)) return false;
+        } else if (!dr.skip(dw)) {
+          return false;
+        }
+      }
+    } else if (field == 4 && wire == 2) {
+      if (!r.len_span(out.in.echo)) return false;
+      out.in.kind = PKind::Bin;
+    } else if (field == 5 && wire == 2) {
+      if (!r.len_span(out.in.echo)) return false;
+      out.in.kind = PKind::Str;
+    } else if (field == 6 && wire == 2) {
+      std::string_view sv;
+      if (!r.len_span(sv)) return false;
+      out.in.kind = PKind::Json;
+    } else if (!r.skip(wire)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
 // Device-graph execution: graphs mixing builtin units with DEVICE_MODEL
 // leaves. The edge evaluates routing/combining natively and ships each
 // model leaf's input tensor to the engine process (ring kind 2); payload
@@ -921,8 +1163,11 @@ struct DevExec {
   int conn_fd = -1;
   uint32_t conn_gen = 0;
   uint64_t t0 = 0;
-  std::string body;  // request copy: doc's spans point into this
-  JDoc doc;          // parsed ONCE over body; survives the park
+  bool is_grpc = false;   // response goes out as proto on h2_sid
+  uint32_t h2_sid = 0;
+  std::string body;  // request copy: doc's/proto spans point into this
+  JDoc doc;          // REST: parsed ONCE over body; survives the park
+  PbSeldonMsg preq;  // gRPC: ditto (meta echo spans into body)
   ExecOut ex;
   DVal result;
   std::vector<DevSite> sites;
@@ -1269,226 +1514,6 @@ bool hpack_decode(const uint8_t* p, const uint8_t* end, HpackDyn& dyn,
 }
 
 // ---------------------------------------------------------------------------
-// Protobuf wire helpers (hand-rolled; schema = proto/prediction.proto)
-// ---------------------------------------------------------------------------
-
-struct PbReader {
-  const uint8_t* p;
-  const uint8_t* end;
-
-  bool varint(uint64_t& out) {
-    out = 0;
-    int shift = 0;
-    while (p < end) {
-      uint8_t b = *p++;
-      out |= (uint64_t)(b & 0x7f) << shift;
-      if (!(b & 0x80)) return true;
-      shift += 7;
-      if (shift > 63) return false;
-    }
-    return false;
-  }
-  bool tag(uint32_t& field, uint32_t& wire) {
-    if (p >= end) return false;
-    uint64_t t;
-    if (!varint(t)) return false;
-    field = (uint32_t)(t >> 3);
-    wire = (uint32_t)(t & 7);
-    return true;
-  }
-  bool len_span(std::string_view& out) {
-    uint64_t len;
-    if (!varint(len)) return false;
-    if ((uint64_t)(end - p) < len) return false;
-    out = {(const char*)p, (size_t)len};
-    p += len;
-    return true;
-  }
-  bool skip(uint32_t wire) {
-    uint64_t tmp;
-    std::string_view sv;
-    switch (wire) {
-      case 0: return varint(tmp);
-      case 1: if (end - p < 8) return false; p += 8; return true;
-      case 2: return len_span(sv);
-      case 5: if (end - p < 4) return false; p += 4; return true;
-      default: return false;
-    }
-  }
-};
-
-struct PbWriter {
-  Buf& b;
-  void varint(uint64_t v) {
-    while (v >= 0x80) {
-      b.push((char)(v | 0x80));
-      v >>= 7;
-    }
-    b.push((char)v);
-  }
-  void tag(uint32_t field, uint32_t wire) { varint((uint64_t)field << 3 | wire); }
-  void str(uint32_t field, std::string_view s) {
-    tag(field, 2);
-    varint(s.size());
-    b.append(s);
-  }
-  void fixed32(uint32_t field, float v) {
-    tag(field, 5);
-    b.append((const char*)&v, 4);
-  }
-  void fixed64_raw(double v) { b.append((const char*)&v, 8); }
-};
-
-// Parsed gRPC SeldonMessage request (spans into the request buffer).
-struct PbSeldonMsg {
-  Payload in;
-  std::string_view puid;
-  std::vector<std::string_view> meta_echo;  // raw Meta fields 2/3/4/5 (tag+len+payload)
-  std::vector<std::string_view> req_metrics_raw;  // Meta field 5 entries
-  int64_t tensor_prod = -1, tensor_nvals = -1;
-  const char* err = nullptr;
-};
-
-// Parse a Meta submessage (echo spans + puid).
-bool pb_parse_meta(std::string_view span, PbSeldonMsg& out) {
-  PbReader r{(const uint8_t*)span.data(), (const uint8_t*)span.data() + span.size()};
-  while (r.p < r.end) {
-    const uint8_t* field_start = r.p;
-    uint32_t field, wire;
-    if (!r.tag(field, wire)) return false;
-    if (field == 1 && wire == 2) {
-      if (!r.len_span(out.puid)) return false;
-    } else if ((field >= 2 && field <= 5) && wire == 2) {
-      std::string_view sv;
-      if (!r.len_span(sv)) return false;
-      std::string_view full{(const char*)field_start, (size_t)(r.p - field_start)};
-      if (field == 5) out.req_metrics_raw.push_back(full);
-      else out.meta_echo.push_back(full);
-    } else {
-      if (!r.skip(wire)) return false;
-    }
-  }
-  return true;
-}
-
-// ListValue rows: count of top-level Value elements; 2-D iff first is a list.
-bool pb_listvalue_rows(std::string_view span, int64_t& rows) {
-  PbReader r{(const uint8_t*)span.data(), (const uint8_t*)span.data() + span.size()};
-  int64_t count = 0;
-  bool first_is_list = false;
-  while (r.p < r.end) {
-    uint32_t field, wire;
-    if (!r.tag(field, wire)) return false;
-    if (field == 1 && wire == 2) {
-      std::string_view value_span;
-      if (!r.len_span(value_span)) return false;
-      if (count == 0) {
-        PbReader vr{(const uint8_t*)value_span.data(),
-                    (const uint8_t*)value_span.data() + value_span.size()};
-        uint32_t vf, vw;
-        if (vr.tag(vf, vw)) first_is_list = (vf == 6);
-      }
-      ++count;
-    } else if (!r.skip(wire)) {
-      return false;
-    }
-  }
-  rows = first_is_list ? count : (count > 0 ? 1 : 0);
-  return true;
-}
-
-bool pb_parse_tensor(std::string_view span, PbSeldonMsg& out) {
-  PbReader r{(const uint8_t*)span.data(), (const uint8_t*)span.data() + span.size()};
-  int64_t prod = 1, rows = 1, nvals = 0, ndims = 0;
-  while (r.p < r.end) {
-    uint32_t field, wire;
-    if (!r.tag(field, wire)) return false;
-    if (field == 1 && wire == 2) {  // packed shape
-      std::string_view sv;
-      if (!r.len_span(sv)) return false;
-      PbReader sr{(const uint8_t*)sv.data(), (const uint8_t*)sv.data() + sv.size()};
-      uint64_t d;
-      while (sr.p < sr.end && sr.varint(d)) {
-        if (ndims == 0) rows = (int64_t)d;
-        prod *= (int64_t)d;
-        ++ndims;
-      }
-    } else if (field == 1 && wire == 0) {  // unpacked shape element
-      uint64_t d;
-      if (!r.varint(d)) return false;
-      if (ndims == 0) rows = (int64_t)d;
-      prod *= (int64_t)d;
-      ++ndims;
-    } else if (field == 2 && wire == 2) {  // packed doubles
-      std::string_view sv;
-      if (!r.len_span(sv)) return false;
-      nvals += (int64_t)(sv.size() / 8);
-    } else if (field == 2 && wire == 1) {
-      if (!r.skip(wire)) return false;
-      ++nvals;
-    } else if (!r.skip(wire)) {
-      return false;
-    }
-  }
-  if (ndims == 0) {
-    prod = nvals;
-    rows = 1;
-  }
-  out.tensor_prod = prod;
-  out.tensor_nvals = nvals;
-  out.in.kind = PKind::Tensor;
-  out.in.rows = ndims >= 2 ? rows : 1;
-  return true;
-}
-
-bool pb_parse_seldon_message(std::string_view msg, PbSeldonMsg& out) {
-  PbReader r{(const uint8_t*)msg.data(), (const uint8_t*)msg.data() + msg.size()};
-  while (r.p < r.end) {
-    uint32_t field, wire;
-    if (!r.tag(field, wire)) return false;
-    if (field == 2 && wire == 2) {  // meta
-      std::string_view sv;
-      if (!r.len_span(sv)) return false;
-      if (!pb_parse_meta(sv, out)) return false;
-    } else if (field == 3 && wire == 2) {  // DefaultData
-      std::string_view data_span;
-      if (!r.len_span(data_span)) return false;
-      PbReader dr{(const uint8_t*)data_span.data(),
-                  (const uint8_t*)data_span.data() + data_span.size()};
-      while (dr.p < dr.end) {
-        uint32_t df, dw;
-        if (!dr.tag(df, dw)) return false;
-        if (df == 2 && dw == 2) {
-          std::string_view tspan;
-          if (!dr.len_span(tspan)) return false;
-          if (!pb_parse_tensor(tspan, out)) return false;
-        } else if (df == 3 && dw == 2) {
-          std::string_view nd;
-          if (!dr.len_span(nd)) return false;
-          out.in.kind = PKind::NDArray;
-          if (!pb_listvalue_rows(nd, out.in.rows)) return false;
-        } else if (!dr.skip(dw)) {
-          return false;
-        }
-      }
-    } else if (field == 4 && wire == 2) {
-      if (!r.len_span(out.in.echo)) return false;
-      out.in.kind = PKind::Bin;
-    } else if (field == 5 && wire == 2) {
-      if (!r.len_span(out.in.echo)) return false;
-      out.in.kind = PKind::Str;
-    } else if (field == 6 && wire == 2) {
-      std::string_view sv;
-      if (!r.len_span(sv)) return false;
-      out.in.kind = PKind::Json;
-    } else if (!r.skip(wire)) {
-      return false;
-    }
-  }
-  return true;
-}
-
-// ---------------------------------------------------------------------------
 // HTTP layer
 // ---------------------------------------------------------------------------
 
@@ -1565,6 +1590,15 @@ struct Server {
   std::unordered_map<uint32_t, RingPending> pending;
   // device-graph requests: one entry per outstanding model call
   std::unordered_map<uint32_t, std::pair<DevExec*, int>> pending_dev;
+  // gRPC streams parked on a full-proto ring round-trip (kind 3)
+  struct GrpcPending {
+    int conn_fd;
+    uint32_t conn_gen;
+    uint32_t sid;
+    uint64_t started_ns;
+    bool is_feedback;
+  };
+  std::unordered_map<uint32_t, GrpcPending> pending_grpc;
   uint16_t ring_worker_id = 0;
   std::vector<char> ring_buf;  // reused drain buffer (slot-sized)
   static constexpr uint64_t kRingTimeoutNs = 30ull * 1000000000ull;
@@ -2553,8 +2587,8 @@ struct Server {
     }
   }
 
-  // All sites landed: resolve the dataflow over st->doc (parsed once at
-  // admission; its spans point into st->body) and respond.
+  // All sites landed: resolve the dataflow over st->doc/body and respond
+  // (JSON for REST parks, proto for gRPC parks).
   void finish_device(DevExec* st) {
     Conn& c = conn(st->conn_fd);
     bool conn_ok = c.fd == st->conn_fd && c.gen == st->conn_gen;
@@ -2562,12 +2596,25 @@ struct Server {
       delete st;
       return;
     }
-    c.waiting_ring = false;
     std::vector<double> vals;
     std::vector<uint32_t> dims;
     uint8_t dt;
     std::string err;
-    if (!resolve_dval(st->result, st->sites, vals, dims, dt, err)) {
+    bool resolved = resolve_dval(st->result, st->sites, vals, dims, dt, err);
+    if (st->is_grpc) {
+      if (!resolved) {
+        grpc_trailers_error(c, st->h2_sid, 13, err);
+        metrics.observe_api("predictions", 500, 1e-9 * (now_ns() - st->t0));
+      } else {
+        send_grpc_device_response(c, *st, vals, dims);
+        metrics.observe_api("predictions", 200, 1e-9 * (now_ns() - st->t0));
+      }
+      flush_out(c);
+      delete st;
+      return;
+    }
+    c.waiting_ring = false;
+    if (!resolved) {
       respond_error(c, 500, "INTERNAL_ERROR", err);
       metrics.observe_api("predictions", 500, 1e-9 * (now_ns() - st->t0));
     } else {
@@ -2577,6 +2624,370 @@ struct Server {
     flush_out(c);
     if (c.fd >= 0 && c.in.size() > 0) process_in(c);
     delete st;
+  }
+
+  static int grpc_code_from_http(int http) {
+    if (http == 400) return 3;   // INVALID_ARGUMENT
+    if (http == 503) return 14;  // UNAVAILABLE
+    if (http == 504) return 4;   // DEADLINE_EXCEEDED
+    return 13;                   // INTERNAL
+  }
+
+  // Park a gRPC stream on a full-proto ring round-trip (kind 3 predict /
+  // kind 4 feedback). The engine answers with proto bytes (status 0) or
+  // 1-byte-grpc-code + message (status 1).
+  void forward_ring_grpc(Conn& c, uint32_t sid, uint8_t kind,
+                         std::string_view body, uint64_t t0) {
+    const char* method = kind == 4 ? "feedback" : "predictions";
+    if (!req_ring || !resp_ring) {
+      grpc_trailers_error(c, sid, 12, "no native program and no engine ring");
+      metrics.observe_api(method, 501, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    uint32_t req_id = next_req_id++;
+    std::vector<char> frame(7 + body.size());
+    memcpy(frame.data(), &ring_worker_id, 2);
+    memcpy(frame.data() + 2, &req_id, 4);
+    frame[6] = (char)kind;
+    memcpy(frame.data() + 7, body.data(), body.size());
+    int rc = scr_push(req_ring, frame.data(), (uint32_t)frame.size());
+    if (rc != 0) {
+      grpc_trailers_error(c, sid, rc == -2 ? 3 : 14,
+                          rc == -2 ? "request larger than ring slot"
+                                   : "engine request ring full");
+      metrics.observe_api(method, rc == -2 ? 413 : 503, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    pending_grpc[req_id] = {c.fd, c.gen, sid, t0, kind == 4};
+    arm_timer();
+  }
+
+  // Native device execution for a gRPC tensor request: same dataflow as the
+  // REST device path, but the park completes with a proto response. The
+  // proto is parsed ONCE over the DevExec's body copy (spans survive the
+  // park — the parse-once discipline of the REST path's JDoc).
+  void handle_grpc_device(Conn& c, uint32_t sid, std::string_view body,
+                          uint64_t t0) {
+    auto* st = new DevExec();
+    st->is_grpc = true;
+    st->h2_sid = sid;
+    st->body.assign(body.data(), body.size());
+    st->preq.want_values = true;
+    if (!pb_parse_seldon_message({st->body.data(), st->body.size()}, st->preq)) {
+      grpc_trailers_error(c, sid, 3, "cannot parse SeldonMessage");
+      metrics.observe_api("predictions", 400, 1e-9 * (now_ns() - t0));
+      delete st;
+      return;
+    }
+    if (st->preq.in.kind != PKind::Tensor || st->preq.has_names ||
+        st->preq.dims.empty() || st->preq.dims.size() > 8) {
+      delete st;
+      forward_ring_grpc(c, sid, 3, body, t0);
+      return;
+    }
+    if (st->preq.tensor_prod != st->preq.tensor_nvals) {
+      grpc_trailers_error(c, sid, 3, "tensor values do not fit shape");
+      metrics.observe_api("predictions", 400, 1e-9 * (now_ns() - t0));
+      delete st;
+      return;
+    }
+    DVal input;
+    input.dtype = 1;
+    input.dims = std::move(st->preq.dims);
+    input.vals = std::move(st->preq.vals);
+
+    Kind owner = Kind::SimpleModel;
+    int owner_site = -1;
+    DVal result;
+    if (!eval_device(prog, prog.root, rng, input, st->ex, st->sites,
+                     st->metric_srcs, result, owner, owner_site)) {
+      grpc_trailers_error(c, sid, st->ex.err_code == 400 ? 3 : 13,
+                          st->ex.err_info);
+      metrics.observe_api("predictions", st->ex.err_code,
+                          1e-9 * (now_ns() - t0));
+      delete st;
+      return;
+    }
+    st->result = std::move(result);
+    st->owner = owner;
+    st->owner_site = owner_site;
+    st->resp_kind = PKind::Tensor;
+    st->conn_fd = c.fd;
+    st->conn_gen = c.gen;
+    st->t0 = t0;
+
+    if (st->sites.empty()) {
+      std::vector<double> vals;
+      std::vector<uint32_t> dims;
+      uint8_t dt;
+      std::string err;
+      if (!resolve_dval(st->result, st->sites, vals, dims, dt, err)) {
+        grpc_trailers_error(c, sid, 13, err);
+        metrics.observe_api("predictions", 500, 1e-9 * (now_ns() - t0));
+      } else {
+        send_grpc_device_response(c, *st, vals, dims);
+        metrics.observe_api("predictions", 200, 1e-9 * (now_ns() - t0));
+      }
+      delete st;
+      return;
+    }
+    if (!req_ring || !resp_ring) {
+      grpc_trailers_error(c, sid, 13, "device models need the engine ring");
+      metrics.observe_api("predictions", 500, 1e-9 * (now_ns() - t0));
+      delete st;
+      return;
+    }
+    for (size_t s = 0; s < st->sites.size(); ++s) {
+      DevSite& site = st->sites[s];
+      site.req_id = next_req_id++;
+      const Unit& u = prog.units[site.unit_idx];
+      size_t ndim = site.req_dims.size();
+      std::vector<char> frame(10 + 4 * ndim + 8 * site.req_vals.size());
+      memcpy(frame.data(), &ring_worker_id, 2);
+      memcpy(frame.data() + 2, &site.req_id, 4);
+      frame[6] = 2;
+      uint16_t mid = (uint16_t)u.model_id;
+      memcpy(frame.data() + 7, &mid, 2);
+      frame[9] = (char)(uint8_t)ndim;
+      memcpy(frame.data() + 10, site.req_dims.data(), 4 * ndim);
+      memcpy(frame.data() + 10 + 4 * ndim, site.req_vals.data(),
+             8 * site.req_vals.size());
+      int rc = scr_push(req_ring, frame.data(), (uint32_t)frame.size());
+      if (rc != 0) {
+        for (size_t k = 0; k < s; ++k) pending_dev.erase(st->sites[k].req_id);
+        grpc_trailers_error(c, sid, rc == -2 ? 3 : 14,
+                            rc == -2 ? "tensor larger than ring slot"
+                                     : "engine request ring full");
+        metrics.observe_api("predictions", rc == -2 ? 413 : 503,
+                            1e-9 * (now_ns() - t0));
+        delete st;
+        return;
+      }
+      pending_dev[site.req_id] = {st, (int)s};
+      site.req_vals.clear();
+      site.req_vals.shrink_to_fit();
+    }
+    st->outstanding = (int)st->sites.size();
+    arm_timer();
+  }
+
+  // Proto response for a completed device-graph gRPC request: the proto
+  // twin of build_device_response (meta echo/routing/path/metrics, real
+  // tensor values, names from the owner site's executor fragment).
+  void send_grpc_device_response(Conn& c, DevExec& st,
+                                 const std::vector<double>& vals,
+                                 const std::vector<uint32_t>& dims) {
+    // meta echo spans parsed once at admission, pointing into st.body
+    PbSeldonMsg& req = st.preq;
+    ExecOut& ex = st.ex;
+    // executor fragments: names (owner) + metrics/tags per site
+    std::vector<JDoc> frag_docs(st.sites.size());
+    std::vector<const JValue*> frag_names(st.sites.size(), nullptr);
+    std::vector<const JValue*> frag_metrics(st.sites.size(), nullptr);
+    for (size_t i = 0; i < st.sites.size(); ++i) {
+      const std::string& frag = st.sites[i].fragment;
+      if (frag.empty()) continue;
+      if (!json_parse(frag.data(), frag.size(), frag_docs[i])) continue;
+      const JValue& froot = frag_docs[i].nodes[0];
+      if (froot.type != JValue::Obj) continue;
+      frag_names[i] = frag_docs[i].get(froot, "names");
+      frag_metrics[i] = frag_docs[i].get(froot, "metrics");
+    }
+
+    Buf meta;
+    PbWriter mw{meta};
+    if (!req.puid.empty()) {
+      mw.str(1, req.puid);
+    } else {
+      char puid[33];
+      rng.puid_hex(puid);
+      mw.str(1, {puid, 32});
+    }
+    if (!ex.bandit_tags.empty()) {
+      const Unit& bu = prog.units[ex.bandit_tags[0].first];
+      {
+        Buf val;
+        PbWriter vw{val};
+        vw.str(3, kind_class(bu.kind));
+        Buf e;
+        PbWriter ew{e};
+        ew.str(1, "bandit");
+        ew.tag(2, 2);
+        ew.varint(val.size());
+        e.append(val.data(), val.size());
+        mw.tag(2, 2);
+        mw.varint(e.size());
+        meta.append(e.data(), e.size());
+      }
+      {
+        Buf lv;
+        for (double m : ex.bandit_tags[0].second) {
+          Buf num;
+          PbWriter nw{num};
+          nw.tag(2, 1);
+          nw.fixed64_raw(nearbyint(m * 1e6) / 1e6);
+          PbWriter lw{lv};
+          lw.tag(1, 2);
+          lw.varint(num.size());
+          lv.append(num.data(), num.size());
+        }
+        Buf val;
+        PbWriter vw{val};
+        vw.tag(6, 2);
+        vw.varint(lv.size());
+        val.append(lv.data(), lv.size());
+        Buf e;
+        PbWriter ew{e};
+        ew.str(1, "branch_means");
+        ew.tag(2, 2);
+        ew.varint(val.size());
+        e.append(val.data(), val.size());
+        mw.tag(2, 2);
+        mw.varint(e.size());
+        meta.append(e.data(), e.size());
+      }
+    }
+    for (auto sv : req.meta_echo) meta.append(sv);
+    for (auto& [name, branch] : ex.routing) {
+      Buf e;
+      PbWriter ew{e};
+      ew.str(1, name);
+      ew.tag(2, 0);
+      ew.varint((uint64_t)branch);
+      mw.tag(3, 2);
+      mw.varint(e.size());
+      meta.append(e.data(), e.size());
+    }
+    for (auto& [name, cls] : ex.path) {
+      Buf e;
+      PbWriter ew{e};
+      ew.str(1, name);
+      ew.str(2, cls);
+      mw.tag(4, 2);
+      mw.varint(e.size());
+      meta.append(e.data(), e.size());
+    }
+    // metrics: owner source first, request echo, remaining traversal order
+    auto emit_stub_triplet = [&]() {
+      struct M { const char* key; int type; float value; };
+      static const M kMs[3] = {{"mycounter", 0, 1.0f}, {"mygauge", 1, 100.0f},
+                               {"mytimer", 2, 20.6f}};
+      for (auto& m : kMs) {
+        Buf e;
+        PbWriter ew{e};
+        ew.str(1, m.key);
+        if (m.type != 0) {
+          ew.tag(2, 0);
+          ew.varint((uint64_t)m.type);
+        }
+        ew.fixed32(3, m.value);
+        mw.tag(5, 2);
+        mw.varint(e.size());
+        meta.append(e.data(), e.size());
+      }
+    };
+    auto emit_site_metrics = [&](int site) {
+      if (!frag_metrics[site]) return;
+      for (int i = 0; i < frag_metrics[site]->n_children; ++i) {
+        const JValue* m = frag_docs[site].item(*frag_metrics[site], i);
+        if (!m || m->type != JValue::Obj) continue;
+        Buf e;
+        PbWriter ew{e};
+        if (auto* k = frag_docs[site].get(*m, "key")) ew.str(1, k->sv);
+        int ty = 0;
+        if (auto* tv = frag_docs[site].get(*m, "type")) {
+          if (tv->sv == "GAUGE") ty = 1;
+          else if (tv->sv == "TIMER") ty = 2;
+        }
+        if (ty != 0) {
+          ew.tag(2, 0);
+          ew.varint((uint64_t)ty);
+        }
+        float fv = 0;
+        if (auto* vv = frag_docs[site].get(*m, "value")) fv = (float)jnum(*vv);
+        ew.fixed32(3, fv);
+        mw.tag(5, 2);
+        mw.varint(e.size());
+        meta.append(e.data(), e.size());
+      }
+    };
+    int owner_src = -2;
+    if (st.owner == Kind::DeviceModel && st.owner_site >= 0) owner_src = st.owner_site;
+    else if (st.owner == Kind::SimpleModel && ex.model_visits > 0) owner_src = -1;
+    bool builtin_owner_used = false;
+    if (owner_src == -1) {
+      emit_stub_triplet();
+      builtin_owner_used = true;
+    } else if (owner_src >= 0) {
+      emit_site_metrics(owner_src);
+    }
+    for (auto sv : req.req_metrics_raw) meta.append(sv);
+    bool builtin_skipped_once = false;
+    for (auto& src : st.metric_srcs) {
+      if (src.site == owner_src && src.site >= 0) continue;
+      if (src.site == -1 && builtin_owner_used && !builtin_skipped_once) {
+        builtin_skipped_once = true;
+        continue;
+      }
+      if (src.site == -1) emit_stub_triplet();
+      else emit_site_metrics(src.site);
+    }
+
+    Buf msg;
+    PbWriter w{msg};
+    w.tag(2, 2);
+    w.varint(meta.size());
+    msg.append(meta.data(), meta.size());
+
+    // DefaultData{names, tensor{shape, packed doubles}}
+    Buf dd;
+    PbWriter dw{dd};
+    if (st.owner == Kind::DeviceModel && st.owner_site >= 0 &&
+        frag_names[st.owner_site]) {
+      const JValue* names = frag_names[st.owner_site];
+      for (int i = 0; i < names->n_children; ++i) {
+        const JValue* n = frag_docs[st.owner_site].item(*names, i);
+        if (n) dw.str(1, n->sv);
+      }
+    } else if (st.owner == Kind::AverageCombiner) {
+      if (dims.size() > 1) {
+        char nb[16];
+        for (uint32_t i = 0; i < dims[1]; ++i) {
+          int n = snprintf(nb, sizeof(nb), "t:%u", i);
+          dw.str(1, {nb, (size_t)n});
+        }
+      }
+    } else {
+      dw.str(1, "class0");
+      dw.str(1, "class1");
+      dw.str(1, "class2");
+    }
+    {
+      Buf t;
+      PbWriter tw{t};
+      Buf shape;
+      PbWriter sw{shape};
+      for (uint32_t d : dims) sw.varint((uint64_t)d);
+      tw.tag(1, 2);
+      tw.varint(shape.size());
+      t.append(shape.data(), shape.size());
+      tw.tag(2, 2);
+      tw.varint(vals.size() * 8);
+      t.append((const char*)vals.data(), vals.size() * 8);
+      dw.tag(2, 2);
+      dw.varint(t.size());
+      dd.append(t.data(), t.size());
+    }
+    w.tag(3, 2);
+    w.varint(dd.size());
+    msg.append(dd.data(), dd.size());
+    grpc_respond_msg(c, st.h2_sid, {msg.data(), msg.size()});
+    metrics.mycounter += ex.model_visits;
+    if (ex.model_visits) {
+      metrics.mygauge = 100.0;
+      for (int i = 0; i < ex.model_visits; ++i) metrics.mytimer.observe(20.6 / 1000.0);
+      metrics.custom_seen += ex.model_visits;
+    }
   }
 
   void arm_timer() {
@@ -2606,6 +3017,34 @@ struct Server {
       uint8_t status = (uint8_t)ring_buf[4];
       auto it = pending.find(req_id);
       if (it == pending.end()) {
+        auto git = pending_grpc.find(req_id);
+        if (git != pending_grpc.end()) {
+          GrpcPending gp = git->second;
+          pending_grpc.erase(git);
+          Conn& c = conn(gp.conn_fd);
+          if (c.fd != gp.conn_fd || c.gen != gp.conn_gen) continue;
+          const char* gmethod = gp.is_feedback ? "feedback" : "predictions";
+          std::string_view body{ring_buf.data() + 5, (size_t)len - 5};
+          if (status == 0) {
+            grpc_respond_msg(c, gp.sid, body);
+            metrics.observe_api(gmethod, 200,
+                                1e-9 * (now_ns() - gp.started_ns));
+          } else {
+            int code = 13;
+            std::string_view info = body;
+            if (!body.empty()) {
+              code = (uint8_t)body[0];
+              info = body.substr(1);
+            }
+            grpc_trailers_error(c, gp.sid, code, info);
+            // inverse of grpc_code_from_http for the metric label
+            int http = code == 3 ? 400 : code == 14 ? 503 : code == 4 ? 504 : 500;
+            metrics.observe_api(gmethod, http,
+                                1e-9 * (now_ns() - gp.started_ns));
+          }
+          flush_out(c);
+          continue;
+        }
         auto dit = pending_dev.find(req_id);
         if (dit == pending_dev.end()) continue;
         DevExec* st = dit->second.first;
@@ -2616,7 +3055,6 @@ struct Server {
           std::string_view ebody{ring_buf.data() + 5, (size_t)len - 5};
           Conn& c = conn(st->conn_fd);
           if (c.fd == st->conn_fd && c.gen == st->conn_gen) {
-            c.waiting_ring = false;
             int http_code = 500;
             JDoc edoc;
             if (json_parse(ebody.data(), ebody.size(), edoc) &&
@@ -2627,14 +3065,20 @@ struct Server {
                   if (parsed >= 400 && parsed < 600) http_code = parsed;
                 }
             }
-            const char* text = http_code == 400 ? "Bad Request"
-                               : http_code == 503 ? "Service Unavailable"
-                                                  : "Internal Server Error";
-            respond(c, http_code, text, ebody);
+            if (st->is_grpc) {
+              grpc_trailers_error(c, st->h2_sid, grpc_code_from_http(http_code),
+                                  ebody);
+            } else {
+              c.waiting_ring = false;
+              const char* text = http_code == 400 ? "Bad Request"
+                                 : http_code == 503 ? "Service Unavailable"
+                                                    : "Internal Server Error";
+              respond(c, http_code, text, ebody);
+            }
             metrics.observe_api("predictions", http_code,
                                 1e-9 * (now_ns() - st->t0));
             flush_out(c);
-            if (c.fd >= 0 && c.in.size() > 0) process_in(c);
+            if (!st->is_grpc && c.fd >= 0 && c.in.size() > 0) process_in(c);
           }
           drop_dev_exec(st);
           continue;
@@ -2662,11 +3106,15 @@ struct Server {
         if (!ok) {
           Conn& c = conn(st->conn_fd);
           if (c.fd == st->conn_fd && c.gen == st->conn_gen) {
-            c.waiting_ring = false;
-            respond_error(c, 500, "INTERNAL_ERROR", "malformed device response");
+            if (st->is_grpc) {
+              grpc_trailers_error(c, st->h2_sid, 13, "malformed device response");
+            } else {
+              c.waiting_ring = false;
+              respond_error(c, 500, "INTERNAL_ERROR", "malformed device response");
+            }
             metrics.observe_api("predictions", 500, 1e-9 * (now_ns() - st->t0));
             flush_out(c);
-            if (c.fd >= 0 && c.in.size() > 0) process_in(c);
+            if (!st->is_grpc && c.fd >= 0 && c.in.size() > 0) process_in(c);
           }
           drop_dev_exec(st);
           continue;
@@ -2745,16 +3193,37 @@ struct Server {
       for (DevExec* st : expired) {
         Conn& c = conn(st->conn_fd);
         if (c.fd == st->conn_fd && c.gen == st->conn_gen) {
-          c.waiting_ring = false;
-          respond_error(c, 504, "ENGINE_TIMEOUT",
-                        "engine did not answer within deadline");
+          if (st->is_grpc) {
+            grpc_trailers_error(c, st->h2_sid, 4,
+                                "engine did not answer within deadline");
+          } else {
+            c.waiting_ring = false;
+            respond_error(c, 504, "ENGINE_TIMEOUT",
+                          "engine did not answer within deadline");
+          }
           metrics.observe_api("predictions", 504, 1e-9 * (now - st->t0));
           flush_out(c);
         }
         drop_dev_exec(st);
       }
     }
-    if (pending.empty() && pending_dev.empty()) disarm_timer();
+    for (auto it2 = pending_grpc.begin(); it2 != pending_grpc.end();) {
+      if (now - it2->second.started_ns < kRingTimeoutNs) {
+        ++it2;
+        continue;
+      }
+      GrpcPending gp = it2->second;
+      it2 = pending_grpc.erase(it2);
+      Conn& c = conn(gp.conn_fd);
+      if (c.fd == gp.conn_fd && c.gen == gp.conn_gen) {
+        grpc_trailers_error(c, gp.sid, 4, "engine did not answer within deadline");
+        metrics.observe_api(gp.is_feedback ? "feedback" : "predictions", 504,
+                            1e-9 * (now - gp.started_ns));
+        flush_out(c);
+      }
+    }
+    if (pending.empty() && pending_dev.empty() && pending_grpc.empty())
+      disarm_timer();
   }
 
   // ---- request routing ----
@@ -3135,14 +3604,6 @@ struct Server {
       metrics.observe_api(method, 503, 1e-9 * (now_ns() - t0));
       return;
     }
-    if (!prog.native || (prog.has_device && !is_feedback)) {
-      // device-graph predictions are REST-native only for now; the engine
-      // process serves gRPC (feedback stays native — bandit state lives here)
-      grpc_trailers_error(c, sid, 12,
-                          "gRPC for non-native graphs is served by the engine process");
-      metrics.observe_api(method, 501, 1e-9 * (now_ns() - t0));
-      return;
-    }
     std::string_view data{s.data.data(), s.data.size()};
     if (data.size() < 5 || data[0] != 0) {
       grpc_trailers_error(c, sid, 13, "bad gRPC frame");
@@ -3157,6 +3618,24 @@ struct Server {
       return;
     }
     std::string_view body = data.substr(5, mlen);
+
+    // Graphs the edge can't execute natively ride the ring as full proto
+    // frames (kind 3 predict / kind 4 feedback): the engine process answers
+    // with proto bytes, so gRPC serves EVERY graph on this port — the
+    // reference's engine serves any graph over gRPC too
+    // (grpc/SeldonService.java:44-79).
+    if (!prog.native) {
+      forward_ring_grpc(c, sid, is_feedback ? 4 : 3, body, t0);
+      return;
+    }
+
+    if (prog.has_device && !is_feedback) {
+      // Native device plane for tensor payloads (feedback stays native —
+      // bandit state lives here); names/ndarray/bin/str/json payloads go
+      // kind-3 so the Python engine keeps exact semantics.
+      handle_grpc_device(c, sid, body, t0);
+      return;
+    }
 
     if (is_feedback) {
       // Feedback{request=1, response=2, reward=3 float, truth=4}; the

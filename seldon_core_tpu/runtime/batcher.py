@@ -90,15 +90,14 @@ class BatcherService:
         self._loop.call_soon_threadsafe(self._loop.stop)
 
 
-_service_init_lock: Any = None
+# created at import time: a lazily-created lock would itself race, which is
+# the exact bug this lock exists to prevent
+import threading as _threading
+
+_service_init_lock = _threading.Lock()
 
 
 def _init_lock():
-    global _service_init_lock
-    if _service_init_lock is None:
-        import threading
-
-        _service_init_lock = threading.Lock()
     return _service_init_lock
 
 

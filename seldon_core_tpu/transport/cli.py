@@ -216,17 +216,19 @@ def run_edge(args: argparse.Namespace) -> None:
     with open(openapi_path, "w") as f:
         json.dump(engine_spec(), f)
 
+    grpc_port = args.grpc_port or int(os.environ.get("ENGINE_SERVER_GRPC_PORT", "0"))
     if program is not None:
         # pure-builtin graph: the edge process needs no Python at all
+        # (native gRPC included when a gRPC port is configured)
         prog_path = write_program(program, os.path.join(tmp, "program.json"))
         logger.info("graph compiled natively; edge serving on port %d", port)
-        os.execv(
-            EDGE_BINARY,
-            [
-                EDGE_BINARY, "--program", prog_path, "--port", str(port),
-                "--openapi", openapi_path, "--workers", str(args.workers),
-            ],
-        )
+        argv = [
+            EDGE_BINARY, "--program", prog_path, "--port", str(port),
+            "--openapi", openapi_path, "--workers", str(args.workers),
+        ]
+        if grpc_port:
+            argv += ["--grpc-port", str(grpc_port)]
+        os.execv(EDGE_BINARY, argv)
 
     # The graph needs Python — build the engine, then try the DEVICE_MODEL
     # compile: graphs of builtins + real model leaves still execute natively
@@ -281,12 +283,18 @@ def run_edge(args: argparse.Namespace) -> None:
     n_workers = max(1, args.workers)
     server = IPCEngineServer(engine, base, n_workers=n_workers,
                              model_executor=executor)
+    edge_argv_tail = []
+    if grpc_port:
+        # the edge serves gRPC on every plane: native for builtin/device
+        # tensor traffic, full-proto ring frames (kind 3/4) into this
+        # engine process for everything else — one port, every graph
+        edge_argv_tail = ["--grpc-port", str(grpc_port)]
     edges = [
         subprocess.Popen(
             [
                 EDGE_BINARY, "--program", prog_path, "--port", str(port),
                 "--ring", base, "--ring-worker", str(w), "--openapi", openapi_path,
-            ]
+            ] + edge_argv_tail
         )
         for w in range(n_workers)
     ]
@@ -572,6 +580,9 @@ def main(argv: Optional[list] = None) -> None:
     edge = sub.add_parser("edge", help="serve a graph behind the native C++ edge")
     edge.add_argument("--spec", default=None, help="path to PredictorSpec JSON")
     edge.add_argument("--port", type=int, default=None)
+    edge.add_argument("--grpc-port", type=int, default=None,
+                      help="gRPC port (default env ENGINE_SERVER_GRPC_PORT; "
+                           "native for builtin graphs, Python engine otherwise)")
     edge.add_argument("--workers", type=int, default=1, help="SO_REUSEPORT event loops")
     edge.add_argument("--ipc-base", default=None, help="ring path base for fallback mode")
     edge.set_defaults(func=run_edge)

@@ -55,6 +55,11 @@ _MODEL_REQ = struct.Struct("<HB")  # model_id, ndim (dims follow as u32 each)
 KIND_PREDICT = 0
 KIND_FEEDBACK = 1
 KIND_MODEL = 2
+# Full-proto frames from the edge's gRPC listener: payload is the raw
+# SeldonMessage/Feedback proto; ok responses carry proto bytes back, error
+# responses carry u8 grpc-status-code + utf8 message.
+KIND_PROTO_PREDICT = 3
+KIND_PROTO_FEEDBACK = 4
 
 
 class ModelExecutor:
@@ -438,21 +443,42 @@ class IPCEngineServer:
             logger.error("dropping malformed IPC frame (%d bytes)", len(frame))
             return
         try:
-            payload = json.loads(frame[_REQ_HEADER.size:])
-            if kind == KIND_PREDICT:
-                out = await self.engine.predict(SeldonMessage.from_dict(payload))
-            elif kind == KIND_FEEDBACK:
-                out = await self.engine.send_feedback(Feedback.from_dict(payload))
+            if kind in (KIND_PROTO_PREDICT, KIND_PROTO_FEEDBACK):
+                from seldon_core_tpu.transport import proto_convert as pc
+                from seldon_core_tpu.transport.proto import prediction_pb2 as pb
+
+                raw = bytes(frame[_REQ_HEADER.size:])
+                if kind == KIND_PROTO_PREDICT:
+                    req = pb.SeldonMessage.FromString(raw)
+                    out = await self.engine.predict(pc.message_from_proto(req))
+                else:
+                    req = pb.Feedback.FromString(raw)
+                    out = await self.engine.send_feedback(pc.feedback_from_proto(req))
+                body = pc.message_to_proto(out).SerializeToString()
+                status = 0
             else:
-                raise SeldonError(f"unknown IPC kind {kind}")
-            body = json.dumps(out.to_dict()).encode()
-            status = 0
+                payload = json.loads(frame[_REQ_HEADER.size:])
+                if kind == KIND_PREDICT:
+                    out = await self.engine.predict(SeldonMessage.from_dict(payload))
+                elif kind == KIND_FEEDBACK:
+                    out = await self.engine.send_feedback(Feedback.from_dict(payload))
+                else:
+                    raise SeldonError(f"unknown IPC kind {kind}")
+                body = json.dumps(out.to_dict()).encode()
+                status = 0
         except Exception as e:
-            body = _error_body(
-                str(e),
-                getattr(e, "reason", "ENGINE_ERROR"),
-                int(getattr(e, "status_code", 500)),
-            )
+            if kind in (KIND_PROTO_PREDICT, KIND_PROTO_FEEDBACK):
+                # edge expects u8 grpc-status + message for proto frames;
+                # mapping mirrors edge.cc grpc_code_from_http
+                http = int(getattr(e, "status_code", 500))
+                code = {400: 3, 503: 14, 504: 4}.get(http, 13)
+                body = bytes([code]) + str(e).encode()
+            else:
+                body = _error_body(
+                    str(e),
+                    getattr(e, "reason", "ENGINE_ERROR"),
+                    int(getattr(e, "status_code", 500)),
+                )
             status = 1
         ring = self.resp_rings.get(worker_id)
         if ring is None:

@@ -131,9 +131,11 @@ def device_edge(tmp_path_factory, ckpt):
         t.start()
         prog_path = write_program(program, str(tmp / f"prog_{key}.json"))
         port = free_port()
+        grpc_port = free_port()
         proc = subprocess.Popen(
             [EDGE_BINARY, "--program", prog_path, "--port", str(port),
-             "--ring", base, "--ring-worker", "0"],
+             "--ring", base, "--ring-worker", "0",
+             "--grpc-port", str(grpc_port)],
             stderr=subprocess.DEVNULL)
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
@@ -145,12 +147,12 @@ def device_edge(tmp_path_factory, ckpt):
                         break
             except Exception:
                 time.sleep(0.05)
-        started[key] = (port, engine, executor, proc, server, base)
+        started[key] = (port, engine, executor, proc, server, base, grpc_port)
         loops.append((loop, server))
         return started[key]
 
     yield start
-    for port, engine, executor, proc, server, base in started.values():
+    for port, engine, executor, proc, server, base, _g in started.values():
         proc.terminate()
         proc.wait(timeout=10)
         server.stop()
@@ -174,7 +176,7 @@ def single_spec(ckpt):
 
 @pytest.mark.parametrize("req_idx", range(len(SINGLE_REQS)))
 def test_single_jax_model_parity(device_edge, ckpt, req_idx):
-    port, _, _, _, _, _ = device_edge("single", single_spec(ckpt))
+    port, _, _, _, _, _, _ = device_edge("single", single_spec(ckpt))
     engine = GraphEngine(PredictorSpec.from_dict(single_spec(ckpt)))
     req = SINGLE_REQS[req_idx]
     expected = engine.predict_sync(
@@ -186,7 +188,7 @@ def test_single_jax_model_parity(device_edge, ckpt, req_idx):
 
 def test_single_model_fallback_payloads(device_edge, ckpt):
     """Non-numeric payloads ride the full-graph ring; status parity holds."""
-    port, _, _, _, _, _ = device_edge("single", single_spec(ckpt))
+    port, _, _, _, _, _, _ = device_edge("single", single_spec(ckpt))
     engine = GraphEngine(PredictorSpec.from_dict(single_spec(ckpt)))
     for req in ({"strData": "hello"},
                 {"data": {"names": ["a", "b", "c", "d"],
@@ -228,7 +230,7 @@ def test_router_over_device_leaf_parity(device_edge, ckpt):
     """Bandit routes to the JAX leaf (best_branch=1, eps=0): routing, path,
     bandit tags, and the real model payload must match the engine; after
     feedback flips the bandit, the stub branch serves (no device call)."""
-    port, _, _, _, _, _ = device_edge("router", router_spec(ckpt))
+    port, _, _, _, _, _, _ = device_edge("router", router_spec(ckpt))
     engine = GraphEngine(PredictorSpec.from_dict(router_spec(ckpt)))
     req = {"data": {"ndarray": [[0.5, 0.5, 0.5, 0.5]]}}
 
@@ -273,7 +275,7 @@ def combiner_spec(ckpt):
 
 
 def test_combiner_over_device_and_stub_parity(device_edge, ckpt):
-    port, _, _, _, _, _ = device_edge("comb", combiner_spec(ckpt))
+    port, _, _, _, _, _, _ = device_edge("comb", combiner_spec(ckpt))
     engine = GraphEngine(PredictorSpec.from_dict(combiner_spec(ckpt)))
     for req in ({"data": {"ndarray": [[0.1, 0.2, 0.3, 0.4]]}},
                 {"data": {"tensor": {"shape": [2, 4],
@@ -288,7 +290,7 @@ def test_combiner_over_device_and_stub_parity(device_edge, ckpt):
 
 def test_device_error_parity(device_edge, ckpt):
     """Wrong feature count: both sides fail with a 4xx/5xx FAILURE status."""
-    port, _, _, _, _, _ = device_edge("single", single_spec(ckpt))
+    port, _, _, _, _, _, _ = device_edge("single", single_spec(ckpt))
     engine = GraphEngine(PredictorSpec.from_dict(single_spec(ckpt)))
     req = {"data": {"ndarray": [[1.0, 2.0]]}}  # model wants 4 features
     with pytest.raises(Exception):
@@ -304,7 +306,7 @@ def test_concurrent_requests_micro_batch(device_edge, ckpt):
     tight tolerance, not bit-equality: stacking changes the XLA batch bucket,
     and f32 reduction order differs per bucket (ULP-level, inherent to
     batched serving on any backend). Meta must still match exactly."""
-    port, _, executor, _, _, _ = device_edge("single", single_spec(ckpt))
+    port, _, executor, _, _, _, _ = device_edge("single", single_spec(ckpt))
     engine = GraphEngine(PredictorSpec.from_dict(single_spec(ckpt)))
     rng = np.random.default_rng(7)
     reqs = [{"data": {"ndarray": rng.standard_normal((1, 4)).tolist()}}
@@ -357,3 +359,165 @@ def test_compile_rules(ckpt):
     assert compile_edge_program(spec, device_components={"m": RawModel()}) is None
     # no device components -> plain fallback (None)
     assert compile_edge_program(spec) is None
+
+
+def test_cli_edge_serves_grpc_for_device_graph(tmp_path, ckpt):
+    """run_edge wires gRPC for non-pure-native graphs through the Python
+    engine on --grpc-port: a device graph must answer BOTH transports."""
+    import os
+    import signal
+    import sys
+
+    spec_path = str(tmp_path / "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(single_spec(ckpt), f)
+    http_port, grpc_port = free_port(), free_port()
+    code = (
+        "import sys\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from seldon_core_tpu.transport.cli import main\n"
+        f"main(['edge', '--spec', {spec_path!r}, '--port', '{http_port}', "
+        f"'--grpc-port', '{grpc_port}', '--workers', '1'])\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stderr=subprocess.DEVNULL,
+                            stdout=subprocess.DEVNULL, start_new_session=True)
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            assert proc.poll() is None, "edge CLI died"
+            try:
+                status, _ = post(http_port, "/api/v0.1/predictions",
+                                 {"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}},
+                                 timeout=5.0)
+                if status == 200:
+                    break
+            except Exception:
+                time.sleep(0.3)
+        else:
+            raise AssertionError("REST predict never became ready")
+
+        from seldon_core_tpu.transport import grpc_client
+
+        engine = GraphEngine(PredictorSpec.from_dict(single_spec(ckpt)))
+        req = {"data": {"ndarray": [[0.5, -1.0, 2.0, 0.25]]}}
+        expected = engine.predict_sync(
+            SeldonMessage.from_dict(json.loads(json.dumps(req)))).to_dict()
+        out = grpc_client.call_sync(
+            f"127.0.0.1:{grpc_port}", "Predict",
+            SeldonMessage.from_dict(json.loads(json.dumps(req))),
+            service="Seldon", timeout_s=60.0).to_dict()
+        assert strip_puid(out)["data"] == strip_puid(expected)["data"]
+    finally:
+        os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        proc.wait(timeout=15)
+
+
+# ---------------------------------------------------------------------------
+# gRPC on device graphs: native tensor plane + full-proto ring fallback
+# ---------------------------------------------------------------------------
+
+def grpc_predict(grpc_port, req_dict, timeout=60.0):
+    from seldon_core_tpu.transport import grpc_client
+
+    return grpc_client.call_sync(
+        f"127.0.0.1:{grpc_port}", "Predict",
+        SeldonMessage.from_dict(json.loads(json.dumps(req_dict))),
+        service="Seldon", timeout_s=timeout)
+
+
+def engine_grpc_expected(spec_dict, req_dict):
+    """What a gRPC client of the Python engine would see: the engine's
+    answer round-tripped through the proto codec (float64 values become
+    proto doubles either way)."""
+    from seldon_core_tpu.transport import proto_convert as pc
+
+    engine = GraphEngine(PredictorSpec.from_dict(spec_dict))
+    out = engine.predict_sync(
+        SeldonMessage.from_dict(json.loads(json.dumps(req_dict))))
+    return pc.message_from_proto(pc.message_to_proto(out)).to_dict()
+
+
+def test_grpc_device_tensor_native_parity(device_edge, ckpt):
+    """Tensor payloads run the native device plane over gRPC: response must
+    equal the engine's proto-round-tripped answer (values, names, meta)."""
+    port, _, _, _, _, _, grpc_port = device_edge("single", single_spec(ckpt))
+    for req in ({"data": {"tensor": {"shape": [1, 4],
+                                     "values": [0.1, -0.4, 2.0, 0.3]}}},
+                {"data": {"tensor": {"shape": [3, 4],
+                                     "values": [float(i) / 7 for i in range(12)]}}},
+                {"meta": {"puid": "gp", "tags": {"k": "v"}},
+                 "data": {"tensor": {"shape": [1, 4], "values": [1, 2, 3, 4]}}}):
+        want = engine_grpc_expected(single_spec(ckpt), req)
+        got = grpc_predict(grpc_port, req).to_dict()
+        assert strip_puid(got) == strip_puid(want), req
+
+
+def test_grpc_device_ndarray_falls_back_to_proto_ring(device_edge, ckpt):
+    """ndarray/strData gRPC payloads ride the kind-3 proto ring into the
+    Python engine — full semantics, same port."""
+    port, _, _, _, _, _, grpc_port = device_edge("single", single_spec(ckpt))
+    req = {"data": {"ndarray": [[0.1, -0.4, 2.0, 0.3], [1.0, 1.0, 1.0, 1.0]]}}
+    want = engine_grpc_expected(single_spec(ckpt), req)
+    got = grpc_predict(grpc_port, req).to_dict()
+    assert strip_puid(got) == strip_puid(want)
+
+    # error path: the engine's failure surfaces as a gRPC status
+    import grpc as grpc_mod
+
+    with pytest.raises(grpc_mod.RpcError):
+        grpc_predict(grpc_port, {"strData": "hello"})
+
+
+def test_grpc_router_over_device_parity_and_feedback(device_edge, ckpt):
+    """Bandit router over a device leaf via gRPC: native route + device
+    tensor call; gRPC feedback updates the native bandit state."""
+    # fresh instance: the module-shared "router" edge carries bandit state
+    # learned by the REST feedback test
+    spec = router_spec(ckpt)
+    spec = json.loads(json.dumps(spec))
+    spec["graph"]["name"] = "eg"
+    port, _, _, _, _, _, grpc_port = device_edge("router_grpc", spec)
+    engine = GraphEngine(PredictorSpec.from_dict(spec))
+    from seldon_core_tpu.transport import grpc_client, proto_convert as pc
+
+    req = {"data": {"tensor": {"shape": [1, 4], "values": [0.5, 0.5, 0.5, 0.5]}}}
+    expected = engine.predict_sync(
+        SeldonMessage.from_dict(json.loads(json.dumps(req))))
+    want = pc.message_from_proto(pc.message_to_proto(expected)).to_dict()
+    got = grpc_predict(grpc_port, req).to_dict()
+    assert strip_puid(got) == strip_puid(want)
+    assert got["meta"]["routing"]["eg"] == 1
+
+    from seldon_core_tpu.contracts.payload import Feedback
+
+    fbs = [({"eg": 0}, 1.0)] * 3 + [({"eg": 1}, 0.25)]
+    for routing, reward in fbs:
+        fb = {"request": req, "response": {"meta": {"routing": routing}},
+              "reward": reward}
+        out = grpc_client.call_sync(
+            f"127.0.0.1:{grpc_port}", "SendFeedback",
+            Feedback.from_dict(json.loads(json.dumps(fb))),
+            service="Seldon", timeout_s=60.0)
+        assert out.to_dict() == {"meta": {}}
+        import asyncio as aio
+
+        aio.run(engine.send_feedback(
+            Feedback.from_dict(json.loads(json.dumps(fb)))))
+
+    expected = engine.predict_sync(
+        SeldonMessage.from_dict(json.loads(json.dumps(req))))
+    want = pc.message_from_proto(pc.message_to_proto(expected)).to_dict()
+    got = grpc_predict(grpc_port, req).to_dict()
+    assert strip_puid(got) == strip_puid(want)
+    assert got["meta"]["routing"]["eg"] == 0
+
+
+def test_grpc_combiner_over_device_parity(device_edge, ckpt):
+    port, _, _, _, _, _, grpc_port = device_edge("comb", combiner_spec(ckpt))
+    req = {"data": {"tensor": {"shape": [2, 4],
+                               "values": [0.1, 0.2, 0.3, 0.4,
+                                          1.0, 1.0, 1.0, 1.0]}}}
+    want = engine_grpc_expected(combiner_spec(ckpt), req)
+    got = grpc_predict(grpc_port, req).to_dict()
+    assert strip_puid(got) == strip_puid(want)
