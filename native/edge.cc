@@ -472,7 +472,7 @@ bool routing_value_to_int(const JValue& v, int& out) {
 // Edge program: the natively-executable graph.
 // ---------------------------------------------------------------------------
 
-enum class Kind { DeviceModel, SimpleModel, SimpleRouter, RandomABTest, AverageCombiner,
+enum class Kind { DeviceModel, DeviceTransform, SimpleModel, SimpleRouter, RandomABTest, AverageCombiner,
                   EpsilonGreedy, ThompsonSampling };
 
 inline bool is_bandit(Kind k) {
@@ -518,6 +518,7 @@ struct Program {
 const char* kind_class(Kind k) {
   switch (k) {
     case Kind::DeviceModel: return "DeviceModel";  // overridden by class_name
+    case Kind::DeviceTransform: return "DeviceTransform";  // ditto
     case Kind::SimpleModel: return "SimpleModel";
     case Kind::SimpleRouter: return "SimpleRouter";
     case Kind::RandomABTest: return "RandomABTest";
@@ -563,10 +564,19 @@ bool load_program(const char* path, Program& prog) {
       unit.kind = Kind::DeviceModel;
       prog.has_device = true;
     }
+    else if (kind == "DEVICE_TRANSFORM") {
+      unit.kind = Kind::DeviceTransform;
+      prog.has_device = true;
+    }
     else return false;
     if (auto* v = doc.get(u, "modelId")) unit.model_id = (int)jnum(*v);
     if (auto* v = doc.get(u, "className")) unit.class_name = std::string(v->sv);
-    if (unit.kind == Kind::DeviceModel && unit.model_id < 0) return false;
+    if ((unit.kind == Kind::DeviceModel || unit.kind == Kind::DeviceTransform) &&
+        unit.model_id < 0)
+      return false;
+    if (unit.kind == Kind::DeviceTransform && unit.children.size() != 1 &&
+        !unit.children.empty())
+      return false;
     if (auto* v = doc.get(u, "ratioA")) unit.ratioA = jnum(*v);
     if (auto* v = doc.get(u, "nBranches")) unit.n_branches = (int)jnum(*v);
     if (auto* v = doc.get(u, "epsilon")) unit.epsilon = jnum(*v);
@@ -753,10 +763,11 @@ bool eval_unit(const Program& prog, int idx, Rng& rng, Payload in, ExecOut& out,
                Payload& result, Kind& owner) {
   const Unit& u = prog.units[idx];
   switch (u.kind) {
-    case Kind::DeviceModel: {
+    case Kind::DeviceModel:
+    case Kind::DeviceTransform: {
       out.err_code = 500;
       out.err_reason = "INTERNAL_ERROR";
-      out.err_info = "DeviceModel unit reached the stub evaluator";
+      out.err_info = "device unit reached the stub evaluator";
       return false;
     }
     case Kind::SimpleModel: {
@@ -1144,6 +1155,9 @@ struct DVal {
 struct DevSite {
   int unit_idx = -1;
   uint32_t req_id = 0;
+  uint8_t method = 0;    // 0 = predict, 1 = transform_input
+  int input_site = -1;   // >=0: input is that site's output (deferred push)
+  bool issued = false;
   bool done = false;
   // request tensor (shipped) and response tensor (filled by drain)
   std::vector<uint32_t> req_dims;
@@ -1190,8 +1204,12 @@ bool eval_device(const Program& prog, int idx, Rng& rng, const DVal& in,
     case Kind::DeviceModel: {
       DevSite site;
       site.unit_idx = idx;
-      site.req_dims = in.dims;
-      site.req_vals = in.vals;
+      if (in.t == DVal::Site) {
+        site.input_site = in.site;  // upstream transform feeds this call
+      } else {
+        site.req_dims = in.dims;
+        site.req_vals = in.vals;
+      }
       sites.push_back(std::move(site));
       metric_srcs.push_back({(int)sites.size() - 1});
       result = DVal{};
@@ -1200,6 +1218,41 @@ bool eval_device(const Program& prog, int idx, Rng& rng, const DVal& in,
       owner = Kind::DeviceModel;
       owner_site = result.site;
       out.path.push_back({u.name, u.class_name.c_str()});
+      return true;
+    }
+    case Kind::DeviceTransform: {
+      // input transformer: ring call produces the child's input
+      DevSite site;
+      site.unit_idx = idx;
+      site.method = 1;
+      if (in.t == DVal::Site) site.input_site = in.site;
+      else {
+        site.req_dims = in.dims;
+        site.req_vals = in.vals;
+      }
+      sites.push_back(std::move(site));
+      int my_site = (int)sites.size() - 1;
+      metric_srcs.push_back({my_site});
+      DVal mine;
+      mine.t = DVal::Site;
+      mine.site = my_site;
+      if (u.children.empty()) {
+        out.path.push_back({u.name, u.class_name.c_str()});
+        result = std::move(mine);
+        owner = Kind::DeviceModel;  // names come from this site's fragment
+        owner_site = my_site;
+        return true;
+      }
+      Kind sub_owner = Kind::SimpleModel;
+      int sub_site = -1;
+      DVal final_out;
+      if (!eval_device(prog, u.children[0], rng, mine, out, sites,
+                       metric_srcs, final_out, sub_owner, sub_site))
+        return false;
+      out.path.push_back({u.name, u.class_name.c_str()});
+      result = std::move(final_out);
+      owner = sub_owner;
+      owner_site = sub_site;
       return true;
     }
     case Kind::SimpleModel: {
@@ -2081,6 +2134,35 @@ struct Server {
     arm_timer();
   }
 
+
+  // Push one device site's kind-2 frame. Returns 0 ok, ring error codes
+  // otherwise. Frame: u16 worker | u32 rid | u8 2 | u16 model | u8 method
+  // | u8 ndim | u32 dims[] | f64 data.
+  int push_site_frame(DevExec* st, size_t s) {
+    DevSite& site = st->sites[s];
+    site.req_id = next_req_id++;
+    const Unit& u = prog.units[site.unit_idx];
+    size_t ndim = site.req_dims.size();
+    std::vector<char> frame(11 + 4 * ndim + 8 * site.req_vals.size());
+    memcpy(frame.data(), &ring_worker_id, 2);
+    memcpy(frame.data() + 2, &site.req_id, 4);
+    frame[6] = 2;  // KIND_MODEL
+    uint16_t mid = (uint16_t)u.model_id;
+    memcpy(frame.data() + 7, &mid, 2);
+    frame[9] = (char)site.method;
+    frame[10] = (char)(uint8_t)ndim;
+    memcpy(frame.data() + 11, site.req_dims.data(), 4 * ndim);
+    memcpy(frame.data() + 11 + 4 * ndim, site.req_vals.data(),
+           8 * site.req_vals.size());
+    int rc = scr_push(req_ring, frame.data(), (uint32_t)frame.size());
+    if (rc != 0) return rc;
+    site.issued = true;
+    pending_dev[site.req_id] = {st, (int)s};
+    site.req_vals.clear();
+    site.req_vals.shrink_to_fit();
+    return 0;
+  }
+
   // ---- device graphs: parse numeric payload, eval, ship model calls ----
   void handle_predictions_device(Conn& c, std::string_view body, uint64_t t0) {
     auto* st = new DevExec();
@@ -2218,35 +2300,18 @@ struct Server {
       return;
     }
     for (size_t s = 0; s < st->sites.size(); ++s) {
-      DevSite& site = st->sites[s];
-      site.req_id = next_req_id++;
-      const Unit& u = prog.units[site.unit_idx];
-      size_t ndim = site.req_dims.size();
-      std::vector<char> frame(10 + 4 * ndim + 8 * site.req_vals.size());
-      memcpy(frame.data(), &ring_worker_id, 2);
-      memcpy(frame.data() + 2, &site.req_id, 4);
-      frame[6] = 2;  // KIND_MODEL
-      uint16_t mid = (uint16_t)u.model_id;
-      memcpy(frame.data() + 7, &mid, 2);
-      frame[9] = (char)(uint8_t)ndim;
-      memcpy(frame.data() + 10, site.req_dims.data(), 4 * ndim);
-      memcpy(frame.data() + 10 + 4 * ndim, site.req_vals.data(),
-             8 * site.req_vals.size());
-      int rc = scr_push(req_ring, frame.data(), (uint32_t)frame.size());
+      if (st->sites[s].input_site >= 0) continue;  // deferred: pushed on dep completion
+      int rc = push_site_frame(st, s);
       if (rc != 0) {
-        for (size_t k = 0; k < s; ++k) pending_dev.erase(st->sites[k].req_id);
+        drop_dev_exec(st);
         respond_error(c, rc == -2 ? 413 : 503,
                       rc == -2 ? "PAYLOAD_TOO_LARGE" : "ENGINE_BUSY",
                       rc == -2 ? "tensor larger than ring slot"
                                : "engine request ring full");
         metrics.observe_api("predictions", rc == -2 ? 413 : 503,
                             1e-9 * (now_ns() - t0));
-        delete st;
         return;
       }
-      pending_dev[site.req_id] = {st, (int)s};
-      site.req_vals.clear();
-      site.req_vals.shrink_to_fit();
     }
     st->conn_fd = c.fd;
     st->conn_gen = c.gen;
@@ -2257,7 +2322,11 @@ struct Server {
   }
 
   void drop_dev_exec(DevExec* st) {
-    for (auto& site : st->sites) pending_dev.erase(site.req_id);
+    // only issued sites own pending entries: a never-issued deferred site
+    // still has req_id 0, which after u32 wraparound could name a live
+    // request's entry
+    for (auto& site : st->sites)
+      if (site.issued) pending_dev.erase(site.req_id);
     delete st;
   }
 
@@ -2738,37 +2807,80 @@ struct Server {
       return;
     }
     for (size_t s = 0; s < st->sites.size(); ++s) {
-      DevSite& site = st->sites[s];
-      site.req_id = next_req_id++;
-      const Unit& u = prog.units[site.unit_idx];
-      size_t ndim = site.req_dims.size();
-      std::vector<char> frame(10 + 4 * ndim + 8 * site.req_vals.size());
-      memcpy(frame.data(), &ring_worker_id, 2);
-      memcpy(frame.data() + 2, &site.req_id, 4);
-      frame[6] = 2;
-      uint16_t mid = (uint16_t)u.model_id;
-      memcpy(frame.data() + 7, &mid, 2);
-      frame[9] = (char)(uint8_t)ndim;
-      memcpy(frame.data() + 10, site.req_dims.data(), 4 * ndim);
-      memcpy(frame.data() + 10 + 4 * ndim, site.req_vals.data(),
-             8 * site.req_vals.size());
-      int rc = scr_push(req_ring, frame.data(), (uint32_t)frame.size());
+      if (st->sites[s].input_site >= 0) continue;  // deferred
+      int rc = push_site_frame(st, s);
       if (rc != 0) {
-        for (size_t k = 0; k < s; ++k) pending_dev.erase(st->sites[k].req_id);
+        drop_dev_exec(st);
         grpc_trailers_error(c, sid, rc == -2 ? 3 : 14,
                             rc == -2 ? "tensor larger than ring slot"
                                      : "engine request ring full");
         metrics.observe_api("predictions", rc == -2 ? 413 : 503,
                             1e-9 * (now_ns() - t0));
-        delete st;
         return;
       }
-      pending_dev[site.req_id] = {st, (int)s};
-      site.req_vals.clear();
-      site.req_vals.shrink_to_fit();
     }
     st->outstanding = (int)st->sites.size();
     arm_timer();
+  }
+
+  // JSON value -> google.protobuf.Value wire bytes (tags fragments from the
+  // executor: numbers, strings, bools, lists, objects).
+  static void json_to_pb_value(const JDoc& doc, const JValue& v, Buf& out) {
+    PbWriter w{out};
+    switch (v.type) {
+      case JValue::Num:
+        w.tag(2, 1);
+        w.fixed64_raw(jnum(v));
+        break;
+      case JValue::Str:
+        w.str(3, v.sv);
+        break;
+      case JValue::Bool:
+        w.tag(4, 0);
+        w.varint(v.b ? 1 : 0);
+        break;
+      case JValue::Arr: {
+        Buf lv;
+        for (int i = 0; i < v.n_children; ++i) {
+          Buf item;
+          json_to_pb_value(doc, *doc.item(v, i), item);
+          PbWriter lw{lv};
+          lw.tag(1, 2);
+          lw.varint(item.size());
+          lv.append(item.data(), item.size());
+        }
+        w.tag(6, 2);
+        w.varint(lv.size());
+        out.append(lv.data(), lv.size());
+        break;
+      }
+      case JValue::Obj: {
+        Buf st;
+        for (int i = 0; i < v.n_children; ++i) {
+          const auto& m = doc.obj_members[v.first_child + i];
+          Buf item;
+          json_to_pb_value(doc, doc.nodes[m.second], item);
+          Buf e;
+          PbWriter ew{e};
+          ew.str(1, m.first);
+          ew.tag(2, 2);
+          ew.varint(item.size());
+          e.append(item.data(), item.size());
+          PbWriter sw{st};
+          sw.tag(1, 2);
+          sw.varint(e.size());
+          st.append(e.data(), e.size());
+        }
+        w.tag(5, 2);
+        w.varint(st.size());
+        out.append(st.data(), st.size());
+        break;
+      }
+      case JValue::Null:
+        w.tag(1, 0);
+        w.varint(0);
+        break;
+    }
   }
 
   // Proto response for a completed device-graph gRPC request: the proto
@@ -2784,6 +2896,7 @@ struct Server {
     std::vector<JDoc> frag_docs(st.sites.size());
     std::vector<const JValue*> frag_names(st.sites.size(), nullptr);
     std::vector<const JValue*> frag_metrics(st.sites.size(), nullptr);
+    std::vector<const JValue*> frag_tags(st.sites.size(), nullptr);
     for (size_t i = 0; i < st.sites.size(); ++i) {
       const std::string& frag = st.sites[i].fragment;
       if (frag.empty()) continue;
@@ -2792,6 +2905,7 @@ struct Server {
       if (froot.type != JValue::Obj) continue;
       frag_names[i] = frag_docs[i].get(froot, "names");
       frag_metrics[i] = frag_docs[i].get(froot, "metrics");
+      frag_tags[i] = frag_docs[i].get(froot, "tags");
     }
 
     Buf meta;
@@ -2839,6 +2953,32 @@ struct Server {
         Buf e;
         PbWriter ew{e};
         ew.str(1, "branch_means");
+        ew.tag(2, 2);
+        ew.varint(val.size());
+        e.append(val.data(), val.size());
+        mw.tag(2, 2);
+        mw.varint(e.size());
+        meta.append(e.data(), e.size());
+      }
+    }
+    // device-site tags (e.g. outlier scores), before the echo so an echoed
+    // request tag with the same key wins (proto map: last entry wins).
+    // Among device sites the FIRST wins — same rule as the REST builder.
+    std::vector<std::string_view> dev_tag_keys;
+    for (size_t i = 0; i < st.sites.size(); ++i) {
+      if (!frag_tags[i] || frag_tags[i]->type != JValue::Obj) continue;
+      for (int k = 0; k < frag_tags[i]->n_children; ++k) {
+        const auto& m = frag_docs[i].obj_members[frag_tags[i]->first_child + k];
+        bool dup = false;
+        for (auto kk : dev_tag_keys)
+          if (kk == m.first) dup = true;
+        if (dup) continue;
+        dev_tag_keys.push_back(m.first);
+        Buf val;
+        json_to_pb_value(frag_docs[i], frag_docs[i].nodes[m.second], val);
+        Buf e;
+        PbWriter ew{e};
+        ew.str(1, m.first);
         ew.tag(2, 2);
         ew.varint(val.size());
         e.append(val.data(), val.size());
@@ -3124,6 +3264,42 @@ struct Server {
         site.vals.resize(n_elems);
         memcpy(site.vals.data(), ring_buf.data() + off, 8 * n_elems);
         site.done = true;
+        // deferred dependents (transform chains): this output is their input
+        int dep_push_failed = 0;  // 0 ok, else the failing rc (-1/-2)
+        for (size_t d = 0; d < st->sites.size(); ++d) {
+          DevSite& dep = st->sites[d];
+          if (dep.input_site != sidx || dep.issued) continue;
+          dep.req_dims = site.dims;
+          dep.req_vals = site.vals;
+          int rc2 = push_site_frame(st, d);
+          if (rc2 != 0) {
+            dep_push_failed = rc2;
+            break;
+          }
+        }
+        if (dep_push_failed) {
+          Conn& c = conn(st->conn_fd);
+          if (c.fd == st->conn_fd && c.gen == st->conn_gen) {
+            bool too_large = dep_push_failed == -2;
+            if (st->is_grpc) {
+              grpc_trailers_error(c, st->h2_sid, too_large ? 3 : 14,
+                                  too_large ? "tensor larger than ring slot"
+                                            : "engine request ring full");
+            } else {
+              c.waiting_ring = false;
+              respond_error(c, too_large ? 413 : 503,
+                            too_large ? "PAYLOAD_TOO_LARGE" : "ENGINE_BUSY",
+                            too_large ? "tensor larger than ring slot"
+                                      : "engine request ring full");
+            }
+            metrics.observe_api("predictions", too_large ? 413 : 503,
+                                1e-9 * (now_ns() - st->t0));
+            flush_out(c);
+            if (!st->is_grpc && c.fd >= 0 && c.in.size() > 0) process_in(c);
+          }
+          drop_dev_exec(st);
+          continue;
+        }
         if (--st->outstanding == 0) finish_device(st);
         continue;
       }

@@ -82,24 +82,51 @@ def compile_edge_program(
     units: List[Dict[str, Any]] = []
     device_models: List[str] = []
 
-    def compile_device_unit(unit: PredictiveUnit) -> Optional[int]:
+    def _device_eligible(unit: PredictiveUnit, method: str) -> Optional[Any]:
         from seldon_core_tpu.components.component import _has_impl, has_raw
-        from seldon_core_tpu.contracts.graph import UnitType
 
         if not device_components or unit.name not in device_components:
             return None
+        component = device_components[unit.name]
+        if component is None or not _has_impl(component, method) \
+                or has_raw(component, method):
+            return None
+        if _has_impl(component, "send_feedback") or has_raw(component, "send_feedback"):
+            # native feedback handling is bandit-only; a component that
+            # learns from feedback must keep the Python engine in the loop
+            return None
+        if getattr(component, "is_async", False):
+            return None
+        return component
+
+    def compile_device_unit(unit: PredictiveUnit, transformed: bool) -> Optional[int]:
+        from seldon_core_tpu.contracts.graph import UnitType
+
+        if unit.type == UnitType.TRANSFORMER and len(unit.children) == 1:
+            # input transformer (e.g. an outlier detector) feeding a device
+            # subtree: its transformed output flows to the child as a
+            # deferred ring call chain
+            component = _device_eligible(unit, "transform_input")
+            if component is None:
+                return None
+            child = compile_unit(unit.children[0], transformed=True)
+            if child is None:
+                return None
+            units.append({
+                "name": unit.name,
+                "kind": "DEVICE_TRANSFORM",
+                "children": [child],
+                "modelId": len(device_models),
+                "className": type(component).__name__,
+            })
+            device_models.append(unit.name)
+            return len(units) - 1
         if unit.children:
             return None  # a device model's output feeding a chain stays Python
         if unit.type not in (None, UnitType.MODEL):
             return None
-        component = device_components[unit.name]
-        if component is None or has_raw(component, "predict"):
-            return None
-        if _has_impl(component, "send_feedback") or has_raw(component, "send_feedback"):
-            # native feedback handling is bandit-only; a model that learns
-            # from feedback must keep the Python engine in the loop
-            return None
-        if getattr(component, "is_async", False):
+        component = _device_eligible(unit, "predict")
+        if component is None:
             return None
         units.append({
             "name": unit.name,
@@ -111,10 +138,15 @@ def compile_edge_program(
         device_models.append(unit.name)
         return len(units) - 1
 
-    def compile_unit(unit: PredictiveUnit) -> Optional[int]:
+    def compile_unit(unit: PredictiveUnit, transformed: bool = False) -> Optional[int]:
         kind = _NATIVE_KINDS.get(unit.implementation)
         if kind is None:
-            return compile_device_unit(unit)
+            return compile_device_unit(unit, transformed)
+        if transformed and kind in ("SIMPLE_MODEL",):
+            # a stub consuming a device-transformed value would need the
+            # transformed row count at eval time, which isn't known until
+            # the ring call completes — keep such graphs on the Python engine
+            return None
         params = unit.parameters_dict()
         if kind in ("RANDOM_ABTEST", "EPSILON_GREEDY", "THOMPSON_SAMPLING") and (
             params.get("seed") is not None
@@ -146,7 +178,7 @@ def compile_edge_program(
                 return None
         children: List[int] = []
         for child in unit.children:
-            idx = compile_unit(child)
+            idx = compile_unit(child, transformed=transformed)
             if idx is None:
                 return None
             children.append(idx)

@@ -237,7 +237,6 @@ def run_edge(args: argparse.Namespace) -> None:
     # custom transformers) keeps full-graph ring fallback (kind 0).
     import asyncio
 
-    from seldon_core_tpu.contracts.graph import UnitType
     from seldon_core_tpu.runtime.engine import GraphEngine
     from seldon_core_tpu.runtime.remote import RemoteComponent
     from seldon_core_tpu.transport.ipc import (
@@ -248,12 +247,12 @@ def run_edge(args: argparse.Namespace) -> None:
     )
 
     engine = GraphEngine(spec, annotations=load_annotations())
+    # the compiler owns device eligibility (unit type/children/method
+    # checks live in compile_edge_program); pass every in-process component
     eligible = {
         st.unit.name: st.component
         for st in engine.state.walk()
         if st.component is not None
-        and not st.children
-        and st.unit.type in (None, UnitType.MODEL)
         and not isinstance(st.component, RemoteComponent)
     }
     program = compile_edge_program(spec, deployment=deployment,

@@ -12,7 +12,8 @@ Frame format (bytes, little-endian):
     u16 worker_id | u32 request_id | u8 kind | payload
 kind: 0 = predict(SeldonMessage JSON), 1 = feedback(Feedback JSON),
       2 = device-model call (binary tensor, no JSON):
-          u16 model_id | u8 ndim | u32 dims[ndim] | f64 data.
+          u16 model_id | u8 method (0=predict, 1=transform_input)
+          | u8 ndim | u32 dims[ndim] | f64 data.
 Responses travel back on a per-worker ring as
     u32 request_id | u8 status | body
 status 0 JSON kinds: JSON payload. status 0 model kind:
@@ -50,7 +51,10 @@ logger = logging.getLogger(__name__)
 
 _REQ_HEADER = struct.Struct("<HIB")
 _RESP_HEADER = struct.Struct("<IB")
-_MODEL_REQ = struct.Struct("<HB")  # model_id, ndim (dims follow as u32 each)
+_MODEL_REQ = struct.Struct("<HBB")  # model_id, method, ndim (dims follow as u32)
+
+METHOD_PREDICT = 0
+METHOD_TRANSFORM_INPUT = 1
 
 KIND_PREDICT = 0
 KIND_FEEDBACK = 1
@@ -116,14 +120,14 @@ class ModelExecutor:
     # ---- frame codecs -------------------------------------------------
     @staticmethod
     def parse_frame(payload: bytes):
-        model_id, ndim = _MODEL_REQ.unpack_from(payload)
+        model_id, method, ndim = _MODEL_REQ.unpack_from(payload)
         dims = struct.unpack_from(f"<{ndim}I", payload, _MODEL_REQ.size)
         off = _MODEL_REQ.size + 4 * ndim
         n = 1
         for d in dims:
             n *= d
         arr = np.frombuffer(payload, dtype="<f8", count=n, offset=off).reshape(dims)
-        return model_id, arr
+        return model_id, method, arr
 
     @staticmethod
     def _ok_response(req_id: int, arr: np.ndarray, frag: bytes) -> bytes:
@@ -134,8 +138,9 @@ class ModelExecutor:
         head += struct.pack("<I", len(frag)) + frag
         return head + out.tobytes()
 
-    def _fragment_for(self, model_id: int, component, result: np.ndarray) -> bytes:
-        key = (model_id, result.ndim,
+    def _fragment_for(self, model_id: int, method: int, component,
+                      result: np.ndarray) -> bytes:
+        key = (model_id, method, result.ndim,
                int(result.shape[1]) if result.ndim > 1 else -1)
         if self._frag_static[model_id]:
             cached = self._frag_cache.get(key)
@@ -145,10 +150,15 @@ class ModelExecutor:
             client_class_names,
             client_custom_metrics,
             client_custom_tags,
+            client_feature_names,
         )
 
         fragment: Dict[str, Any] = {}
-        names = client_class_names(component, result)
+        if method == METHOD_TRANSFORM_INPUT:
+            # request-flow response: engine construct_response(is_request=True)
+            names = client_feature_names(component, [])
+        else:
+            names = client_class_names(component, result)
         if names:
             fragment["names"] = list(names)
         tags = client_custom_tags(component)
@@ -167,9 +177,9 @@ class ModelExecutor:
         return _RESP_HEADER.pack(req_id, 1) + _error_body(info, reason, code)
 
     # ---- execution ----------------------------------------------------
-    def _predict_frames(self, model_id: int, frames) -> Dict[tuple, bytes]:
-        """frames: [((worker_id, req_id), arr)]; one stacked predict when
-        shapes allow. Keys are (worker, req) pairs throughout: req_ids are
+    def _predict_frames(self, model_id: int, method: int, frames) -> Dict[tuple, bytes]:
+        """frames: [((worker_id, req_id), arr)]; one stacked call when shapes
+        allow. Keys are (worker, req) pairs throughout: req_ids are
         per-edge-worker counters, so with multiple edge workers the bare
         req_id collides across workers."""
         out: Dict[tuple, bytes] = {}
@@ -179,6 +189,17 @@ class ModelExecutor:
                     key[1], f"unknown device model {model_id}", "BAD_GRAPH")
             return out
         component = self.models[model_id]
+        if method == METHOD_TRANSFORM_INPUT:
+            def call(arr):
+                return component.transform_input(arr, [], meta={})
+        elif method == METHOD_PREDICT:
+            def call(arr):
+                return component.predict(arr, [], meta={})
+        else:
+            for key, _ in frames:
+                out[key] = self._err_response(
+                    key[1], f"unknown device method {method}", "BAD_GRAPH")
+            return out
 
         def finish(key: tuple, result: np.ndarray) -> None:
             if not (isinstance(result, np.ndarray)
@@ -190,14 +211,22 @@ class ModelExecutor:
                     "ENGINE_ERROR")
                 return
             out[key] = self._ok_response(
-                key[1], result, self._fragment_for(model_id, component, result))
+                key[1], result,
+                self._fragment_for(model_id, method, component, result))
 
         # stack 2-D frames with equal feature shape into one call, chunked at
         # the largest compiled bucket (stacking must never out-shape the
-        # warmed compile cache)
+        # warmed compile cache). Components with DYNAMIC tags/metrics (e.g.
+        # outlier detectors scoring each request) must run solo: a stacked
+        # call would compute one tags() for the whole batch and misattribute
+        # per-request scores.
         max_rows = self.max_rows[model_id]
-        stackable = [(r, a) for r, a in frames if a.ndim >= 2]
-        solo = [(r, a) for r, a in frames if a.ndim < 2]
+        if self._frag_static[model_id]:
+            stackable = [(r, a) for r, a in frames if a.ndim >= 2]
+            solo = [(r, a) for r, a in frames if a.ndim < 2]
+        else:
+            stackable = []
+            solo = list(frames)
         by_shape: Dict[tuple, list] = {}
         for r, a in stackable:
             by_shape.setdefault(a.shape[1:], []).append((r, a))
@@ -217,11 +246,10 @@ class ModelExecutor:
             try:
                 if len(group) == 1:
                     key, arr = group[0]
-                    finish(key, np.asarray(
-                        component.predict(arr, [], meta={})))
+                    finish(key, np.asarray(call(arr)))
                 else:
                     stacked = np.concatenate([a for _, a in group], axis=0)
-                    result = np.asarray(component.predict(stacked, [], meta={}))
+                    result = np.asarray(call(stacked))
                     if result.shape[:1] != stacked.shape[:1]:
                         raise SeldonError(
                             "device model output rows do not match stacked "
@@ -240,7 +268,7 @@ class ModelExecutor:
                         int(getattr(e, "status_code", 500)))
         for key, arr in solo:
             try:
-                finish(key, np.asarray(component.predict(arr, [], meta={})))
+                finish(key, np.asarray(call(arr)))
             except Exception as e:
                 out[key] = self._err_response(
                     key[1], str(e),
@@ -251,18 +279,19 @@ class ModelExecutor:
     def execute(self, frames) -> Dict[int, Dict[int, bytes]]:
         """frames: [(worker_id, req_id, payload_bytes)] →
         {worker_id: {req_id: response_bytes}}."""
-        parsed: Dict[int, list] = {}
+        parsed: Dict[tuple, list] = {}
         responses: Dict[int, Dict[int, bytes]] = {}
         for worker_id, req_id, payload in frames:
             try:
-                model_id, arr = self.parse_frame(payload)
+                model_id, method, arr = self.parse_frame(payload)
             except Exception:
                 responses.setdefault(worker_id, {})[req_id] = self._err_response(
                     req_id, "malformed device-model frame", "MICROSERVICE_BAD_DATA", 400)
                 continue
-            parsed.setdefault(model_id, []).append(((worker_id, req_id), arr))
-        for model_id, group in parsed.items():
-            for (worker_id, req_id), resp in self._predict_frames(model_id, group).items():
+            parsed.setdefault((model_id, method), []).append(((worker_id, req_id), arr))
+        for (model_id, method), group in parsed.items():
+            for (worker_id, req_id), resp in self._predict_frames(
+                    model_id, method, group).items():
                 responses.setdefault(worker_id, {})[req_id] = resp
         return responses
 

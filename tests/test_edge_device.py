@@ -107,11 +107,12 @@ def device_edge(tmp_path_factory, ckpt):
         engine = GraphEngine(spec)
         from seldon_core_tpu.runtime.remote import RemoteComponent
 
+        # the compiler owns eligibility (type/children/method checks); hand
+        # it every in-process component
         eligible = {
             st.unit.name: st.component
             for st in engine.state.walk()
-            if st.component is not None and not st.children
-            and st.unit.type in (None, UnitType.MODEL)
+            if st.component is not None
             and not isinstance(st.component, RemoteComponent)
         }
         program = compile_edge_program(spec, device_components=eligible)
@@ -521,3 +522,74 @@ def test_grpc_combiner_over_device_parity(device_edge, ckpt):
     want = engine_grpc_expected(combiner_spec(ckpt), req)
     got = grpc_predict(grpc_port, req).to_dict()
     assert strip_puid(got) == strip_puid(want)
+
+
+# ---------------------------------------------------------------------------
+# DEVICE_TRANSFORM: input transformers (outlier detector) feeding device models
+# ---------------------------------------------------------------------------
+
+def outlier_spec(ckpt):
+    return {
+        "name": "p",
+        "graph": {
+            "name": "od", "type": "TRANSFORMER",
+            "implementation": "MAHALANOBIS_OD",
+            "parameters": [{"name": "threshold", "value": "2.0", "type": "FLOAT"}],
+            "children": [jax_unit("m", ckpt)],
+        },
+    }
+
+
+def test_outlier_transformer_chain_compiles(ckpt):
+    """TRANSFORMER->MODEL compiles to DEVICE_TRANSFORM->DEVICE_MODEL; a
+    stub consuming the transformed value keeps the graph on Python."""
+    spec = PredictorSpec.from_dict(outlier_spec(ckpt))
+    engine = GraphEngine(spec)
+    eligible = {st.unit.name: st.component for st in engine.state.walk()
+                if st.component is not None}
+    prog = compile_edge_program(spec, device_components=eligible)
+    assert prog is not None
+    kinds = {u["name"]: u["kind"] for u in prog["units"]}
+    assert kinds == {"od": "DEVICE_TRANSFORM", "m": "DEVICE_MODEL"}
+    assert prog["deviceModels"] == ["m", "od"] or prog["deviceModels"] == ["od", "m"]
+
+    stub_child = json.loads(json.dumps(outlier_spec(ckpt)))
+    stub_child["graph"]["children"] = [
+        {"name": "s", "type": "MODEL", "implementation": "SIMPLE_MODEL"}]
+    spec2 = PredictorSpec.from_dict(stub_child)
+    engine2 = GraphEngine(spec2)
+    eligible2 = {st.unit.name: st.component for st in engine2.state.walk()
+                 if st.component is not None}
+    assert compile_edge_program(spec2, device_components=eligible2) is None
+
+
+def test_outlier_transformer_over_device_model_parity(device_edge, ckpt):
+    """The reference's flagship outlier topology (seldon-od-transformer):
+    detector scores each request into tags, features flow to the model.
+    Stateful parity: the SAME request sequence against a fresh engine must
+    match response-for-response (scores depend on the running stats), over
+    REST and gRPC, including the final fallback payload sharing state."""
+    port, fixture_engine, _, _, _, _, grpc_port = device_edge(
+        "outlier", outlier_spec(ckpt))
+    engine = GraphEngine(PredictorSpec.from_dict(outlier_spec(ckpt)))
+    from seldon_core_tpu.transport import proto_convert as pc
+
+    rng = np.random.default_rng(11)
+    for i in range(4):
+        req = {"data": {"ndarray": rng.standard_normal((2, 4)).round(3).tolist()}}
+        expected = engine.predict_sync(
+            SeldonMessage.from_dict(json.loads(json.dumps(req))))
+        status, got = post(port, "/api/v0.1/predictions", req)
+        assert status == 200, got
+        assert strip_puid(got) == strip_puid(expected.to_dict()), i
+        assert "outlier_score" in got["meta"]["tags"], i
+        assert got["meta"]["requestPath"]["od"] == "MahalanobisOutlierDetector"
+
+    # gRPC tensor joins the same state stream
+    req = {"data": {"tensor": {"shape": [1, 4], "values": [9.0, -9.0, 9.0, -9.0]}}}
+    expected = engine.predict_sync(
+        SeldonMessage.from_dict(json.loads(json.dumps(req))))
+    want = pc.message_from_proto(pc.message_to_proto(expected)).to_dict()
+    got = grpc_predict(grpc_port, req).to_dict()
+    assert strip_puid(got) == strip_puid(want)
+    assert "outlier_score" in got["meta"]["tags"]

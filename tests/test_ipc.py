@@ -207,7 +207,7 @@ def test_model_executor_same_req_id_different_workers():
 
     def frame(model_id, value):
         data = np.array([[value]], dtype="<f8")
-        return (struct.pack("<HB", model_id, 2) + struct.pack("<2I", 1, 1)
+        return (struct.pack("<HBB", model_id, 0, 2) + struct.pack("<2I", 1, 1)
                 + data.tobytes())
 
     # worker 0 req 7 -> model 0 (x2); worker 1 req 7 -> model 1 (x3)
