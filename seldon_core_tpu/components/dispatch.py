@@ -50,12 +50,68 @@ def _coerce_raw(component: Any, result: Any, request: Optional[SeldonMessage], i
     return construct_response(component, is_request, request, result)
 
 
-def predict(component: Any, request: SeldonMessage) -> SeldonMessage:
+def predict(component: Any, request: SeldonMessage):
+    """Returns a SeldonMessage — or, when the request joins a shared
+    continuous batch from async code, an Awaitable[SeldonMessage] (every
+    transport in this repo already handles awaitable results, matching the
+    is_async component path)."""
     if has_raw(component, "predict"):
         return _coerce_raw(component, component.predict_raw(request), request, is_request=False)
+    batched = _maybe_continuous_batch(component, request)
+    if batched is not None:
+        return batched
     payload = request.payload()
     result = client_predict(component, payload, request.names, meta=request.meta.to_dict())
     return construct_response(component, False, request, result)
+
+
+def _maybe_continuous_batch(component: Any, request: SeldonMessage):
+    """Single-prompt LLM predicts join the component's shared continuous
+    batch when it opted in (``continuous_batching`` slots > 0) — regardless
+    of which transport reached this dispatch (component REST/gRPC, the graph
+    engine, or the edge's ring fallback), concurrent clients then share one
+    in-flight decode. The RESPONSE is byte-identical in shape to the
+    unbatched path (generate()'s {"texts", "tokens"} dict through
+    construct_response, meta included); per-request sampling params keep the
+    private path so output never silently changes."""
+    if int(getattr(component, "continuous_batching", 0) or 0) <= 0:
+        return None  # a streaming-only 1-slot service must not capture /predict
+    if request.which != "jsonData" or not isinstance(request.json_data, dict):
+        return None
+    body = request.json_data
+    if "prompt" not in body or "prompts" in body \
+            or "temperature" in body or "seed" in body:
+        return None
+    from seldon_core_tpu.runtime.batcher import get_batcher_service
+
+    svc = get_batcher_service(component)
+    if svc is None:
+        return None
+
+    def to_msg(toks):
+        # same shape + meta as the unbatched path: LLMServer.predict returns
+        # {"texts": [...], "tokens": [[...]]} for jsonData prompts
+        tokenizer = getattr(component, "_tokenizer", None)
+        text = (tokenizer.decode(toks) if tokenizer is not None
+                and isinstance(body["prompt"], str) else None)
+        return construct_response(
+            component, False, request, {"texts": [text], "tokens": [toks]})
+
+    import asyncio
+
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        # sync transport (gRPC worker thread): block this thread only
+        return to_msg(svc.submit_sync(body["prompt"], body.get("max_new_tokens")))
+
+    async def run():
+        # async transport (graph engine, REST app, ring handler): never block
+        # the event loop while the shared batch decodes
+        toks = await svc.submit(body["prompt"], body.get("max_new_tokens"))
+        return to_msg(toks)
+
+    return run()
 
 
 def transform_input(component: Any, request: SeldonMessage) -> SeldonMessage:

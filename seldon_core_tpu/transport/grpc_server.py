@@ -67,35 +67,9 @@ def _component_methods(component: Any, unit_id: str) -> Dict[str, Dict[str, Call
     def fb(comp, f):
         return dispatch.send_feedback(comp, f, unit_id=unit_id or None)
 
-    def predict_fn(comp, msg):
-        # Single-prompt LLM predicts join the shared continuous batch when
-        # the component opted in (continuous_batching slots): concurrent
-        # gRPC clients then share one in-flight decode instead of serial
-        # private generate() calls. Batch payloads keep the normal path.
-        if msg.which == "jsonData" and isinstance(msg.json_data, dict) \
-                and "prompt" in msg.json_data and "prompts" not in msg.json_data \
-                and "temperature" not in msg.json_data \
-                and "seed" not in msg.json_data:
-            # per-request sampling params can't join the shared batch; those
-            # requests fall through to dispatch.predict -> generate()
-            from seldon_core_tpu.runtime.batcher import get_batcher_service
-
-            svc = get_batcher_service(comp)
-            if svc is not None:
-                body = msg.json_data
-                toks = svc.submit_sync(body["prompt"],
-                                       body.get("max_new_tokens"))
-                tokenizer = getattr(comp, "_tokenizer", None)
-                text = (tokenizer.decode(toks)
-                        if tokenizer is not None
-                        and isinstance(body["prompt"], str) else None)
-                from seldon_core_tpu.contracts.payload import SeldonMessage
-
-                return SeldonMessage(json_data={"tokens": toks, "text": text},
-                                     which="jsonData")
-        return dispatch.predict(comp, msg)
-
-    predict = wrap(predict_fn, pc.message_from_proto, "predict")
+    # single-prompt continuous batching lives in dispatch.predict itself
+    # (_maybe_continuous_batch), so every transport shares the one batch
+    predict = wrap(dispatch.predict, pc.message_from_proto, "predict")
     tin = wrap(dispatch.transform_input, pc.message_from_proto, "transform_input")
     tout = wrap(dispatch.transform_output, pc.message_from_proto, "transform_output")
     route = wrap(dispatch.route, pc.message_from_proto, "route")

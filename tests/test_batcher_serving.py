@@ -158,7 +158,10 @@ def test_grpc_jsondata_prompt_joins_batch(batched_component, solo_tokens):
         for t in threads:
             t.join()
         for i, r in enumerate(results):
-            assert r["tokens"] == solo_tokens[i], i
+            # batched path keeps the component /predict contract exactly:
+            # generate()'s plural shape through construct_response
+            assert r["tokens"] == [solo_tokens[i]], i
+            assert isinstance(r["texts"][0], str)
         assert batched_component._batcher_service.submitted - before == 4
     finally:
         server.stop(None)
@@ -194,3 +197,61 @@ def test_generate_without_batcher_still_serves(solo_tokens):
         assert getattr(comp, "_batcher_service", None) is None
     finally:
         loop.call_soon_threadsafe(loop.stop)
+
+
+def test_engine_graph_jsondata_prompt_joins_batch(batched_component, solo_tokens):
+    """An LLM behind the GRAPH ENGINE (the edge's ring path reaches the same
+    coroutine): concurrent single-prompt jsonData predicts share the batch
+    without blocking the engine's event loop."""
+    import asyncio as aio
+
+    from seldon_core_tpu.contracts.graph import PredictorSpec
+    from seldon_core_tpu.contracts.payload import SeldonMessage
+    from seldon_core_tpu.runtime.engine import GraphEngine
+
+    spec = PredictorSpec.from_dict(
+        {"name": "p", "graph": {"name": "llm", "type": "MODEL"}})
+    engine = GraphEngine(spec, components={"llm": batched_component})
+    before = batched_component._batcher_service.submitted
+
+    async def drive():
+        reqs = [SeldonMessage.from_dict({"jsonData": {"prompt": PROMPTS[i]}})
+                for i in range(4)]
+        return await aio.gather(*[engine.predict(r) for r in reqs])
+
+    outs = aio.run(drive())
+    for i, out in enumerate(outs):
+        assert out.json_data["tokens"] == [solo_tokens[i]], i
+    assert batched_component._batcher_service.submitted - before == 4
+
+
+def test_batched_predict_shape_matches_unbatched(batched_component, solo_tokens):
+    """The SAME jsonData prompt request must produce an identically-shaped
+    response whether or not the component batches (meta included)."""
+    from seldon_core_tpu.components import dispatch
+    from seldon_core_tpu.contracts.payload import SeldonMessage
+
+    plain = make_server()
+    req = {"meta": {"puid": "pp"}, "jsonData": {"prompt": PROMPTS[0]}}
+    want = dispatch.predict(plain, SeldonMessage.from_dict(json.loads(json.dumps(req))))
+    got = dispatch.predict(batched_component,
+                           SeldonMessage.from_dict(json.loads(json.dumps(req))))
+    assert not asyncio.iscoroutine(got)  # sync context -> sync result
+    assert got.to_dict() == want.to_dict()
+
+
+def test_stream_service_does_not_capture_predict(solo_tokens):
+    """A component with batching OFF that served one stream must keep the
+    private generate() path for /predict (the 1-slot streaming service must
+    not reroute it)."""
+    from seldon_core_tpu.components import dispatch
+    from seldon_core_tpu.contracts.payload import SeldonMessage
+    from seldon_core_tpu.runtime.batcher import ensure_stream_service
+
+    comp = make_server()
+    svc = ensure_stream_service(comp)  # what a streaming request creates
+    before = svc.submitted
+    out = dispatch.predict(
+        comp, SeldonMessage.from_dict({"jsonData": {"prompt": PROMPTS[1]}}))
+    assert out.json_data["tokens"] == [solo_tokens[1]]
+    assert svc.submitted == before  # predict did NOT go through the batcher
