@@ -1158,6 +1158,9 @@ struct DevSite {
   uint8_t method = 0;    // 0 = predict, 1 = transform_input
   int input_site = -1;   // >=0: input is that site's output (deferred push)
   bool issued = false;
+  bool owns_pending = false;  // inserted into pending_dev under req_id
+  bool chain_member = false;  // carried inside an upstream site's frame
+  std::vector<int> chain;     // fused downstream stages (in order)
   bool done = false;
   // request tensor (shipped) and response tensor (filled by drain)
   std::vector<uint32_t> req_dims;
@@ -2143,24 +2146,75 @@ struct Server {
     site.req_id = next_req_id++;
     const Unit& u = prog.units[site.unit_idx];
     size_t ndim = site.req_dims.size();
-    std::vector<char> frame(11 + 4 * ndim + 8 * site.req_vals.size());
+    size_t n_extra = site.chain.size();
+    // 7 ring hdr + 2 mid + 1 method + 1 n_extra + 3/stage + 1 ndim + dims + data
+    std::vector<char> frame(12 + 3 * n_extra + 4 * ndim +
+                            8 * site.req_vals.size());
     memcpy(frame.data(), &ring_worker_id, 2);
     memcpy(frame.data() + 2, &site.req_id, 4);
     frame[6] = 2;  // KIND_MODEL
     uint16_t mid = (uint16_t)u.model_id;
     memcpy(frame.data() + 7, &mid, 2);
     frame[9] = (char)site.method;
-    frame[10] = (char)(uint8_t)ndim;
-    memcpy(frame.data() + 11, site.req_dims.data(), 4 * ndim);
-    memcpy(frame.data() + 11 + 4 * ndim, site.req_vals.data(),
-           8 * site.req_vals.size());
+    size_t off = 10;
+    frame[off++] = (char)(uint8_t)n_extra;
+    for (int m : site.chain) {  // fused downstream stages, one RTT total
+      const Unit& cu = prog.units[st->sites[m].unit_idx];
+      uint16_t cmid = (uint16_t)cu.model_id;
+      memcpy(frame.data() + off, &cmid, 2);
+      frame[off + 2] = (char)st->sites[m].method;
+      off += 3;
+    }
+    frame[off++] = (char)(uint8_t)ndim;
+    memcpy(frame.data() + off, site.req_dims.data(), 4 * ndim);
+    off += 4 * ndim;
+    memcpy(frame.data() + off, site.req_vals.data(), 8 * site.req_vals.size());
     int rc = scr_push(req_ring, frame.data(), (uint32_t)frame.size());
     if (rc != 0) return rc;
     site.issued = true;
+    site.owns_pending = true;
     pending_dev[site.req_id] = {st, (int)s};
+    for (int m : site.chain) st->sites[m].issued = true;
     site.req_vals.clear();
     site.req_vals.shrink_to_fit();
     return 0;
+  }
+
+  // Collapse linear dependency runs into fused chains: a site whose output
+  // feeds exactly ONE downstream site carries that site (and its sole
+  // successors) inside its own frame — the transform->model path costs one
+  // ring round-trip instead of one per hop.
+  static void plan_chains(DevExec* st) {
+    size_t n = st->sites.size();
+    std::vector<int> dep_count(n, 0), sole_dep(n, -1);
+    for (size_t i = 0; i < n; ++i) {
+      int in = st->sites[i].input_site;
+      if (in >= 0) {
+        if (++dep_count[in] == 1) sole_dep[in] = (int)i;
+        else sole_dep[in] = -1;
+      }
+    }
+    for (size_t i = 0; i < n; ++i) {
+      DevSite& s = st->sites[i];
+      if (s.input_site >= 0 && dep_count[s.input_site] == 1)
+        s.chain_member = true;
+    }
+    for (size_t i = 0; i < n; ++i) {
+      DevSite& s = st->sites[i];
+      if (s.chain_member) continue;  // heads only
+      int cur = (int)i;
+      while (sole_dep[cur] >= 0) {
+        s.chain.push_back(sole_dep[cur]);
+        cur = sole_dep[cur];
+      }
+      // the wire carries chain length as u8: a run deeper than 255 extras
+      // does not fuse at all — members revert to the (correct, per-hop)
+      // deferred path rather than a truncated frame
+      if (s.chain.size() > 255) {
+        for (int m : s.chain) st->sites[m].chain_member = false;
+        s.chain.clear();
+      }
+    }
   }
 
   // ---- device graphs: parse numeric payload, eval, ship model calls ----
@@ -2299,6 +2353,7 @@ struct Server {
       delete st;
       return;
     }
+    plan_chains(st);
     for (size_t s = 0; s < st->sites.size(); ++s) {
       if (st->sites[s].input_site >= 0) continue;  // deferred: pushed on dep completion
       int rc = push_site_frame(st, s);
@@ -2326,7 +2381,7 @@ struct Server {
     // still has req_id 0, which after u32 wraparound could name a live
     // request's entry
     for (auto& site : st->sites)
-      if (site.issued) pending_dev.erase(site.req_id);
+      if (site.owns_pending) pending_dev.erase(site.req_id);
     delete st;
   }
 
@@ -2806,6 +2861,7 @@ struct Server {
       delete st;
       return;
     }
+    plan_chains(st);
     for (size_t s = 0; s < st->sites.size(); ++s) {
       if (st->sites[s].input_site >= 0) continue;  // deferred
       int rc = push_site_frame(st, s);
@@ -3264,13 +3320,60 @@ struct Server {
         site.vals.resize(n_elems);
         memcpy(site.vals.data(), ring_buf.data() + off, 8 * n_elems);
         site.done = true;
-        // deferred dependents (transform chains): this output is their input
+        int completed = 1;
+        int value_site = sidx;  // who ends up holding the returned tensor
+        if (!site.chain.empty()) {
+          // fused chain: fragment is a JSON array of per-stage fragments;
+          // the returned tensor is the LAST stage's output
+          JDoc fdoc;
+          bool fok = json_parse(site.fragment.data(), site.fragment.size(), fdoc)
+                     && fdoc.nodes[0].type == JValue::Arr
+                     && fdoc.nodes[0].n_children == (int)site.chain.size() + 1;
+          if (!fok) {
+            Conn& c = conn(st->conn_fd);
+            if (c.fd == st->conn_fd && c.gen == st->conn_gen) {
+              if (st->is_grpc) {
+                grpc_trailers_error(c, st->h2_sid, 13, "malformed chain response");
+              } else {
+                c.waiting_ring = false;
+                respond_error(c, 500, "INTERNAL_ERROR", "malformed chain response");
+              }
+              metrics.observe_api("predictions", 500, 1e-9 * (now_ns() - st->t0));
+              flush_out(c);
+              if (!st->is_grpc && c.fd >= 0 && c.in.size() > 0) process_in(c);
+            }
+            drop_dev_exec(st);
+            continue;
+          }
+          std::vector<std::string> stage_frags(site.chain.size() + 1);
+          for (int fi = 0; fi <= (int)site.chain.size(); ++fi) {
+            const JValue* el = fdoc.item(fdoc.nodes[0], fi);
+            stage_frags[fi].assign(el->raw.data(), el->raw.size());
+          }
+          int last = site.chain.back();
+          DevSite& last_site = st->sites[last];
+          last_site.dims = site.dims;
+          last_site.vals = std::move(site.vals);
+          last_site.dtype = site.dtype;
+          site.dims.clear();
+          site.vals.clear();
+          site.fragment = std::move(stage_frags[0]);
+          for (size_t mi = 0; mi < site.chain.size(); ++mi) {
+            DevSite& m = st->sites[site.chain[mi]];
+            m.fragment = std::move(stage_frags[mi + 1]);
+            m.done = true;
+          }
+          completed += (int)site.chain.size();
+          value_site = last;
+        }
+        // deferred dependents: the value-holder's output is their input
+        DevSite& vsite = st->sites[value_site];
         int dep_push_failed = 0;  // 0 ok, else the failing rc (-1/-2)
         for (size_t d = 0; d < st->sites.size(); ++d) {
           DevSite& dep = st->sites[d];
-          if (dep.input_site != sidx || dep.issued) continue;
-          dep.req_dims = site.dims;
-          dep.req_vals = site.vals;
+          if (dep.input_site != value_site || dep.issued) continue;
+          dep.req_dims = vsite.dims;
+          dep.req_vals = vsite.vals;
           int rc2 = push_site_frame(st, d);
           if (rc2 != 0) {
             dep_push_failed = rc2;
@@ -3300,7 +3403,8 @@ struct Server {
           drop_dev_exec(st);
           continue;
         }
-        if (--st->outstanding == 0) finish_device(st);
+        st->outstanding -= completed;
+        if (st->outstanding == 0) finish_device(st);
         continue;
       }
       RingPending rp = it->second;

@@ -13,7 +13,10 @@ Frame format (bytes, little-endian):
 kind: 0 = predict(SeldonMessage JSON), 1 = feedback(Feedback JSON),
       2 = device-model call (binary tensor, no JSON):
           u16 model_id | u8 method (0=predict, 1=transform_input)
-          | u8 ndim | u32 dims[ndim] | f64 data.
+          | u8 n_chain_extra | n_chain_extra x (u16 model, u8 method)
+          | u8 ndim | u32 dims[ndim] | f64 data
+          (chained stages run sequentially in one round-trip; the response
+          fragment is then a JSON array, one fragment per stage).
 Responses travel back on a per-worker ring as
     u32 request_id | u8 status | body
 status 0 JSON kinds: JSON payload. status 0 model kind:
@@ -51,7 +54,12 @@ logger = logging.getLogger(__name__)
 
 _REQ_HEADER = struct.Struct("<HIB")
 _RESP_HEADER = struct.Struct("<IB")
-_MODEL_REQ = struct.Struct("<HBB")  # model_id, method, ndim (dims follow as u32)
+# model_id, method, n_chain_extra, then n_chain_extra x (u16 model, u8
+# method) chained stages, then u8 ndim + u32 dims. A chained frame runs its
+# stages sequentially (stage i+1 consumes stage i's output) in ONE ring
+# round-trip — the transform->model hot path costs one RTT, not one per hop.
+_MODEL_REQ = struct.Struct("<HBB")
+_CHAIN_STAGE = struct.Struct("<HB")
 
 METHOD_PREDICT = 0
 METHOD_TRANSFORM_INPUT = 1
@@ -120,14 +128,24 @@ class ModelExecutor:
     # ---- frame codecs -------------------------------------------------
     @staticmethod
     def parse_frame(payload: bytes):
-        model_id, method, ndim = _MODEL_REQ.unpack_from(payload)
-        dims = struct.unpack_from(f"<{ndim}I", payload, _MODEL_REQ.size)
-        off = _MODEL_REQ.size + 4 * ndim
+        """Returns (stages, arr): stages = ((model_id, method), ...) — one
+        entry for plain frames, several for fused chains."""
+        model_id, method, n_extra = _MODEL_REQ.unpack_from(payload)
+        off = _MODEL_REQ.size
+        stages = [(model_id, method)]
+        for _ in range(n_extra):
+            m, meth = _CHAIN_STAGE.unpack_from(payload, off)
+            stages.append((m, meth))
+            off += _CHAIN_STAGE.size
+        ndim = payload[off]
+        off += 1
+        dims = struct.unpack_from(f"<{ndim}I", payload, off)
+        off += 4 * ndim
         n = 1
         for d in dims:
             n *= d
         arr = np.frombuffer(payload, dtype="<f8", count=n, offset=off).reshape(dims)
-        return model_id, method, arr
+        return tuple(stages), arr
 
     @staticmethod
     def _ok_response(req_id: int, arr: np.ndarray, frag: bytes) -> bytes:
@@ -177,6 +195,44 @@ class ModelExecutor:
         return _RESP_HEADER.pack(req_id, 1) + _error_body(info, reason, code)
 
     # ---- execution ----------------------------------------------------
+    def _call_stacked(self, call, items, max_rows, finish, fail):
+        """Shared micro-batch machinery: ``items`` = [(key, arr)] with equal
+        trailing shapes; concatenates into chunks of <= max_rows rows, one
+        call per chunk, splits results back per key. Both the plain frame
+        path and the fused-chain path use THIS loop so stacking policy,
+        the row-split guard, and accounting can never diverge."""
+        idx = 0
+        while idx < len(items):
+            chunk = []
+            rows = 0
+            while idx < len(items):
+                _, a = items[idx]
+                if chunk and rows + a.shape[0] > max_rows:
+                    break
+                chunk.append(items[idx])
+                rows += a.shape[0]
+                idx += 1
+            try:
+                if len(chunk) == 1:
+                    key, arr = chunk[0]
+                    finish(key, np.asarray(call(arr)))
+                    continue
+                stacked = np.concatenate([a for _, a in chunk], axis=0)
+                result = np.asarray(call(stacked))
+                if result.shape[:1] != stacked.shape[:1]:
+                    raise SeldonError(
+                        "device model output rows do not match stacked "
+                        "input rows; cannot split a micro-batch")
+                self.batched_calls += 1
+                self.batched_rows += stacked.shape[0]
+                offset = 0
+                for key, a in chunk:
+                    finish(key, result[offset:offset + a.shape[0]])
+                    offset += a.shape[0]
+            except Exception as e:
+                for key, _ in chunk:
+                    fail(key, e)
+
     def _predict_frames(self, model_id: int, method: int, frames) -> Dict[tuple, bytes]:
         """frames: [((worker_id, req_id), arr)]; one stacked call when shapes
         allow. Keys are (worker, req) pairs throughout: req_ids are
@@ -230,50 +286,19 @@ class ModelExecutor:
         by_shape: Dict[tuple, list] = {}
         for r, a in stackable:
             by_shape.setdefault(a.shape[1:], []).append((r, a))
-        chunked = []
+        def fail(key, e):
+            out[key] = self._err_response(
+                key[1], str(e),
+                getattr(e, "reason", "ENGINE_ERROR"),
+                int(getattr(e, "status_code", 500)))
+
         for shape, group in by_shape.items():
-            chunk: list = []
-            rows = 0
-            for r, a in group:
-                if chunk and rows + a.shape[0] > max_rows:
-                    chunked.append((shape, chunk))
-                    chunk, rows = [], 0
-                chunk.append((r, a))
-                rows += a.shape[0]
-            if chunk:
-                chunked.append((shape, chunk))
-        for shape, group in chunked:
-            try:
-                if len(group) == 1:
-                    key, arr = group[0]
-                    finish(key, np.asarray(call(arr)))
-                else:
-                    stacked = np.concatenate([a for _, a in group], axis=0)
-                    result = np.asarray(call(stacked))
-                    if result.shape[:1] != stacked.shape[:1]:
-                        raise SeldonError(
-                            "device model output rows do not match stacked "
-                            "input rows; cannot split a micro-batch")
-                    self.batched_calls += 1
-                    self.batched_rows += stacked.shape[0]
-                    offset = 0
-                    for key, a in group:
-                        finish(key, result[offset:offset + a.shape[0]])
-                        offset += a.shape[0]
-            except Exception as e:
-                for key, _ in group:
-                    out[key] = self._err_response(
-                        key[1], str(e),
-                        getattr(e, "reason", "ENGINE_ERROR"),
-                        int(getattr(e, "status_code", 500)))
+            self._call_stacked(call, group, max_rows, finish, fail)
         for key, arr in solo:
             try:
                 finish(key, np.asarray(call(arr)))
             except Exception as e:
-                out[key] = self._err_response(
-                    key[1], str(e),
-                    getattr(e, "reason", "ENGINE_ERROR"),
-                    int(getattr(e, "status_code", 500)))
+                fail(key, e)
         return out
 
     def execute(self, frames) -> Dict[int, Dict[int, bytes]]:
@@ -283,17 +308,105 @@ class ModelExecutor:
         responses: Dict[int, Dict[int, bytes]] = {}
         for worker_id, req_id, payload in frames:
             try:
-                model_id, method, arr = self.parse_frame(payload)
+                stages, arr = self.parse_frame(payload)
             except Exception:
                 responses.setdefault(worker_id, {})[req_id] = self._err_response(
                     req_id, "malformed device-model frame", "MICROSERVICE_BAD_DATA", 400)
                 continue
+            if len(stages) > 1:
+                parsed.setdefault(stages, []).append(((worker_id, req_id), arr))
+                continue
+            model_id, method = stages[0]
             parsed.setdefault((model_id, method), []).append(((worker_id, req_id), arr))
-        for (model_id, method), group in parsed.items():
-            for (worker_id, req_id), resp in self._predict_frames(
-                    model_id, method, group).items():
+        for gkey, group in parsed.items():
+            if isinstance(gkey[0], tuple):  # fused chain group
+                results = self._run_chains(gkey, group)
+            else:
+                model_id, method = gkey
+                results = self._predict_frames(model_id, method, group)
+            for (worker_id, req_id), resp in results.items():
                 responses.setdefault(worker_id, {})[req_id] = resp
         return responses
+
+    def _run_chains(self, stages, group) -> Dict[tuple, bytes]:
+        """Fused chains, executed STAGE-WISE across all frames sharing the
+        stage tuple: a dynamic-tags stage (outlier detector) runs solo per
+        frame (per-request score attribution), while a static stage (the
+        model) stacks every frame's rows into one jitted call — the chain
+        costs one ring RTT and the model stage still micro-batches. The
+        response fragment is a JSON array, one fragment per stage."""
+        current: Dict[tuple, np.ndarray] = {key: arr for key, arr in group}
+        frags: Dict[tuple, list] = {key: [] for key, _ in group}
+        out: Dict[tuple, bytes] = {}
+
+        def fail(key, e):
+            out[key] = self._err_response(
+                key[1], str(e), getattr(e, "reason", "ENGINE_ERROR"),
+                int(getattr(e, "status_code", 500)))
+            current.pop(key, None)
+
+        for model_id, method in stages:
+            if not current:
+                break
+            if model_id >= len(self.models):
+                for key in list(current):
+                    fail(key, SeldonError(f"unknown device model {model_id}",
+                                          reason="BAD_GRAPH"))
+                break
+            component = self.models[model_id]
+            if method == METHOD_TRANSFORM_INPUT:
+                def call(a, _c=component):
+                    return _c.transform_input(a, [], meta={})
+            elif method == METHOD_PREDICT:
+                def call(a, _c=component):
+                    return _c.predict(a, [], meta={})
+            else:
+                for key in list(current):
+                    fail(key, SeldonError(f"unknown device method {method}",
+                                          reason="BAD_GRAPH"))
+                break
+
+            def finish_stage(key, result):
+                result = np.asarray(result)
+                if not (np.issubdtype(result.dtype, np.number)
+                        or result.dtype == np.bool_):
+                    fail(key, SeldonError(
+                        "device model returned a non-numeric payload"))
+                    return
+                frags[key].append(self._fragment_for(
+                    model_id, method, component, result).decode() or "{}")
+                current[key] = result
+
+            keys = list(current)
+            if self._frag_static[model_id]:
+                by_shape: Dict[tuple, list] = {}
+                solo = []
+                for k in keys:
+                    a = current[k]
+                    if a.ndim >= 2:
+                        by_shape.setdefault(a.shape[1:], []).append((k, a))
+                    else:
+                        solo.append(k)
+                for shape, items in by_shape.items():
+                    self._call_stacked(call, items, self.max_rows[model_id],
+                                       finish_stage, fail)
+                for k in solo:
+                    try:
+                        finish_stage(k, np.asarray(call(current[k])))
+                    except Exception as e:
+                        fail(k, e)
+            else:
+                # dynamic tags/metrics: solo per frame (per-request scores)
+                for k in keys:
+                    try:
+                        finish_stage(k, call(current[k]))
+                    except Exception as e:
+                        fail(k, e)
+
+        for key, arr in current.items():
+            frag = ("[" + ",".join(frags[key]) + "]").encode()
+            out[key] = self._ok_response(key[1], arr, frag)
+        return out
 
 
 def _error_body(info: str, reason: str, code: int = 500) -> bytes:

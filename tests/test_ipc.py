@@ -207,8 +207,9 @@ def test_model_executor_same_req_id_different_workers():
 
     def frame(model_id, value):
         data = np.array([[value]], dtype="<f8")
-        return (struct.pack("<HBB", model_id, 0, 2) + struct.pack("<2I", 1, 1)
-                + data.tobytes())
+        # header: model, method=predict, n_chain_extra=0, then ndim + dims
+        return (struct.pack("<HBB", model_id, 0, 0) + bytes([2])
+                + struct.pack("<2I", 1, 1) + data.tobytes())
 
     # worker 0 req 7 -> model 0 (x2); worker 1 req 7 -> model 1 (x3)
     responses = ex.execute([(0, 7, frame(0, 10.0)), (1, 7, frame(1, 10.0))])
@@ -225,3 +226,95 @@ def test_model_executor_same_req_id_different_workers():
 
     assert value_of(responses[0][7]) == 20.0
     assert value_of(responses[1][7]) == 30.0
+
+
+def _chain_frame(stages, arr):
+    """Wire frame payload for a fused chain: header stage + extras + tensor."""
+    import struct
+
+    import numpy as np
+
+    (m0, meth0), *extra = stages
+    payload = struct.pack("<HBB", m0, meth0, len(extra))
+    for m, meth in extra:
+        payload += struct.pack("<HB", m, meth)
+    a = np.asarray(arr, dtype="<f8")
+    payload += bytes([a.ndim]) + struct.pack(f"<{a.ndim}I", *a.shape)
+    return payload + a.tobytes()
+
+
+def _parse_ok(resp):
+    import json as _json
+    import struct
+
+    import numpy as np
+
+    from seldon_core_tpu.transport.ipc import _RESP_HEADER
+
+    req_id, status = _RESP_HEADER.unpack_from(resp)
+    assert status == 0, resp
+    dtype_code, ndim = resp[5], resp[6]
+    off = 7
+    dims = struct.unpack_from(f"<{ndim}I", resp, off)
+    off += 4 * ndim
+    (json_len,) = struct.unpack_from("<I", resp, off)
+    off += 4
+    frag = _json.loads(resp[off:off + json_len]) if json_len else None
+    off += json_len
+    n = 1
+    for d in dims:
+        n *= d
+    vals = np.frombuffer(resp, "<f8", count=n, offset=off).reshape(dims)
+    return frag, vals
+
+
+def test_model_executor_fused_chain_pure_python():
+    """Chained frames (transform -> predict) run both stages in one call,
+    return a fragment PER STAGE and only the final tensor — no edge binary
+    involved, so this covers the chain wire format in toolchain-less CI."""
+    import numpy as np
+
+    from seldon_core_tpu.components.component import SeldonComponent
+    from seldon_core_tpu.transport.ipc import ModelExecutor
+
+    class AddOne(SeldonComponent):  # transformer stage with dynamic tags
+        def transform_input(self, X, names, meta=None):
+            return np.asarray(X, np.float64) + 1.0
+
+        def tags(self):
+            return {"stage": "t"}
+
+    class Tripler(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.asarray(X, np.float64) * 3.0
+
+    ex = ModelExecutor([AddOne(), Tripler()])
+    stages = ((0, 1), (1, 0))  # transform_input on model 0, predict on model 1
+    frames = [(0, i, _chain_frame(stages, [[float(i)]])) for i in range(5)]
+    responses = ex.execute(frames)
+    for i in range(5):
+        frag, vals = _parse_ok(responses[0][i])
+        assert vals.tolist() == [[(i + 1) * 3.0]], i
+        assert isinstance(frag, list) and len(frag) == 2
+        assert frag[0]["tags"] == {"stage": "t"}
+    # the static predict stage stacked across the chained frames
+    assert ex.batched_calls >= 1
+
+
+def test_model_executor_chain_mid_stage_error():
+    import numpy as np
+
+    from seldon_core_tpu.components.component import SeldonComponent
+    from seldon_core_tpu.transport.ipc import ModelExecutor, _RESP_HEADER
+
+    class Ok(SeldonComponent):
+        def transform_input(self, X, names, meta=None):
+            return np.asarray(X, np.float64)
+
+    ex = ModelExecutor([Ok()])
+    # second stage names an unknown model
+    frames = [(0, 1, _chain_frame(((0, 1), (9, 0)), [[1.0]]))]
+    resp = ex.execute(frames)[0][1]
+    req_id, status = _RESP_HEADER.unpack_from(resp)
+    assert status == 1
+    assert b"unknown device model" in resp
