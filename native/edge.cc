@@ -2603,34 +2603,32 @@ struct Server {
           first = false;
           body_buf.append(kModelMetrics);
         };
-        // owner-first
-        int owner_src = -2;  // -2 none, -1 builtin, >=0 site
-        if (st.owner == Kind::DeviceModel && st.owner_site >= 0)
-          owner_src = st.owner_site;
-        else if (st.owner == Kind::SimpleModel && ex.model_visits > 0)
-          owner_src = -1;
-        bool builtin_owner_used = false;
-        if (owner_src == -1) {
-          emit_builtin();
-          builtin_owner_used = true;
-        } else if (owner_src >= 0) {
-          emit_site(owner_src);
-        }
-        if (req_metrics)
+        auto emit_request_metrics = [&]() {
+          if (!req_metrics) return;
           for (int i = 0; i < req_metrics->n_children; ++i) {
             if (!first) body_buf.append(", ");
             first = false;
             body_buf.append(doc.item(*req_metrics, i)->raw);
           }
-        bool builtin_skipped_once = false;
-        for (auto& src : st.metric_srcs) {
-          if (src.site == owner_src && src.site >= 0) continue;
-          if (src.site == -1 && builtin_owner_used && !builtin_skipped_once) {
-            builtin_skipped_once = true;  // the owner consumed one visit
-            continue;
+        };
+        // Engine merge order (probed against GraphEngine, fused default):
+        // non-combiner owner -> component metrics in REVERSE traversal
+        // order (flow-final node first, upstream transforms after), request
+        // metrics LAST. Combiner owner -> request metrics FIRST, children
+        // in traversal order (the fused aggregate's order).
+        if (st.owner == Kind::AverageCombiner) {
+          emit_request_metrics();
+          for (auto& src : st.metric_srcs) {
+            if (src.site == -1) emit_builtin();
+            else emit_site(src.site);
           }
-          if (src.site == -1) emit_builtin();
-          else emit_site(src.site);
+        } else {
+          for (auto it2 = st.metric_srcs.rbegin(); it2 != st.metric_srcs.rend();
+               ++it2) {
+            if (it2->site == -1) emit_builtin();
+            else emit_site(it2->site);
+          }
+          emit_request_metrics();
         }
         body_buf.push(']');
       }
@@ -3107,26 +3105,22 @@ struct Server {
         meta.append(e.data(), e.size());
       }
     };
-    int owner_src = -2;
-    if (st.owner == Kind::DeviceModel && st.owner_site >= 0) owner_src = st.owner_site;
-    else if (st.owner == Kind::SimpleModel && ex.model_visits > 0) owner_src = -1;
-    bool builtin_owner_used = false;
-    if (owner_src == -1) {
-      emit_stub_triplet();
-      builtin_owner_used = true;
-    } else if (owner_src >= 0) {
-      emit_site_metrics(owner_src);
-    }
-    for (auto sv : req.req_metrics_raw) meta.append(sv);
-    bool builtin_skipped_once = false;
-    for (auto& src : st.metric_srcs) {
-      if (src.site == owner_src && src.site >= 0) continue;
-      if (src.site == -1 && builtin_owner_used && !builtin_skipped_once) {
-        builtin_skipped_once = true;
-        continue;
+    // same probed engine order as the REST builder: combiner owner ->
+    // request first + traversal order; otherwise reverse traversal then
+    // request last
+    if (st.owner == Kind::AverageCombiner) {
+      for (auto sv : req.req_metrics_raw) meta.append(sv);
+      for (auto& src : st.metric_srcs) {
+        if (src.site == -1) emit_stub_triplet();
+        else emit_site_metrics(src.site);
       }
-      if (src.site == -1) emit_stub_triplet();
-      else emit_site_metrics(src.site);
+    } else {
+      for (auto it2 = st.metric_srcs.rbegin(); it2 != st.metric_srcs.rend();
+           ++it2) {
+        if (it2->site == -1) emit_stub_triplet();
+        else emit_site_metrics(it2->site);
+      }
+      for (auto sv : req.req_metrics_raw) meta.append(sv);
     }
 
     Buf msg;

@@ -516,7 +516,10 @@ def test_grpc_router_over_device_parity_and_feedback(device_edge, ckpt):
 
 def test_grpc_combiner_over_device_parity(device_edge, ckpt):
     port, _, _, _, _, _, grpc_port = device_edge("comb", combiner_spec(ckpt))
-    req = {"data": {"tensor": {"shape": [2, 4],
+    # request metrics included: the combiner-owner proto ordering (request
+    # first, children after) must match the engine
+    req = {"meta": {"metrics": [{"key": "cm", "type": "GAUGE", "value": 5.0}]},
+           "data": {"tensor": {"shape": [2, 4],
                                "values": [0.1, 0.2, 0.3, 0.4,
                                           1.0, 1.0, 1.0, 1.0]}}}
     want = engine_grpc_expected(combiner_spec(ckpt), req)
@@ -585,11 +588,120 @@ def test_outlier_transformer_over_device_model_parity(device_edge, ckpt):
         assert "outlier_score" in got["meta"]["tags"], i
         assert got["meta"]["requestPath"]["od"] == "MahalanobisOutlierDetector"
 
-    # gRPC tensor joins the same state stream
-    req = {"data": {"tensor": {"shape": [1, 4], "values": [9.0, -9.0, 9.0, -9.0]}}}
+    # gRPC tensor joins the same state stream (request metrics included:
+    # ordering through the proto builder must match the engine)
+    req = {"meta": {"metrics": [{"key": "cm", "type": "GAUGE", "value": 7.0}]},
+           "data": {"tensor": {"shape": [1, 4], "values": [9.0, -9.0, 9.0, -9.0]}}}
     expected = engine.predict_sync(
         SeldonMessage.from_dict(json.loads(json.dumps(req))))
     want = pc.message_from_proto(pc.message_to_proto(expected)).to_dict()
     got = grpc_predict(grpc_port, req).to_dict()
     assert strip_puid(got) == strip_puid(want)
     assert "outlier_score" in got["meta"]["tags"]
+
+
+# ---------------------------------------------------------------------------
+# Randomized parity fuzz over device graphs (deterministic routing configs)
+# ---------------------------------------------------------------------------
+
+def fuzz_specs(ckpt):
+    """Graph shapes covering the device planes: chain fusion, combiner
+    fan-in, deterministic bandit over mixed leaves. Routing is pinned
+    (epsilon=0) so edge and engine take identical paths."""
+    return {
+        "fz_chain": {  # transform -> model fused chain
+            "name": "p",
+            "graph": {"name": "od", "type": "TRANSFORMER",
+                      "implementation": "MAHALANOBIS_OD",
+                      "parameters": [{"name": "threshold", "value": "1.0",
+                                      "type": "FLOAT"}],
+                      "children": [jax_unit("m", ckpt)]},
+        },
+        "fz_comb": {  # combiner over device + stub
+            "name": "p",
+            "graph": {"name": "c", "type": "COMBINER",
+                      "implementation": "AVERAGE_COMBINER",
+                      "children": [jax_unit("m", ckpt),
+                                   {"name": "s", "type": "MODEL",
+                                    "implementation": "SIMPLE_MODEL"}]},
+        },
+        "fz_bandit": {  # exploit-only bandit over stub + device
+            "name": "p",
+            "graph": {"name": "eg", "type": "ROUTER",
+                      "implementation": "EPSILON_GREEDY",
+                      "parameters": [
+                          {"name": "n_branches", "value": "2", "type": "INT"},
+                          {"name": "epsilon", "value": "0.0", "type": "FLOAT"},
+                          {"name": "best_branch", "value": "1", "type": "INT"}],
+                      "children": [
+                          {"name": "s", "type": "MODEL",
+                           "implementation": "SIMPLE_MODEL"},
+                          jax_unit("m", ckpt)]},
+        },
+    }
+
+
+@pytest.mark.parametrize("key", ["fz_chain", "fz_comb", "fz_bandit"])
+def test_randomized_device_graph_parity_fuzz(device_edge, ckpt, key):
+    """25 random requests + interleaved feedback per graph: the edge's
+    answer must equal a fresh engine fed the identical sequence. Covers
+    values parsing, chain fusion, combiner math, tags/metrics merge, meta
+    echo, and bandit feedback accounting under arbitrary payloads."""
+    import zlib
+
+    spec = fuzz_specs(ckpt)[key]
+    port, _, _, _, _, _, _ = device_edge(key, spec)
+    engine = GraphEngine(PredictorSpec.from_dict(spec))
+    # crc32, not hash(): str hashes are salted per process, which would make
+    # a failing fuzz case unreproducible
+    rng = np.random.default_rng(zlib.crc32(key.encode()))
+
+    for step in range(25):
+        kind = rng.integers(0, 4)
+        if kind == 3 and key == "fz_bandit":
+            # feedback on a random valid branch
+            fb = {"response": {"meta": {"routing": {"eg": int(rng.integers(0, 2))}}},
+                  "reward": round(float(rng.uniform(0, 1)), 3)}
+            status, body = post(port, "/api/v0.1/feedback", fb)
+            assert status == 200, (step, body)
+            asyncio.run(engine.send_feedback(
+                Feedback.from_dict(json.loads(json.dumps(fb)))))
+            continue
+        rows = int(rng.integers(1, 4))
+        vals = rng.standard_normal((rows, 4)).round(3)
+        if kind == 1:
+            req = {"data": {"tensor": {"shape": [rows, 4],
+                                       "values": vals.ravel().tolist()}}}
+        elif kind == 2:
+            req = {"meta": {"puid": f"fz{step}",
+                            "tags": {"step": step},
+                            "metrics": [{"key": "cm", "type": "GAUGE",
+                                         "value": float(step)}]},
+                   "data": {"ndarray": vals.tolist()}}
+        else:
+            req = {"data": {"ndarray": vals.tolist()}}
+        expected = engine.predict_sync(
+            SeldonMessage.from_dict(json.loads(json.dumps(req))))
+        status, got = post(port, "/api/v0.1/predictions", req)
+        assert status == 200, (step, got)
+        # values compare with f32-ULP tolerance: the engine's whole-graph
+        # fusion runs the model at the raw batch while the executor pads to
+        # its bucket — legitimate XLA tiling differences in the last bits.
+        # Everything else (meta, names, structure) must be EXACT.
+        g, w = strip_puid(got), strip_puid(expected.to_dict())
+        def split_vals(d):
+            data = d.get("data", {})
+            if "ndarray" in data:
+                return np.asarray(data.pop("ndarray"), np.float64)
+            if "tensor" in data:
+                t = data.pop("tensor")
+                return np.asarray(t["values"], np.float64), t["shape"]
+            return None
+        gv, wv = split_vals(g), split_vals(w)
+        assert g == w, (key, step, req)
+        if isinstance(gv, tuple):
+            assert gv[1] == wv[1], (key, step)
+            gv, wv = gv[0], wv[0]
+        if gv is not None:
+            np.testing.assert_allclose(gv, wv, rtol=1e-5, atol=1e-7,
+                                       err_msg=str((key, step)))
