@@ -321,7 +321,25 @@ DEVICE_SPEC_TEMPLATE = {
 }
 
 
-def bench_device(duration: float, workers: int = 1) -> dict:
+def outlier_device_spec(ckpt_dir: str) -> dict:
+    """TRANSFORMER (Mahalanobis, dynamic per-request tags) over the MLP —
+    compiles to DEVICE_TRANSFORM -> DEVICE_MODEL, one fused chain frame per
+    request over the ring."""
+    return {
+        "name": "p",
+        "graph": {
+            "name": "od", "type": "TRANSFORMER",
+            "implementation": "MAHALANOBIS_OD",
+            "parameters": [{"name": "threshold", "value": "2.0", "type": "FLOAT"}],
+            "children": [{"name": "m", "type": "MODEL",
+                          "implementation": "JAX_SERVER", "modelUri": ckpt_dir}],
+        },
+    }
+
+
+def bench_device(duration: float, workers: int = 1, spec_builder=None,
+                 label: str = "device-mlp", metric: str | None = None,
+                 grpc_conns=(32, 64, 96, 128), rest_conns=(16, 64, 256)) -> dict:
     # workers=1: on this one-core harness extra edge processes only add
     # context-switch churn (measured 18.5k rps at 1 worker vs 14.2k at 4)
     """VERDICT r2 item 2's second half: a graph with a REAL JAX model served
@@ -330,7 +348,8 @@ def bench_device(duration: float, workers: int = 1) -> dict:
     micro-batches concurrent requests into one jitted call. The engine
     process is CPU-forced so the number is tunnel-independent (the
     architecture is identical on real TPU; device dispatch replaces the CPU
-    jit call)."""
+    jit call). ``spec_builder(ckpt_dir)`` swaps in a different device graph
+    over the same exported MLP (e.g. the outlier DEVICE_TRANSFORM chain)."""
     import tempfile
 
     ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
@@ -348,8 +367,11 @@ def bench_device(duration: float, workers: int = 1) -> dict:
     ).format(repo=REPO, ckpt=ckpt_dir)
     subprocess.run([sys.executable, "-c", gen], check=True, capture_output=True)
 
-    spec = json.loads(json.dumps(DEVICE_SPEC_TEMPLATE))
-    spec["graph"]["modelUri"] = ckpt_dir
+    if spec_builder is None:
+        spec = json.loads(json.dumps(DEVICE_SPEC_TEMPLATE))
+        spec["graph"]["modelUri"] = ckpt_dir
+    else:
+        spec = spec_builder(ckpt_dir)
     spec_path = os.path.join("/tmp", f"device_spec_{os.getpid()}.json")
     with open(spec_path, "w") as f:
         json.dump(spec, f)
@@ -379,11 +401,11 @@ def bench_device(duration: float, workers: int = 1) -> dict:
             with open(stderr_log) as f:
                 tail = f.read()[-2000:]
             raise RuntimeError(f"{e}; wrapper stderr: {tail}") from e
-        runs = [run_loadgen(port, c, duration, f"device-mlp-{c}c")
-                for c in (16, 64, 256)]
+        runs = [run_loadgen(port, c, duration, f"{label}-{c}c")
+                for c in rest_conns]
         grpc_runs = [run_loadgen(grpc_port, c, duration,
-                                 f"device-mlp-grpc-{c}c", grpc=True)
-                     for c in (16, 64, 128)]
+                                 f"{label}-grpc-{c}c", grpc=True)
+                     for c in grpc_conns]
     finally:
         import signal
 
@@ -409,9 +431,10 @@ def bench_device(duration: float, workers: int = 1) -> dict:
     best = max(runs, key=lambda r: r["throughput_rps"])
     best_grpc = max(grpc_runs, key=lambda r: r["throughput_rps"])
     return {
-        "metric": "single-JAX-model graph throughput (native edge "
-                  "DEVICE_MODEL -> packed-tensor ring -> ModelExecutor "
-                  "micro-batched jit; MLP 4->128->128->3)",
+        "metric": metric or (
+            "single-JAX-model graph throughput (native edge "
+            "DEVICE_MODEL -> packed-tensor ring -> ModelExecutor "
+            "micro-batched jit; MLP 4->128->128->3)"),
         "best": best,
         "runs": runs,
         "grpc_best": best_grpc,
@@ -432,7 +455,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=30.0)
     ap.add_argument("--mode", default="native",
-                    choices=["native", "ring", "bandit", "device", "all"])
+                    choices=["native", "ring", "bandit", "device", "outlier", "all"])
     args = ap.parse_args()
     if not build_edge_binaries():
         raise SystemExit("native toolchain unavailable")
@@ -466,7 +489,21 @@ def main() -> None:
         with open(os.path.join(outdir, "report_device_model.json"), "w") as f:
             json.dump(device, f, indent=2)
         print(json.dumps({"device_rps": device["best"]["throughput_rps"],
-                          "vs_baseline": device["vs_baseline"]}))
+                          "vs_baseline": device["vs_baseline"],
+                          "grpc_rps": device["grpc_best"]["throughput_rps"],
+                          "grpc_vs_baseline": device["grpc_vs_baseline"]}))
+    if args.mode in ("outlier", "all"):
+        outlier = bench_device(
+            args.duration, spec_builder=outlier_device_spec,
+            label="outlier-device",
+            metric="outlier-detector graph throughput (DEVICE_TRANSFORM "
+                   "Mahalanobis -> DEVICE_MODEL MLP fused chain over the "
+                   "ring; detector STACKS concurrent requests with per-row "
+                   "tag attribution — row_slice protocol)")
+        with open(os.path.join(outdir, "report_outlier_device.json"), "w") as f:
+            json.dump(outlier, f, indent=2)
+        print(json.dumps({"outlier_rps": outlier["best"]["throughput_rps"],
+                          "vs_baseline": outlier["vs_baseline"]}))
 
 
 if __name__ == "__main__":

@@ -1619,6 +1619,8 @@ struct Conn {
   bool want_close = false;
   bool waiting_ring = false;  // response will come from the ring
   bool is_h2 = false;
+  bool flush_pending = false;  // queued for one coalesced flush at the end
+                               // of the current ring-drain pass
   std::unique_ptr<H2State> h2;
 };
 
@@ -1657,6 +1659,8 @@ struct Server {
   std::unordered_map<uint32_t, GrpcPending> pending_grpc;
   uint16_t ring_worker_id = 0;
   std::vector<char> ring_buf;  // reused drain buffer (slot-sized)
+  bool defer_flush = false;    // drain pass active: flush_out queues instead
+  std::vector<int> flush_queue;
   static constexpr uint64_t kRingTimeoutNs = 30ull * 1000000000ull;
 
   std::vector<Conn> conns;
@@ -3195,8 +3199,28 @@ struct Server {
     timer_armed = false;
   }
 
+  void run_deferred_flushes() {
+    defer_flush = false;
+    for (int fd : flush_queue) {
+      Conn& c = conn(fd);
+      if (c.fd == fd && c.flush_pending) {
+        c.flush_pending = false;
+        flush_out(c);
+      }
+    }
+    flush_queue.clear();
+  }
+
+  // Re-enters false + flushes on every exit path of drain_ring_responses.
+  struct FlushGuard {
+    Server* s;
+    explicit FlushGuard(Server* srv) : s(srv) { s->defer_flush = true; }
+    ~FlushGuard() { s->run_deferred_flushes(); }
+  };
+
   void drain_ring_responses() {
     if (!resp_ring) return;
+    FlushGuard guard{this};
     if (ring_buf.size() < ring_slot) ring_buf.resize(ring_slot);
     for (;;) {
       int len = scr_pop(resp_ring, ring_buf.data(), ring_slot);
@@ -4196,6 +4220,17 @@ struct Server {
 
   // ---- connection I/O ----
   void flush_out(Conn& c) {
+    if (defer_flush) {
+      // ring-drain pass in progress: queue one coalesced flush per
+      // connection instead of one send() per response — with 8 gRPC
+      // streams per connection a single drain batch would otherwise issue
+      // up to 8 syscalls where one suffices
+      if (!c.flush_pending) {
+        c.flush_pending = true;
+        flush_queue.push_back(c.fd);
+      }
+      return;
+    }
     while (c.out_off < c.outbuf.size()) {
       ssize_t n = ::send(c.fd, c.outbuf.data() + c.out_off,
                          c.outbuf.size() - c.out_off, MSG_NOSIGNAL);
@@ -4220,6 +4255,16 @@ struct Server {
 
   void close_conn(Conn& c) {
     if (c.fd < 0) return;
+    if (defer_flush && c.flush_pending && c.out_off < c.outbuf.size()) {
+      // a completed response is parked for the end-of-drain flush; send it
+      // best-effort before closing (pre-deferral behaviour: responses were
+      // flushed synchronously ahead of whatever closes the connection)
+      defer_flush = false;
+      c.flush_pending = false;
+      flush_out(c);  // may itself close on send error
+      defer_flush = true;
+      if (c.fd < 0) return;
+    }
     epoll_ctl(epfd, EPOLL_CTL_DEL, c.fd, nullptr);
     ::close(c.fd);
     c.fd = -1;
@@ -4230,6 +4275,9 @@ struct Server {
     c.want_close = false;
     c.waiting_ring = false;
     c.is_h2 = false;
+    c.flush_pending = false;  // never leak the queued-flush mark to a
+                              // reused fd (a stale true would swallow the
+                              // new connection's first deferred flush)
     c.h2.reset();
   }
 

@@ -73,6 +73,30 @@ class _OutlierTransformer(SeldonComponent):
             create_gauge("n_outliers", float(np.sum(self._last_scores > self.threshold))),
         ]
 
+    def row_slice(self, lo: int, hi: int):
+        """(tags, metrics) attributed to rows [lo, hi) of the LAST scored
+        batch. This is the contract that lets the serving executor stack k
+        concurrent requests into ONE score() call and still hand each
+        request its own rows' scores — scoring is row-independent given the
+        running state, and the state update is batch-wise (matching the
+        reference detector, which also scores per arriving batch:
+        components/outlier-detection/mahalanobis/CoreMahalanobis.py:42-80).
+        For a solo request (lo=0, hi=n) this equals tags()/metrics()."""
+        with self._lock:
+            if self._last_scores is None or hi > len(self._last_scores):
+                return {}, []
+            s = np.array(self._last_scores[lo:hi])
+        flags = s > self.threshold
+        tags = {
+            "outlier_score": [float(x) for x in s],
+            "is_outlier": [int(f) for f in flags],
+        }
+        mets = [
+            create_gauge("outlier_score_max", float(np.max(s))),
+            create_gauge("n_outliers", float(np.sum(flags))),
+        ]
+        return tags, mets
+
 
 class MahalanobisOutlierDetector(_OutlierTransformer):
     """Online Mahalanobis distance (`mahalanobis/CoreMahalanobis.py:191`):
@@ -96,6 +120,12 @@ class MahalanobisOutlierDetector(_OutlierTransformer):
         self._state: Optional[Tuple[Any, Any, Any]] = None  # (mean, cov, n)
         self._step = None
 
+    # Serving pads batches up to these row counts so the jitted step sees a
+    # handful of static shapes instead of one compile per distinct batch
+    # size (the executor's request stacking produces arbitrary row totals;
+    # an unseen total used to cost a ~0.4 s XLA compile mid-traffic).
+    _ROW_BUCKETS = (1, 16, 256)
+
     def _build(self, d: int):
         import jax
         import jax.numpy as jnp
@@ -103,21 +133,27 @@ class MahalanobisOutlierDetector(_OutlierTransformer):
         reg_eps = self.reg_eps
         n_clip = float(self.n_clip)
 
-        def step(state, X):
+        def step(state, X, n_valid):
+            # X is zero-padded to a row bucket; n_valid rows are real. The
+            # masked moments make padding exactly a no-op for the running
+            # statistics; padded rows' scores are garbage and sliced off by
+            # the caller.
             mean, cov, n = state
+            mask = (jnp.arange(X.shape[0]) < n_valid).astype(X.dtype)
             Xc = X - mean
             prec = jnp.linalg.inv(cov + reg_eps * jnp.eye(d))
             scores = jnp.sqrt(jnp.maximum(jnp.einsum("bi,ij,bj->b", Xc, prec, Xc), 0.0))
 
             # fold the batch into the running statistics (clipped n so the
             # estimator keeps adapting)
-            b = X.shape[0]
-            batch_mean = jnp.mean(X, axis=0)
+            b = n_valid.astype(X.dtype)
+            bs = jnp.maximum(b, 1.0)
+            batch_mean = jnp.sum(X * mask[:, None], axis=0) / bs
             delta = batch_mean - mean
             n_new = n + b
             new_mean = mean + delta * (b / n_new)
-            Xb = X - batch_mean
-            batch_cov = (Xb.T @ Xb) / jnp.maximum(b, 1)
+            Xb = (X - batch_mean) * mask[:, None]
+            batch_cov = (Xb.T @ Xb) / bs
             w_old = n / n_new
             w_b = b / n_new
             new_cov = w_old * cov + w_b * batch_cov + w_old * w_b * jnp.outer(delta, delta)
@@ -133,7 +169,14 @@ class MahalanobisOutlierDetector(_OutlierTransformer):
         if self.n_components and X.shape[1] > self.n_components:
             # cheap spectral projection instead of the reference's sklearn PCA
             X = X[:, : self.n_components]
-        d = X.shape[1]
+        rows, d = X.shape
+        padded = next((b for b in self._ROW_BUCKETS if b >= rows), None)
+        if padded is None:  # beyond the top bucket: round up to its multiple
+            top = self._ROW_BUCKETS[-1]
+            padded = -(-rows // top) * top
+        if padded != rows:
+            X = np.concatenate(
+                [X, np.zeros((padded - rows, d), X.dtype)], axis=0)
         with self._lock:
             if self._state is None:
                 self._state = (
@@ -142,8 +185,16 @@ class MahalanobisOutlierDetector(_OutlierTransformer):
                     jnp.asarray(0.0, jnp.float32),
                 )
                 self._step = self._build(d)
-            scores, self._state = self._step(self._state, jnp.asarray(X, dtype=jnp.float32))
-        return np.asarray(scores)
+                # compile every row bucket NOW (readiness-time, before
+                # traffic): a bucket first seen under load would stall the
+                # serving loop behind its XLA compile
+                zero_n = jnp.asarray(0.0, jnp.float32)
+                for b in self._ROW_BUCKETS:
+                    self._step(self._state, jnp.zeros((b, d), jnp.float32), zero_n)
+            scores, self._state = self._step(
+                self._state, jnp.asarray(X, dtype=jnp.float32),
+                jnp.asarray(rows, jnp.float32))
+        return np.asarray(scores)[:rows]
 
     # jax buffers don't pickle portably; persist as numpy.
     def __getstate__(self):
@@ -347,6 +398,13 @@ class Seq2SeqOutlierDetector(_OutlierTransformer):
     ``timesteps`` windows (tail padded by repetition) and each row inherits
     its window's score, so tags()/metrics() keep their per-row shape.
     """
+
+    # NOT row-independent: 2-D scoring frames rows into timesteps windows,
+    # so stacking concurrent requests would slide window boundaries across
+    # request edges (request B's rows scored inside request A's window).
+    # Opting out of the row_slice protocol keeps this detector solo per
+    # request in the serving executor.
+    row_slice = None
 
     def __init__(
         self,

@@ -11,6 +11,7 @@ from typing import Any, Callable, Sequence, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from seldon_core_tpu.models.registry import register_model
 
@@ -49,6 +50,22 @@ class BottleneckBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth(x, block: int = 2):
+    """(B, H, W, C) -> (B, H/b, W/b, b*b*C), channel order (di, dj, c).
+
+    Pure data-layout transform; the classic TPU ResNet stem trick (the
+    MLPerf-era space-to-depth input pipeline): the 7x7/s2 stem conv over a
+    3-channel image packs the MXU at 3/128 input channels, while the same
+    arithmetic expressed as a 4x4/s1 conv over the 2x2-packed 12-channel
+    image packs it 4x denser — see fold_space_to_depth for the exact weight
+    refold. Runs fine on host (numpy) or device (jnp)."""
+    b, h, w, c = x.shape
+    xp = np if isinstance(x, np.ndarray) else jnp
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = xp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(b, h // block, w // block, block * block * c)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     num_classes: int = 1000
@@ -59,11 +76,20 @@ class ResNet(nn.Module):
     # BN stats read + f32 affine chain from the serving graph (HBM traffic),
     # leaving pure conv+bias+relu for XLA to fuse.
     fused: bool = False
+    # Inference-only space-to-depth stem (requires fused=True): the input is
+    # 2x2-packed to (B, H/2, W/2, 12) and the 7x7/s2 stem conv becomes a
+    # bit-equivalent 4x4/s1 conv named conv_init_s2d — params come from
+    # fold_space_to_depth(fold_batchnorm(vars)). The packing itself happens
+    # inside __call__ (device-side) unless the caller stages pre-packed
+    # (B, H/2, W/2, 12) input, which is detected by channel count.
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if self.fused and train:
             raise ValueError("fused=True is inference-only (BN is folded away)")
+        if self.stem_s2d and not self.fused:
+            raise ValueError("stem_s2d=True requires fused=True (inference-only)")
         conv = partial(nn.Conv, use_bias=self.fused, dtype=self.dtype)
         if self.fused:
             norm = lambda **kw: _NoNorm()  # noqa: E731 (name kwarg dropped)
@@ -76,7 +102,20 @@ class ResNet(nn.Module):
                 dtype=self.dtype,
             )
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.stem_s2d:
+            if x.shape[-1] == 3:
+                x = space_to_depth(x)
+            # offsets: s2d row u holds original rows {2u, 2u+1}; output i of
+            # the 7x7/s2 conv needs original rows 2i-3..2i+3, i.e. s2d rows
+            # i-2..i+1 -> kernel 4, stride 1, padding (2, 1).
+            x = conv(
+                self.num_filters, (4, 4), (1, 1), padding=[(2, 1), (2, 1)],
+                name="conv_init_s2d",
+            )(x)
+        else:
+            x = conv(
+                self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init"
+            )(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
@@ -140,21 +179,51 @@ def fold_batchnorm(variables):
     return {"params": out}
 
 
+def fold_space_to_depth(variables):
+    """Refold a folded-BN conv_init (7,7,3,F) kernel into the equivalent
+    conv_init_s2d (4,4,12,F) kernel for the ``stem_s2d=True`` module.
+
+    Derivation: output i of the 7x7/s2 conv reads original rows 2i-3..2i+3.
+    With 2x2 space-to-depth, s2d row u = i-2+a (a=0..3) carries original
+    rows 2u+di (di=0,1), i.e. original offset index p' = 2a+di over the
+    8-row window starting at 2i-4. Pad the kernel's 7 taps to 8 with a zero
+    at the FRONT (offset -4 is never read by the original conv), then
+    K[a, b, (di, dj, c), f] = Wpad[2a+di, 2b+dj, c, f] — exactly a reshape
+    (8,8,3,F)->(4,2,4,2,3,F) + transpose to (4,4,2,2,3,F) + channel merge,
+    matching space_to_depth's (di, dj, c) packing order. Zero extra FLOPs
+    beyond the 4 dead taps; numerics identical up to summation order."""
+    params = {k: v for k, v in variables["params"].items()}
+    conv = params.pop("conv_init")
+    w = conv["kernel"]  # (7, 7, C, F)
+    kh, kw, c, f = w.shape
+    if (kh, kw) != (7, 7):
+        raise ValueError(f"fold_space_to_depth expects a 7x7 stem, got {(kh, kw)}")
+    xp = np if isinstance(w, np.ndarray) else jnp
+    wpad = xp.pad(w, ((1, 0), (1, 0), (0, 0), (0, 0)))  # zero tap at offset -4
+    k = wpad.reshape(4, 2, 4, 2, c, f)  # (a, di, b, dj, c, f)
+    k = xp.transpose(k, (0, 2, 1, 3, 4, 5)).reshape(4, 4, 4 * c, f)
+    params["conv_init_s2d"] = {"kernel": k, "bias": conv["bias"]}
+    return {"params": params}
+
+
 @register_model("resnet50")
-def make_resnet50(num_classes: int = 1000, dtype: str = "bfloat16", fused: bool = False):
+def make_resnet50(num_classes: int = 1000, dtype: str = "bfloat16", fused: bool = False,
+                  stem_s2d: bool = False):
     return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
-                  dtype=jnp.dtype(dtype), fused=fused)
+                  dtype=jnp.dtype(dtype), fused=fused, stem_s2d=stem_s2d)
 
 
 @register_model("resnet18")
-def make_resnet18(num_classes: int = 1000, dtype: str = "bfloat16", fused: bool = False):
+def make_resnet18(num_classes: int = 1000, dtype: str = "bfloat16", fused: bool = False,
+                  stem_s2d: bool = False):
     # 18-layer variant uses the same bottleneck stack shrunk to (2,2,2,2);
     # kept bottleneck (not basic-block) for MXU-friendly 1x1 convs.
     return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes,
-                  dtype=jnp.dtype(dtype), fused=fused)
+                  dtype=jnp.dtype(dtype), fused=fused, stem_s2d=stem_s2d)
 
 
 @register_model("resnet101")
-def make_resnet101(num_classes: int = 1000, dtype: str = "bfloat16", fused: bool = False):
+def make_resnet101(num_classes: int = 1000, dtype: str = "bfloat16", fused: bool = False,
+                   stem_s2d: bool = False):
     return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes,
-                  dtype=jnp.dtype(dtype), fused=fused)
+                  dtype=jnp.dtype(dtype), fused=fused, stem_s2d=stem_s2d)

@@ -12,10 +12,13 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+import struct
 import subprocess
 import threading
 import time
 from typing import Optional
+
+_U32 = struct.Struct("<I")
 
 logger = logging.getLogger(__name__)
 
@@ -58,6 +61,26 @@ def _load():
         lib.scr_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
         lib.scr_pop.restype = ctypes.c_int
         lib.scr_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32]
+        lib.scr_pop_many.restype = ctypes.c_int
+        lib.scr_pop_many.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.scr_push_model_resps.restype = ctypes.c_int
+        lib.scr_push_model_resps.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,  # req_ids u32*
+            ctypes.c_void_p,  # row_offsets u64*
+            ctypes.c_void_p,  # row_counts u32*
+            ctypes.c_uint32,  # n
+            ctypes.c_void_p,  # data f8*
+            ctypes.c_uint64,  # row_nvals
+            ctypes.c_void_p,  # tail_dims u32*
+            ctypes.c_uint32,  # n_tail
+            ctypes.c_char_p,  # frag
+            ctypes.c_uint32,  # frag_len
+            ctypes.c_uint32,  # dtype_code
+        ]
         _lib = lib
         return lib
 
@@ -99,6 +122,7 @@ class SharedRing:
         self.capacity = int(self._lib.scr_capacity(self._h))
         self.slot_size = int(self._lib.scr_slot_size(self._h))
         self._popbuf = ctypes.create_string_buffer(self.slot_size)
+        self._manybuf = None  # lazy (pop_many only; engine-side)
 
     # ------------------------------------------------------------------
     def push(self, payload: bytes) -> bool:
@@ -142,6 +166,87 @@ class SharedRing:
                 continue
             out.append(item)
         return out
+
+    def pop_many(self, max_items: int, wait_s: float = 0.0, spin_s: float = 0.0002):
+        """Batched drain: ONE FFI call pops up to max_items frames into the
+        reusable pop buffer and returns zero-copy memoryview slices into it.
+
+        The views are valid only until the next pop/pop_many on this ring —
+        callers must finish with (or copy) each frame within the drain
+        cycle. Falls back timing-wise like pop_batch: waits up to wait_s for
+        the first frame."""
+        if self._manybuf is None:
+            # slot_size + 4 guarantees the largest possible frame always
+            # fits (progress), the extra room batches typical small frames
+            self._manybuf = ctypes.create_string_buffer(self.slot_size + 4 + (256 << 10))
+        used = ctypes.c_uint32(0)
+        deadline = time.monotonic() + wait_s
+        while True:
+            n = self._lib.scr_pop_many(
+                self._h, self._manybuf, len(self._manybuf), max_items,
+                ctypes.byref(used))
+            if n > 0:
+                break
+            if time.monotonic() > deadline:
+                return []
+            time.sleep(spin_s)
+        # ctypes buffers expose format 'c' memoryviews, whose item access
+        # returns 1-byte bytes (and struct/int indexing raises); cast to 'B'.
+        # Read-only: np.frombuffer over these views must yield read-only
+        # arrays so an in-place-mutating component fails fast (as it did
+        # with pop_batch's bytes) instead of scribbling over the shared
+        # drain buffer under other frames.
+        mv = memoryview(self._manybuf).cast("B").toreadonly()
+        out = []
+        off = 0
+        for _ in range(n):
+            (length,) = _U32.unpack_from(mv, off)
+            out.append(mv[off + 4:off + 4 + length])
+            off += 4 + length
+        return out
+
+    def push_model_resps(self, req_ids, row_offsets, row_counts, data,
+                         row_nvals: int, tail_dims, frag: bytes,
+                         dtype_code: int, timeout_s: float = 5.0,
+                         spin_s: float = 0.0002) -> None:
+        """Bulk kind-2 OK response push: the C side builds each response
+        frame directly in its ring slot (ModelExecutor._ok_response layout)
+        from one stacked f8 row buffer. Retries the unpushed tail when the
+        ring is momentarily full; raises RingFull past timeout_s and
+        PayloadTooLarge when a response exceeds the slot."""
+        import numpy as np
+
+        req_ids = np.ascontiguousarray(req_ids, dtype=np.uint32)
+        row_offsets = np.ascontiguousarray(row_offsets, dtype=np.uint64)
+        row_counts = np.ascontiguousarray(row_counts, dtype=np.uint32)
+        tail = np.ascontiguousarray(tail_dims, dtype=np.uint32)
+        if data.dtype != np.float64 or not data.flags.c_contiguous:
+            raise ValueError("push_model_resps needs C-contiguous float64 rows")
+        # pre-check EVERY response against the slot size so the C call can
+        # never commit a partial batch and then fail (-2 after i pushes
+        # would leave pushed frames to be answered AGAIN by the fallback)
+        head = 7 + 4 * (1 + len(tail)) + 4 + len(frag)
+        if int(row_counts.max(initial=0)) * row_nvals * 8 + head > self.slot_size:
+            raise PayloadTooLarge(
+                f"model response exceeds slot_size {self.slot_size}")
+        deadline = time.monotonic() + timeout_s
+        start = 0
+        n = len(req_ids)
+        while start < n:
+            rc = self._lib.scr_push_model_resps(
+                self._h,
+                req_ids[start:].ctypes.data, row_offsets[start:].ctypes.data,
+                row_counts[start:].ctypes.data, n - start,
+                data.ctypes.data, row_nvals,
+                tail.ctypes.data, len(tail), frag, len(frag), dtype_code)
+            if rc == -2:
+                raise PayloadTooLarge(
+                    f"model response exceeds slot_size {self.slot_size}")
+            start += rc
+            if start < n:
+                if time.monotonic() > deadline:
+                    raise RingFull(f"ring {self.path} full for {timeout_s}s")
+                time.sleep(spin_s)
 
     def __len__(self) -> int:
         return int(self._lib.scr_size(self._h))

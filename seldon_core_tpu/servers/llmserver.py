@@ -116,6 +116,11 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
     return ((n + top - 1) // top) * top
 
 
+# f32 init trees above this stream leaf-by-leaf through the quantizer
+# instead of materializing whole (27 GB at 7B vs 16 GB single-chip HBM).
+STREAM_INIT_THRESHOLD_BYTES = 2 << 30
+
+
 class LLMServer(SeldonComponent):
     """Serves a registered transformer-family model for text generation.
 
@@ -231,7 +236,19 @@ class LLMServer(SeldonComponent):
         self._module = get_model(name, **cfg_kwargs)
         self._cfg = self._module.cfg
 
-        if params is None:
+        # Big-config random init (e.g. Llama-2-7B dims for capacity/perf
+        # work): whole-tree f32 init is 4 bytes/param — 27 GB at 7B, over
+        # single-chip HBM — so when the int8 serving path is requested and
+        # the f32 tree would exceed 2 GiB, initialize leaf-by-leaf on
+        # device, quantizing each leaf as it is made. Peak residency is the
+        # final int8 tree plus one f32 leaf.
+        streamed = (
+            params is None
+            and self.init_random
+            and self.quantize == "int8"
+            and self._init_nbytes_f32() > STREAM_INIT_THRESHOLD_BYTES
+        )
+        if params is None and not streamed:
             if not self.init_random:
                 raise SeldonError(
                     "No checkpoint: pass model_uri or init_random=True", status_code=500
@@ -240,7 +257,8 @@ class LLMServer(SeldonComponent):
                 jax.random.PRNGKey(self.seed), jnp.zeros((1, 8), jnp.int32)
             )
 
-        params = _cast_params(params, self.param_dtype, self._cfg.dtype)
+        if not streamed:
+            params = _cast_params(params, self.param_dtype, self._cfg.dtype)
 
         if self.mesh is None and (self.tensor_parallel > 1 or self.sequence_parallel > 1):
             from seldon_core_tpu.parallel.mesh import make_mesh
@@ -265,7 +283,7 @@ class LLMServer(SeldonComponent):
                 raise SeldonError(f"unsupported quantize={self.quantize!r} (int8 only)", status_code=500)
             from seldon_core_tpu.ops.quantize import dequantize_params, quantize_params
 
-            params = quantize_params(params)
+            params = self._streamed_quantized_init() if streamed else quantize_params(params)
             self._dequant = dequantize_params
 
         if self.mesh is not None:
@@ -282,6 +300,70 @@ class LLMServer(SeldonComponent):
         self.eos_id = self._eos_override if self._eos_override is not None else self._tokenizer.eos_id
         self.ready = True
         logger.info("LLMServer loaded %s (vocab=%d)", name, self._cfg.vocab_size)
+
+    def _init_shapes(self):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.eval_shape(
+            self._module.init, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )
+
+    def _init_nbytes_f32(self) -> int:
+        import jax
+
+        return sum(leaf.size * 4 for leaf in jax.tree.leaves(self._init_shapes()))
+
+    def _streamed_quantized_init(self):
+        """Leaf-by-leaf on-device random init + int8 quantize.
+
+        Semantics match the whole-tree path in kind (≥2-D float leaves
+        become QuantizedTensor, 1-D leaves stay float) but not in exact
+        values: leaves draw from per-leaf keys (seed folded with the leaf
+        path) with variance-scaled normals (std = 1/sqrt(fan_in)) for ≥2-D
+        leaves, ones for 1-D scale/weight (norm) leaves, zeros otherwise.
+        jit caches by (shape, std), so the 32 identical layers of a 7B
+        config cost ~a dozen compiles, not ~200."""
+        import zlib
+        from functools import partial as _partial
+
+        import jax
+        import jax.numpy as jnp
+        from jax.tree_util import keystr, tree_flatten_with_path
+
+        from seldon_core_tpu.ops.quantize import _register_pytree, quantize_array
+
+        _register_pytree()  # jit returns QuantizedTensor leaves
+        target = jnp.dtype(self._cfg.dtype) if self.param_dtype == "auto" else (
+            jnp.dtype(self.param_dtype) if self.param_dtype else jnp.float32
+        )
+
+        @_partial(jax.jit, static_argnums=(1, 2))
+        def make_quantized(key, shape, std):
+            w = jax.random.normal(key, shape, jnp.float32) * std
+            return quantize_array(w.astype(target))
+
+        flat, treedef = tree_flatten_with_path(self._init_shapes())
+        root = jax.random.PRNGKey(self.seed)
+        leaves = []
+        for path, spec in flat:
+            name = keystr(path)
+            if jnp.issubdtype(spec.dtype, jnp.floating) and spec.ndim >= 2:
+                key = jax.random.fold_in(root, zlib.crc32(name.encode()) & 0x7FFFFFFF)
+                fan_in = int(np.prod(spec.shape[:-1]))
+                leaves.append(make_quantized(key, spec.shape, 1.0 / float(fan_in) ** 0.5))
+            elif jnp.issubdtype(spec.dtype, jnp.floating):
+                fill = 1.0 if ("norm" in name.lower() or "scale" in name.lower()
+                               or name.lower().endswith("weight']")) else 0.0
+                # target, not spec.dtype: the whole-tree path casts 1-D f32
+                # leaves through _cast_params too, and the two init paths
+                # must serve with the same norm-weight dtype
+                leaves.append(jnp.full(
+                    spec.shape, fill,
+                    target if spec.dtype == jnp.float32 else spec.dtype))
+            else:
+                leaves.append(jnp.zeros(spec.shape, spec.dtype))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
 
     def _load_params(self, path: str, name: str, cfg_kwargs: Dict[str, Any]):
         orbax_dir = os.path.join(path, "params")

@@ -280,8 +280,11 @@ def run_edge(args: argparse.Namespace) -> None:
     # One edge process per worker, each with its own response ring (an edge's
     # internal fork cannot be used here: forked loops would race on one ring).
     n_workers = max(1, args.workers)
+    # drain up to 256 frames per FFI crossing: under a 512-stream gRPC load
+    # one cycle then feeds the micro-batcher a full compile bucket instead
+    # of four 64-frame nibbles (pop_many is one C call either way)
     server = IPCEngineServer(engine, base, n_workers=n_workers,
-                             model_executor=executor)
+                             model_executor=executor, batch=256)
     edge_argv_tail = []
     if grpc_port:
         # the edge serves gRPC on every plane: native for builtin/device

@@ -48,7 +48,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from seldon_core_tpu.contracts.payload import Feedback, SeldonError, SeldonMessage
-from seldon_core_tpu.native import PayloadTooLarge, SharedRing
+from seldon_core_tpu.native import PayloadTooLarge, RingFull, SharedRing
 
 logger = logging.getLogger(__name__)
 
@@ -100,6 +100,13 @@ class ModelExecutor:
         self._frag_static = [
             not (_has_impl(m, "tags") or _has_impl(m, "metrics"))
             for m in self.models
+        ]
+        # Dynamic-fragment components that can attribute tags/metrics to a
+        # row range (row_slice protocol, e.g. outlier detectors): stacked
+        # into one scoring call with per-frame row attribution instead of
+        # running solo per request.
+        self._row_sliceable = [
+            callable(getattr(m, "row_slice", None)) for m in self.models
         ]
         self._frag_cache: Dict[tuple, bytes] = {}
 
@@ -190,17 +197,45 @@ class ModelExecutor:
             self._frag_cache[key] = frag
         return frag
 
+    def _row_fragment(self, method: int, component, result: np.ndarray,
+                      lo: int, hi: int) -> bytes:
+        """Fragment for rows [lo, hi) of a stacked call on a row-sliceable
+        dynamic component — same encoded shape as _fragment_for, but tags/
+        metrics come from the component's per-row attribution."""
+        from seldon_core_tpu.components.component import (
+            client_class_names,
+            client_feature_names,
+        )
+
+        fragment: Dict[str, Any] = {}
+        if method == METHOD_TRANSFORM_INPUT:
+            names = client_feature_names(component, [])
+        else:
+            names = client_class_names(component, result)
+        if names:
+            fragment["names"] = list(names)
+        tags, mets = component.row_slice(lo, hi)
+        if tags:
+            fragment["tags"] = tags
+        if mets:
+            fragment["metrics"] = mets
+        return json.dumps(fragment).encode() if fragment else b""
+
     @staticmethod
     def _err_response(req_id: int, info: str, reason: str, code: int = 500) -> bytes:
         return _RESP_HEADER.pack(req_id, 1) + _error_body(info, reason, code)
 
     # ---- execution ----------------------------------------------------
-    def _call_stacked(self, call, items, max_rows, finish, fail):
+    def _call_stacked(self, call, items, max_rows, finish, fail, finish_chunk=None):
         """Shared micro-batch machinery: ``items`` = [(key, arr)] with equal
         trailing shapes; concatenates into chunks of <= max_rows rows, one
         call per chunk, splits results back per key. Both the plain frame
         path and the fused-chain path use THIS loop so stacking policy,
-        the row-split guard, and accounting can never diverge."""
+        the row-split guard, and accounting can never diverge.
+
+        ``finish_chunk(chunk, result)``, when given, may consume a whole
+        stacked chunk at once (the C bulk-response path); returning False
+        falls back to per-frame ``finish``."""
         idx = 0
         while idx < len(items):
             chunk = []
@@ -225,6 +260,8 @@ class ModelExecutor:
                         "input rows; cannot split a micro-batch")
                 self.batched_calls += 1
                 self.batched_rows += stacked.shape[0]
+                if finish_chunk is not None and finish_chunk(chunk, result):
+                    continue
                 offset = 0
                 for key, a in chunk:
                     finish(key, result[offset:offset + a.shape[0]])
@@ -233,7 +270,68 @@ class ModelExecutor:
                 for key, _ in chunk:
                     fail(key, e)
 
-    def _predict_frames(self, model_id: int, method: int, frames) -> Dict[tuple, bytes]:
+    def _chunk_pusher(self, model_id: int, method: int, component, rings):
+        """finish_chunk callback for _call_stacked: pushes a whole stacked
+        chunk's responses through scr_push_model_resps — the C side frames
+        each response directly into its ring slot, replacing per-frame
+        struct packs + bytes concats + one FFI push per frame. Returns None
+        when the bulk path doesn't apply (no rings / dynamic fragment)."""
+        if not rings or not self._frag_static[model_id]:
+            return None
+
+        def finish_chunk(chunk, result) -> bool:
+            if result.ndim < 2 or not (
+                np.issubdtype(result.dtype, np.number) or result.dtype == np.bool_
+            ):
+                return False  # per-frame path handles odd shapes/dtypes
+            workers = {key[0] for key, _ in chunk}
+            if any(w not in rings for w in workers):
+                return False
+            frag = self._fragment_for(model_id, method, component, result)
+            dtype_code = 1 if result.dtype == np.float64 else 0
+            data = np.ascontiguousarray(result, dtype="<f8")
+            row_nvals = int(np.prod(result.shape[1:], dtype=np.int64))
+            tail = result.shape[1:]
+            by_worker: Dict[int, list] = {}
+            off = 0
+            for (worker_id, req_id), a in chunk:
+                by_worker.setdefault(worker_id, []).append(
+                    (req_id, off, a.shape[0]))
+                off += a.shape[0]
+            pushed_any = False
+            try:
+                for worker_id, entries in by_worker.items():
+                    rings[worker_id].push_model_resps(
+                        [e[0] for e in entries], [e[1] for e in entries],
+                        [e[2] for e in entries], data, row_nvals, tail, frag,
+                        dtype_code)
+                    pushed_any = True
+            except PayloadTooLarge:
+                if pushed_any:
+                    # can't re-answer the pushed workers' frames without
+                    # duplicating responses; the oversized worker's frames
+                    # time out at the edge (504). push_model_resps
+                    # pre-checks sizes, so a partial WORKER batch is
+                    # impossible — only partial multi-worker chunks are.
+                    logger.error("bulk response overflow after partial "
+                                 "multi-worker push; remaining frames will "
+                                 "time out at the edge")
+                    return True
+                return False  # per-frame path raises per-request errors
+            except RingFull:
+                # ring jammed for the full timeout — answering the same
+                # frames again via the fallback would enqueue duplicates
+                # into the same jammed ring; let the edge's deadline answer
+                # them (504) instead of killing the drain thread
+                logger.error("response ring full during bulk push; "
+                             "affected frames will time out at the edge")
+                return True
+            return True
+
+        return finish_chunk
+
+    def _predict_frames(self, model_id: int, method: int, frames,
+                        rings=None) -> Dict[tuple, bytes]:
         """frames: [((worker_id, req_id), arr)]; one stacked call when shapes
         allow. Keys are (worker, req) pairs throughout: req_ids are
         per-edge-worker counters, so with multiple edge workers the bare
@@ -275,9 +373,12 @@ class ModelExecutor:
         # warmed compile cache). Components with DYNAMIC tags/metrics (e.g.
         # outlier detectors scoring each request) must run solo: a stacked
         # call would compute one tags() for the whole batch and misattribute
-        # per-request scores.
+        # per-request scores — UNLESS the component implements the row_slice
+        # protocol, in which case the stacked call's tags/metrics are sliced
+        # per frame from its own rows.
         max_rows = self.max_rows[model_id]
-        if self._frag_static[model_id]:
+        row_sliced = self._row_sliceable[model_id] and not self._frag_static[model_id]
+        if self._frag_static[model_id] or row_sliced:
             stackable = [(r, a) for r, a in frames if a.ndim >= 2]
             solo = [(r, a) for r, a in frames if a.ndim < 2]
         else:
@@ -292,8 +393,31 @@ class ModelExecutor:
                 getattr(e, "reason", "ENGINE_ERROR"),
                 int(getattr(e, "status_code", 500)))
 
+        if row_sliced:
+            def finish_chunk(chunk, result):
+                if not (np.issubdtype(result.dtype, np.number)
+                        or result.dtype == np.bool_):
+                    return False  # finish() errors each frame (non-numeric)
+                if result.ndim < 2:
+                    # falling to finish() would attach whole-batch tags to
+                    # every frame — misattribution; fail the chunk instead
+                    raise SeldonError(
+                        "row-sliceable component returned <2-D output "
+                        "from a stacked call")
+                off = 0
+                for key, a in chunk:
+                    rows = a.shape[0]
+                    out[key] = self._ok_response(
+                        key[1], result[off:off + rows],
+                        self._row_fragment(method, component,
+                                           result[off:off + rows],
+                                           off, off + rows))
+                    off += rows
+                return True
+        else:
+            finish_chunk = self._chunk_pusher(model_id, method, component, rings)
         for shape, group in by_shape.items():
-            self._call_stacked(call, group, max_rows, finish, fail)
+            self._call_stacked(call, group, max_rows, finish, fail, finish_chunk)
         for key, arr in solo:
             try:
                 finish(key, np.asarray(call(arr)))
@@ -301,9 +425,14 @@ class ModelExecutor:
                 fail(key, e)
         return out
 
-    def execute(self, frames) -> Dict[int, Dict[int, bytes]]:
+    def execute(self, frames, rings=None) -> Dict[int, Dict[int, bytes]]:
         """frames: [(worker_id, req_id, payload_bytes)] →
-        {worker_id: {req_id: response_bytes}}."""
+        {worker_id: {req_id: response_bytes}}.
+
+        With ``rings`` ({worker_id: SharedRing}), stacked chunks with static
+        fragments push their responses directly through the C bulk path and
+        do NOT appear in the returned dict — only solo frames, errors, and
+        fallback cases come back as bytes for the caller to push."""
         parsed: Dict[tuple, list] = {}
         responses: Dict[int, Dict[int, bytes]] = {}
         for worker_id, req_id, payload in frames:
@@ -323,7 +452,7 @@ class ModelExecutor:
                 results = self._run_chains(gkey, group)
             else:
                 model_id, method = gkey
-                results = self._predict_frames(model_id, method, group)
+                results = self._predict_frames(model_id, method, group, rings)
             for (worker_id, req_id), resp in results.items():
                 responses.setdefault(worker_id, {})[req_id] = resp
         return responses
@@ -378,7 +507,9 @@ class ModelExecutor:
                 current[key] = result
 
             keys = list(current)
-            if self._frag_static[model_id]:
+            row_sliced = (self._row_sliceable[model_id]
+                          and not self._frag_static[model_id])
+            if self._frag_static[model_id] or row_sliced:
                 by_shape: Dict[tuple, list] = {}
                 solo = []
                 for k in keys:
@@ -387,16 +518,40 @@ class ModelExecutor:
                         by_shape.setdefault(a.shape[1:], []).append((k, a))
                     else:
                         solo.append(k)
+                finish_chunk = None
+                if row_sliced:
+                    # one scoring call for the whole chunk; each frame's
+                    # stage fragment is sliced from its own rows
+                    def finish_chunk(chunk, result,
+                                     _m=model_id, _meth=method, _c=component):
+                        if not (np.issubdtype(result.dtype, np.number)
+                                or result.dtype == np.bool_):
+                            return False  # finish_stage errors per frame
+                        if result.ndim < 2:
+                            raise SeldonError(
+                                "row-sliceable component returned <2-D "
+                                "output from a stacked call")
+                        off = 0
+                        for k, a in chunk:
+                            rows = a.shape[0]
+                            frag = self._row_fragment(
+                                _meth, _c, result[off:off + rows],
+                                off, off + rows)
+                            frags[k].append(frag.decode() or "{}")
+                            current[k] = result[off:off + rows]
+                            off += rows
+                        return True
                 for shape, items in by_shape.items():
                     self._call_stacked(call, items, self.max_rows[model_id],
-                                       finish_stage, fail)
+                                       finish_stage, fail, finish_chunk)
                 for k in solo:
                     try:
                         finish_stage(k, np.asarray(call(current[k])))
                     except Exception as e:
                         fail(k, e)
             else:
-                # dynamic tags/metrics: solo per frame (per-request scores)
+                # dynamic tags/metrics without row attribution: solo per
+                # frame (per-request scores)
                 for k in keys:
                     try:
                         finish_stage(k, call(current[k]))
@@ -502,7 +657,11 @@ class IPCEngineServer:
             try:
                 while not self._stop:
                     t0 = time.perf_counter()
-                    frames = self.req_ring.pop_batch(self.batch, poll_wait_s)
+                    # one FFI call per drain; frames are zero-copy views into
+                    # the ring's pop buffer, consumed before the next drain
+                    # (model frames synchronously below; JSON frames copied
+                    # into bytes before crossing to the event loop)
+                    frames = self.req_ring.pop_many(self.batch, poll_wait_s)
                     if not frames:
                         continue
                     t1 = time.perf_counter()
@@ -518,6 +677,7 @@ class IPCEngineServer:
                             model_frames.append(
                                 (worker_id, req_id, f[_REQ_HEADER.size:]))
                         else:
+                            f = bytes(f)
                             while inflight and inflight[0].done():
                                 inflight.popleft()
                             if len(inflight) >= max_inflight:
@@ -540,7 +700,7 @@ class IPCEngineServer:
 
     def _handle_models_sync(self, model_frames) -> None:
         try:
-            responses = self.model_executor.execute(model_frames)
+            responses = self.model_executor.execute(model_frames, rings=self.resp_rings)
         except Exception:
             logger.exception("model executor batch failed")
             responses = {}

@@ -318,3 +318,63 @@ def test_model_executor_chain_mid_stage_error():
     req_id, status = _RESP_HEADER.unpack_from(resp)
     assert status == 1
     assert b"unknown device model" in resp
+
+
+def test_model_executor_row_sliced_detector_batches():
+    """A dynamic-tag component implementing the row_slice protocol (outlier
+    detectors) is STACKED into one scoring call, and each frame's fragment
+    carries exactly its own rows' scores — identical to what a solo twin
+    scoring the same concatenated batch attributes to those rows."""
+    import numpy as np
+
+    from seldon_core_tpu.analytics import MahalanobisOutlierDetector
+    from seldon_core_tpu.components.component import SeldonComponent
+    from seldon_core_tpu.transport.ipc import ModelExecutor
+
+    class Tripler(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.asarray(X, np.float64) * 3.0
+
+    det = MahalanobisOutlierDetector(n_components=2, n_stdev=3.0)
+    twin = MahalanobisOutlierDetector(n_components=2, n_stdev=3.0)
+    ex = ModelExecutor([det, Tripler()])
+    stages = ((0, 1), (1, 0))  # detector transform -> model predict
+    rng = np.random.default_rng(7)
+    batches = [rng.normal(size=(r, 3)) for r in (1, 2, 1)]
+    frames = [(0, i, _chain_frame(stages, b)) for i, b in enumerate(batches)]
+    responses = ex.execute(frames)
+
+    # oracle: the twin scores the SAME stacked batch once (batch-wise update
+    # semantics), rows attribute per frame
+    stacked = np.concatenate(batches, axis=0)
+    twin.transform_input(stacked, [])
+    lo = 0
+    for i, b in enumerate(batches):
+        frag, vals = _parse_ok(responses[0][i])
+        np.testing.assert_allclose(vals, b * 3.0)
+        tags, mets = twin.row_slice(lo, lo + b.shape[0])
+        assert frag[0]["tags"] == tags
+        assert frag[0]["metrics"] == mets
+        assert len(frag[0]["tags"]["outlier_score"]) == b.shape[0]
+        lo += b.shape[0]
+    # ONE stacked scoring call for the detector stage (plus one for the
+    # model stage)
+    assert ex.batched_calls == 2
+    # running state advanced identically to the solo twin
+    for a, b in zip(det._state[:2], twin._state[:2]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_seq2seq_detector_not_row_sliceable():
+    """Seq2Seq's windowed scoring is NOT row-independent (2-D rows frame
+    into timesteps windows), so it must stay out of the row_slice stacking
+    protocol and keep solo-per-request execution."""
+    from seldon_core_tpu.analytics import (
+        MahalanobisOutlierDetector,
+        Seq2SeqOutlierDetector,
+    )
+    from seldon_core_tpu.transport.ipc import ModelExecutor
+
+    ex = ModelExecutor([Seq2SeqOutlierDetector(timesteps=4),
+                        MahalanobisOutlierDetector()])
+    assert ex._row_sliceable == [False, True]

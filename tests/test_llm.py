@@ -344,3 +344,33 @@ def test_prefix_cache_overlong_prompt():
     assert len(out) <= 3
     again = cached.generate([prompt], max_new_tokens=3)["tokens"][0]
     assert again == out
+
+
+def test_streamed_quantized_init(monkeypatch):
+    """Big-config path: when the f32 init tree would exceed the streaming
+    threshold and int8 serving is requested, params are initialized
+    leaf-by-leaf already quantized (never materializing the full f32 tree),
+    and generate() works end to end. Forced here by dropping the threshold
+    to zero on a tiny config."""
+    import seldon_core_tpu.servers.llmserver as llmserver_mod
+    from seldon_core_tpu.ops.quantize import QuantizedTensor
+    from seldon_core_tpu.servers.llmserver import LLMServer
+
+    monkeypatch.setattr(llmserver_mod, "STREAM_INIT_THRESHOLD_BYTES", 0)
+    kwargs = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=4,
+                  ffn_dim=128, max_seq_len=128)
+    server = LLMServer(
+        model="transformer", model_kwargs=kwargs, init_random=True,
+        max_new_tokens=8, len_buckets=(16,), batch_buckets=(2,),
+        temperature=0.0, eos_id=-1, quantize="int8",
+    )
+    server.load()
+    is_q = lambda x: isinstance(x, QuantizedTensor)  # noqa: E731
+    leaves = jax.tree.leaves(server._params, is_leaf=is_q)
+    n_q = sum(map(is_q, leaves))
+    # 7 matmul weights per layer (wq/wk/wv/wo/w1/w2/w3) + embed + head
+    assert n_q == 2 + 7 * kwargs["n_layers"]
+    # every >=2-D float leaf is quantized; 1-D norm weights are ones
+    assert all(is_q(l) or getattr(l, "ndim", 0) <= 1 for l in leaves)
+    out = server.generate([[1, 2, 3], [4, 5, 6]], max_new_tokens=8)
+    assert [len(t) for t in out["tokens"]] == [8, 8]

@@ -134,6 +134,42 @@ def test_fold_batchnorm_matches_unfused():
         fused.apply(fold_batchnorm(v), x, train=True)
 
 
+def test_space_to_depth_stem_matches_folded():
+    """stem_s2d=True + fold_space_to_depth must reproduce the folded-BN
+    forward up to float summation order, both when the module packs the
+    input itself and when the caller stages pre-packed (B,H/2,W/2,12)."""
+    from seldon_core_tpu.models.resnet import (
+        fold_batchnorm,
+        fold_space_to_depth,
+        space_to_depth,
+    )
+
+    m = get_model("resnet18", num_classes=10, dtype="float32")
+    x = jnp.asarray(
+        np.random.default_rng(2).standard_normal((2, 32, 32, 3), dtype=np.float32)
+    )
+    v = fold_batchnorm(m.init(jax.random.PRNGKey(0), x))
+    fused = get_model("resnet18", num_classes=10, dtype="float32", fused=True)
+    s2d = get_model("resnet18", num_classes=10, dtype="float32", fused=True, stem_s2d=True)
+    vs = fold_space_to_depth(v)
+
+    ref = np.asarray(fused.apply(v, x, train=False))
+    np.testing.assert_allclose(
+        np.asarray(s2d.apply(vs, x, train=False)), ref, atol=1e-5, rtol=1e-5
+    )
+    # host-side packing (numpy in, same packing order as the device path)
+    packed = space_to_depth(np.asarray(x))
+    assert isinstance(packed, np.ndarray) and packed.shape == (2, 16, 16, 12)
+    np.testing.assert_allclose(
+        np.asarray(s2d.apply(vs, jnp.asarray(packed), train=False)), ref, atol=1e-5, rtol=1e-5
+    )
+    # s2d stem is inference-only
+    with pytest.raises(ValueError, match="requires fused"):
+        get_model("resnet18", num_classes=10, dtype="float32", stem_s2d=True).init(
+            jax.random.PRNGKey(0), x
+        )
+
+
 def test_seq2seq_bad_sequence_length_raises():
     from seldon_core_tpu.analytics import Seq2SeqOutlierDetector
 
