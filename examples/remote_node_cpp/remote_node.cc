@@ -1,0 +1,187 @@
+// A non-Python microservice implementing the Seldon wire contract — the
+// role of the reference's nodejs wrapper (wrappers/s2i/nodejs/
+// microservice.js:1-147): any language that can serve these routes can be
+// a graph node. The engine reaches it through a unit's "endpoint" field
+// (runtime/remote.py), no implementation required.
+//
+// Routes (REST):
+//   GET  /live, /ready, /health/ping        -> 200
+//   POST /predict, /api/v0.1/predictions    -> SeldonMessage JSON
+//   POST /transform-input                   -> same contract
+//
+// The "user model" here doubles every value and names the features — enough
+// to prove a C++ node joins a graph with full payload/meta semantics.
+// Build:  g++ -O2 -std=c++17 remote_node.cc -o remote_node
+// Run:    ./remote_node <port>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// -- minimal JSON number-matrix extraction ---------------------------------
+// Parses {"data": {"ndarray": [[...]]}} (or a flat list) without a JSON
+// library: finds the "ndarray" key, then reads nested number rows. Good for
+// the contract's numeric payloads; anything else answers 400.
+bool parse_ndarray(const std::string& body, std::vector<std::vector<double>>& rows) {
+  size_t key = body.find("\"ndarray\"");
+  if (key == std::string::npos) return false;
+  size_t p = body.find('[', key);
+  if (p == std::string::npos) return false;
+  size_t depth = 0;
+  std::vector<double> cur;
+  bool any_nested = false;
+  std::string num;
+  auto flush_num = [&]() {
+    if (!num.empty()) {
+      cur.push_back(atof(num.c_str()));
+      num.clear();
+    }
+  };
+  for (size_t i = p; i < body.size(); ++i) {
+    char c = body[i];
+    if (c == '[') {
+      ++depth;
+      if (depth == 2) any_nested = true;
+      continue;
+    }
+    if (c == ']') {
+      flush_num();
+      if (depth == 2 || (depth == 1 && !any_nested)) {
+        if (!cur.empty()) rows.push_back(cur);
+        cur.clear();
+      }
+      if (--depth == 0) return !rows.empty();
+      continue;
+    }
+    if (c == ',' || isspace((unsigned char)c)) {
+      flush_num();
+      continue;
+    }
+    if (isdigit((unsigned char)c) || c == '-' || c == '+' || c == '.' ||
+        c == 'e' || c == 'E') {
+      num.push_back(c);
+      continue;
+    }
+    return false;  // strings/objects inside the array: not a numeric matrix
+  }
+  return false;
+}
+
+std::string predict_response(const std::vector<std::vector<double>>& rows) {
+  // the "user model": y = 2x, names c0..cN — mirrors the nodejs example's
+  // trivially-verifiable transform
+  std::string out = "{\"data\": {\"names\": [";
+  size_t cols = rows.empty() ? 0 : rows[0].size();
+  for (size_t j = 0; j < cols; ++j) {
+    if (j) out += ", ";
+    out += "\"c" + std::to_string(j) + "\"";
+  }
+  out += "], \"ndarray\": [";
+  char buf[64];
+  for (size_t i = 0; i < rows.size(); ++i) {
+    if (i) out += ", ";
+    out += "[";
+    for (size_t j = 0; j < rows[i].size(); ++j) {
+      if (j) out += ", ";
+      snprintf(buf, sizeof(buf), "%.12g", 2.0 * rows[i][j]);
+      out += buf;
+    }
+    out += "]";
+  }
+  out += "]}}";
+  return out;
+}
+
+void respond(int fd, int code, const char* text, const std::string& body,
+             const char* ctype = "application/json") {
+  char head[256];
+  int n = snprintf(head, sizeof(head),
+                   "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                   "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+                   code, text, ctype, body.size());
+  (void)!write(fd, head, n);
+  (void)!write(fd, body.data(), body.size());
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 9000;
+  signal(SIGPIPE, SIG_IGN);
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(srv, (sockaddr*)&addr, sizeof(addr)) != 0 || listen(srv, 64) != 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  fprintf(stderr, "remote_node listening on %d\n", port);
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::string req;
+    char buf[65536];
+    // read until headers + declared body are in (Connection: close model)
+    size_t content_len = 0, hdr_end = std::string::npos;
+    for (;;) {
+      ssize_t n = read(fd, buf, sizeof(buf));
+      if (n <= 0) break;
+      req.append(buf, (size_t)n);
+      if (hdr_end == std::string::npos) {
+        hdr_end = req.find("\r\n\r\n");
+        if (hdr_end != std::string::npos) {
+          size_t cl = req.find("Content-Length:");
+          if (cl == std::string::npos) cl = req.find("content-length:");
+          if (cl != std::string::npos && cl < hdr_end)
+            content_len = strtoul(req.c_str() + cl + 15, nullptr, 10);
+        }
+      }
+      if (hdr_end != std::string::npos &&
+          req.size() >= hdr_end + 4 + content_len)
+        break;
+    }
+    if (hdr_end == std::string::npos) {
+      close(fd);
+      continue;
+    }
+    bool is_get = req.rfind("GET ", 0) == 0;
+    bool is_post = req.rfind("POST ", 0) == 0;
+    std::string path = req.substr(is_get ? 4 : 5, req.find(' ', 5) - (is_get ? 4 : 5));
+    std::string body = req.substr(hdr_end + 4);
+    if (is_get && (path == "/live" || path == "/ready" || path == "/health/ping")) {
+      respond(fd, 200, "OK", "{\"status\": \"ok\"}");
+    } else if (is_post && (path == "/predict" || path == "/transform-input" ||
+                           path == "/api/v0.1/predictions" ||
+                           path == "/api/v1.0/predictions")) {
+      std::vector<std::vector<double>> rows;
+      if (parse_ndarray(body, rows)) {
+        respond(fd, 200, "OK", predict_response(rows));
+      } else {
+        respond(fd, 400, "Bad Request",
+                "{\"status\": {\"code\": 400, \"reason\": "
+                "\"MICROSERVICE_BAD_DATA\", \"info\": "
+                "\"expected data.ndarray of numbers\", \"status\": \"FAILURE\"}}");
+      }
+    } else if (is_post && path == "/send-feedback") {
+      respond(fd, 200, "OK", "{\"meta\": {}}");
+    } else {
+      respond(fd, 404, "Not Found", "{\"status\": {\"code\": 404}}");
+    }
+    close(fd);
+  }
+}

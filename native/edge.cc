@@ -55,6 +55,8 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include "np_rng.h"
+
 // ---------------------------------------------------------------------------
 // Shared ring (ring.cc) — linked in; used for the Python-engine fallback.
 // ---------------------------------------------------------------------------
@@ -497,6 +499,11 @@ struct Unit {
   double epsilon = 0.1;
   int best_branch = 0;
   double alpha0 = 1.0, beta0 = 1.0;
+  // Seeded units replay the Python stream exactly (np_rng.h): numpy PCG64
+  // for the bandits, CPython MT19937 for RandomABTest — so seeded graphs
+  // serve natively with request-for-request routing parity.
+  std::shared_ptr<nprng::NpRng> np_rng;
+  std::shared_ptr<nprng::PyRng> py_rng;
   mutable std::vector<uint64_t> pulls;
   mutable std::vector<double> reward_sum, fail_sum;
 
@@ -583,6 +590,13 @@ bool load_program(const char* path, Program& prog) {
     if (auto* v = doc.get(u, "bestBranch")) unit.best_branch = (int)jnum(*v);
     if (auto* v = doc.get(u, "alpha")) unit.alpha0 = jnum(*v);
     if (auto* v = doc.get(u, "beta")) unit.beta0 = jnum(*v);
+    if (auto* v = doc.get(u, "seed")) {
+      uint64_t seed = (uint64_t)jnum(*v);
+      if (unit.kind == Kind::RandomABTest)
+        unit.py_rng = std::make_shared<nprng::PyRng>(seed);
+      else
+        unit.np_rng = std::make_shared<nprng::NpRng>(seed);
+    }
     if (auto* v = doc.get(u, "children"))
       for (int c = 0; c < v->n_children; ++c)
         unit.children.push_back((int)jnum(*doc.item(*v, c)));
@@ -808,17 +822,24 @@ bool eval_unit(const Program& prog, int idx, Rng& rng, Payload in, ExecOut& out,
     case Kind::ThompsonSampling: {
       int branch = 0;
       if (u.kind == Kind::RandomABTest) {
-        if (u.n_branches == 2)
+        if (u.py_rng) {  // seeded: CPython random.Random replay
+          branch = u.n_branches == 2
+                       ? (u.py_rng->random() < u.ratioA ? 0 : 1)
+                       : (int)u.py_rng->randrange((uint64_t)u.n_branches);
+        } else if (u.n_branches == 2)
           branch = rng.uniform() < u.ratioA ? 0 : 1;
         else
           branch = (int)(rng.uniform() * u.n_branches) % u.n_branches;
       } else if (u.kind == Kind::EpsilonGreedy) {
         // analytics/routers.py EpsilonGreedy.route: explore with prob eps,
-        // else exploit argmax mean (best_branch before any feedback)
+        // else exploit argmax mean (best_branch before any feedback);
+        // seeded units replay numpy default_rng draw-for-draw
         uint64_t total = 0;
         for (uint64_t p : u.pulls) total += p;
-        if (rng.uniform() < u.epsilon) {
-          branch = (int)(rng.next() % (uint64_t)u.n_branches);
+        double eps_draw = u.np_rng ? u.np_rng->random() : rng.uniform();
+        if (eps_draw < u.epsilon) {
+          branch = u.np_rng ? (int)u.np_rng->integers((uint64_t)u.n_branches)
+                            : (int)(rng.next() % (uint64_t)u.n_branches);
         } else if (total == 0) {
           branch = u.best_branch;
         } else {
@@ -1287,15 +1308,21 @@ bool eval_device(const Program& prog, int idx, Rng& rng, const DVal& in,
     case Kind::ThompsonSampling: {
       int branch = 0;
       if (u.kind == Kind::RandomABTest) {
-        if (u.n_branches == 2)
+        if (u.py_rng) {  // seeded: CPython random.Random replay
+          branch = u.n_branches == 2
+                       ? (u.py_rng->random() < u.ratioA ? 0 : 1)
+                       : (int)u.py_rng->randrange((uint64_t)u.n_branches);
+        } else if (u.n_branches == 2)
           branch = rng.uniform() < u.ratioA ? 0 : 1;
         else
           branch = (int)(rng.uniform() * u.n_branches) % u.n_branches;
       } else if (u.kind == Kind::EpsilonGreedy) {
         uint64_t total = 0;
         for (uint64_t p : u.pulls) total += p;
-        if (rng.uniform() < u.epsilon) {
-          branch = (int)(rng.next() % (uint64_t)u.n_branches);
+        double eps_draw = u.np_rng ? u.np_rng->random() : rng.uniform();
+        if (eps_draw < u.epsilon) {
+          branch = u.np_rng ? (int)u.np_rng->integers((uint64_t)u.n_branches)
+                            : (int)(rng.next() % (uint64_t)u.n_branches);
         } else if (total == 0) {
           branch = u.best_branch;
         } else {

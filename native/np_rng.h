@@ -1,0 +1,266 @@
+// Bit-exact replays of the two RNG streams the Python routers draw from,
+// so SEEDED router units can execute on the native edge and still reproduce
+// the Python engine's routing decisions request-for-request:
+//
+//   NpRng  — numpy ``np.random.default_rng(seed)``: SeedSequence -> PCG64
+//            (setseq 128/64 XSL-RR) with the Generator's buffered uint32
+//            path and Lemire bounded integers. Used by the bandit routers
+//            (analytics/routers.py `_BanditRouter.__init__`).
+//   PyRng  — CPython ``random.Random(seed)``: MT19937 via init_by_array,
+//            53-bit random(), _randbelow via getrandbits rejection. Used by
+//            RandomABTest (components/builtin.py).
+//
+// Parity is enforced by tests/test_native.py::test_np_rng_parity* which
+// compare these (via ctypes hooks in ring.cc) against numpy / CPython
+// draw-for-draw, including the uint32-buffer interleaving.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace nprng {
+
+using uint128 = unsigned __int128;
+
+// ---------------------------------------------------------------------------
+// numpy SeedSequence (pool_size 4, uint32 words) — bit_generator.pyx
+// ---------------------------------------------------------------------------
+struct SeedSequence {
+  static constexpr uint32_t INIT_A = 0x43b0d7e5u, MULT_A = 0x931e8875u;
+  static constexpr uint32_t INIT_B = 0x8b51f9ddu, MULT_B = 0x58f38dedu;
+  static constexpr uint32_t MIX_MULT_L = 0xca01f9ddu, MIX_MULT_R = 0x4973f715u;
+  static constexpr int XSHIFT = 16, POOL_SIZE = 4;
+
+  uint32_t pool[POOL_SIZE];
+
+  explicit SeedSequence(uint64_t seed) {
+    // entropy = the seed as little-endian uint32 words (numpy
+    // _coerce_to_uint32_array; 0 stays one zero word)
+    std::vector<uint32_t> entropy;
+    if (seed == 0) {
+      entropy.push_back(0);
+    } else {
+      while (seed) {
+        entropy.push_back(static_cast<uint32_t>(seed));
+        seed >>= 32;
+      }
+    }
+    uint32_t hash_const = INIT_A;
+    auto hash = [&hash_const](uint32_t value) {
+      value ^= hash_const;
+      hash_const *= MULT_A;
+      value *= hash_const;
+      value ^= value >> XSHIFT;
+      return value;
+    };
+    auto mix = [](uint32_t x, uint32_t y) {
+      uint32_t result = x * MIX_MULT_L - y * MIX_MULT_R;
+      result ^= result >> XSHIFT;
+      return result;
+    };
+    for (int i = 0; i < POOL_SIZE; ++i)
+      pool[i] = hash(i < (int)entropy.size() ? entropy[i] : 0);
+    for (int i_src = 0; i_src < POOL_SIZE; ++i_src)
+      for (int i_dst = 0; i_dst < POOL_SIZE; ++i_dst)
+        if (i_src != i_dst) pool[i_dst] = mix(pool[i_dst], hash(pool[i_src]));
+    for (int i_src = POOL_SIZE; i_src < (int)entropy.size(); ++i_src)
+      for (int i_dst = 0; i_dst < POOL_SIZE; ++i_dst)
+        pool[i_dst] = mix(pool[i_dst], hash(entropy[i_src]));
+  }
+
+  // n 32-bit words of generated state
+  void generate_state(uint32_t* out, int n) const {
+    uint32_t hash_const = INIT_B;
+    for (int i = 0; i < n; ++i) {
+      uint32_t v = pool[i % POOL_SIZE];
+      v ^= hash_const;
+      hash_const *= MULT_B;
+      v *= hash_const;
+      v ^= v >> XSHIFT;
+      out[i] = v;
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// PCG64 (setseq 128/64 XSL-RR) + numpy Generator draw protocols
+// ---------------------------------------------------------------------------
+struct NpRng {
+  uint128 state = 0, inc = 0;
+  // numpy's pcg64_next32 buffers the high half of a 64-bit draw
+  bool has_uint32 = false;
+  uint32_t uinteger = 0;
+
+  static constexpr uint64_t MUL_HI = 0x2360ed051fc65da4ull;
+  static constexpr uint64_t MUL_LO = 0x4385df649fccf645ull;
+
+  explicit NpRng(uint64_t seed) {
+    SeedSequence ss(seed);
+    uint32_t w[8];
+    ss.generate_state(w, 8);  // = generate_state(4, uint64) little-endian
+    auto u64 = [&w](int i) {
+      return (uint64_t)w[2 * i] | ((uint64_t)w[2 * i + 1] << 32);
+    };
+    // pcg64_set_seed: seed words 0..1 (hi, lo), inc words 2..3 (hi, lo)
+    uint128 initstate = ((uint128)u64(0) << 64) | u64(1);
+    uint128 initseq = ((uint128)u64(2) << 64) | u64(3);
+    state = 0;
+    inc = (initseq << 1) | 1;
+    step();
+    state += initstate;
+    step();
+  }
+
+  void step() {
+    const uint128 mul = ((uint128)MUL_HI << 64) | MUL_LO;
+    state = state * mul + inc;
+  }
+
+  uint64_t next64() {
+    step();
+    uint64_t hi = (uint64_t)(state >> 64), lo = (uint64_t)state;
+    uint64_t value = hi ^ lo;
+    unsigned rot = (unsigned)(state >> 122);
+    return rot ? (value >> rot) | (value << (64 - rot)) : value;
+  }
+
+  uint32_t next32() {
+    if (has_uint32) {
+      has_uint32 = false;
+      return uinteger;
+    }
+    uint64_t v = next64();
+    has_uint32 = true;
+    uinteger = (uint32_t)(v >> 32);
+    return (uint32_t)v;
+  }
+
+  // Generator.random(): 53-bit double in [0, 1)
+  double random() { return (next64() >> 11) * (1.0 / 9007199254740992.0); }
+
+  // Generator.integers(0, n) for int64 dtype, 0 < n <= 2^32: numpy's
+  // random_bounded_uint64_fill takes the 32-bit path (rng = n-1 fits in
+  // uint32) -> buffered Lemire over next32 (distributions.c
+  // buffered_bounded_lemire_uint32).
+  uint64_t integers(uint64_t n) {
+    uint64_t rng = n - 1;
+    if (rng == 0) return 0;
+    if (rng == 0xFFFFFFFFull) return next32();
+    uint32_t rng_excl = (uint32_t)(rng + 1);
+    uint64_t m = (uint64_t)next32() * rng_excl;
+    uint32_t leftover = (uint32_t)m;
+    if (leftover < rng_excl) {
+      const uint32_t threshold = (uint32_t)(-rng_excl) % rng_excl;  // 2^32 % excl
+      while (leftover < threshold) {
+        m = (uint64_t)next32() * rng_excl;
+        leftover = (uint32_t)m;
+      }
+    }
+    return m >> 32;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// CPython random.Random(seed): MT19937 + init_by_array + _randbelow
+// ---------------------------------------------------------------------------
+struct PyRng {
+  static constexpr int N = 624, M = 397;
+  static constexpr uint32_t MATRIX_A = 0x9908b0dfu;
+  static constexpr uint32_t UPPER_MASK = 0x80000000u, LOWER_MASK = 0x7fffffffu;
+
+  uint32_t mt[N];
+  int mti = N + 1;
+
+  explicit PyRng(uint64_t seed) {
+    // CPython random_seed: key = abs(seed) as 32-bit little-endian words
+    std::vector<uint32_t> key;
+    if (seed == 0) {
+      key.push_back(0);
+    } else {
+      uint64_t s = seed;
+      while (s) {
+        key.push_back((uint32_t)s);
+        s >>= 32;
+      }
+    }
+    init_by_array(key.data(), (int)key.size());
+  }
+
+  void init_genrand(uint32_t s) {
+    mt[0] = s;
+    for (mti = 1; mti < N; ++mti)
+      mt[mti] = 1812433253u * (mt[mti - 1] ^ (mt[mti - 1] >> 30)) + (uint32_t)mti;
+  }
+
+  void init_by_array(const uint32_t* key, int key_length) {
+    init_genrand(19650218u);
+    int i = 1, j = 0;
+    int k = N > key_length ? N : key_length;
+    for (; k; --k) {
+      mt[i] = (mt[i] ^ ((mt[i - 1] ^ (mt[i - 1] >> 30)) * 1664525u)) + key[j] + (uint32_t)j;
+      ++i;
+      ++j;
+      if (i >= N) {
+        mt[0] = mt[N - 1];
+        i = 1;
+      }
+      if (j >= key_length) j = 0;
+    }
+    for (k = N - 1; k; --k) {
+      mt[i] = (mt[i] ^ ((mt[i - 1] ^ (mt[i - 1] >> 30)) * 1566083941u)) - (uint32_t)i;
+      ++i;
+      if (i >= N) {
+        mt[0] = mt[N - 1];
+        i = 1;
+      }
+    }
+    mt[0] = 0x80000000u;
+  }
+
+  uint32_t genrand_uint32() {
+    uint32_t y;
+    if (mti >= N) {
+      static const uint32_t mag01[2] = {0u, MATRIX_A};
+      int kk;
+      for (kk = 0; kk < N - M; ++kk) {
+        y = (mt[kk] & UPPER_MASK) | (mt[kk + 1] & LOWER_MASK);
+        mt[kk] = mt[kk + M] ^ (y >> 1) ^ mag01[y & 1];
+      }
+      for (; kk < N - 1; ++kk) {
+        y = (mt[kk] & UPPER_MASK) | (mt[kk + 1] & LOWER_MASK);
+        mt[kk] = mt[kk + (M - N)] ^ (y >> 1) ^ mag01[y & 1];
+      }
+      y = (mt[N - 1] & UPPER_MASK) | (mt[0] & LOWER_MASK);
+      mt[N - 1] = mt[M - 1] ^ (y >> 1) ^ mag01[y & 1];
+      mti = 0;
+    }
+    y = mt[mti++];
+    y ^= y >> 11;
+    y ^= (y << 7) & 0x9d2c5680u;
+    y ^= (y << 15) & 0xefc60000u;
+    y ^= y >> 18;
+    return y;
+  }
+
+  // random_random: 53-bit double from two 32-bit draws
+  double random() {
+    uint32_t a = genrand_uint32() >> 5, b = genrand_uint32() >> 6;
+    return (a * 67108864.0 + b) * (1.0 / 9007199254740992.0);
+  }
+
+  // getrandbits(k) for k <= 32
+  uint32_t getrandbits(int k) { return genrand_uint32() >> (32 - k); }
+
+  // Random._randbelow_with_getrandbits -> randrange(n)
+  uint64_t randrange(uint64_t n) {
+    if (n <= 1) return 0;
+    int k = 64 - __builtin_clzll(n);  // CPython _randbelow: k = n.bit_length()
+    uint32_t r = getrandbits(k);
+    while (r >= n) r = getrandbits(k);
+    return r;
+  }
+};
+
+}  // namespace nprng

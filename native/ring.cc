@@ -337,3 +337,29 @@ int scr_push_model_resps(void* handle, const uint32_t* req_ids,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Test hooks for the seeded-router RNG replays (native/np_rng.h): pytest
+// compares these draw-for-draw against numpy / CPython so the native edge's
+// seeded routing is PROVEN bit-exact, not assumed.
+// ---------------------------------------------------------------------------
+#include "np_rng.h"
+
+extern "C" {
+
+void* np_rng_new(uint64_t seed) { return new nprng::NpRng(seed); }
+void np_rng_free(void* h) { delete static_cast<nprng::NpRng*>(h); }
+double np_rng_random(void* h) { return static_cast<nprng::NpRng*>(h)->random(); }
+uint64_t np_rng_next64(void* h) { return static_cast<nprng::NpRng*>(h)->next64(); }
+uint64_t np_rng_integers(void* h, uint64_t n) {
+  return static_cast<nprng::NpRng*>(h)->integers(n);
+}
+
+void* py_rng_new(uint64_t seed) { return new nprng::PyRng(seed); }
+void py_rng_free(void* h) { delete static_cast<nprng::PyRng*>(h); }
+double py_rng_random(void* h) { return static_cast<nprng::PyRng*>(h)->random(); }
+uint64_t py_rng_randrange(void* h, uint64_t n) {
+  return static_cast<nprng::PyRng*>(h)->randrange(n);
+}
+
+}  // extern "C"

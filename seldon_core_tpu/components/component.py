@@ -116,16 +116,30 @@ class SeldonComponent:
 # capability of `python/seldon_core/user_model.py:94-331`.
 # ---------------------------------------------------------------------------
 
+_IMPL_CACHE: Dict[Any, bool] = {}
+
+
 def _has_impl(obj: Any, name: str) -> bool:
-    """True if obj defines `name` itself (not the NotImplementedError base stub)."""
-    meth = getattr(obj, name, None)
-    if meth is None or not callable(meth):
-        return False
-    base = getattr(SeldonComponent, name, None)
-    func = getattr(meth, "__func__", None)
-    if base is not None and func is base:
-        return False
-    return True
+    """True if obj defines `name` itself (not the NotImplementedError base
+    stub). Class-level answers are cached — this runs several times per
+    request on the serving path, and the reflection chain costs more than
+    the rest of the meta assembly. Instance-level overrides (obj.tags = fn)
+    bypass the cache."""
+    d = getattr(obj, "__dict__", None)
+    if d is not None and name in d:
+        return callable(d[name])
+    cls = type(obj)
+    key = (cls, name)
+    hit = _IMPL_CACHE.get(key)
+    if hit is None:
+        meth = getattr(cls, name, None)
+        if meth is None or not callable(meth):
+            hit = False
+        else:
+            base = getattr(SeldonComponent, name, None)
+            hit = not (base is not None and meth is base)
+        _IMPL_CACHE[key] = hit
+    return hit
 
 
 def has_raw(obj: Any, name: str) -> bool:

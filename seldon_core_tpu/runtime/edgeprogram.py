@@ -148,11 +148,32 @@ def compile_edge_program(
             # the ring call completes — keep such graphs on the Python engine
             return None
         params = unit.parameters_dict()
-        if kind in ("RANDOM_ABTEST", "EPSILON_GREEDY", "THOMPSON_SAMPLING") and (
-            params.get("seed") is not None
-        ):
-            # seeded routing must reproduce the Python engine's RNG sequence
-            # exactly; only the Python engine can honor that
+        if kind == "THOMPSON_SAMPLING" and params.get("seed") is not None:
+            # seeded Thompson draws Beta variates — replaying numpy's gamma
+            # rejection sampler bit-for-bit is not implemented, so only the
+            # Python engine can honor a seeded Thompson stream. Seeded
+            # epsilon-greedy and AB-test ARE native: the edge replays
+            # numpy's PCG64 / CPython's MT19937 exactly (native/np_rng.h,
+            # parity-proven by tests/test_native.py::test_np_rng_parity).
+            return None
+        if str(params.get("python_routing", "")).lower() in ("true", "1"):
+            # Seeded determinism scope: each serving PLANE replays its own
+            # exact stream from the seed (same per-replica model as
+            # multi-worker edges / multi-replica engines). Traffic that
+            # splits across planes (e.g. strData riding the ring while
+            # tensors run native) therefore interleaves two streams. A
+            # deployment that needs ONE globally-deterministic stream sets
+            # python_routing=true on the router to pin it to the Python
+            # engine — the pre-round-4 behavior.
+            return None
+        try:
+            seed = params.get("seed")
+            seed = None if seed is None else int(seed)
+            if seed is not None and not 0 <= seed < 2**53:
+                # negative (numpy raises) or beyond double precision (the
+                # program JSON carries numbers as doubles): Python plane
+                return None
+        except (TypeError, ValueError):
             return None
         if kind in ("EPSILON_GREEDY", "THOMPSON_SAMPLING"):
             # Parameters the Python constructor would reject must surface as
@@ -186,10 +207,14 @@ def compile_edge_program(
         if kind == "RANDOM_ABTEST":
             out["ratioA"] = float(params.get("ratioA", 0.5))
             out["nBranches"] = int(params.get("n_branches", 2))
+            if seed is not None:
+                out["seed"] = seed
         elif kind == "EPSILON_GREEDY":
             out["nBranches"] = int(params.get("n_branches", 2))
             out["epsilon"] = float(params.get("epsilon", 0.1))
             out["bestBranch"] = int(params.get("best_branch", 0))
+            if seed is not None:
+                out["seed"] = seed
         elif kind == "THOMPSON_SAMPLING":
             out["nBranches"] = int(params.get("n_branches", 2))
             out["alpha"] = float(params.get("alpha", 1.0))

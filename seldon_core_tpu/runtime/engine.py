@@ -59,6 +59,21 @@ logger = logging.getLogger(__name__)
 ComponentFactory = Callable[[PredictiveUnit], SeldonComponent]
 
 
+def _drive_sync(coro):
+    """Run a coroutine that never truly suspends (fully-local graph: every
+    await is another such coroutine) to completion without an event loop.
+    One send() reaches the first real suspension point — which must not
+    exist — or StopIteration with the result."""
+    try:
+        coro.send(None)
+    except StopIteration as stop:
+        return stop.value
+    coro.close()
+    raise SeldonError(
+        "graph coroutine suspended on real async work despite "
+        "has_async_nodes=False; report this as a bug", status_code=500)
+
+
 def make_puid() -> str:
     """Request id: 26 base32-ish chars, the entropy class of the reference's
     SecureRandom 130-bit id (`service/PredictionService.java:77-83`)."""
@@ -142,6 +157,34 @@ class GraphEngine:
         self.state = self._build(spec)
         if fuse:
             self._try_fuse(self.state.root)
+        # A graph whose every node is local+synchronous never truly suspends:
+        # predict()/send_feedback() coroutines run to completion without an
+        # event loop (the only awaits are child coroutines and — avoided
+        # below for this case — asyncio.gather). The IPC drain uses this to
+        # execute plane-3 frames inline on its own thread, skipping the
+        # event-loop hop entirely.
+        from seldon_core_tpu.runtime.remote import RemoteComponent
+
+        def _is_async_component(comp) -> bool:
+            if comp is None:
+                return False
+            if isinstance(comp, RemoteComponent) or getattr(comp, "is_async", False):
+                return True
+            # _call also supports plain `async def` methods (awaitable
+            # results) without the is_async marker — those suspend for real
+            for name in ("predict", "transform_input", "transform_output",
+                         "route", "aggregate", "send_feedback",
+                         "predict_raw", "transform_input_raw",
+                         "transform_output_raw", "route_raw",
+                         "aggregate_raw", "send_feedback_raw"):
+                meth = getattr(comp, name, None)
+                if meth is not None and inspect.iscoroutinefunction(meth):
+                    return True
+            return False
+
+        self.has_async_nodes = any(
+            _is_async_component(s.component) for s in self.state.walk()
+        )
 
     # ------------------------------------------------------------------
     # Build
@@ -285,7 +328,14 @@ class GraphEngine:
         return response
 
     def predict_sync(self, request: SeldonMessage) -> SeldonMessage:
-        return asyncio.run(self.predict(request))
+        if self.has_async_nodes:
+            return asyncio.run(self.predict(request))
+        return _drive_sync(self.predict(request))
+
+    def send_feedback_sync(self, feedback: "Feedback") -> SeldonMessage:
+        if self.has_async_nodes:
+            return asyncio.run(self.send_feedback(feedback))
+        return _drive_sync(self.send_feedback(feedback))
 
     async def _get_output(self, state: UnitState, message: SeldonMessage) -> SeldonMessage:
         # Fused fast path: the whole subtree is one XLA call. Meta parity with
@@ -335,9 +385,18 @@ class GraphEngine:
         # 3. children
         if state.children:
             if branch == -1:
-                child_outputs = await asyncio.gather(
-                    *[self._get_output(c, transformed) for c in state.children]
-                )
+                if self.has_async_nodes:
+                    child_outputs = await asyncio.gather(
+                        *[self._get_output(c, transformed) for c in state.children]
+                    )
+                else:
+                    # local components are synchronous: gather buys no
+                    # concurrency here, only Task/loop overhead — and
+                    # avoiding it keeps the whole coroutine loop-free so
+                    # predict_sync can drive it without an event loop
+                    child_outputs = [
+                        await self._get_output(c, transformed) for c in state.children
+                    ]
             else:
                 child_outputs = [await self._get_output(state.children[branch], transformed)]
         else:
@@ -433,7 +492,12 @@ class GraphEngine:
                 routing = feedback.response.meta.routing
             branch = routing.get(state.name, -1)
             if branch == -1:
-                await asyncio.gather(*[self._feedback(c, feedback) for c in state.children])
+                if self.has_async_nodes:
+                    await asyncio.gather(
+                        *[self._feedback(c, feedback) for c in state.children])
+                else:
+                    for c in state.children:
+                        await self._feedback(c, feedback)
             elif 0 <= branch < len(state.children):
                 await self._feedback(state.children[branch], feedback)
             else:

@@ -30,6 +30,8 @@ def download(uri: str, out_dir: Optional[str] = None) -> str:
     if scheme == "s3":
         return _s3(parsed, out_dir)
     if scheme in ("http", "https"):
+        if parsed.netloc.endswith(".blob.core.windows.net"):
+            return _azure_blob(parsed, out_dir)
         return _http(uri, out_dir)
     raise StorageError(f"Unsupported model URI scheme {scheme!r} in {uri!r}")
 
@@ -108,6 +110,50 @@ def _s3(parsed, out_dir: Optional[str]) -> str:
             count += 1
     if count == 0:
         raise StorageError(f"No objects found at s3://{parsed.netloc}/{prefix}")
+    return out_dir
+
+
+def _azure_blob(parsed, out_dir: Optional[str]) -> str:
+    """``https://<account>.blob.core.windows.net/<container>/<prefix>``
+    (the reference's `storage.py:109-128` _download_blob, modernized to the
+    ``azure-storage-blob`` ContainerClient API). Credentials: the
+    ``AZURE_STORAGE_CONNECTION_STRING`` env var when set, else anonymous
+    (public containers, matching the reference's credential-less
+    BlockBlobService default)."""
+    try:
+        from azure.storage.blob import ContainerClient  # type: ignore
+    except ImportError as e:
+        raise StorageError(
+            "azure blob model URIs require azure-storage-blob, which is not installed"
+        ) from e
+    path = parsed.path.lstrip("/")
+    if "/" not in path:
+        container, prefix = path, ""
+    else:
+        container, prefix = path.split("/", 1)
+    if not container:
+        raise StorageError(f"Azure blob URI needs a container: {parsed.geturl()!r}")
+    conn = os.environ.get("AZURE_STORAGE_CONNECTION_STRING")
+    if conn:
+        client = ContainerClient.from_connection_string(conn, container_name=container)
+    else:
+        client = ContainerClient(
+            account_url=f"https://{parsed.netloc}", container_name=container
+        )
+    out_dir = _workdir(out_dir)
+    count = 0
+    for blob in client.list_blobs(name_starts_with=prefix):
+        name = getattr(blob, "name", None) or blob["name"]
+        rel = os.path.relpath(name, prefix) if prefix and name != prefix else (
+            os.path.basename(name) if name == prefix else name)
+        dst = os.path.join(out_dir, rel)
+        os.makedirs(os.path.dirname(dst) or out_dir, exist_ok=True)
+        with open(dst, "wb") as f:
+            client.download_blob(name).readinto(f)
+        count += 1
+    if count == 0:
+        raise StorageError(
+            f"No blobs found at https://{parsed.netloc}/{container}/{prefix}")
     return out_dir
 
 

@@ -653,6 +653,13 @@ class IPCEngineServer:
         inflight: Any = deque()
         max_inflight = max(4 * self.batch, 64)
 
+        # Fully-local graph: plane-3 frames execute inline on the drain
+        # thread (engine coroutines never suspend), skipping the
+        # run_coroutine_threadsafe hop + to_thread response push that
+        # dominated the old per-request cost. Async graphs (remote nodes,
+        # async user components) keep the event-loop path.
+        inline_plane3 = not getattr(self.engine, "has_async_nodes", True)
+
         def drain() -> None:
             try:
                 while not self._stop:
@@ -676,6 +683,8 @@ class IPCEngineServer:
                         if kind == KIND_MODEL and self.model_executor is not None:
                             model_frames.append(
                                 (worker_id, req_id, f[_REQ_HEADER.size:]))
+                        elif inline_plane3:
+                            self._handle_sync(f)
                         else:
                             f = bytes(f)
                             while inflight and inflight[0].done():
@@ -734,6 +743,76 @@ class IPCEngineServer:
 
     def stop(self) -> None:
         self._stop = True
+
+    def _handle_sync(self, frame) -> None:
+        """Plane-3 frame (JSON kind 0/1 or proto kind 3/4) executed INLINE on
+        the drain thread — no event-loop hop, no to_thread push. Only valid
+        when the graph has no async nodes (engine.has_async_nodes False), in
+        which case predict()/send_feedback() never suspend; the serve loop
+        picks between this and the coroutine path once at startup."""
+        try:
+            worker_id, req_id, kind = _REQ_HEADER.unpack_from(frame)
+        except struct.error:
+            logger.error("dropping malformed IPC frame (%d bytes)", len(frame))
+            return
+        try:
+            if kind in (KIND_PROTO_PREDICT, KIND_PROTO_FEEDBACK):
+                from seldon_core_tpu.transport import proto_convert as pc
+                from seldon_core_tpu.transport.proto import prediction_pb2 as pb
+
+                raw = bytes(frame[_REQ_HEADER.size:])
+                if kind == KIND_PROTO_PREDICT:
+                    out = self.engine.predict_sync(
+                        pc.message_from_proto(pb.SeldonMessage.FromString(raw)))
+                else:
+                    out = self.engine.send_feedback_sync(
+                        pc.feedback_from_proto(pb.Feedback.FromString(raw)))
+                body = pc.message_to_proto(out).SerializeToString()
+            else:
+                payload = json.loads(bytes(frame[_REQ_HEADER.size:]))
+                if kind == KIND_PREDICT:
+                    out = self.engine.predict_sync(SeldonMessage.from_dict(payload))
+                elif kind == KIND_FEEDBACK:
+                    out = self.engine.send_feedback_sync(Feedback.from_dict(payload))
+                else:
+                    raise SeldonError(f"unknown IPC kind {kind}")
+                body = json.dumps(out.to_dict()).encode()
+            status = 0
+        except Exception as e:
+            if kind in (KIND_PROTO_PREDICT, KIND_PROTO_FEEDBACK):
+                http = int(getattr(e, "status_code", 500))
+                code = {400: 3, 503: 14, 504: 4}.get(http, 13)
+                body = bytes([code]) + str(e).encode()
+            else:
+                body = _error_body(
+                    str(e),
+                    getattr(e, "reason", "ENGINE_ERROR"),
+                    int(getattr(e, "status_code", 500)),
+                )
+            status = 1
+        ring = self.resp_rings.get(worker_id)
+        if ring is None:
+            logger.error("response for unknown worker %d dropped", worker_id)
+            return
+        try:
+            ring.push_wait(_RESP_HEADER.pack(req_id, status) + body, 5.0)
+        except PayloadTooLarge:
+            err = _error_body(
+                f"response too large for IPC slot "
+                f"({len(body)} bytes > {ring.slot_size - _RESP_HEADER.size})",
+                "RESPONSE_TOO_LARGE",
+                500,
+            )
+            try:
+                ring.push_wait(_RESP_HEADER.pack(req_id, 1) + err, 5.0)
+            except Exception:
+                logger.exception(
+                    "dropping oversized response %d for worker %d", req_id, worker_id)
+        except RingFull:
+            # jammed for the full timeout; the edge's deadline 504s this
+            # request — do not kill the drain thread
+            logger.error("response ring full; dropping response %d for worker %d",
+                         req_id, worker_id)
 
     async def _handle(self, frame: bytes) -> None:
         # No failure below may escape: serve_forever gathers these, so one bad

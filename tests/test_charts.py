@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 
 import pytest
 
@@ -125,3 +126,85 @@ def test_kustomize_base_points_at_raw_manifests():
 def test_chart_render_cli_lists_all_templates():
     rendered = render_chart(os.path.join(CHARTS_DIR, "seldon-core-tpu-operator"))
     assert [name for name, _ in rendered] == ["operator.yaml"]
+
+
+def test_model_chart_widened_values_flow():
+    """Round-4 values surface (reference seldon-single-model parity:
+    sdepLabels / predictorLabels / annotations / engine resources+env
+    passthrough) flows into the CR and validates."""
+    from seldon_core_tpu.contracts.graph import SeldonDeploymentSpec
+    from seldon_core_tpu.controlplane.validate import require_valid
+
+    docs = render_chart_docs(
+        os.path.join(CHARTS_DIR, "seldon-single-model"),
+        values={
+            "sdepLabels": {"app": "seldon", "team": "ranking"},
+            "predictorLabels": {"version": "v2"},
+            "annotations": {"seldon.io/rest-read-timeout": "5000",
+                            "seldon.io/grpc-max-message-size": "10485760"},
+            "replicas": 3,
+            "engine": {
+                "resources": {"requests": {"cpu": "2", "memory": "1Gi"}},
+                "env": [{"name": "SELDON_LOG_LEVEL", "value": "DEBUG"},
+                        {"name": "EXTRA", "value": "1"}],
+            },
+        })
+    cr = docs[0]
+    assert cr["metadata"]["labels"] == {"app": "seldon", "team": "ranking"}
+    p = cr["spec"]["predictors"][0]
+    assert p["labels"] == {"version": "v2"}
+    assert p["replicas"] == 3
+    assert cr["spec"]["annotations"]["seldon.io/grpc-max-message-size"] == "10485760"
+    assert p["svcOrchSpec"]["resources"]["requests"]["memory"] == "1Gi"
+    assert {e["name"] for e in p["svcOrchSpec"]["env"]} == {"SELDON_LOG_LEVEL", "EXTRA"}
+    require_valid(SeldonDeploymentSpec.from_dict(cr))
+    # the engine renderer actually consumes what the chart exposes
+    from seldon_core_tpu.controlplane.render import render_manifests
+
+    sdep = SeldonDeploymentSpec.from_dict(cr)
+    manifests = render_manifests(sdep, namespace="ns", tpu_chips=0)
+    dep = next(m for m in manifests if m["kind"] == "Deployment")
+    eng = dep["spec"]["template"]["spec"]["containers"][0]
+    assert eng["resources"]["requests"]["memory"] == "1Gi"
+    assert {"name": "EXTRA", "value": "1"} in eng["env"]
+
+
+def test_mab_chart_svcorch_values_flow():
+    docs = render_chart_docs(
+        os.path.join(CHARTS_DIR, "seldon-mab"),
+        values={"engine": {"resources": {"requests": {"cpu": "1"}},
+                           "env": [{"name": "A", "value": "b"}]},
+                "annotations": {"seldon.io/rest-read-timeout": "2000"}})
+    p = docs[0]["spec"]["predictors"][0]
+    assert p["svcOrchSpec"]["resources"]["requests"]["cpu"] == "1"
+    assert docs[0]["spec"]["annotations"]["seldon.io/rest-read-timeout"] == "2000"
+
+
+@pytest.mark.skipif(shutil.which("helm") is None, reason="no helm binary")
+@pytest.mark.parametrize("chart", [
+    "seldon-core-tpu-operator", "seldon-single-model", "seldon-abtest", "seldon-mab",
+])
+def test_stock_helm_agrees_with_subset_renderer(chart, tmp_path):
+    """When a real helm binary exists (the CI helm-parity job provides one),
+    `helm template` must produce byte-identical objects to the in-repo
+    subset renderer, and `helm lint` must pass — proving the charts are
+    stock-helm-valid, not just subset-renderer-valid."""
+    import subprocess
+
+    chart_dir = os.path.join(CHARTS_DIR, chart)
+    lint = subprocess.run(["helm", "lint", chart_dir], capture_output=True, text=True)
+    assert lint.returncode == 0, lint.stdout + lint.stderr
+    out = subprocess.run(
+        ["helm", "template", "seldon", chart_dir, "--namespace", "seldon-system"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    import yaml
+
+    helm_docs = [d for d in yaml.safe_load_all(out.stdout) if d is not None]
+    ours = render_chart_docs(chart_dir)
+    # helm template skips crds/; our renderer does too (templates/ only)
+    def key(d):
+        return (d.get("kind"), d.get("metadata", {}).get("name"))
+
+    assert sorted(helm_docs, key=lambda d: str(key(d))) == \
+        sorted(ours, key=lambda d: str(key(d)))

@@ -254,13 +254,14 @@ def test_keepalive_many_requests(edge):
 
 
 def test_fallback_mode_serves_python_engine(tmp_path):
-    """A graph the edge cannot compile (a SEEDED bandit router — the numpy
-    RNG sequence can only be replayed by the Python engine) is served by the
-    Python engine behind the shared-memory ring, edge as frontend."""
+    """A graph the edge cannot compile (a SEEDED Thompson router — Beta
+    variate replay is Python-only; seeded epsilon-greedy/AB-test are native
+    now) is served by the Python engine behind the shared-memory ring, edge
+    as frontend."""
     spec = {
         "name": "p",
         "graph": {
-            "name": "eg", "type": "ROUTER", "implementation": "EPSILON_GREEDY",
+            "name": "eg", "type": "ROUTER", "implementation": "THOMPSON_SAMPLING",
             "parameters": [{"name": "n_branches", "value": "2", "type": "INT"},
                            {"name": "seed", "value": "7", "type": "INT"}],
             "children": [
@@ -286,7 +287,7 @@ def test_fallback_mode_serves_python_engine(tmp_path):
         status, got = post(port, "/api/v0.1/predictions", {"data": {"ndarray": [[1.0]]}}, timeout=30)
         assert status == 200
         assert got["meta"]["routing"]["eg"] in (0, 1)
-        assert got["meta"]["tags"]["bandit"] == "EpsilonGreedy"
+        assert got["meta"]["tags"]["bandit"] == "ThompsonSampling"
         assert got["data"]["ndarray"][0] == pytest.approx([0.1, 0.9, 0.5], rel=1e-6)
     finally:
         proc.terminate()
@@ -393,10 +394,21 @@ def test_bandit_compiles_native():
     for spec in (EG_EXPLOIT, TS_SPEC):
         prog = compile_edge_program(PredictorSpec.from_dict(spec))
         assert prog is not None and prog["native"]
-    # seeded -> Python engine fallback (numpy RNG replay)
+    # seeded epsilon-greedy compiles NATIVE (the edge replays numpy's PCG64
+    # bit-exactly — native/np_rng.h); seeded Thompson still falls back (Beta
+    # variate replay not implemented)
     seeded = json.loads(json.dumps(EG_EXPLOIT))
     seeded["graph"]["parameters"].append({"name": "seed", "value": "3", "type": "INT"})
-    assert compile_edge_program(PredictorSpec.from_dict(seeded)) is None
+    prog = compile_edge_program(PredictorSpec.from_dict(seeded))
+    assert prog is not None and prog["native"]
+    assert prog["units"][prog["root"]]["seed"] == 3
+    seeded_ts = json.loads(json.dumps(TS_SPEC))
+    seeded_ts["graph"]["parameters"].append({"name": "seed", "value": "3", "type": "INT"})
+    assert compile_edge_program(PredictorSpec.from_dict(seeded_ts)) is None
+    # seeds outside [0, 2^53) keep Python semantics (program JSON is doubles)
+    big = json.loads(json.dumps(EG_EXPLOIT))
+    big["graph"]["parameters"].append({"name": "seed", "value": str(2**60), "type": "INT"})
+    assert compile_edge_program(PredictorSpec.from_dict(big)) is None
     # invalid params -> fallback so the Python engine raises the build error
     bad = json.loads(json.dumps(EG_EXPLOIT))
     bad["graph"]["parameters"][1] = {"name": "epsilon", "value": "1.5", "type": "FLOAT"}
@@ -544,3 +556,59 @@ def test_bandit_foreign_params_stay_native():
     ts["graph"]["parameters"].append({"name": "epsilon", "value": "1.5", "type": "FLOAT"})
     prog = compile_edge_program(PredictorSpec.from_dict(ts))
     assert prog is not None and prog["native"]
+
+
+def _seeded_spec(impl, name, seed, n_branches=3, extra=()):
+    children = [{"name": f"m{i}", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+                for i in range(n_branches)]
+    return {"name": "p", "graph": {
+        "name": name, "type": "ROUTER", "implementation": impl,
+        "parameters": [{"name": "n_branches", "value": str(n_branches), "type": "INT"},
+                       {"name": "seed", "value": str(seed), "type": "INT"},
+                       *extra],
+        "children": children}}
+
+
+@pytest.mark.parametrize("impl,name,extra", [
+    ("EPSILON_GREEDY", "eg", ({"name": "epsilon", "value": "0.6", "type": "FLOAT"},)),
+    ("RANDOM_ABTEST", "ab", ()),
+])
+def test_seeded_router_native_routing_parity(edge, impl, name, extra):
+    """A SEEDED router graph served natively must reproduce the Python
+    engine's routing decisions request-for-request — the edge replays
+    numpy's PCG64 (epsilon-greedy) / CPython's MT19937 (AB-test) streams
+    bit-exactly, including through feedback-driven state changes."""
+    import asyncio as aio
+
+    from seldon_core_tpu.contracts.payload import Feedback
+    from seldon_core_tpu.runtime.engine import GraphEngine
+
+    spec = _seeded_spec(impl, name, seed=11, extra=list(extra))
+    prog = compile_edge_program(PredictorSpec.from_dict(spec))
+    assert prog is not None and prog["native"], impl
+    port = edge(f"seeded_{name}", spec)
+    oracle = GraphEngine(PredictorSpec.from_dict(spec))
+    req = {"data": {"ndarray": [[1.0]]}}
+
+    def oracle_route():
+        out = oracle.predict_sync(SeldonMessage.from_dict(json.loads(json.dumps(req))))
+        return out.to_dict()["meta"]["routing"][name]
+
+    def edge_route():
+        status, body = post(port, "/api/v0.1/predictions", req)
+        assert status == 200
+        return body["meta"]["routing"][name]
+
+    seq_native = [edge_route() for _ in range(40)]
+    seq_oracle = [oracle_route() for _ in range(40)]
+    assert seq_native == seq_oracle
+    if impl == "EPSILON_GREEDY":
+        # feedback flips the exploit arm on BOTH sides; the streams must
+        # stay aligned through the state change
+        fb = {"request": req, "response": {"meta": {"routing": {name: 2}}},
+              "reward": 1.0}
+        for _ in range(3):
+            assert post(port, "/api/v0.1/feedback", fb)[0] == 200
+            aio.run(oracle.send_feedback(
+                Feedback.from_dict(json.loads(json.dumps(fb)))))
+        assert [edge_route() for _ in range(30)] == [oracle_route() for _ in range(30)]

@@ -96,3 +96,63 @@ def test_multiprocess_producers(tmp_path):
     ring.close()
     for w in range(workers):
         assert seen[w] == list(range(n_per))  # per-producer FIFO
+
+
+def test_np_rng_parity_numpy_and_cpython():
+    """The native seeded-router RNG replays (native/np_rng.h, exposed via
+    ctypes hooks in ring.cc) must match numpy's default_rng and CPython's
+    random.Random DRAW-FOR-DRAW, including numpy's buffered-uint32
+    interleaving between random() and integers() — this is the proof that
+    lets seeded routers compile to the native edge."""
+    import ctypes
+    import random as pyrandom
+
+    from seldon_core_tpu.native.staging import build_native
+
+    lib = ctypes.CDLL(build_native())
+    protos = [
+        ("np_rng_new", ctypes.c_void_p, [ctypes.c_uint64]),
+        ("np_rng_free", None, [ctypes.c_void_p]),
+        ("np_rng_random", ctypes.c_double, [ctypes.c_void_p]),
+        ("np_rng_next64", ctypes.c_uint64, [ctypes.c_void_p]),
+        ("np_rng_integers", ctypes.c_uint64, [ctypes.c_void_p, ctypes.c_uint64]),
+        ("py_rng_new", ctypes.c_void_p, [ctypes.c_uint64]),
+        ("py_rng_free", None, [ctypes.c_void_p]),
+        ("py_rng_random", ctypes.c_double, [ctypes.c_void_p]),
+        ("py_rng_randrange", ctypes.c_uint64, [ctypes.c_void_p, ctypes.c_uint64]),
+    ]
+    for fname, res, args in protos:
+        f = getattr(lib, fname)
+        f.restype = res
+        f.argtypes = args
+
+    for seed in (0, 7, 3, 123456789, 2**40 + 17, 2**52 + 1):
+        h = lib.np_rng_new(seed)
+        ref = np.random.default_rng(seed)
+        assert [lib.np_rng_next64(h) for _ in range(8)] == [
+            int(x) for x in ref.integers(0, 2**64, 8, dtype=np.uint64)
+        ], seed
+        lib.np_rng_free(h)
+
+    # interleaved random()/integers() across bucket sizes (exercises the
+    # Lemire path, the power-of-two path, and the uint32 buffer carry)
+    h = lib.np_rng_new(7)
+    ref = np.random.default_rng(7)
+    for i in range(5000):
+        if i % 3 == 0:
+            n = 2 + i % 7
+            assert lib.np_rng_integers(h, n) == int(ref.integers(n)), i
+        else:
+            assert lib.np_rng_random(h) == float(ref.random()), i
+    lib.np_rng_free(h)
+
+    for seed in (0, 7, 3, 987654321, 2**41 + 5):
+        h = lib.py_rng_new(seed)
+        ref2 = pyrandom.Random(seed)
+        for i in range(3000):
+            if i % 3 == 0:
+                n = 2 + i % 7
+                assert lib.py_rng_randrange(h, n) == ref2.randrange(n), (seed, i)
+            else:
+                assert lib.py_rng_random(h) == ref2.random(), (seed, i)
+        lib.py_rng_free(h)

@@ -1,0 +1,120 @@
+"""A NON-PYTHON graph node, end to end: the C++ microservice in
+examples/remote_node_cpp implements the wire contract (the reference's
+nodejs wrapper role, `wrappers/s2i/nodejs/microservice.js:1-147`), and the
+engine drives it through a unit's `endpoint` field — proving a second
+language joins a graph as a first-class node, not just as documentation."""
+
+import asyncio
+import os
+import shutil
+import socket
+import subprocess
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.contracts.graph import PredictorSpec
+from seldon_core_tpu.contracts.payload import SeldonError, SeldonMessage
+from seldon_core_tpu.runtime.engine import GraphEngine
+
+SRC = os.path.join(os.path.dirname(__file__), "..", "examples",
+                   "remote_node_cpp", "remote_node.cc")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def remote_node(tmp_path_factory):
+    binary = str(tmp_path_factory.mktemp("rn") / "remote_node")
+    subprocess.run(["g++", "-O2", "-std=c++17", SRC, "-o", binary], check=True)
+    port = _free_port()
+    proc = subprocess.Popen([binary, str(port)], stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, "remote_node died"
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/ready", timeout=1.0) as r:
+                if r.status == 200:
+                    break
+        except Exception:
+            time.sleep(0.05)
+    else:
+        raise AssertionError("remote_node never became ready")
+    yield port, proc
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _engine_for(port):
+    spec = {
+        "name": "p",
+        "graph": {
+            "name": "root", "type": "MODEL", "implementation": "SIMPLE_MODEL",
+            "children": [{
+                "name": "cpp", "type": "MODEL",
+                "endpoint": {"service_host": "127.0.0.1",
+                             "service_port": port, "type": "REST"},
+            }],
+        },
+    }
+    return GraphEngine(PredictorSpec.from_dict(spec))
+
+
+def test_cpp_node_joins_graph(remote_node):
+    port, _ = remote_node
+    engine = _engine_for(port)
+    assert engine.has_async_nodes  # remote nodes keep the async engine path
+    msg = SeldonMessage.from_dict({"data": {"ndarray": [[1.5, -2.0], [0.0, 4.0]]}})
+    out = asyncio.run(engine.predict(msg))
+    d = out.to_dict()
+    # SIMPLE_MODEL feeds [0.1, 0.9, 0.5]-ish output into the C++ doubler;
+    # the chain's final payload is the C++ node's 2x with its names
+    assert d["data"]["names"] == ["c0", "c1", "c2"]
+    np.testing.assert_allclose(
+        np.asarray(d["data"]["ndarray"]),
+        2.0 * np.asarray([[0.1, 0.9, 0.5], [0.1, 0.9, 0.5]]), rtol=1e-6)
+    assert d["meta"]["requestPath"]["cpp"] == "RemoteComponent"
+
+
+def test_cpp_node_direct_contract(remote_node):
+    """The node's own wire behavior: predict doubles, bad payloads 400."""
+    import json
+
+    port, _ = remote_node
+    body = json.dumps({"data": {"ndarray": [[3.0, 5.0]]}}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        out = json.loads(r.read())
+    assert out["data"]["ndarray"] == [[6.0, 10.0]]
+    bad = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=b'{"strData": "x"}',
+        method="POST")
+    try:
+        urllib.request.urlopen(bad, timeout=5)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert json.loads(e.read())["status"]["reason"] == "MICROSERVICE_BAD_DATA"
+
+
+def test_cpp_node_down_gives_remote_unavailable():
+    """Retry/503 path: a dead endpoint surfaces REMOTE_NODE_UNAVAILABLE."""
+    engine = _engine_for(_free_port())  # nothing listening
+    msg = SeldonMessage.from_dict({"data": {"ndarray": [[1.0]]}})
+    with pytest.raises(SeldonError) as e:
+        asyncio.run(engine.predict(msg))
+    assert e.value.status_code == 503
+    assert "unreachable" in str(e.value)

@@ -1,0 +1,130 @@
+"""Model-artifact fetcher (seldon_core_tpu/storage): scheme dispatch, local
+paths, and the Azure blob scheme against a fake SDK (the reference's
+storage.py:109-128 capability — no cloud account needed to prove the
+download/layout logic)."""
+
+import os
+import sys
+import types
+
+import pytest
+
+from seldon_core_tpu import storage
+from seldon_core_tpu.storage import StorageError
+
+
+def test_local_path_passthrough(tmp_path):
+    d = tmp_path / "model"
+    d.mkdir()
+    (d / "weights.bin").write_bytes(b"w")
+    assert storage.download(str(d)) == str(d)
+    assert storage.download(f"file://{d}") == str(d)
+
+
+def test_local_copy_to_out_dir(tmp_path):
+    d = tmp_path / "model"
+    d.mkdir()
+    (d / "weights.bin").write_bytes(b"w")
+    out = tmp_path / "out"
+    got = storage.download(str(d), out_dir=str(out))
+    assert os.path.exists(os.path.join(got, "weights.bin"))
+
+
+def test_missing_local_path_raises(tmp_path):
+    with pytest.raises(StorageError, match="does not exist"):
+        storage.download(str(tmp_path / "nope"))
+
+
+def test_unsupported_scheme_raises():
+    with pytest.raises(StorageError, match="Unsupported model URI scheme"):
+        storage.download("ftp://host/model")
+
+
+class _FakeBlob:
+    def __init__(self, name):
+        self.name = name
+
+
+class _FakeDownload:
+    def __init__(self, data):
+        self._data = data
+
+    def readinto(self, f):
+        f.write(self._data)
+        return len(self._data)
+
+
+class _FakeContainerClient:
+    """Mimics azure.storage.blob.ContainerClient for list/download."""
+
+    blobs = {}
+    created = []
+
+    def __init__(self, account_url=None, container_name=None):
+        type(self).created.append({"account_url": account_url,
+                                   "container": container_name})
+        self.container = container_name
+
+    @classmethod
+    def from_connection_string(cls, conn, container_name=None):
+        inst = cls(account_url=f"conn:{conn}", container_name=container_name)
+        return inst
+
+    def list_blobs(self, name_starts_with=""):
+        return [_FakeBlob(n) for n in sorted(self.blobs)
+                if n.startswith(name_starts_with)]
+
+    def download_blob(self, name):
+        return _FakeDownload(self.blobs[name])
+
+
+@pytest.fixture
+def fake_azure(monkeypatch):
+    mod = types.ModuleType("azure.storage.blob")
+    mod.ContainerClient = _FakeContainerClient
+    azure = types.ModuleType("azure")
+    azure_storage = types.ModuleType("azure.storage")
+    monkeypatch.setitem(sys.modules, "azure", azure)
+    monkeypatch.setitem(sys.modules, "azure.storage", azure_storage)
+    monkeypatch.setitem(sys.modules, "azure.storage.blob", mod)
+    _FakeContainerClient.blobs = {}
+    _FakeContainerClient.created = []
+    return _FakeContainerClient
+
+
+def test_azure_blob_download(fake_azure, tmp_path, monkeypatch):
+    monkeypatch.delenv("AZURE_STORAGE_CONNECTION_STRING", raising=False)
+    fake_azure.blobs = {
+        "models/llm/config.json": b"{}",
+        "models/llm/params/weights.bin": b"abc",
+        "other/skip.bin": b"no",
+    }
+    uri = "https://acct.blob.core.windows.net/cont/models/llm"
+    got = storage.download(uri, out_dir=str(tmp_path / "out"))
+    assert open(os.path.join(got, "config.json")).read() == "{}"
+    assert open(os.path.join(got, "params/weights.bin"), "rb").read() == b"abc"
+    assert not os.path.exists(os.path.join(got, "skip.bin"))
+    # anonymous client hit the account URL with the right container
+    assert fake_azure.created[0] == {
+        "account_url": "https://acct.blob.core.windows.net", "container": "cont"}
+
+
+def test_azure_blob_connection_string(fake_azure, tmp_path, monkeypatch):
+    monkeypatch.setenv("AZURE_STORAGE_CONNECTION_STRING", "cs=1")
+    fake_azure.blobs = {"m/weights.bin": b"w"}
+    storage.download("https://acct.blob.core.windows.net/c/m",
+                     out_dir=str(tmp_path / "out"))
+    assert fake_azure.created[0]["account_url"] == "conn:cs=1"
+
+
+def test_azure_blob_empty_prefix_raises(fake_azure, tmp_path, monkeypatch):
+    monkeypatch.delenv("AZURE_STORAGE_CONNECTION_STRING", raising=False)
+    with pytest.raises(StorageError, match="No blobs found"):
+        storage.download("https://acct.blob.core.windows.net/cont/nothing",
+                         out_dir=str(tmp_path / "out"))
+
+
+def test_azure_blob_needs_container(fake_azure, tmp_path):
+    with pytest.raises(StorageError, match="needs a container"):
+        storage.download("https://acct.blob.core.windows.net/",
+                         out_dir=str(tmp_path / "out"))
