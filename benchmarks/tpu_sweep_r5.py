@@ -1,0 +1,167 @@
+"""Round-5 same-session TPU A/B: Pallas fused residual chains vs XLA.
+
+Measures, in ONE chip session (cross-session variance is 9-16%, so only
+in-session deltas count — benchmarks/MFU_NOTES.md):
+  1. folded-BN XLA baseline (bench.py recipe, median of N)
+  2. resnet_serve_forward pure-XLA (sanity: must match 1 within noise)
+  3. resnet_serve_forward with Pallas chains per stage subset
+  4. identity-chain microbench: 2-block 56x56x256 chain, XLA vs Pallas
+
+Appends JSON rows (r5-*) to tpu_sweep_results.jsonl.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RESULTS = "/root/repo/benchmarks/tpu_sweep_results.jsonl"
+BATCH = 128
+ITERS = 30
+WARMUP = 2
+REPEATS = 5
+
+
+def log(row):
+    row = {"tag": row.pop("tag"), **row}
+    with open(RESULTS, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(json.dumps(row), flush=True)
+
+
+def timed_serve(fn, pool, iters=ITERS, warmup=WARMUP, repeats=REPEATS):
+    """bench.py recipe: scan-chained iters, median of repeats after warmup."""
+
+    @partial(jax.jit, static_argnums=1)
+    def serve_loop(pool, iters):
+        def body(x, _):
+            logits = fn(x)
+            x = x * (1.0 + 1e-12 * jnp.mean(logits).astype(x.dtype))
+            return x, jnp.mean(logits)
+
+        _, means = jax.lax.scan(body, pool, None, length=iters)
+        return means
+
+    np.asarray(serve_loop(pool, iters))  # compile
+    for _ in range(warmup):
+        np.asarray(serve_loop(pool, iters))
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.asarray(serve_loop(pool, iters))
+        times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    return BATCH * iters / med, 1e3 * med / iters, 100.0 * (max(times) - min(times)) / med
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, flush=True)
+
+    from seldon_core_tpu.models import get_model
+    from seldon_core_tpu.models.resnet import fold_batchnorm
+    from seldon_core_tpu.models.resnet_infer import resnet_serve_forward
+    from seldon_core_tpu.ops.fused_resnet import (
+        folded_block_params,
+        fused_identity_chain,
+        identity_chain_ref,
+    )
+
+    model = get_model("resnet50", fused=True)
+    init_model = get_model("resnet50")
+    x0 = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = fold_batchnorm(jax.jit(init_model.init)(jax.random.PRNGKey(0), x0))
+    pool = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(0).standard_normal(
+                (BATCH, 224, 224, 3), dtype=np.float32
+            )
+        ).astype(jnp.bfloat16),
+        dev,
+    )
+
+    # 1. folded XLA baseline (flax apply — same graph bench.py serves)
+    imgs, ms, spread = timed_serve(
+        lambda x: model.apply(variables, x, train=False), pool
+    )
+    log({"tag": "r5-folded-xla-b128", "imgs_per_s": round(imgs, 1),
+         "ms_per_batch": round(ms, 3), "spread_pct": round(spread, 1)})
+    base = imgs
+
+    # 2. serve-forward pure XLA (sanity)
+    imgs, ms, spread = timed_serve(
+        lambda x: resnet_serve_forward(variables, x), pool
+    )
+    log({"tag": "r5-serveforward-xla-b128", "imgs_per_s": round(imgs, 1),
+         "ms_per_batch": round(ms, 3), "spread_pct": round(spread, 1),
+         "vs_folded": round(imgs / base, 3)})
+
+    # 3. pallas stage subsets
+    for stages in [(0,), (0, 1), (0, 1, 2, 3)]:
+        tag = "r5-serveforward-pallas-s" + "".join(map(str, stages))
+        try:
+            imgs, ms, spread = timed_serve(
+                lambda x, s=tuple(stages): resnet_serve_forward(
+                    variables, x, pallas_stages=s
+                ),
+                pool,
+            )
+            log({"tag": tag, "imgs_per_s": round(imgs, 1),
+                 "ms_per_batch": round(ms, 3), "spread_pct": round(spread, 1),
+                 "vs_folded": round(imgs / base, 3)})
+        except Exception as e:  # noqa: BLE001 — record compile failures as data
+            log({"tag": tag, "error": repr(e)[:500]})
+
+    # 4. chain microbench: stage-1 identity pair on its real shapes
+    blocks = [
+        folded_block_params(variables["params"][f"BottleneckBlock_{j}"])
+        for j in (1, 2)
+    ]
+    xs = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(1).standard_normal((BATCH, 56, 56, 256)),
+        ).astype(jnp.bfloat16),
+        dev,
+    )
+
+    def micro(fn, tag, iters=50):
+        # scan-chained like timed_serve: per-call dispatch over the ~75ms
+        # tunnel RTT would measure the tunnel, not the chain
+        @partial(jax.jit, static_argnums=1)
+        def loop(x, iters):
+            def body(x, _):
+                return fn(x), ()
+
+            y, _ = jax.lax.scan(body, x, None, length=iters)
+            return y
+
+        try:
+            jax.block_until_ready(loop(xs, iters))
+            for _ in range(WARMUP):
+                jax.block_until_ready(loop(xs, iters))
+            times = []
+            for _ in range(7):
+                t0 = time.perf_counter()
+                jax.block_until_ready(loop(xs, iters))
+                times.append(time.perf_counter() - t0)
+            med = float(np.median(times)) * 1e3 / iters
+            # min traffic: read+write x once = 2*B*56*56*256*2 bytes
+            gb = 2 * BATCH * 56 * 56 * 256 * 2 / 1e9
+            log({"tag": tag, "ms": round(med, 3),
+                 "effective_GBps": round(gb / (med / 1e3), 1)})
+            return med
+        except Exception as e:  # noqa: BLE001
+            log({"tag": tag, "error": repr(e)[:500]})
+            return None
+
+    micro(lambda x: identity_chain_ref(x, blocks), "r5-chain2-xla-56x56")
+    micro(lambda x: fused_identity_chain(x, blocks), "r5-chain2-pallas-56x56")
+
+
+if __name__ == "__main__":
+    main()
