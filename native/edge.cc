@@ -644,6 +644,8 @@ struct Metrics {
   Histo mytimer;
   uint64_t custom_seen = 0;
 
+  uint64_t shed_total = 0;  // overload-shed predictions (429/RESOURCE_EXHAUSTED)
+
   void observe_api(const char* method, int code, double secs) {
     char key[64];
     snprintf(key, sizeof(key), "%s|%d", method, code);
@@ -663,6 +665,13 @@ struct Metrics {
     b.push('}');
   }
   void expose(Buf& b) {
+    b.append("# HELP seldon_edge_shed_total predictions shed under overload (HTTP 429 / gRPC RESOURCE_EXHAUSTED)\n");
+    b.append("# TYPE seldon_edge_shed_total counter\n");
+    b.append("seldon_edge_shed_total");
+    labels(b);
+    b.push(' ');
+    b.append_double((double)shed_total);
+    b.push('\n');
     b.append("# HELP seldon_api_executor_server_requests_total API requests by method and code\n");
     b.append("# TYPE seldon_api_executor_server_requests_total counter\n");
     for (auto& [key, count] : api) {
@@ -1665,6 +1674,7 @@ struct Server {
   Metrics metrics;
   Rng rng;
   bool paused = false;
+  size_t max_inflight = 4096;  // overload-shed threshold (--max-inflight)
   std::string openapi;  // served at /seldon.json when provided
 
   // ring fallback
@@ -1732,10 +1742,30 @@ struct Server {
                        : code == 404 ? "Not Found"
                        : code == 405 ? "Method Not Allowed"
                        : code == 413 ? "Payload Too Large"
+                       : code == 429 ? "Too Many Requests"
                        : code == 503 ? "Service Unavailable"
                        : code == 504 ? "Gateway Timeout"
                                      : "Internal Server Error";
     respond(c, code, text, {body.data(), body.size()});
+  }
+
+  // ---- overload shed ----
+  // Deterministic load-shed past the knee (the reference degrades via
+  // bounded Tomcat pools, RestClientController.java:120-132; the edge's
+  // equivalent is a bound on parked in-flight work). When the total parked
+  // population reaches --max-inflight, new predictions get an immediate
+  // HTTP 429 / gRPC RESOURCE_EXHAUSTED instead of joining a queue that can
+  // only grow — responses stay well-formed at any offered load, and the
+  // shed count is visible in /metrics (seldon_edge_shed_total).
+  bool overloaded() const {
+    return pending.size() + pending_dev.size() + pending_grpc.size() >=
+           max_inflight;
+  }
+  void shed_http(Conn& c, uint64_t t0) {
+    ++metrics.shed_total;
+    respond_error(c, 429, "RESOURCE_EXHAUSTED",
+                  "in-flight request limit reached; retry later");
+    metrics.observe_api("predictions", 429, 1e-9 * (now_ns() - t0));
   }
 
   // ---- predictions ----
@@ -1744,6 +1774,10 @@ struct Server {
       respond(c, 503, "Service Unavailable",
               "{\"status\": {\"code\": 503, \"info\": \"paused\", \"status\": \"FAILURE\"}}");
       metrics.observe_api("predictions", 503, 1e-9 * (now_ns() - t0));
+      return;
+    }
+    if (overloaded()) {
+      shed_http(c, t0);
       return;
     }
     if (!prog.native) {
@@ -3929,6 +3963,13 @@ struct Server {
       metrics.observe_api(method, 503, 1e-9 * (now_ns() - t0));
       return;
     }
+    if (!is_feedback && overloaded()) {
+      ++metrics.shed_total;
+      grpc_trailers_error(c, sid, 8,  // RESOURCE_EXHAUSTED
+                          "in-flight request limit reached; retry later");
+      metrics.observe_api(method, 429, 1e-9 * (now_ns() - t0));
+      return;
+    }
     std::string_view data{s.data.data(), s.data.size()};
     if (data.size() < 5 || data[0] != 0) {
       grpc_trailers_error(c, sid, 13, "bad gRPC frame");
@@ -4540,6 +4581,7 @@ int main(int argc, char** argv) {
   int grpc_port = 0;
   int workers = 1;
   int ring_worker = 0;
+  int max_inflight = 4096;
   for (int i = 1; i < argc; ++i) {
     std::string_view a = argv[i];
     auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : nullptr; };
@@ -4551,6 +4593,7 @@ int main(int argc, char** argv) {
     else if (a == "--ring-worker") ring_worker = atoi(next());
     else if (a == "--openapi") openapi_path = next();
     else if (a == "--workers") workers = atoi(next());
+    else if (a == "--max-inflight") max_inflight = atoi(next());
     else {
       fprintf(stderr, "unknown arg %s\n", argv[i]);
       return 2;
@@ -4574,6 +4617,9 @@ int main(int argc, char** argv) {
   Server srv;
   srv.rng.seed();
   srv.init_grpc_constants();
+  // --max-inflight 0 disables shedding entirely (unbounded parked work).
+  srv.max_inflight =
+      max_inflight > 0 ? (size_t)max_inflight : (size_t)-1;
   if (!load_program(program_path, srv.prog)) {
     fprintf(stderr, "cannot load program %s\n", program_path);
     return 1;

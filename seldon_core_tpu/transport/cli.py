@@ -225,6 +225,7 @@ def run_edge(args: argparse.Namespace) -> None:
         argv = [
             EDGE_BINARY, "--program", prog_path, "--port", str(port),
             "--openapi", openapi_path, "--workers", str(args.workers),
+            "--max-inflight", str(args.max_inflight),
         ]
         if grpc_port:
             argv += ["--grpc-port", str(grpc_port)]
@@ -296,6 +297,7 @@ def run_edge(args: argparse.Namespace) -> None:
             [
                 EDGE_BINARY, "--program", prog_path, "--port", str(port),
                 "--ring", base, "--ring-worker", str(w), "--openapi", openapi_path,
+                "--max-inflight", str(args.max_inflight),
             ] + edge_argv_tail
         )
         for w in range(n_workers)
@@ -586,6 +588,9 @@ def main(argv: Optional[list] = None) -> None:
                       help="gRPC port (default env ENGINE_SERVER_GRPC_PORT; "
                            "native for builtin graphs, Python engine otherwise)")
     edge.add_argument("--workers", type=int, default=1, help="SO_REUSEPORT event loops")
+    edge.add_argument("--max-inflight", type=int, default=4096,
+                      help="overload-shed threshold: parked in-flight predictions "
+                           "beyond this get HTTP 429 / gRPC RESOURCE_EXHAUSTED")
     edge.add_argument("--ipc-base", default=None, help="ring path base for fallback mode")
     edge.set_defaults(func=run_edge)
 
