@@ -225,7 +225,10 @@ int scr_pop(void* handle, void* out, uint32_t out_cap) {
 
 // Batched drain: pops up to max_items payloads into out, packed as
 // [u32 len][payload]... back to back. Returns the number of frames popped
-// (0 when empty); *bytes_used receives the total packed size. Stops early
+// (0 when empty), or -3 when the ring is non-empty but the FIRST pending
+// frame exceeds out_cap (matching scr_pop) — without the distinct code an
+// undersized caller would spin forever on "0 popped" with no way to tell
+// it from empty. *bytes_used receives the total packed size. Stops early
 // when the next payload would not fit in out_cap (item left in place).
 // One FFI round-trip replaces max_items ctypes calls on the Python side —
 // at ~1.5us per ctypes crossing that is most of the per-frame drain cost
@@ -237,6 +240,7 @@ int scr_pop_many(void* handle, void* out, uint32_t out_cap, uint32_t max_items,
   uint8_t* dst = static_cast<uint8_t*>(out);
   uint32_t off = 0;
   uint32_t count = 0;
+  bool first_too_big = false;
   while (count < max_items) {
     uint64_t pos = h->dequeue_pos.load(std::memory_order_relaxed);
     CellHeader* cell;
@@ -246,7 +250,10 @@ int scr_pop_many(void* handle, void* out, uint32_t out_cap, uint32_t max_items,
       uint64_t seq = cell->seq.load(std::memory_order_acquire);
       intptr_t dif = static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos + 1);
       if (dif == 0) {
-        if (off + 4 + cell->len > out_cap) break;  // no room: leave in place
+        if (off + 4 + cell->len > out_cap) {  // no room: leave in place
+          if (count == 0) first_too_big = true;
+          break;
+        }
         if (h->dequeue_pos.compare_exchange_weak(pos, pos + 1,
                                                  std::memory_order_relaxed)) {
           got = true;
@@ -267,6 +274,7 @@ int scr_pop_many(void* handle, void* out, uint32_t out_cap, uint32_t max_items,
     ++count;
   }
   if (bytes_used) *bytes_used = off;
+  if (count == 0 && first_too_big) return -3;
   return static_cast<int>(count);
 }
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import inspect
 import os
-from typing import Any, List, Optional, Sequence
+from typing import Any, Awaitable, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -50,6 +50,21 @@ def _coerce_raw(component: Any, result: Any, request: Optional[SeldonMessage], i
     return construct_response(component, is_request, request, result)
 
 
+def _respond(component: Any, is_request: bool, request, result):
+    """construct_response that tolerates a *sync* component method returning
+    an awaitable (async __call__ objects, sync defs delegating to async
+    impls — the shapes iscoroutinefunction cannot see): the awaitable is
+    awaited first, so it reaches the payload coercion as a value, and the
+    caller gets an awaitable it already knows how to handle (every transport
+    and the engine's _call await awaitable dispatch results)."""
+    if inspect.isawaitable(result):
+        async def _await():
+            return construct_response(component, is_request, request, await result)
+
+        return _await()
+    return construct_response(component, is_request, request, result)
+
+
 def predict(component: Any, request: SeldonMessage):
     """Returns a SeldonMessage — or, when the request joins a shared
     continuous batch from async code, an Awaitable[SeldonMessage] (every
@@ -62,7 +77,7 @@ def predict(component: Any, request: SeldonMessage):
         return batched
     payload = request.payload()
     result = client_predict(component, payload, request.names, meta=request.meta.to_dict())
-    return construct_response(component, False, request, result)
+    return _respond(component, False, request, result)
 
 
 def _maybe_continuous_batch(component: Any, request: SeldonMessage):
@@ -114,23 +129,23 @@ def _maybe_continuous_batch(component: Any, request: SeldonMessage):
     return run()
 
 
-def transform_input(component: Any, request: SeldonMessage) -> SeldonMessage:
+def transform_input(component: Any, request: SeldonMessage) -> Union[SeldonMessage, Awaitable[SeldonMessage]]:
     if has_raw(component, "transform_input"):
         return _coerce_raw(component, component.transform_input_raw(request), request, is_request=True)
     payload = request.payload()
     result = client_transform_input(component, payload, request.names, meta=request.meta.to_dict())
-    return construct_response(component, True, request, result)
+    return _respond(component, True, request, result)
 
 
-def transform_output(component: Any, request: SeldonMessage) -> SeldonMessage:
+def transform_output(component: Any, request: SeldonMessage) -> Union[SeldonMessage, Awaitable[SeldonMessage]]:
     if has_raw(component, "transform_output"):
         return _coerce_raw(component, component.transform_output_raw(request), request, is_request=False)
     payload = request.payload()
     result = client_transform_output(component, payload, request.names, meta=request.meta.to_dict())
-    return construct_response(component, False, request, result)
+    return _respond(component, False, request, result)
 
 
-def route(component: Any, request: SeldonMessage) -> SeldonMessage:
+def route(component: Any, request: SeldonMessage) -> Union[SeldonMessage, Awaitable[SeldonMessage]]:
     """Returns a 1x1 ndarray-encoded branch index, as the reference does
     (`seldon_methods.py:159-189`); the index must be an int >= -1."""
     if has_raw(component, "route"):
@@ -147,6 +162,15 @@ def route(component: Any, request: SeldonMessage) -> SeldonMessage:
         return msg
     payload = request.payload()
     branch = client_route(component, payload, request.names)
+    if inspect.isawaitable(branch):  # sync def returning an awaitable
+        async def _await():
+            return _route_response(component, request, await branch)
+
+        return _await()
+    return _route_response(component, request, branch)
+
+
+def _route_response(component: Any, request: SeldonMessage, branch) -> SeldonMessage:
     if not isinstance(branch, int) or isinstance(branch, bool):
         raise SeldonError("Routing response must be an integer")
     if branch < -1:
@@ -175,7 +199,7 @@ def extract_route(msg: SeldonMessage) -> int:
     raise SeldonError("Routing response must contain a single integer")
 
 
-def aggregate(component: Any, requests: SeldonMessageList) -> SeldonMessage:
+def aggregate(component: Any, requests: SeldonMessageList) -> Union[SeldonMessage, Awaitable[SeldonMessage]]:
     if has_raw(component, "aggregate"):
         return _coerce_raw(component, component.aggregate_raw(requests.messages), None, is_request=False)
     arrays: List[np.ndarray] = []
@@ -185,10 +209,10 @@ def aggregate(component: Any, requests: SeldonMessageList) -> SeldonMessage:
         names.append(m.names)
     result = client_aggregate(component, arrays, names)
     first = requests.messages[0] if requests.messages else None
-    return construct_response(component, False, first, result)
+    return _respond(component, False, first, result)
 
 
-def send_feedback(component: Any, feedback: Feedback, unit_id: Optional[str] = None) -> SeldonMessage:
+def send_feedback(component: Any, feedback: Feedback, unit_id: Optional[str] = None) -> Union[SeldonMessage, Awaitable[SeldonMessage]]:
     """Deliver feedback. ``unit_id`` selects this unit's routing decision from
     the response meta (the reference reads env PREDICTIVE_UNIT_ID,
     `seldon_methods.py:52-90`)."""
@@ -212,6 +236,14 @@ def send_feedback(component: Any, feedback: Feedback, unit_id: Optional[str] = N
         routing = feedback.response.meta.routing.get(uid)
 
     result = client_send_feedback(component, features, feature_names, feedback.reward, truth, routing)
+    if inspect.isawaitable(result):  # sync def returning an awaitable
+        async def _await():
+            value = await result
+            if value is None:
+                return SeldonMessage(meta=response_meta(component, None))
+            return construct_response(component, False, feedback.request, value)
+
+        return _await()
     if result is None:
         return SeldonMessage(meta=response_meta(component, None))
     return construct_response(component, False, feedback.request, result)

@@ -187,6 +187,13 @@ class SharedRing:
                 ctypes.byref(used))
             if n > 0:
                 break
+            if n == -3:
+                # non-empty ring whose first frame exceeds our buffer: the
+                # sizing above makes this impossible (slot_size + 4 always
+                # fits), so spinning would loop forever on a real bug
+                raise RuntimeError(
+                    "scr_pop_many: pending frame larger than drain buffer "
+                    f"({len(self._manybuf)} bytes) — ring slot_size mismatch")
             if time.monotonic() > deadline:
                 return []
             time.sleep(spin_s)

@@ -59,6 +59,13 @@ logger = logging.getLogger(__name__)
 ComponentFactory = Callable[[PredictiveUnit], SeldonComponent]
 
 
+class _Suspended(Exception):
+    """A graph coroutine suspended on real async work despite
+    has_async_nodes=False — the detection heuristic missed an async
+    component (e.g. a sync method returning an awaitable, or a callable
+    object with async __call__). Callers degrade to the event-loop path."""
+
+
 def _drive_sync(coro):
     """Run a coroutine that never truly suspends (fully-local graph: every
     await is another such coroutine) to completion without an event loop.
@@ -69,9 +76,7 @@ def _drive_sync(coro):
     except StopIteration as stop:
         return stop.value
     coro.close()
-    raise SeldonError(
-        "graph coroutine suspended on real async work despite "
-        "has_async_nodes=False; report this as a bug", status_code=500)
+    raise _Suspended()
 
 
 def make_puid() -> str:
@@ -330,12 +335,41 @@ class GraphEngine:
     def predict_sync(self, request: SeldonMessage) -> SeldonMessage:
         if self.has_async_nodes:
             return asyncio.run(self.predict(request))
-        return _drive_sync(self.predict(request))
+        try:
+            return _drive_sync(self.predict(request))
+        except _Suspended:
+            self._degrade_to_async("predict")
+            return asyncio.run(self.predict(request))
 
     def send_feedback_sync(self, feedback: "Feedback") -> SeldonMessage:
         if self.has_async_nodes:
             return asyncio.run(self.send_feedback(feedback))
-        return _drive_sync(self.send_feedback(feedback))
+        try:
+            return _drive_sync(self.send_feedback(feedback))
+        except _Suspended:
+            self._degrade_to_async("send_feedback")
+            return asyncio.run(self.send_feedback(feedback))
+
+    def _degrade_to_async(self, op: str) -> None:
+        """Async-detection miss (a component's sync method returned an
+        awaitable, or an async __call__ object slipped past the
+        iscoroutinefunction check): flip the graph to the event-loop path
+        permanently so this and every later request runs there instead of
+        500ing.
+
+        Caveat, by design: the aborted inline attempt already executed every
+        node UPSTREAM of the suspension point, and the retry re-executes
+        them — for this one degraded request, side-effectful upstream
+        components (feedback counters, external calls) fire twice. The
+        alternative (500 after the same partial execution, every request)
+        is strictly worse; the log below makes the one-time re-execution
+        auditable."""
+        logger.warning(
+            "graph suspended on real async work during sync %s despite "
+            "has_async_nodes=False; degrading to the event-loop path. "
+            "Nodes upstream of the suspension re-execute for this request "
+            "(side effects may fire twice, once).", op)
+        self.has_async_nodes = True
 
     async def _get_output(self, state: UnitState, message: SeldonMessage) -> SeldonMessage:
         # Fused fast path: the whole subtree is one XLA call. Meta parity with

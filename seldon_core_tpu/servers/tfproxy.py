@@ -121,11 +121,30 @@ def _iter_fields(buf: bytes):
         yield field, wire, val
 
 
+def _signed64(v: int) -> int:
+    """Protobuf varints carry negatives as 64-bit two's complement."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _varint_list(val, wire) -> list:
+    """Decode an int_val/int64_val field occurrence: packed (wire 2) holds
+    back-to-back varints; unpacked (wire 0) is a single value."""
+    if wire == 0:
+        return [_signed64(val)]
+    out = []
+    off = 0
+    while off < len(val):
+        v, off = _read_varint(val, off)
+        out.append(_signed64(v))
+    return out
+
+
 def decode_tensor_proto(buf: bytes) -> np.ndarray:
     dtype = _DT_FLOAT
     dims = []
     floats: list = []
     doubles: list = []
+    ints: list = []
     for field, wire, val in _iter_fields(buf):
         if field == 1 and wire == 0:
             dtype = val
@@ -145,10 +164,23 @@ def decode_tensor_proto(buf: bytes) -> np.ndarray:
                 doubles.extend(struct.unpack(f"<{len(val) // 8}d", val))
             else:
                 doubles.append(struct.unpack("<d", val)[0])
-    if dtype == _DT_DOUBLE or doubles:
+        elif field == 7:  # int_val (DT_INT32 and narrower)
+            ints.extend(_varint_list(val, wire))
+        elif field == 10:  # int64_val
+            ints.extend(_varint_list(val, wire))
+    if dtype == _DT_DOUBLE:
         arr = np.asarray(doubles, dtype=np.float64)
-    else:
+    elif dtype == _DT_FLOAT:
         arr = np.asarray(floats, dtype=np.float32)
+    elif dtype == _DT_INT32:
+        arr = np.asarray(ints, dtype=np.int32)
+    elif dtype == _DT_INT64:
+        arr = np.asarray(ints, dtype=np.int64)
+    else:
+        raise SeldonError(
+            f"TF-Serving returned TensorProto dtype {dtype}, which this proxy "
+            "does not decode (supported: DT_FLOAT/DT_DOUBLE/DT_INT32/DT_INT64)",
+            status_code=502, reason="UPSTREAM_ERROR")
     if dims and int(np.prod(dims)) == arr.size:
         arr = arr.reshape(dims)
     return arr

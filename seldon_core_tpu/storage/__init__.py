@@ -60,6 +60,38 @@ def _workdir(out_dir: Optional[str]) -> str:
     return out_dir
 
 
+def _safe_rel(name: str, prefix: str) -> Optional[str]:
+    """Destination-relative path for a listed object, or None to skip it.
+
+    Object listings are remote-controlled input: a prefix query for
+    ``models/llm`` also matches ``models/llm2/x``, whose relpath would be
+    ``../llm2/x`` — a path traversal out of the download dir. Treat the
+    prefix as a *directory boundary*: only the exact object or objects
+    under ``prefix/`` qualify."""
+    if not prefix:
+        return name
+    if name == prefix:
+        return os.path.basename(name)
+    boundary = prefix if prefix.endswith("/") else prefix + "/"
+    if not name.startswith(boundary):
+        return None
+    return name[len(boundary):]
+
+
+def _safe_dst(out_dir: str, name: str, prefix: str) -> Optional[str]:
+    """Containment-checked local destination for object ``name``; None if
+    the object falls outside the prefix boundary or would escape out_dir."""
+    rel = _safe_rel(name, prefix)
+    if rel is None or not rel or rel.endswith("/"):
+        return None
+    dst = os.path.join(out_dir, rel)
+    root = os.path.realpath(out_dir)
+    if not os.path.realpath(dst).startswith(root + os.sep):
+        return None
+    os.makedirs(os.path.dirname(dst) or out_dir, exist_ok=True)
+    return dst
+
+
 def _gcs(parsed, out_dir: Optional[str]) -> str:
     try:
         from google.cloud import storage as gcs  # type: ignore
@@ -76,9 +108,9 @@ def _gcs(parsed, out_dir: Optional[str]) -> str:
     prefix = parsed.path.lstrip("/")
     count = 0
     for blob in bucket.list_blobs(prefix=prefix):
-        rel = os.path.relpath(blob.name, prefix) if blob.name != prefix else os.path.basename(blob.name)
-        dst = os.path.join(out_dir, rel)
-        os.makedirs(os.path.dirname(dst) or out_dir, exist_ok=True)
+        dst = _safe_dst(out_dir, blob.name, prefix)
+        if dst is None:
+            continue
         blob.download_to_filename(dst)
         count += 1
     if count == 0:
@@ -103,9 +135,9 @@ def _s3(parsed, out_dir: Optional[str]) -> str:
     paginator = s3.get_paginator("list_objects_v2")
     for page in paginator.paginate(Bucket=parsed.netloc, Prefix=prefix):
         for obj in page.get("Contents", []):
-            rel = os.path.relpath(obj["Key"], prefix) if obj["Key"] != prefix else os.path.basename(obj["Key"])
-            dst = os.path.join(out_dir, rel)
-            os.makedirs(os.path.dirname(dst) or out_dir, exist_ok=True)
+            dst = _safe_dst(out_dir, obj["Key"], prefix)
+            if dst is None:
+                continue
             s3.download_file(parsed.netloc, obj["Key"], dst)
             count += 1
     if count == 0:
@@ -144,10 +176,9 @@ def _azure_blob(parsed, out_dir: Optional[str]) -> str:
     count = 0
     for blob in client.list_blobs(name_starts_with=prefix):
         name = getattr(blob, "name", None) or blob["name"]
-        rel = os.path.relpath(name, prefix) if prefix and name != prefix else (
-            os.path.basename(name) if name == prefix else name)
-        dst = os.path.join(out_dir, rel)
-        os.makedirs(os.path.dirname(dst) or out_dir, exist_ok=True)
+        dst = _safe_dst(out_dir, name, prefix)
+        if dst is None:
+            continue
         with open(dst, "wb") as f:
             client.download_blob(name).readinto(f)
         count += 1

@@ -235,7 +235,9 @@ class ModelExecutor:
 
         ``finish_chunk(chunk, result)``, when given, may consume a whole
         stacked chunk at once (the C bulk-response path); returning False
-        falls back to per-frame ``finish``."""
+        falls back to per-frame ``finish``, and returning a set of keys
+        marks those frames as already answered (partial bulk push) so only
+        the REMAINING frames take the per-frame path."""
         idx = 0
         while idx < len(items):
             chunk = []
@@ -247,6 +249,8 @@ class ModelExecutor:
                 chunk.append(items[idx])
                 rows += a.shape[0]
                 idx += 1
+            answered: set = set()  # keys already responded to — a late
+            # exception must not fail() these (duplicate responses)
             try:
                 if len(chunk) == 1:
                     key, arr = chunk[0]
@@ -260,15 +264,21 @@ class ModelExecutor:
                         "input rows; cannot split a micro-batch")
                 self.batched_calls += 1
                 self.batched_rows += stacked.shape[0]
-                if finish_chunk is not None and finish_chunk(chunk, result):
+                handled = finish_chunk(chunk, result) if finish_chunk else False
+                if handled is True:
                     continue
+                if isinstance(handled, set):
+                    answered |= handled
                 offset = 0
                 for key, a in chunk:
-                    finish(key, result[offset:offset + a.shape[0]])
+                    if key not in answered:
+                        finish(key, result[offset:offset + a.shape[0]])
+                        answered.add(key)
                     offset += a.shape[0]
             except Exception as e:
                 for key, _ in chunk:
-                    fail(key, e)
+                    if key not in answered:
+                        fail(key, e)
 
     def _chunk_pusher(self, model_id: int, method: int, component, rings):
         """finish_chunk callback for _call_stacked: pushes a whole stacked
@@ -298,34 +308,41 @@ class ModelExecutor:
                 by_worker.setdefault(worker_id, []).append(
                     (req_id, off, a.shape[0]))
                 off += a.shape[0]
-            pushed_any = False
-            try:
-                for worker_id, entries in by_worker.items():
+            pushed: set = set()  # worker_ids whose batch fully pushed
+            for worker_id, entries in by_worker.items():
+                try:
                     rings[worker_id].push_model_resps(
                         [e[0] for e in entries], [e[1] for e in entries],
                         [e[2] for e in entries], data, row_nvals, tail, frag,
                         dtype_code)
-                    pushed_any = True
-            except PayloadTooLarge:
-                if pushed_any:
-                    # can't re-answer the pushed workers' frames without
-                    # duplicating responses; the oversized worker's frames
-                    # time out at the edge (504). push_model_resps
-                    # pre-checks sizes, so a partial WORKER batch is
-                    # impossible — only partial multi-worker chunks are.
-                    logger.error("bulk response overflow after partial "
-                                 "multi-worker push; remaining frames will "
-                                 "time out at the edge")
-                    return True
-                return False  # per-frame path raises per-request errors
-            except RingFull:
-                # ring jammed for the full timeout — answering the same
-                # frames again via the fallback would enqueue duplicates
-                # into the same jammed ring; let the edge's deadline answer
-                # them (504) instead of killing the drain thread
-                logger.error("response ring full during bulk push; "
-                             "affected frames will time out at the edge")
-                return True
+                    pushed.add(worker_id)
+                except PayloadTooLarge:
+                    # Rings can have differing slot sizes, so one worker of
+                    # a multi-worker chunk can overflow while the rest fit.
+                    # push_model_resps pre-checks sizes per call, so the
+                    # failing worker pushed NOTHING — its frames are safe to
+                    # re-answer via the per-frame fallback, as are those of
+                    # workers not yet attempted. Report only the
+                    # already-pushed workers' frames as handled.
+                    if not pushed:
+                        return False  # nothing pushed: plain per-frame path
+                    logger.warning(
+                        "bulk response overflow on worker %d after partial "
+                        "multi-worker push; remaining frames take the "
+                        "per-frame fallback", worker_id)
+                    return {key for key, _ in chunk if key[0] in pushed}
+                except RingFull:
+                    # Worker %d's ring jammed for the full timeout — a
+                    # partial per-WORKER push is possible here, so answering
+                    # its frames again would enqueue duplicates into the
+                    # same jammed ring; its frames 504 at the edge. Other
+                    # workers' rings are healthy: pushed ones are done,
+                    # unattempted ones take the per-frame fallback.
+                    logger.error(
+                        "response ring full during bulk push to worker %d; "
+                        "its frames will time out at the edge", worker_id)
+                    return {key for key, _ in chunk
+                            if key[0] in pushed or key[0] == worker_id}
             return True
 
         return finish_chunk

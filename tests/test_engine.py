@@ -322,3 +322,29 @@ def test_engine_passes_annotations_to_remote_nodes():
     )
     rc = engine.state.root.component
     assert rc.retries == 1 and rc.timeout_s == 1.5
+
+
+def test_sync_path_degrades_on_missed_async_component():
+    """ADVICE r4: a sync method returning an awaitable (or an async
+    __call__ object) slips past the iscoroutinefunction detection, so the
+    graph takes the inline path and suspends mid-_drive_sync. That must
+    degrade to the event-loop path — once, then permanently — not 500."""
+
+    async def _apredict(X):
+        await asyncio.sleep(0)  # real suspension point
+        return X * 2
+
+    class SneakyAsync(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return _apredict(X)  # sync def returning an awaitable
+
+    engine = GraphEngine(
+        spec({"name": "m", "type": "MODEL"}), components={"m": SneakyAsync()},
+        fuse=False)
+    assert engine.has_async_nodes is False  # the detection miss, by design
+    out = engine.predict_sync(tensor_msg([1.0, 2.0], [1, 2]))
+    assert out.to_dict()["data"]["tensor"]["values"] == pytest.approx([2.0, 4.0])
+    # flipped permanently: later requests go straight to asyncio.run
+    assert engine.has_async_nodes is True
+    out2 = engine.predict_sync(tensor_msg([3.0], [1, 1]))
+    assert out2.to_dict()["data"]["tensor"]["values"] == pytest.approx([6.0])

@@ -378,3 +378,38 @@ def test_seq2seq_detector_not_row_sliceable():
     ex = ModelExecutor([Seq2SeqOutlierDetector(timesteps=4),
                         MahalanobisOutlierDetector()])
     assert ex._row_sliceable == [False, True]
+
+
+def test_call_stacked_partial_chunk_set_contract():
+    """ADVICE r4: when the bulk pusher answers only SOME workers of a
+    multi-worker chunk (differing ring slot sizes -> PayloadTooLarge on a
+    later worker), it returns the set of already-answered keys and
+    _call_stacked must run the per-frame fallback for exactly the rest."""
+    import numpy as np
+
+    from seldon_core_tpu.components.component import SeldonComponent
+    from seldon_core_tpu.transport.ipc import ModelExecutor
+
+    class Ident(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.asarray(X, np.float64)
+
+    ex = ModelExecutor([Ident()])
+    items = [((0, 1), np.ones((1, 2))), ((1, 1), np.ones((1, 2)) * 2),
+             ((2, 1), np.ones((1, 2)) * 3)]
+    finished, failed = [], []
+
+    def finish_chunk(chunk, result):
+        return {(0, 1)}  # worker 0 already answered by the bulk path
+
+    ex._call_stacked(
+        lambda a: a, items, max_rows=64,
+        finish=lambda key, arr: finished.append((key, arr.copy())),
+        fail=lambda key, e: failed.append((key, e)),
+        finish_chunk=finish_chunk)
+    assert not failed
+    assert sorted(k for k, _ in finished) == [(1, 1), (2, 1)]
+    # each remaining frame got ITS OWN rows (offsets preserved)
+    by_key = dict(finished)
+    np.testing.assert_array_equal(by_key[(1, 1)], np.ones((1, 2)) * 2)
+    np.testing.assert_array_equal(by_key[(2, 1)], np.ones((1, 2)) * 3)

@@ -156,3 +156,24 @@ def test_np_rng_parity_numpy_and_cpython():
             else:
                 assert lib.py_rng_random(h) == ref2.random(), (seed, i)
         lib.py_rng_free(h)
+
+
+def test_pop_many_distinguishes_oversized_first_frame(ring):
+    """ADVICE r4: scr_pop_many returned 0 both for 'empty' and 'first frame
+    does not fit in out_cap' — an undersized caller would spin forever on a
+    non-empty ring. It must return -3 (matching scr_pop) instead."""
+    import ctypes
+
+    assert ring.push(b"x" * 600)
+    lib = ring._lib
+    small = ctypes.create_string_buffer(64)  # < 4 + 600
+    used = ctypes.c_uint32(0)
+    n = lib.scr_pop_many(ring._h, small, len(small), 8, ctypes.byref(used))
+    assert n == -3
+    # frame left in place: a properly sized drain still gets it
+    big = ctypes.create_string_buffer(4096)
+    n = lib.scr_pop_many(ring._h, big, len(big), 8, ctypes.byref(used))
+    assert n == 1
+    # and empty still reads as 0, not -3
+    n = lib.scr_pop_many(ring._h, big, len(big), 8, ctypes.byref(used))
+    assert n == 0

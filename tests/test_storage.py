@@ -128,3 +128,37 @@ def test_azure_blob_needs_container(fake_azure, tmp_path):
     with pytest.raises(StorageError, match="needs a container"):
         storage.download("https://acct.blob.core.windows.net/",
                          out_dir=str(tmp_path / "out"))
+
+
+def test_azure_blob_prefix_is_directory_boundary(fake_azure, tmp_path, monkeypatch):
+    """Remote listings are untrusted: name_starts_with='models/llm' also
+    matches 'models/llm2/x', whose naive relpath '../llm2/x' would be
+    written OUTSIDE out_dir (path traversal). The prefix must act as a
+    directory boundary."""
+    monkeypatch.delenv("AZURE_STORAGE_CONNECTION_STRING", raising=False)
+    fake_azure.blobs = {
+        "models/llm/weights.bin": b"ok",
+        "models/llm2/evil.bin": b"evil",          # sibling dir, same prefix
+        "models/llm/../../escape.bin": b"evil",    # literal dot-dot segments
+    }
+    out = tmp_path / "out"
+    got = storage.download(
+        "https://acct.blob.core.windows.net/cont/models/llm", out_dir=str(out))
+    assert open(os.path.join(got, "weights.bin"), "rb").read() == b"ok"
+    # nothing escaped the download dir, nothing from the sibling landed
+    all_files = {os.path.relpath(os.path.join(r, f), tmp_path)
+                 for r, _, fs in os.walk(tmp_path) for f in fs}
+    assert all_files == {"out/weights.bin"}
+
+
+def test_safe_rel_and_dst_containment(tmp_path):
+    from seldon_core_tpu.storage import _safe_dst, _safe_rel
+
+    assert _safe_rel("models/llm/w.bin", "models/llm") == "w.bin"
+    assert _safe_rel("models/llm", "models/llm") == "llm"   # exact object
+    assert _safe_rel("models/llm2/w.bin", "models/llm") is None
+    assert _safe_rel("anything/x", "") == "anything/x"      # no prefix: as-is
+    out = tmp_path / "o"
+    out.mkdir()
+    assert _safe_dst(str(out), "p/../../../etc/passwd", "p") is None
+    assert _safe_dst(str(out), "p/ok/x.bin", "p") == str(out / "ok/x.bin")

@@ -162,3 +162,35 @@ def test_decode_missing_output_raises():
     with pytest.raises(SeldonError, match="missing output"):
         decode_predict_response(resp, "b")
 
+
+
+def test_tensor_proto_int_roundtrip():
+    """DT_INT32/DT_INT64 decode (ADVICE r4: previously silently decoded to
+    an empty float32 array). The encoder itself emits these for token-id
+    inputs, so encode->decode must round-trip, negatives included."""
+    from seldon_core_tpu.servers.tfproxy import (
+        decode_tensor_proto, encode_predict_request, _iter_fields)
+
+    def tensor_bytes(req: bytes) -> bytes:
+        for field, wire, val in _iter_fields(req):
+            if field == 2 and wire == 2:  # inputs map entry
+                for f2, w2, v2 in _iter_fields(val):
+                    if f2 == 2 and w2 == 2:
+                        return v2
+        raise AssertionError("no TensorProto in request")
+
+    for dtype in (np.int32, np.int64):
+        arr = np.array([[1, -2, 3], [2**31 - 1, 0, -7]], dtype=dtype)
+        out = decode_tensor_proto(tensor_bytes(
+            encode_predict_request(arr, "m", "s", "in")))
+        assert out.dtype == dtype
+        np.testing.assert_array_equal(out, arr)
+
+
+def test_tensor_proto_unsupported_dtype_raises():
+    from seldon_core_tpu.contracts.payload import SeldonError
+    from seldon_core_tpu.servers.tfproxy import _tag, _varint, decode_tensor_proto
+
+    buf = _tag(1, 0) + _varint(7)  # DT_STRING: not decodable here
+    with pytest.raises(SeldonError, match="dtype 7"):
+        decode_tensor_proto(buf)
