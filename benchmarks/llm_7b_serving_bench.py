@@ -24,6 +24,12 @@ across phases):
 
 Writes benchmarks/report_llm_7b_serving.json and appends the attribution
 to DECODE_NOTES.md (by hand, from the printed table).
+
+At 7B the phases do NOT co-fit in one process's HBM (weights 6.7 GB +
+generate b8/b1 KV + the batcher's slot caches exhaust the chip when the
+earlier phases' executables are still resident), so each invocation runs
+the phases named in argv ("A", "BC", "D"; default all — the CPU rehearsal
+fits in one) and MERGES its keys into the existing report.
 """
 
 from __future__ import annotations
@@ -51,8 +57,13 @@ def log(key, value):
 def main() -> None:
     import jax
 
+    phases = "".join(sys.argv[1:]).upper() or "ABCD"
     on_tpu = jax.devices()[0].platform == "tpu"
-    report = {"platform": jax.devices()[0].platform}
+    report = {}
+    if os.path.exists(REPORT):
+        with open(REPORT) as f:
+            report = json.load(f)
+    report["platform"] = jax.devices()[0].platform
     if not on_tpu:
         # CPU rehearsal config: same code path, toy dims
         model_kwargs = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
@@ -89,7 +100,7 @@ def main() -> None:
 
     # ---- A. direct decode (in-session basis for the attribution) -------
     decode = {}
-    for b in (8, 1):
+    for b in (8, 1) if "A" in phases else ():
         prompts = [rng.integers(1, vocab, size=plen).tolist() for _ in range(b)]
         t0 = time.perf_counter()
         server.generate(prompts, max_new_tokens=max_new)  # compile + warm
@@ -107,9 +118,26 @@ def main() -> None:
             "compile_s": round(compile_s, 1),
         }
         log(f"decode_b{b}", decode[f"b{b}"])
-    report["direct_decode"] = decode
+    if "A" in phases:
+        report["direct_decode"] = decode
+        _write(report)
 
     # ---- B. REST + ContinuousBatcher, N concurrent clients -------------
+    if "B" in phases:
+        _rest_batching(server, report, plen, max_new)
+
+    # ---- C. prefix-cached multi-turn prefill: cold vs cached -----------
+    if "C" in phases:
+        _prefix_multi_turn(server, report, rng, vocab, plen, max_new)
+
+    # ---- D. b8 vs b1 decode-step attribution ---------------------------
+    if on_tpu and "D" in phases:
+        _attribution(server, report, rng, vocab, plen, on_tpu)
+
+    _write(report)
+
+
+def _rest_batching(server, report, plen, max_new) -> None:
     from aiohttp import web
 
     from seldon_core_tpu.transport.rest import make_component_app
@@ -181,8 +209,12 @@ def main() -> None:
         "tunnel; absolute tok/s is tunnel-bound, the N-scaling ratio is "
         "the architecture claim")
     report["rest_continuous_batching"] = serving
+    _write(report)
 
-    # ---- C. prefix-cached multi-turn prefill: cold vs cached -----------
+
+def _prefix_multi_turn(server, report, rng, vocab, plen, max_new) -> None:
+    import numpy as np
+
     turn1 = rng.integers(1, vocab, size=plen).tolist()
     ans = server.generate([turn1], max_new_tokens=max_new)["tokens"][0]
     follow = rng.integers(1, vocab, size=max_new).tolist()
@@ -211,11 +243,15 @@ def main() -> None:
         "prefix_hits_total": server._prefix_hits,
     }
     log("prefix_multi_turn", report["prefix_multi_turn"])
+    _write(report)
 
-    # ---- D. b8 vs b1 decode-step attribution ---------------------------
-    if on_tpu:
-        from benchmarks.tpu_profile import summarize, walk_op_profile
 
+def _attribution(server, report, rng, vocab, plen, on_tpu, max_new=16) -> None:
+    import jax
+
+    from benchmarks.tpu_profile import summarize, walk_op_profile
+
+    if True:
         attrib = {}
         for b in (1, 8):
             prompts = [rng.integers(1, vocab, size=plen).tolist()
@@ -237,7 +273,10 @@ def main() -> None:
                 attrib[f"b{b}"] = s
             log(f"profiled_b{b}", "ok" if "data" in s else s)
         report["step_attribution_top_ops"] = attrib
+    _write(report)
 
+
+def _write(report) -> None:
     with open(REPORT, "w") as f:
         json.dump(report, f, indent=2)
     print("written", REPORT, flush=True)

@@ -862,10 +862,14 @@ bool eval_unit(const Program& prog, int idx, Rng& rng, Payload in, ExecOut& out,
           }
         }
       } else if (u.kind == Kind::ThompsonSampling) {
-        // theta_i ~ Beta(alpha0 + reward_i, beta0 + fail_i), argmax
+        // theta_i ~ Beta(alpha0 + reward_i, beta0 + fail_i), argmax;
+        // seeded units replay Generator.beta's elementwise array draw
+        // (np_rng.h random_beta) so routing matches the Python engine
+        // request-for-request
         double best = -1.0;
         for (int i = 0; i < u.n_branches; ++i) {
-          double theta = rng.beta(u.alpha0 + u.reward_sum[i], u.beta0 + u.fail_sum[i]);
+          double a = u.alpha0 + u.reward_sum[i], b = u.beta0 + u.fail_sum[i];
+          double theta = u.np_rng ? u.np_rng->beta(a, b) : rng.beta(a, b);
           if (theta > best) {
             best = theta;
             branch = i;
@@ -1345,9 +1349,14 @@ bool eval_device(const Program& prog, int idx, Rng& rng, const DVal& in,
           }
         }
       } else if (u.kind == Kind::ThompsonSampling) {
+        // theta_i ~ Beta(alpha0 + reward_i, beta0 + fail_i), argmax;
+        // seeded units replay Generator.beta's elementwise array draw
+        // (np_rng.h random_beta) so routing matches the Python engine
+        // request-for-request
         double best = -1.0;
         for (int i = 0; i < u.n_branches; ++i) {
-          double theta = rng.beta(u.alpha0 + u.reward_sum[i], u.beta0 + u.fail_sum[i]);
+          double a = u.alpha0 + u.reward_sum[i], b = u.beta0 + u.fail_sum[i];
+          double theta = u.np_rng ? u.np_rng->beta(a, b) : rng.beta(a, b);
           if (theta > best) {
             best = theta;
             branch = i;

@@ -362,6 +362,18 @@ uint64_t np_rng_next64(void* h) { return static_cast<nprng::NpRng*>(h)->next64()
 uint64_t np_rng_integers(void* h, uint64_t n) {
   return static_cast<nprng::NpRng*>(h)->integers(n);
 }
+double np_rng_standard_normal(void* h) {
+  return static_cast<nprng::NpRng*>(h)->standard_normal();
+}
+double np_rng_standard_exponential(void* h) {
+  return static_cast<nprng::NpRng*>(h)->standard_exponential();
+}
+double np_rng_standard_gamma(void* h, double shape) {
+  return static_cast<nprng::NpRng*>(h)->standard_gamma(shape);
+}
+double np_rng_beta(void* h, double a, double b) {
+  return static_cast<nprng::NpRng*>(h)->beta(a, b);
+}
 
 void* py_rng_new(uint64_t seed) { return new nprng::PyRng(seed); }
 void py_rng_free(void* h) { delete static_cast<nprng::PyRng*>(h); }

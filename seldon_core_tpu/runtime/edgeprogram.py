@@ -30,8 +30,9 @@ _NATIVE_KINDS = {
     UnitImplementation.RANDOM_ABTEST: "RANDOM_ABTEST",
     UnitImplementation.AVERAGE_COMBINER: "AVERAGE_COMBINER",
     # Stateful bandits execute natively too (per-edge-process state, the
-    # multi-replica model of analytics/routers.py); seeded instances fall
-    # back to the Python engine, whose numpy RNG sequence they must replay.
+    # multi-replica model of analytics/routers.py); seeded instances also
+    # run native — the edge replays the numpy/CPython streams bit-exactly
+    # (np_rng.h: PCG64 + Lemire integers + ziggurat gamma/beta).
     UnitImplementation.EPSILON_GREEDY: "EPSILON_GREEDY",
     UnitImplementation.THOMPSON_SAMPLING: "THOMPSON_SAMPLING",
 }
@@ -148,14 +149,6 @@ def compile_edge_program(
             # the ring call completes — keep such graphs on the Python engine
             return None
         params = unit.parameters_dict()
-        if kind == "THOMPSON_SAMPLING" and params.get("seed") is not None:
-            # seeded Thompson draws Beta variates — replaying numpy's gamma
-            # rejection sampler bit-for-bit is not implemented, so only the
-            # Python engine can honor a seeded Thompson stream. Seeded
-            # epsilon-greedy and AB-test ARE native: the edge replays
-            # numpy's PCG64 / CPython's MT19937 exactly (native/np_rng.h,
-            # parity-proven by tests/test_native.py::test_np_rng_parity).
-            return None
         if str(params.get("python_routing", "")).lower() in ("true", "1"):
             # Seeded determinism scope: each serving PLANE replays its own
             # exact stream from the seed (same per-replica model as
@@ -219,6 +212,11 @@ def compile_edge_program(
             out["nBranches"] = int(params.get("n_branches", 2))
             out["alpha"] = float(params.get("alpha", 1.0))
             out["beta"] = float(params.get("beta", 1.0))
+            if seed is not None:
+                # the edge replays Generator.beta draw-for-draw
+                # (np_rng.h standard_gamma/beta over the extracted
+                # ziggurat tables, proven by test_np_rng_gamma_beta_parity)
+                out["seed"] = seed
         units.append(out)
         return len(units) - 1
 

@@ -254,16 +254,17 @@ def test_keepalive_many_requests(edge):
 
 
 def test_fallback_mode_serves_python_engine(tmp_path):
-    """A graph the edge cannot compile (a SEEDED Thompson router — Beta
-    variate replay is Python-only; seeded epsilon-greedy/AB-test are native
-    now) is served by the Python engine behind the shared-memory ring, edge
-    as frontend."""
+    """A graph pinned off the native plane (python_routing=true — every
+    seeded router is native now, so the pin is the remaining fallback
+    vehicle) is served by the Python engine behind the shared-memory ring,
+    edge as frontend."""
     spec = {
         "name": "p",
         "graph": {
             "name": "eg", "type": "ROUTER", "implementation": "THOMPSON_SAMPLING",
             "parameters": [{"name": "n_branches", "value": "2", "type": "INT"},
-                           {"name": "seed", "value": "7", "type": "INT"}],
+                           {"name": "seed", "value": "7", "type": "INT"},
+                           {"name": "python_routing", "value": "true", "type": "BOOL"}],
             "children": [
                 {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
                 {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
@@ -394,9 +395,9 @@ def test_bandit_compiles_native():
     for spec in (EG_EXPLOIT, TS_SPEC):
         prog = compile_edge_program(PredictorSpec.from_dict(spec))
         assert prog is not None and prog["native"]
-    # seeded epsilon-greedy compiles NATIVE (the edge replays numpy's PCG64
-    # bit-exactly — native/np_rng.h); seeded Thompson still falls back (Beta
-    # variate replay not implemented)
+    # every seeded bandit compiles NATIVE: the edge replays numpy's PCG64 +
+    # Lemire integers (epsilon-greedy) and the ziggurat gamma/beta chain
+    # (Thompson) bit-exactly — native/np_rng.h
     seeded = json.loads(json.dumps(EG_EXPLOIT))
     seeded["graph"]["parameters"].append({"name": "seed", "value": "3", "type": "INT"})
     prog = compile_edge_program(PredictorSpec.from_dict(seeded))
@@ -404,7 +405,9 @@ def test_bandit_compiles_native():
     assert prog["units"][prog["root"]]["seed"] == 3
     seeded_ts = json.loads(json.dumps(TS_SPEC))
     seeded_ts["graph"]["parameters"].append({"name": "seed", "value": "3", "type": "INT"})
-    assert compile_edge_program(PredictorSpec.from_dict(seeded_ts)) is None
+    prog = compile_edge_program(PredictorSpec.from_dict(seeded_ts))
+    assert prog is not None and prog["native"]
+    assert prog["units"][prog["root"]]["seed"] == 3
     # seeds outside [0, 2^53) keep Python semantics (program JSON is doubles)
     big = json.loads(json.dumps(EG_EXPLOIT))
     big["graph"]["parameters"].append({"name": "seed", "value": str(2**60), "type": "INT"})
@@ -572,12 +575,14 @@ def _seeded_spec(impl, name, seed, n_branches=3, extra=()):
 @pytest.mark.parametrize("impl,name,extra", [
     ("EPSILON_GREEDY", "eg", ({"name": "epsilon", "value": "0.6", "type": "FLOAT"},)),
     ("RANDOM_ABTEST", "ab", ()),
+    ("THOMPSON_SAMPLING", "ts", ()),
 ])
 def test_seeded_router_native_routing_parity(edge, impl, name, extra):
     """A SEEDED router graph served natively must reproduce the Python
     engine's routing decisions request-for-request — the edge replays
-    numpy's PCG64 (epsilon-greedy) / CPython's MT19937 (AB-test) streams
-    bit-exactly, including through feedback-driven state changes."""
+    numpy's PCG64 (epsilon-greedy), CPython's MT19937 (AB-test), and
+    Generator.beta's ziggurat gamma chain (Thompson) streams bit-exactly,
+    including through feedback-driven state changes."""
     import asyncio as aio
 
     from seldon_core_tpu.contracts.payload import Feedback
@@ -602,12 +607,15 @@ def test_seeded_router_native_routing_parity(edge, impl, name, extra):
     seq_native = [edge_route() for _ in range(40)]
     seq_oracle = [oracle_route() for _ in range(40)]
     assert seq_native == seq_oracle
-    if impl == "EPSILON_GREEDY":
-        # feedback flips the exploit arm on BOTH sides; the streams must
-        # stay aligned through the state change
-        fb = {"request": req, "response": {"meta": {"routing": {name: 2}}},
-              "reward": 1.0}
-        for _ in range(3):
+    if impl in ("EPSILON_GREEDY", "THOMPSON_SAMPLING"):
+        # feedback changes the routing state on BOTH sides (exploit arm /
+        # Beta posteriors); the streams must stay aligned through it. For
+        # Thompson, reward mass pushes the posteriors off the Johnk path
+        # into the Marsaglia-Tsang + exponential-ziggurat gamma chain.
+        for reward, branch in ((1.0, 2), (0.0, 1), (2.5, 2)):
+            fb = {"request": req,
+                  "response": {"meta": {"routing": {name: branch}}},
+                  "reward": reward}
             assert post(port, "/api/v0.1/feedback", fb)[0] == 200
             aio.run(oracle.send_feedback(
                 Feedback.from_dict(json.loads(json.dumps(fb)))))
