@@ -224,9 +224,9 @@ def _prefix_multi_turn(server, report, rng, vocab, plen, max_new) -> None:
         times = []
         for _ in range(repeats):
             if clear:
-                server._prefix_cache.clear()
+                server.clear_prefix_cache()
             else:
-                server._prefix_cache.clear()
+                server.clear_prefix_cache()
                 server.generate([turn1], max_new_tokens=1)  # re-prime prefix
             t0 = time.perf_counter()
             server.generate([turn2], max_new_tokens=1)
@@ -235,12 +235,72 @@ def _prefix_multi_turn(server, report, rng, vocab, plen, max_new) -> None:
 
     cold = prefill_time(clear=True)
     cached = prefill_time(clear=False)
+
+    # Wall time through the tunnel is dispatch-bound (~75 ms RTT >> the
+    # compute saved), so ALSO time the raw jitted calls the two paths
+    # dispatch — full-prompt prefill vs suffix-only extend — minus a
+    # measured trivial-dispatch floor, which isolates device time.
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.transformer import PAD_POS
+
+    def med_call(fn, *a, repeats=15):
+        fn(*a)  # warm
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*a))
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times))
+
+    noop = jax.jit(lambda x: x + 1)
+    floor = med_call(noop, jnp.zeros((8,), jnp.float32))
+
+    buckets = sorted(server.len_buckets)
+    plen2 = len(turn2)
+    bucket2 = next((b for b in buckets if b >= plen2), plen2)
+    mlen = max(plen2, buckets[-1]) + max_new
+    toks = np.zeros((1, bucket2), np.int32)
+    poss = np.full((1, bucket2), PAD_POS, np.int32)
+    toks[0, :plen2] = turn2
+    poss[0, :plen2] = np.arange(plen2)
+    prefill = server._get_prefill(1, bucket2, mlen)
+    cold_call = med_call(prefill, server._params, jnp.asarray(toks), jnp.asarray(poss))
+
+    server.clear_prefix_cache()
+    server.generate([turn1], max_new_tokens=1)  # prime turn1 prefix
+    hit = server._prefix_lookup(turn2, mlen)
+    assert hit is not None, "prefix lookup must hit after priming"
+    p0, caches, _ = hit
+    suffix = turn2[p0:]
+    sbucket = next((b for b in buckets if b >= len(suffix)), len(suffix))
+    stoks = np.zeros((1, sbucket), np.int32)
+    spos = np.full((1, sbucket), PAD_POS, np.int32)
+    stoks[0, :len(suffix)] = suffix
+    spos[0, :len(suffix)] = np.arange(p0, p0 + len(suffix))
+    extend = server._get_extend(1, sbucket, mlen)
+    cached_call = med_call(extend, server._params, caches, jnp.asarray(stoks),
+                           jnp.asarray(spos), jnp.asarray(p0, jnp.int32))
+
     report["prefix_multi_turn"] = {
         "turn2_prompt_tokens": len(turn2),
         "cold_prefill_s": round(cold, 4),
         "cached_prefill_s": round(cached, 4),
-        "cached_speedup": round(cold / cached, 2) if cached else None,
+        "cached_speedup_wall": round(cold / cached, 2) if cached else None,
         "prefix_hits_total": server._prefix_hits,
+        "device_isolated": {
+            "dispatch_floor_s": round(floor, 4),
+            "cold_prefill_call_s": round(cold_call, 4),
+            "cached_extend_call_s": round(cached_call, 4),
+            "cold_minus_floor_s": round(cold_call - floor, 4),
+            "cached_minus_floor_s": round(cached_call - floor, 4),
+            "device_speedup": round(
+                (cold_call - floor) / max(cached_call - floor, 1e-9), 2),
+            "note": "wall through the ~75ms-RTT tunnel is dispatch-bound; "
+                    "the floor-subtracted pair isolates the device-side "
+                    "cost of full-prompt prefill vs suffix-only extend",
+        },
     }
     log("prefix_multi_turn", report["prefix_multi_turn"])
     _write(report)

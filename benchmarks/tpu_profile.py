@@ -87,7 +87,19 @@ def summarize(logdir: str) -> dict:
         data = out[0] if isinstance(out, tuple) else out
         return {"tool": "op_profile", "data": json.loads(data)}
     except Exception as e:
-        return {"error": f"op_profile convert failed: {e!r}", "files": paths}
+        # the plugin drags in TF and breaks under protobuf skew; fall back
+        # to the in-tree wire-format reader (benchmarks/xplane_parse.py)
+        try:
+            try:
+                from benchmarks.xplane_parse import op_table
+            except ModuleNotFoundError:  # running as a script: HERE on path
+                from xplane_parse import op_table
+
+            return {"tool": "xplane_parse", "rows": op_table(logdir),
+                    "plugin_error": repr(e)}
+        except Exception as e2:
+            return {"error": f"op_profile convert failed: {e!r}; "
+                             f"xplane_parse failed: {e2!r}", "files": paths}
 
 
 def walk_op_profile(node, out, depth=0):

@@ -1,0 +1,176 @@
+"""Dependency-free xplane.pb reader: device-time attribution by op.
+
+``jax.profiler.trace`` writes XSpace protos; the stock parser
+(tensorboard_plugin_profile) drags in TensorFlow and breaks under protobuf
+implementation skew, so this decodes the wire format directly — the same
+hand-rolled varint/tag approach the framework's tfproxy uses for
+TensorProto (servers/tfproxy.py). Only the fields attribution needs:
+
+  XSpace.planes(1) -> XPlane{name(2), lines(3), event_metadata(4)}
+  XPlane.lines -> XLine{name(2), events(4)}
+  XLine.events -> XEvent{metadata_id(1), duration_ps(3)}
+  XPlane.event_metadata -> map<i64, XEventMetadata{id(1), name(2)}>
+
+``op_table(logdir)`` aggregates duration by event name over the TPU device
+plane's op lines and returns rows sorted by total time.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Iterator, List, Tuple
+
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
+    """Yield (field_number, wire_type, raw) over a message's bytes."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+            yield field, wt, v
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            yield field, wt, buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            yield field, wt, buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            yield field, wt, buf[i:i + 8]
+            i += 8
+        else:  # groups (3/4) never appear in xplane
+            raise ValueError(f"unsupported wire type {wt}")
+
+
+def _event_metadata(raw: bytes) -> Tuple[int, str]:
+    mid, name = 0, ""
+    for f, _, v in _fields(raw):
+        if f == 1:
+            mid = v
+        elif f == 2:
+            name = v.decode("utf-8", "replace")
+    return mid, name
+
+
+def _plane(raw: bytes):
+    name = ""
+    lines: List[bytes] = []
+    meta: Dict[int, str] = {}
+    for f, _, v in _fields(raw):
+        if f == 2:
+            name = v.decode("utf-8", "replace")
+        elif f == 3:
+            lines.append(v)
+        elif f == 4:  # map entry {key(1): i64, value(2): XEventMetadata}
+            mid = 0
+            mname = ""
+            for mf, _, mv in _fields(v):
+                if mf == 1:
+                    mid = mv
+                elif mf == 2:
+                    mid2, mname = _event_metadata(mv)
+                    mid = mid or mid2
+            meta[mid] = mname
+    return name, lines, meta
+
+
+def _line(raw: bytes):
+    # XLine: id=1, name=2, timestamp_ns=3, events=4, display_name=11
+    name = ""
+    events: List[bytes] = []
+    for f, wt, v in _fields(raw):
+        if f == 2 and wt == 2:
+            name = v.decode("utf-8", "replace")
+        elif f == 4 and wt == 2:
+            events.append(v)
+    return name, events
+
+
+def _event(raw: bytes) -> Tuple[int, int]:
+    mid, dur = 0, 0
+    for f, _, v in _fields(raw):
+        if f == 1:
+            mid = v
+        elif f == 3:
+            dur = v
+    return mid, dur
+
+
+def op_table(logdir: str, line_filter: str = "XLA Op") -> List[dict]:
+    """[{name, total_ps, count, time_frac}] over the device plane's op
+    lines, sorted by total device time (all xplane.pb files under logdir)."""
+    paths = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {logdir}")
+    totals: Dict[str, list] = {}
+    for path in paths:
+        space = open(path, "rb").read()
+        for f, _, v in _fields(space):
+            if f != 1:
+                continue
+            pname, lines, meta = _plane(v)
+            if "TPU" not in pname and "/device" not in pname:
+                continue
+            for lraw in lines:
+                lname, events = _line(lraw)
+                if line_filter and line_filter.lower() not in lname.lower():
+                    continue
+                for eraw in events:
+                    mid, dur = _event(eraw)
+                    name = meta.get(mid, f"op#{mid}")
+                    row = totals.setdefault(name, [0, 0])
+                    row[0] += dur
+                    row[1] += 1
+    grand = sum(t for t, _ in totals.values()) or 1
+    rows = [
+        {"name": k, "total_ps": t, "count": c,
+         "time_frac": round(t / grand, 6)}
+        for k, (t, c) in totals.items()
+    ]
+    rows.sort(key=lambda r: -r["total_ps"])
+    return rows
+
+
+def device_lines(logdir: str) -> List[Tuple[str, str, int]]:
+    """(plane, line, total_ps) inventory — for picking a line_filter."""
+    paths = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+    out = []
+    for path in paths:
+        space = open(path, "rb").read()
+        for f, _, v in _fields(space):
+            if f != 1:
+                continue
+            pname, lines, _meta = _plane(v)
+            for lraw in lines:
+                lname, events = _line(lraw)
+                total = sum(_event(e)[1] for e in events)
+                out.append((pname, lname, total))
+    return out
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    logdir = sys.argv[1]
+    if len(sys.argv) > 2 and sys.argv[2] == "--lines":
+        for plane, line, total in device_lines(logdir):
+            print(f"{total/1e9:12.3f}ms  {plane} :: {line}")
+    else:
+        for row in op_table(logdir)[:30]:
+            print(json.dumps(row))

@@ -63,9 +63,11 @@ class BatcherService:
         self._loop = asyncio.new_event_loop()
         threading.Thread(target=self._loop.run_forever, name="batcher-loop",
                          daemon=True).start()
+        max_len = getattr(server, "continuous_batching_max_len", None)
 
         async def make():
-            return ContinuousBatcher(server, max_slots=max_slots)
+            return ContinuousBatcher(server, max_slots=max_slots,
+                                     max_len=max_len)
 
         self.batcher = asyncio.run_coroutine_threadsafe(make(), self._loop).result()
         self.submitted = 0
@@ -147,8 +149,22 @@ class ContinuousBatcher:
         self.server = server
         self.S = int(max_slots)
         cfg = server._cfg
-        self.max_len = int(max_len or (cfg.max_seq_len + server.max_new_tokens))
+        # Slot caches are HBM-resident for the batcher's whole life (S slots
+        # x max_len x KV bytes/token — ~0.5 MB/token at 7B), so size them to
+        # what serving actually admits: prompts bucket to len_buckets with
+        # one round-up step past the top bucket (_bucket), plus decode
+        # headroom. Defaulting to the model's full trained context instead
+        # (4k at 7B) allocates 17 GB of KV and OOMs the chip before the
+        # first request. Prompts longer than 2x the top bucket truncate to
+        # the cache (admit keeps the TAIL, same rule as before); a
+        # deployment expecting longer prompts passes max_len explicitly
+        # (LLMServer.continuous_batching_max_len).
         self.len_buckets = tuple(len_buckets or server.len_buckets)
+        if max_len is None:
+            max_len = min(2 * max(self.len_buckets), cfg.max_seq_len) + max(
+                int(server.max_new_tokens), 1
+            )
+        self.max_len = int(max_len)
         self.eos_id = server.eos_id
         self._slots = [_Slot() for _ in range(self.S)]
         from collections import deque
@@ -180,11 +196,15 @@ class ContinuousBatcher:
         self._insert = insert
 
         top_k = server.top_k
+        # int8 serving: dequant inside the jit exactly like the server's
+        # prefill/decode paths (XLA fuses it into the matmuls; the int8
+        # copy stays the resident one)
+        deq = server._dequant
 
         @jax.jit
         def decode_step(params, caches, last_tok, next_pos, key, temperature):
             logits, caches = module.apply(
-                params,
+                deq(params),
                 last_tok[:, None],
                 positions=next_pos[:, None],
                 caches=caches,
@@ -268,6 +288,19 @@ class ContinuousBatcher:
             self.server._cfg.max_seq_len,
             self.max_len - 1,
         )
+        if len(ids) > plen:
+            # same tail-keeping rule as before, but observable: batched and
+            # unbatched serving can differ here (generate() sizes its cache
+            # per request; the batcher's slot cache is fixed at max_len)
+            logger.warning(
+                "batcher truncating %d-token prompt to its last %d tokens "
+                "(slot cache max_len=%d; raise continuous_batching_max_len "
+                "to match generate())", len(ids), plen, self.max_len)
+        if max_new > self.max_len - plen:
+            logger.warning(
+                "batcher will stop at %d new tokens (requested %d): slot "
+                "cache max_len=%d minus prompt %d",
+                self.max_len - plen, max_new, self.max_len, plen)
         ids = ids[-plen:]
         L = len(ids)
         tokens = np.zeros((1, plen), np.int32)

@@ -105,15 +105,7 @@ def _cast_params(params, param_dtype: str, module_dtype) -> Any:
     return jax.tree.map(cast, params)
 
 
-def _bucket(n: int, buckets: Sequence[int]) -> int:
-    """Smallest bucket >= n; beyond the largest bucket, round up to a multiple
-    of it (bounded compile count) instead of silently truncating the prompt —
-    the model's max_seq_len is the only hard cap (applied by callers)."""
-    for b in buckets:
-        if n <= b:
-            return b
-    top = buckets[-1]
-    return ((n + top - 1) // top) * top
+from seldon_core_tpu.utils import bucket as _bucket  # single bucketing policy
 
 
 # f32 init trees above this stream leaf-by-leaf through the quantizer
@@ -150,6 +142,7 @@ class LLMServer(SeldonComponent):
         quantize: str = "",
         param_dtype: str = "",
         continuous_batching: int = 0,
+        continuous_batching_max_len: int = 0,
         prefix_cache_size: int = 0,
         prefix_cache_bytes: int = 0,
         seed: int = 0,
@@ -188,6 +181,9 @@ class LLMServer(SeldonComponent):
         # ContinuousBatcher with this many slots (runtime/batcher.py), so
         # concurrent clients join one in-flight decode batch.
         self.continuous_batching = int(continuous_batching)
+        # cache length for the batcher's slot KV (0 = sized from the
+        # len_buckets; see ContinuousBatcher.__init__)
+        self.continuous_batching_max_len = int(continuous_batching_max_len) or None
         # Prefix caching (opt-in): single-prompt requests reuse the KV cache
         # of the longest previously-prefilled token prefix (shared system
         # prompts prefill once); entries are LRU-evicted past this size.
@@ -459,6 +455,16 @@ class LLMServer(SeldonComponent):
             for arr in layer:
                 n += int(getattr(arr, "nbytes", 0))
         return n
+
+    def clear_prefix_cache(self) -> None:
+        """Drop every cached prefix AND its byte accounting. Clearing the
+        OrderedDict directly instead leaves ``_prefix_bytes`` stuck at the
+        old total, and once that phantom total nears the budget every later
+        store immediately self-evicts — a permanent, silent 0% hit rate
+        (found at 7B where one entry is ~300 MB of the 512 MB default)."""
+        with self._prefix_lock:
+            self._prefix_cache.clear()
+            self._prefix_bytes = 0
 
     def _prefix_lookup(self, tokens: List[int], max_len: int):
         """Longest cached prefix of ``tokens`` with a compatible cache size;

@@ -1,0 +1,18 @@
+"""Small shared helpers with no heavier home."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n; beyond the largest bucket, round up to a
+    multiple of it (bounded compile count) instead of silently truncating —
+    any hard cap (model context, cache length) is applied by callers. The
+    single bucketing policy for prompt lengths (servers/llmserver.py) and
+    detector window counts (analytics/outliers.py)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    top = buckets[-1]
+    return ((n + top - 1) // top) * top

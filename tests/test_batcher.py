@@ -116,3 +116,29 @@ def test_batcher_rejects_after_close(server):
             await batcher.submit([3], max_new_tokens=2)
 
     asyncio.run(go())
+
+
+def test_continuous_batcher_int8_matches_generate():
+    """int8 serving: the batcher's decode_step must dequant inside the jit
+    like the server's own prefill/decode paths (round-5 fix: it applied
+    raw QuantizedTensor leaves and crashed at 7B)."""
+    import asyncio
+
+    from seldon_core_tpu.runtime.batcher import ContinuousBatcher
+    from seldon_core_tpu.servers.llmserver import LLMServer
+
+    kw = dict(vocab_size=96, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+              ffn_dim=64, max_seq_len=96)
+    s = LLMServer(model="transformer", model_kwargs=kw, init_random=True,
+                  max_new_tokens=6, len_buckets=(16,), batch_buckets=(1, 4),
+                  temperature=0.0, eos_id=-1, seed=3, quantize="int8")
+    s.load()
+    solo = s.generate([[5, 9, 11, 2]])["tokens"][0]
+
+    async def run():
+        b = ContinuousBatcher(s, max_slots=2)
+        got = await b.submit([5, 9, 11, 2])
+        await b.close()
+        return got
+
+    assert asyncio.run(run()) == solo

@@ -374,3 +374,71 @@ def test_streamed_quantized_init(monkeypatch):
     assert all(is_q(l) or getattr(l, "ndim", 0) <= 1 for l in leaves)
     out = server.generate([[1, 2, 3], [4, 5, 6]], max_new_tokens=8)
     assert [len(t) for t in out["tokens"]] == [8, 8]
+
+
+def test_clear_prefix_cache_resets_byte_accounting():
+    """Round-5 7B finding: clearing the OrderedDict directly leaves
+    _prefix_bytes at the old total, and once that phantom total nears
+    prefix_cache_bytes every later store self-evicts — 0% hits forever.
+    The public clear must reset both."""
+    from seldon_core_tpu.servers.llmserver import LLMServer
+
+    kw = dict(vocab_size=96, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+              ffn_dim=64, max_seq_len=96)
+    s = LLMServer(model="transformer", model_kwargs=kw, init_random=True,
+                  max_new_tokens=4, len_buckets=(16,), batch_buckets=(1,),
+                  temperature=0.0, eos_id=-1, seed=0, prefix_cache_size=4)
+    s.load()
+    s.generate([[5, 9, 11, 2]], max_new_tokens=1)
+    entry_bytes = s._prefix_bytes
+    assert entry_bytes > 0 and len(s._prefix_cache) == 1
+    # budget that fits exactly one entry: any phantom leftover evicts it
+    s.prefix_cache_bytes = entry_bytes
+    s.clear_prefix_cache()
+    assert s._prefix_bytes == 0
+    s.generate([[5, 9, 11, 2]], max_new_tokens=1)
+    assert len(s._prefix_cache) == 1  # stored, not self-evicted
+    s.generate([[5, 9, 11, 2, 7]], max_new_tokens=1)
+    assert s._prefix_hits >= 1
+
+
+def test_multi_turn_prefix_cache_e2e():
+    """Conversation-shaped e2e (VERDICT r4 #8): turn-2's prompt extends
+    turn-1's, the prefix cache must HIT, and the cached generation must be
+    token-identical to a cache-less twin. Runs at toy dims on CPU; the 7B
+    on-chip latency pair lives in benchmarks/report_llm_7b_serving.json
+    (device-isolated 1.27x cheaper cached prefill)."""
+    import numpy as np
+
+    from seldon_core_tpu.servers.llmserver import LLMServer
+
+    kw = dict(vocab_size=128, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+              ffn_dim=64, max_seq_len=256)
+    base = dict(model="transformer", model_kwargs=kw, init_random=True,
+                max_new_tokens=8, len_buckets=(16, 32, 64), batch_buckets=(1,),
+                temperature=0.0, eos_id=-1, seed=5)
+    cached = LLMServer(prefix_cache_size=4, **base)
+    plain = LLMServer(**base)
+    cached.load()
+    plain.load()
+
+    rng = np.random.default_rng(2)
+    turn1 = rng.integers(1, 127, size=16).tolist()
+    ans_cached = cached.generate([turn1])["tokens"][0]
+    ans_plain = plain.generate([turn1])["tokens"][0]
+    assert ans_cached == ans_plain
+
+    follow = rng.integers(1, 127, size=8).tolist()
+    turn2 = turn1 + ans_cached + follow
+    out_cached = cached.generate([turn2])["tokens"][0]
+    out_plain = plain.generate([turn2])["tokens"][0]
+    assert cached._prefix_hits >= 1  # turn-2 reused turn-1's KV
+    assert out_cached == out_plain  # cache changes cost, never tokens
+
+    # turn 3 extends turn 2 — the conversation keeps hitting
+    hits_before = cached._prefix_hits
+    turn3 = turn2 + out_cached + rng.integers(1, 127, size=8).tolist()
+    out3_cached = cached.generate([turn3])["tokens"][0]
+    out3_plain = plain.generate([turn3])["tokens"][0]
+    assert cached._prefix_hits > hits_before
+    assert out3_cached == out3_plain
