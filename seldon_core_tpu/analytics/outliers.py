@@ -399,12 +399,19 @@ class Seq2SeqOutlierDetector(_OutlierTransformer):
     its window's score, so tags()/metrics() keep their per-row shape.
     """
 
-    # NOT row-independent: 2-D scoring frames rows into timesteps windows,
-    # so stacking concurrent requests would slide window boundaries across
-    # request edges (request B's rows scored inside request A's window).
-    # Opting out of the row_slice protocol keeps this detector solo per
-    # request in the serving executor.
-    row_slice = None
+    # 2-D scoring frames rows into timesteps windows, so naive row-stacking
+    # of concurrent requests would slide window boundaries across request
+    # edges (request B's rows scored inside request A's window). The
+    # stack_segments protocol (the windowed analogue of row_slice) fixes
+    # that: the executor announces each stacked request's row count, rows
+    # are framed into windows PER SEGMENT, and the window batch — padded to
+    # a compile bucket — scores in one jitted call. row_slice (inherited)
+    # then hands each request its own rows' scores, which are identical to
+    # its solo scores because no window ever straddles a boundary
+    # (tests/test_outliers.py::test_seq2seq_stacked_matches_solo).
+
+    # window-count compile buckets; beyond the top, round up to its multiple
+    _W_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
     def __init__(
         self,
@@ -412,15 +419,63 @@ class Seq2SeqOutlierDetector(_OutlierTransformer):
         timesteps: int = 8,
         hidden_dim: int = 32,
         seed: int = 0,
+        model_uri: str = "",
         **kwargs: Any,
     ):
         super().__init__(threshold=threshold, **kwargs)
         self.timesteps = int(timesteps)
         self.hidden_dim = int(hidden_dim)
         self.seed = int(seed)
+        self.model_uri = model_uri
         self._params = None
         self._d: Optional[int] = None
         self._score_fn = None
+        self._pending_segments: Optional[List[int]] = None
+
+    def load(self) -> None:
+        """Adopt a FITTED detector pickled by ``save()`` from model_uri —
+        the serving path for a detector trained offline (same contract as
+        IsolationForest's joblib artifact; graphs declare
+        SEQ2SEQ_OD with a model_uri parameter)."""
+        if self._params is not None or not self.model_uri:
+            return
+        import os
+        import pickle
+
+        from seldon_core_tpu import storage
+
+        path = storage.download(self.model_uri)
+        candidate = os.path.join(path, "detector.pkl")
+        with open(candidate if os.path.exists(candidate) else path, "rb") as f:
+            fitted = pickle.load(f)
+        if not isinstance(fitted, Seq2SeqOutlierDetector) or fitted._params is None:
+            raise RuntimeError(
+                f"{self.model_uri} does not contain a fitted "
+                "Seq2SeqOutlierDetector (save() one after fit())")
+        for attr in ("threshold", "timesteps", "hidden_dim", "seed",
+                     "_params", "_d"):
+            setattr(self, attr, getattr(fitted, attr))
+        self._score_fn = None  # rebuilt lazily for the adopted dims
+
+    def save(self, out_dir: str) -> str:
+        """Pickle this fitted detector as <out_dir>/detector.pkl (the
+        artifact ``load()`` consumes)."""
+        import os
+        import pickle
+
+        if self._params is None:
+            raise RuntimeError("fit() before save()")
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, "detector.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(self, f)
+        return path
+
+    def stack_segments(self, counts: Sequence[int]) -> None:
+        """Executor protocol: the NEXT 2-D score() call's rows are the
+        concatenation of ``len(counts)`` requests with these row counts.
+        Consumed once; without it a call is one segment (solo semantics)."""
+        self._pending_segments = [int(c) for c in counts]
 
     def _module(self, d: int):
         import flax.linen as nn
@@ -507,6 +562,11 @@ class Seq2SeqOutlierDetector(_OutlierTransformer):
 
         self._score_fn = score_fn
 
+    def _w_bucket(self, w: int) -> int:
+        from seldon_core_tpu.utils import bucket
+
+        return bucket(w, self._W_BUCKETS)
+
     def score(self, X: np.ndarray) -> np.ndarray:
         if self._params is None:
             raise RuntimeError("Seq2SeqOutlierDetector needs fit() before scoring")
@@ -514,8 +574,33 @@ class Seq2SeqOutlierDetector(_OutlierTransformer):
             self._build_score()
         import jax.numpy as jnp
 
-        windows, row_map = self._frame(X)
-        per_window = np.asarray(self._score_fn(self._params, jnp.asarray(windows)))
+        segs, self._pending_segments = self._pending_segments, None
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 3 or not segs or sum(segs) != np.atleast_2d(X).shape[0]:
+            # solo request (or per-sequence 3-D input, where rows are
+            # already independent windows): one segment
+            windows, row_map = self._frame(X)
+        else:
+            # stacked 2-D call: frame each request's rows separately so no
+            # window straddles a request boundary, then score every window
+            # in one batch
+            X = np.atleast_2d(X)
+            parts, maps, off, woff = [], [], 0, 0
+            for c in segs:
+                w, m = self._frame(X[off:off + c])
+                parts.append(w)
+                maps.append(m + woff)
+                off += c
+                woff += len(w)
+            windows = np.concatenate(parts, axis=0)
+            row_map = np.concatenate(maps)
+        w = len(windows)
+        padded = self._w_bucket(w)
+        if padded != w:  # repeat-pad to the compile bucket; scores sliced off
+            windows = np.concatenate(
+                [windows, np.repeat(windows[-1:], padded - w, axis=0)], axis=0)
+        per_window = np.asarray(
+            self._score_fn(self._params, jnp.asarray(windows)))[:w]
         if row_map is None:
             return per_window
         return per_window[row_map]

@@ -226,7 +226,8 @@ class ModelExecutor:
         return _RESP_HEADER.pack(req_id, 1) + _error_body(info, reason, code)
 
     # ---- execution ----------------------------------------------------
-    def _call_stacked(self, call, items, max_rows, finish, fail, finish_chunk=None):
+    def _call_stacked(self, call, items, max_rows, finish, fail, finish_chunk=None,
+                      set_segments=None):
         """Shared micro-batch machinery: ``items`` = [(key, arr)] with equal
         trailing shapes; concatenates into chunks of <= max_rows rows, one
         call per chunk, splits results back per key. Both the plain frame
@@ -237,7 +238,12 @@ class ModelExecutor:
         stacked chunk at once (the C bulk-response path); returning False
         falls back to per-frame ``finish``, and returning a set of keys
         marks those frames as already answered (partial bulk push) so only
-        the REMAINING frames take the per-frame path."""
+        the REMAINING frames take the per-frame path.
+
+        ``set_segments(counts)``, when given, is told each chunk's
+        per-frame row counts right before the stacked call — the windowed
+        components' stack_segments protocol (window framing must not
+        straddle request boundaries; analytics/outliers.py Seq2Seq)."""
         idx = 0
         while idx < len(items):
             chunk = []
@@ -257,6 +263,8 @@ class ModelExecutor:
                     finish(key, np.asarray(call(arr)))
                     continue
                 stacked = np.concatenate([a for _, a in chunk], axis=0)
+                if set_segments is not None:
+                    set_segments([a.shape[0] for _, a in chunk])
                 result = np.asarray(call(stacked))
                 if result.shape[:1] != stacked.shape[:1]:
                     raise SeldonError(
@@ -433,8 +441,11 @@ class ModelExecutor:
                 return True
         else:
             finish_chunk = self._chunk_pusher(model_id, method, component, rings)
+        seg_hook = (getattr(component, "stack_segments", None)
+                    if row_sliced else None)
         for shape, group in by_shape.items():
-            self._call_stacked(call, group, max_rows, finish, fail, finish_chunk)
+            self._call_stacked(call, group, max_rows, finish, fail, finish_chunk,
+                               set_segments=seg_hook)
         for key, arr in solo:
             try:
                 finish(key, np.asarray(call(arr)))
@@ -558,9 +569,12 @@ class ModelExecutor:
                             current[k] = result[off:off + rows]
                             off += rows
                         return True
+                seg_hook = (getattr(component, "stack_segments", None)
+                            if row_sliced else None)
                 for shape, items in by_shape.items():
                     self._call_stacked(call, items, self.max_rows[model_id],
-                                       finish_stage, fail, finish_chunk)
+                                       finish_stage, fail, finish_chunk,
+                                       set_segments=seg_hook)
                 for k in solo:
                     try:
                         finish_stage(k, np.asarray(call(current[k])))

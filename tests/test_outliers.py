@@ -1,0 +1,73 @@
+"""Seq2Seq stacking protocol (stack_segments, round 5): window-granularity
+batching with solo-identical scores."""
+
+import numpy as np
+
+from seldon_core_tpu.analytics import Seq2SeqOutlierDetector
+
+
+def test_seq2seq_stacked_matches_solo():
+    """stack_segments parity: framing windows per segment makes stacked
+    scoring bit-identical to solo scoring for every segment, including
+    tail-padded ones (rows not a multiple of timesteps), and padding the
+    window batch to a compile bucket must not change scores."""
+    rng = np.random.default_rng(11)
+    det = Seq2SeqOutlierDetector(timesteps=4, hidden_dim=8, seed=1)
+    det.fit(rng.normal(size=(40, 3)), epochs=10)
+
+    batches = [rng.normal(size=(r, 3)) for r in (6, 4, 9, 1)]
+    solo = [np.asarray(det.score(b)) for b in batches]
+
+    det.stack_segments([b.shape[0] for b in batches])
+    stacked = np.asarray(det.score(np.concatenate(batches, axis=0)))
+    off = 0
+    for b, s in zip(batches, solo):
+        np.testing.assert_array_equal(stacked[off:off + b.shape[0]], s)
+        off += b.shape[0]
+
+    # consume-once: the next plain call is solo semantics again
+    plain = np.asarray(det.score(np.concatenate(batches, axis=0)))
+    assert plain.shape == stacked.shape
+    with np.testing.assert_raises(AssertionError):
+        np.testing.assert_array_equal(plain, stacked)  # boundaries differ
+
+
+def test_seq2seq_stale_segment_counts_fall_back_to_solo():
+    """A segment list that does not sum to the batch's rows (stale or
+    foreign) must be ignored, not crash or mis-frame."""
+    rng = np.random.default_rng(3)
+    det = Seq2SeqOutlierDetector(timesteps=4, hidden_dim=8, seed=1)
+    det.fit(rng.normal(size=(16, 2)), epochs=5)
+    X = rng.normal(size=(8, 2))
+    want = np.asarray(det.score(X))
+    det.stack_segments([3, 3])  # sums to 6 != 8
+    got = np.asarray(det.score(X))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_seq2seq_save_load_roundtrip(tmp_path):
+    """Offline-fit -> save() -> serve-side load() via model_uri: the
+    adopted detector scores identically to the fitted original."""
+    rng = np.random.default_rng(7)
+    det = Seq2SeqOutlierDetector(timesteps=4, hidden_dim=8, seed=2,
+                                 threshold=0.4)
+    det.fit(rng.normal(size=(24, 3)), epochs=5)
+    det.save(str(tmp_path))
+
+    served = Seq2SeqOutlierDetector(model_uri=str(tmp_path))
+    served.load()
+    assert served.threshold == det.threshold
+    X = rng.normal(size=(9, 3))
+    np.testing.assert_array_equal(served.score(X), det.score(X))
+
+
+def test_seq2seq_load_rejects_unfitted(tmp_path):
+    import pickle
+
+    with open(tmp_path / "detector.pkl", "wb") as f:
+        pickle.dump(Seq2SeqOutlierDetector(), f)
+    det = Seq2SeqOutlierDetector(model_uri=str(tmp_path))
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError):
+        det.load()
