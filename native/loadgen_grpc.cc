@@ -111,6 +111,7 @@ struct Conn {
 };
 
 struct Stats {
+  uint64_t shed = 0;
   std::vector<uint32_t> lat_us;
   uint64_t ok = 0, errors = 0;
 };
@@ -281,10 +282,18 @@ int main(int argc, char** argv) {
                           payload.find(std::string_view("grpc-status\x01"
                                                         "0", 13)) !=
                               std::string_view::npos;
+                // RESOURCE_EXHAUSTED (status 8) = deterministic overload
+                // shed: well-formed by design, counted apart from failures
+                bool is_shed = !ok && payload.find(std::string_view(
+                                          "grpc-status\x01"
+                                          "8", 13)) != std::string_view::npos;
                 if (measuring) {
                   if (ok) ++stats.ok;
+                  else if (is_shed) ++stats.shed;
                   else ++stats.errors;
-                  stats.lat_us.push_back((uint32_t)(lat / 1000));
+                  // percentiles describe SERVED requests only (see
+                  // loadgen_http.cc: sheds are near-instant by design)
+                  if (ok) stats.lat_us.push_back((uint32_t)(lat / 1000));
                 }
                 c.t_send.erase(it);
                 start_stream(c);
@@ -333,12 +342,12 @@ int main(int argc, char** argv) {
   for (auto v : stats.lat_us) mean += v;
   mean = stats.lat_us.empty() ? 0 : mean / stats.lat_us.size() / 1000.0;
   printf("{\"label\": \"%s\", \"throughput_rps\": %.2f, \"requests\": %" PRIu64
-         ", \"failures\": %" PRIu64
+         ", \"failures\": %" PRIu64 ", \"shed\": %" PRIu64
          ", \"duration_s\": %.2f, \"connections\": %d, \"streams_per_conn\": %d, "
          "\"latency_ms\": {\"mean\": %.3f, \"p50\": %.3f, \"p75\": %.3f, "
          "\"p90\": %.3f, \"p95\": %.3f, \"p98\": %.3f, \"p99\": %.3f, "
          "\"max\": %.3f}}\n",
-         label, (stats.ok + stats.errors) / elapsed, stats.ok, stats.errors,
+         label, stats.ok / elapsed, stats.ok, stats.errors, stats.shed,
          elapsed, connections, streams_per_conn, mean, pct(50), pct(75),
          pct(90), pct(95), pct(98), pct(99),
          stats.lat_us.empty() ? 0 : stats.lat_us.back() / 1000.0);
