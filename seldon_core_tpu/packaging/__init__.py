@@ -1,0 +1,14 @@
+"""Container packaging: wrap user model code into a servable image.
+
+The tpu-native equivalent of the reference's s2i python wrapper pipeline
+(`wrappers/s2i/python/s2i/bin/assemble` + `run` + `Dockerfile.tmpl`):
+instead of source-to-image injection, `wrap` layers the user's model
+directory onto the engine image and bakes the microservice invocation the
+s2i `run` script would have exec'd.
+"""
+
+from seldon_core_tpu.packaging.wrap import (  # noqa: F401
+    containerfile_for_model,
+    detect_runtime,
+    wrap_model,
+)

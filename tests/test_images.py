@@ -1,0 +1,131 @@
+"""Container packaging (VERDICT r4 missing #1): the images the manifests
+reference must be buildable from this repo, and user model code must wrap
+into a servable image (the reference's s2i pipeline role —
+wrappers/s2i/python/s2i/bin/run:10-20, assemble, Dockerfile.tmpl).
+
+Structural tests always run; the build+boot test needs a container runtime
+(skip-guarded; `.github/workflows/ci.yaml` image-build job forbids the
+skip in CI, same pattern as helm-parity)."""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from seldon_core_tpu.packaging import (
+    containerfile_for_model,
+    detect_runtime,
+    wrap_model,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+IMAGES_DIR = os.path.join(REPO, "deploy", "images")
+
+
+def test_containerfiles_exist_for_every_referenced_image():
+    """Every image name the shipped manifests reference has a build path."""
+    referenced = set()
+    op = open(os.path.join(REPO, "deploy", "operator.yaml")).read()
+    referenced.update(re.findall(r"image:\s*(\S+)", op))
+    values = yaml.safe_load(
+        open(os.path.join(REPO, "deploy", "charts",
+                          "seldon-core-tpu-operator", "values.yaml")))
+    referenced.add(values["operator"]["image"])
+    referenced.add(values["engine"]["image"])
+    for image in referenced:
+        name = image.split("/")[-1].split(":")[0]
+        path = os.path.join(IMAGES_DIR, f"Containerfile.{name}")
+        assert os.path.exists(path), f"{image} referenced but {path} missing"
+
+
+def test_engine_containerfile_matches_render_contract():
+    """The rendered Deployment passes args ["engine", ...] — the image's
+    ENTRYPOINT must be the CLI for that to dispatch (render.py:70)."""
+    text = open(os.path.join(IMAGES_DIR, "Containerfile.engine")).read()
+    assert "seldon_core_tpu.transport.cli" in text
+    assert "native" in text  # native edge compiled into the image
+    # source layout preserved: edgeprogram resolves native/ from repo root
+    assert "PYTHONPATH=/app" in text
+
+
+def test_wrap_generates_s2i_equivalent_containerfile(tmp_path):
+    (tmp_path / "MyModel.py").write_text(
+        "class MyModel:\n    def predict(self, X, names=None):\n        return X\n")
+    (tmp_path / "requirements.txt").write_text("numpy\n")
+    cmd = wrap_model("MyModel", str(tmp_path), "example/mymodel:0.1",
+                     api="GRPC", install_requirements=True, persistence=True)
+    assert cmd[1:] == ["build", "-f", str(tmp_path / "Containerfile"),
+                       "-t", "example/mymodel:0.1", str(tmp_path)]
+    text = (tmp_path / "Containerfile").read_text()
+    assert "FROM seldon-core-tpu/engine:latest" in text
+    assert "MODEL_NAME=MyModel" in text
+    assert "API_TYPE=GRPC" in text
+    assert "PERSISTENCE=1" in text
+    assert "requirements.txt" in text
+    # the baked command is the wrapper CLI, knobs via env (s2i run contract)
+    assert "microservice" in text and "$MODEL_NAME" in text
+
+
+def test_wrap_requires_model_file(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        wrap_model("Missing", str(tmp_path), "x:y")
+
+
+def test_wrap_rejects_unknown_api(tmp_path):
+    with pytest.raises(ValueError):
+        containerfile_for_model("M", api="SOAP")
+
+
+@pytest.mark.skipif(detect_runtime() is None,
+                    reason="no container runtime on this host (CI forces)")
+def test_build_and_boot_engine_image(tmp_path):
+    """Build the engine image from the checkout and serve a real graph from
+    it: /ready then a prediction through the containerized engine."""
+    runtime = detect_runtime()
+    subprocess.run(
+        [runtime, "build", "-f",
+         os.path.join(IMAGES_DIR, "Containerfile.engine"),
+         "-t", "seldon-core-tpu/engine:test", REPO],
+        check=True)
+    spec = {"name": "p", "graph": {
+        "name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    import base64
+
+    # same env + args contract the rendered Deployment uses (render.py)
+    encoded = base64.b64encode(json.dumps(spec).encode()).decode()
+    proc = subprocess.Popen(
+        [runtime, "run", "--rm", "-p", f"{port}:8000",
+         "-e", "ENGINE_PREDICTOR=" + encoded,
+         "seldon-core-tpu/engine:test", "engine", "--port", "8000"])
+    try:
+        deadline = time.time() + 120
+        ready = False
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/ready", timeout=2):
+                    ready = True
+                    break
+            except Exception:
+                time.sleep(1)
+        assert ready, "containerized engine never became ready"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v0.1/predictions",
+            data=b'{"data":{"ndarray":[[1.0]]}}',
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.load(r)
+        assert out["data"]["ndarray"][0]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
