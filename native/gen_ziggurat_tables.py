@@ -220,9 +220,11 @@ def sim_standard_gamma(s: Stream, T: dict, shape: float) -> float:
         U = s.dbl()
         if U < 1.0 - 0.0331 * (X * X) * (X * X):
             return b * V
-        if U == 0.0:
-            continue  # log(0) guard: numpy compares log(U); U==0 -> -inf < rhs is False only if rhs -inf; replicate via explicit check below
-        if math.log(U) < 0.5 * X * X + b * (1.0 - V + math.log(V)):
+        # numpy computes a bare log(U): U==0 gives -inf, which compares True
+        # against the finite rhs — numpy ACCEPTS and returns b*V. Mirror that
+        # exactly (math.log(0) would raise, so map it to -inf explicitly).
+        logU = math.log(U) if U > 0.0 else -math.inf
+        if logU < 0.5 * X * X + b * (1.0 - V + math.log(V)):
             return b * V
 
 

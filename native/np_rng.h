@@ -243,7 +243,8 @@ struct NpRng {
       V = V * V * V;
       double U = random();
       if (U < 1.0 - 0.0331 * (X * X) * (X * X)) return b * V;
-      // log(0.0) = -inf rejects, matching numpy's bare log(U) compare
+      // log(0.0) = -inf ACCEPTS (-inf < finite rhs), matching numpy's bare
+      // log(U) compare
       if (log(U) < 0.5 * X * X + b * (1.0 - V + log(V))) return b * V;
     }
   }

@@ -103,14 +103,20 @@ def _maybe_continuous_batch(component: Any, request: SeldonMessage):
     if svc is None:
         return None
 
+    info: dict = {}
+
     def to_msg(toks):
         # same shape + meta as the unbatched path: LLMServer.predict returns
         # {"texts": [...], "tokens": [[...]]} for jsonData prompts
         tokenizer = getattr(component, "_tokenizer", None)
         text = (tokenizer.decode(toks) if tokenizer is not None
                 and isinstance(body["prompt"], str) else None)
-        return construct_response(
+        msg = construct_response(
             component, False, request, {"texts": [text], "tokens": [toks]})
+        if info.get("truncated_prompt"):
+            # truncation changes outputs — tell the CLIENT, not just the log
+            msg.meta.tags["seldon.io/truncated-prompt"] = info["truncated_prompt"]
+        return msg
 
     import asyncio
 
@@ -118,12 +124,14 @@ def _maybe_continuous_batch(component: Any, request: SeldonMessage):
         asyncio.get_running_loop()
     except RuntimeError:
         # sync transport (gRPC worker thread): block this thread only
-        return to_msg(svc.submit_sync(body["prompt"], body.get("max_new_tokens")))
+        return to_msg(svc.submit_sync(body["prompt"], body.get("max_new_tokens"),
+                                      info=info))
 
     async def run():
         # async transport (graph engine, REST app, ring handler): never block
         # the event loop while the shared batch decodes
-        toks = await svc.submit(body["prompt"], body.get("max_new_tokens"))
+        toks = await svc.submit(body["prompt"], body.get("max_new_tokens"),
+                                info=info)
         return to_msg(toks)
 
     return run()

@@ -62,6 +62,60 @@ class MetricsRegistry:
         self._custom_counters: Dict[str, Counter] = {}
         self._custom_gauges: Dict[str, Gauge] = {}
         self._custom_timers: Dict[str, Histogram] = {}
+        # resilience layer (runtime/resilience.py): shed + deadline counters,
+        # breaker state gauges/transition counters, admission occupancy
+        self._shed = Counter(
+            "seldon_resilience_shed_total",
+            "Requests shed at admission (503 + Retry-After / RESOURCE_EXHAUSTED)",
+            base + ["transport"],
+            registry=self.registry,
+        )
+        self._deadline_exceeded = Counter(
+            "seldon_resilience_deadline_exceeded_total",
+            "Requests that exhausted their deadline budget",
+            base + ["transport"],
+            registry=self.registry,
+        )
+        self._breaker_state = Gauge(
+            "seldon_resilience_breaker_state",
+            "Per-node circuit breaker state (0 closed, 1 half-open, 2 open)",
+            base + ["node"],
+            registry=self.registry,
+        )
+        self._breaker_transitions = Counter(
+            "seldon_resilience_breaker_transitions_total",
+            "Per-node circuit breaker transitions by target state",
+            base + ["node", "to"],
+            registry=self.registry,
+        )
+        self._breaker_rejected = Counter(
+            "seldon_resilience_breaker_rejected_total",
+            "Calls rejected by an open circuit breaker",
+            base + ["node"],
+            registry=self.registry,
+        )
+        self._inflight = Gauge(
+            "seldon_resilience_inflight",
+            "Admitted requests currently in flight",
+            base + ["transport"],
+            registry=self.registry,
+        )
+        self._queue_depth = Gauge(
+            "seldon_resilience_queue_depth",
+            "Requests waiting in the admission queue",
+            base + ["transport"],
+            registry=self.registry,
+        )
+        self._remaining_budget = Histogram(
+            "seldon_resilience_remaining_budget_seconds",
+            "Deadline budget remaining at response time",
+            base,
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        # breakers publish transitions through on_transition; remember which
+        # are wired so scrape-time syncs are idempotent
+        self._bound_breakers: set = set()
 
     # ------------------------------------------------------------------
     def _base(self) -> Dict[str, str]:
@@ -79,6 +133,52 @@ class MetricsRegistry:
         self._feedback.labels(**self._base()).inc()
         if feedback.reward:
             self._feedback_reward.labels(**self._base()).inc(abs(feedback.reward))
+
+    # ------------------------------------------------------------------
+    # Resilience observability (runtime/resilience.py)
+    # ------------------------------------------------------------------
+    def observe_deadline_exceeded(self, transport: str) -> None:
+        self._deadline_exceeded.labels(**self._base(), transport=transport).inc()
+
+    def observe_remaining_budget(self, seconds: float) -> None:
+        self._remaining_budget.labels(**self._base()).observe(max(seconds, 0.0))
+
+    def sync_resilience(
+        self,
+        engine: Any = None,
+        admission: Any = None,
+        transport: str = "rest",
+    ) -> None:
+        """Refresh breaker/admission gauges at scrape time; wires each
+        breaker's transition callback to the transitions counter on first
+        sight (idempotent — scraped every /metrics hit)."""
+        if engine is not None and hasattr(engine, "breakers"):
+            for node, breaker in engine.breakers():
+                if id(breaker) not in self._bound_breakers:
+                    self._bound_breakers.add(id(breaker))
+                    counter = self._breaker_transitions
+
+                    def on_transition(name, to, _c=counter):
+                        _c.labels(**self._base(), node=name, to=to).inc()
+
+                    breaker.on_transition = on_transition
+                self._breaker_state.labels(**self._base(), node=node).set(breaker.state_code())
+                rejected = self._breaker_rejected.labels(**self._base(), node=node)
+                # counter catch-up from the breaker's own tally (breaker
+                # rejections happen on the engine hot path, counted locally
+                # to avoid a labels() lookup per call)
+                delta = breaker.rejected_total - rejected._value.get()
+                if delta > 0:
+                    rejected.inc(delta)
+        if admission is not None:
+            self._inflight.labels(**self._base(), transport=transport).set(admission.inflight)
+            self._queue_depth.labels(**self._base(), transport=transport).set(
+                admission.queue_depth()
+            )
+            shed = self._shed.labels(**self._base(), transport=transport)
+            delta = admission.shed_total - shed._value.get()
+            if delta > 0:
+                shed.inc(delta)
 
     # ------------------------------------------------------------------
     def register_custom(self, response: SeldonMessage) -> None:

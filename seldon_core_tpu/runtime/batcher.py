@@ -73,17 +73,20 @@ class BatcherService:
         self.submitted = 0
 
     def submit_sync(self, prompt: Any, max_new_tokens: Optional[int] = None,
-                    timeout_s: float = 600.0) -> List[int]:
+                    timeout_s: float = 600.0,
+                    info: Optional[dict] = None) -> List[int]:
         self.submitted += 1
         return asyncio.run_coroutine_threadsafe(
-            self.batcher.submit(prompt, max_new_tokens), self._loop
+            self.batcher.submit(prompt, max_new_tokens, info=info), self._loop
         ).result(timeout_s)
 
     async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None,
-                     on_token: Optional[Any] = None) -> List[int]:
+                     on_token: Optional[Any] = None,
+                     info: Optional[dict] = None) -> List[int]:
         self.submitted += 1
         cfut = asyncio.run_coroutine_threadsafe(
-            self.batcher.submit(prompt, max_new_tokens, on_token=on_token),
+            self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
+                                info=info),
             self._loop)
         return await asyncio.wrap_future(cfut)
 
@@ -160,6 +163,11 @@ class ContinuousBatcher:
         # deployment expecting longer prompts passes max_len explicitly
         # (LLMServer.continuous_batching_max_len).
         self.len_buckets = tuple(len_buckets or server.len_buckets)
+        if max_len is not None and int(max_len) <= 0:
+            # 0/negative means "unset" from every caller's point of view;
+            # taking it literally would produce plen=min(...,-1) nonsense
+            # tail slicing (ADVICE.md round 5)
+            max_len = None
         if max_len is None:
             max_len = min(2 * max(self.len_buckets), cfg.max_seq_len) + max(
                 int(server.max_new_tokens), 1
@@ -224,13 +232,19 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------------
     async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None,
-                     on_token: Optional[Any] = None) -> List[int]:
+                     on_token: Optional[Any] = None,
+                     info: Optional[dict] = None) -> List[int]:
         """prompt: str or token sequence. Resolves to generated token ids.
 
         ``on_token(tok)`` (optional) fires for every generated token as it is
         decoded and ``on_token(None)`` once at completion — from a worker
         thread, so the callback must be thread-safe (streaming transports
-        bridge it onto their loop with call_soon_threadsafe)."""
+        bridge it onto their loop with call_soon_threadsafe).
+
+        ``info`` (optional dict) is filled in-place at admission with
+        anything the caller should surface to the client — today the
+        ``truncated_prompt`` record when the slot cache is smaller than the
+        prompt (transports attach it to the response meta)."""
         if self._closed:
             raise RuntimeError("batcher closed")
         if isinstance(prompt, str):
@@ -242,7 +256,8 @@ class ContinuousBatcher:
         self._loop = asyncio.get_running_loop()
         fut: asyncio.Future = self._loop.create_future()
         self._pending.append(
-            (ids, int(max_new_tokens or self.server.max_new_tokens), fut, on_token))
+            (ids, int(max_new_tokens or self.server.max_new_tokens), fut,
+             on_token, info))
         self._ensure_running()
         self._wakeup.set()
         return await fut
@@ -273,7 +288,8 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------------
     def _admit(self, ids: List[int], max_new: int, fut: asyncio.Future,
-               on_token: Optional[Any] = None) -> bool:
+               on_token: Optional[Any] = None,
+               info: Optional[dict] = None) -> bool:
         import jax.numpy as jnp
 
         from seldon_core_tpu.models.transformer import PAD_POS
@@ -291,7 +307,16 @@ class ContinuousBatcher:
         if len(ids) > plen:
             # same tail-keeping rule as before, but observable: batched and
             # unbatched serving can differ here (generate() sizes its cache
-            # per request; the batcher's slot cache is fixed at max_len)
+            # per request; the batcher's slot cache is fixed at max_len).
+            # The info record travels back to the CLIENT as a response meta
+            # tag / field — truncation changes outputs, so a server-side log
+            # alone is not enough (ADVICE.md round 5)
+            if info is not None:
+                info["truncated_prompt"] = {
+                    "prompt_tokens": len(ids),
+                    "kept_tokens": plen,
+                    "max_len": self.max_len,
+                }
             logger.warning(
                 "batcher truncating %d-token prompt to its last %d tokens "
                 "(slot cache max_len=%d; raise continuous_batching_max_len "
@@ -388,9 +413,9 @@ class ContinuousBatcher:
                 # device work runs in a worker thread so the event loop (and
                 # co-hosted HTTP handlers) stays responsive during decode
                 while self._pending:
-                    ids, max_new, fut, on_token = self._pending[0]
+                    ids, max_new, fut, on_token, info = self._pending[0]
                     if not await asyncio.to_thread(self._admit, ids, max_new, fut,
-                                                   on_token):
+                                                   on_token, info):
                         break  # no free slot — decode until one frees up
                     self._pending.popleft()
                 if any(s.active for s in self._slots):
@@ -421,7 +446,7 @@ class ContinuousBatcher:
                     slot.active = False
                     slot.future = None
             while self._pending:
-                _, _, fut, on_token = self._pending.popleft()
+                _, _, fut, on_token, _ = self._pending.popleft()
                 if on_token is not None:
                     try:
                         on_token(None)

@@ -44,6 +44,67 @@ EDGE_BINARY = os.path.join(_NATIVE_DIR, "build", "seldon_edge")
 LOADGEN_BINARY = os.path.join(_NATIVE_DIR, "build", "seldon_loadgen")
 
 
+# Golden draws recorded from numpy 2.0.2 — the version the checked-in
+# ziggurat tables (native/ziggurat_tables.h) and np_rng.h replay logic were
+# extracted from and verified against. Seeded-native routing is only sound
+# when the INSTALLED numpy produces these exact streams: the native edge
+# replays numpy draw-for-draw, and the Python engine plane uses the installed
+# numpy directly, so any drift would silently desync the two planes
+# (ADVICE.md round 5). pyproject pins numpy to a known-good range; this probe
+# is the belt-and-braces runtime check before enabling seeded-native compile.
+_NUMPY_PARITY_SEED_BETA = 20260803
+_NUMPY_PARITY_BETA = (
+    ((1.0, 1.0), 0.8861055853627264),
+    ((0.5, 0.5), 0.2187824033435847),
+    ((2.5, 1.7), 0.6781937015134641),
+    ((9.3, 0.2), 0.9919305747956653),
+)
+_NUMPY_PARITY_SEED_GAMMA = 7
+_NUMPY_PARITY_GAMMA = (
+    (0.4, 0.309950474806918),
+    (1.0, 0.5685486573832514),
+    (3.7, 1.982692295846162),
+)
+_NUMPY_PARITY_SEED_INT = 123
+_NUMPY_PARITY_INTEGERS = (15, 682, 592, 53)
+_NUMPY_PARITY_UNIFORM = (0.22035987277261138, 0.1843718106986697)
+
+_numpy_parity_cache: Optional[bool] = None
+
+
+def numpy_stream_parity_ok() -> bool:
+    """Cheap startup probe: do the installed numpy's Generator streams
+    (beta/gamma ziggurat paths, Lemire integers, uniform doubles) still match
+    the numpy 2.0.2 goldens the native replay was extracted from? Bit-exact
+    comparison — parity is all-or-nothing. Cached after the first call."""
+    global _numpy_parity_cache
+    if _numpy_parity_cache is not None:
+        return _numpy_parity_cache
+    import numpy as np
+
+    ok = True
+    try:
+        g = np.random.Generator(np.random.PCG64(_NUMPY_PARITY_SEED_BETA))
+        ok &= all(g.beta(a, b) == want for (a, b), want in _NUMPY_PARITY_BETA)
+        g = np.random.Generator(np.random.PCG64(_NUMPY_PARITY_SEED_GAMMA))
+        ok &= all(g.standard_gamma(shape) == want for shape, want in _NUMPY_PARITY_GAMMA)
+        g = np.random.Generator(np.random.PCG64(_NUMPY_PARITY_SEED_INT))
+        ok &= tuple(g.integers(0, 1000, 4).tolist()) == _NUMPY_PARITY_INTEGERS
+        ok &= tuple(g.random(2).tolist()) == _NUMPY_PARITY_UNIFORM
+    except Exception:
+        ok = False
+    if not ok:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "installed numpy %s diverges from the 2.0.2 streams the native "
+            "tables were extracted from; seeded units stay on the Python "
+            "engine (native replay would desync)", np.__version__,
+        )
+    _numpy_parity_cache = bool(ok)
+    return _numpy_parity_cache
+
+
 def build_edge_binaries() -> bool:
     """Build the native edge/loadgens if needed; False when no toolchain."""
     binaries = (EDGE_BINARY, LOADGEN_BINARY, LOADGEN_BINARY + "_grpc")
@@ -167,6 +228,11 @@ def compile_edge_program(
                 # program JSON carries numbers as doubles): Python plane
                 return None
         except (TypeError, ValueError):
+            return None
+        if seed is not None and not numpy_stream_parity_ok():
+            # installed numpy drifted from the recorded 2.0.2 streams: the
+            # native replay would silently desync from the Python plane, so
+            # seeded units fall back to the Python engine
             return None
         if kind in ("EPSILON_GREEDY", "THOMPSON_SAMPLING"):
             # Parameters the Python constructor would reject must surface as

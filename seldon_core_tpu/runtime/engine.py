@@ -53,8 +53,21 @@ from seldon_core_tpu.contracts.payload import (
     SeldonMessage,
     SeldonMessageList,
 )
+from seldon_core_tpu.runtime.resilience import (
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    ResilienceConfig,
+    current_deadline,
+    deadline_scope,
+    failure_counts_for_breaker,
+)
 
 logger = logging.getLogger(__name__)
+
+TAG_PARTIAL_RESPONSE = "seldon.io/partial-response"
+TAG_DROPPED_BRANCHES = "seldon.io/dropped-branches"
+TAG_REROUTED = "seldon.io/rerouted"
 
 ComponentFactory = Callable[[PredictiveUnit], SeldonComponent]
 
@@ -79,6 +92,28 @@ def _drive_sync(coro):
     raise _Suspended()
 
 
+def _is_async_component(comp) -> bool:
+    """Does this component's execution leave the process or suspend for real
+    (remote endpoint, is_async marker, or any `async def` method)?"""
+    if comp is None:
+        return False
+    from seldon_core_tpu.runtime.remote import RemoteComponent
+
+    if isinstance(comp, RemoteComponent) or getattr(comp, "is_async", False):
+        return True
+    # _call also supports plain `async def` methods (awaitable
+    # results) without the is_async marker — those suspend for real
+    for name in ("predict", "transform_input", "transform_output",
+                 "route", "aggregate", "send_feedback",
+                 "predict_raw", "transform_input_raw",
+                 "transform_output_raw", "route_raw",
+                 "aggregate_raw", "send_feedback_raw"):
+        meth = getattr(comp, name, None)
+        if meth is not None and inspect.iscoroutinefunction(meth):
+            return True
+    return False
+
+
 def make_puid() -> str:
     """Request id: 26 base32-ish chars, the entropy class of the reference's
     SecureRandom 130-bit id (`service/PredictionService.java:77-83`)."""
@@ -98,6 +133,9 @@ class UnitState:
     component: Optional[SeldonComponent]
     children: List["UnitState"] = field(default_factory=list)
     image: str = ""
+    # Per-node circuit breaker; built only for remote/async nodes (local
+    # in-process calls cannot flake independently of the server itself).
+    breaker: Optional[CircuitBreaker] = None
     # Set when this node's entire subtree fused into one jitted callable.
     fused_fn: Optional[Callable[[Any], Any]] = None
     # All units covered by fused_fn, and the component whose class_names/
@@ -150,6 +188,7 @@ class GraphEngine:
         fuse: bool = True,
         remote_client: Optional[Any] = None,
         annotations: Optional[Dict[str, str]] = None,
+        resilience: Optional[ResilienceConfig] = None,
     ):
         self.spec = spec
         self._components = dict(components or {})
@@ -159,6 +198,7 @@ class GraphEngine:
         # deployment annotations tune the remote-node client (retry counts,
         # connect/read deadlines — the reference's per-deployment flags)
         self._annotations = dict(annotations or {})
+        self.resilience = resilience or ResilienceConfig.from_annotations(self._annotations)
         self.state = self._build(spec)
         if fuse:
             self._try_fuse(self.state.root)
@@ -168,28 +208,21 @@ class GraphEngine:
         # below for this case — asyncio.gather). The IPC drain uses this to
         # execute plane-3 frames inline on its own thread, skipping the
         # event-loop hop entirely.
-        from seldon_core_tpu.runtime.remote import RemoteComponent
-
-        def _is_async_component(comp) -> bool:
-            if comp is None:
-                return False
-            if isinstance(comp, RemoteComponent) or getattr(comp, "is_async", False):
-                return True
-            # _call also supports plain `async def` methods (awaitable
-            # results) without the is_async marker — those suspend for real
-            for name in ("predict", "transform_input", "transform_output",
-                         "route", "aggregate", "send_feedback",
-                         "predict_raw", "transform_input_raw",
-                         "transform_output_raw", "route_raw",
-                         "aggregate_raw", "send_feedback_raw"):
-                meth = getattr(comp, name, None)
-                if meth is not None and inspect.iscoroutinefunction(meth):
-                    return True
-            return False
-
         self.has_async_nodes = any(
             _is_async_component(s.component) for s in self.state.walk()
         )
+        # Breakers wrap remote/async node calls only: a purely local call
+        # cannot fail independently of this process, so a breaker there would
+        # just add lock traffic to the fused hot path.
+        for s in self.state.walk():
+            if _is_async_component(s.component):
+                s.breaker = self.resilience.make_breaker(s.name)
+
+    def breakers(self) -> List[Tuple[str, CircuitBreaker]]:
+        """(node name, breaker) for every breaker-wrapped node, stable order
+        — the metrics scrape walks this to publish state gauges."""
+        out = [(s.name, s.breaker) for s in self.state.walk() if s.breaker is not None]
+        return sorted(out, key=lambda kv: kv[0])
 
     # ------------------------------------------------------------------
     # Build
@@ -324,11 +357,24 @@ class GraphEngine:
     # ------------------------------------------------------------------
     # Predict
     # ------------------------------------------------------------------
-    async def predict(self, request: SeldonMessage) -> SeldonMessage:
+    async def predict(
+        self, request: SeldonMessage, deadline: Optional[Deadline] = None
+    ) -> SeldonMessage:
         if not request.meta.puid:
             request.meta.puid = make_puid()
         puid = request.meta.puid
-        response = await self._get_output(self.state.root, request)
+        # Deadline resolution: explicit arg > transport-set contextvar >
+        # deployment default annotation. The scope re-publishes it on the
+        # contextvar so remote hops see the budget regardless of which path
+        # delivered it.
+        if deadline is None:
+            deadline = current_deadline()
+        if deadline is None and self.resilience.default_deadline_ms:
+            deadline = Deadline.from_ms(
+                self.resilience.default_deadline_ms, clock=self.resilience.clock
+            )
+        with deadline_scope(deadline):
+            response = await self._get_output(self.state.root, request)
         response.meta.puid = puid
         return response
 
@@ -372,6 +418,13 @@ class GraphEngine:
         self.has_async_nodes = True
 
     async def _get_output(self, state: UnitState, message: SeldonMessage) -> SeldonMessage:
+        # Budget check BEFORE executing this node: an exhausted deadline
+        # short-circuits the remaining subtree with 504 instead of doing work
+        # the client has already given up on.
+        deadline = current_deadline()
+        if deadline is not None:
+            deadline.check(f"node {state.name}")
+
         # Fused fast path: the whole subtree is one XLA call. Meta parity with
         # the unfused flow: every covered unit contributes its requestPath
         # entry and tags/metrics; the flow-final component owns the payload
@@ -413,24 +466,69 @@ class GraphEngine:
                     status_code=500,
                     reason="BAD_ROUTING",
                 )
+            if branch >= 0:
+                # graceful degradation: reroute away from a branch whose
+                # subtree has an open breaker, onto the healthiest sibling
+                healthy = self._healthy_branch(state, branch)
+                if healthy != branch:
+                    logger.warning(
+                        "router %s: branch %d unavailable (breaker open), rerouting to %d",
+                        state.name, branch, healthy,
+                    )
+                    rerouted = dict(transformed.meta.tags.get(TAG_REROUTED) or {})
+                    rerouted[state.name] = {"from": branch, "to": healthy}
+                    transformed.meta.tags[TAG_REROUTED] = rerouted
+                    branch = healthy
             transformed.meta.routing[state.name] = branch
             self._merge_meta(transformed, route_msg.meta, routing_only_tags=True)
 
         # 3. children
+        dropped_branches: List[str] = []
         if state.children:
             if branch == -1:
+                allow_partial = (
+                    self.resilience.allow_partial
+                    and state.has_method(UnitMethod.AGGREGATE)
+                    and len(state.children) > 1
+                )
                 if self.has_async_nodes:
-                    child_outputs = await asyncio.gather(
-                        *[self._get_output(c, transformed) for c in state.children]
+                    results = await asyncio.gather(
+                        *[self._get_output(c, transformed) for c in state.children],
+                        return_exceptions=allow_partial,
                     )
                 else:
                     # local components are synchronous: gather buys no
                     # concurrency here, only Task/loop overhead — and
                     # avoiding it keeps the whole coroutine loop-free so
                     # predict_sync can drive it without an event loop
-                    child_outputs = [
-                        await self._get_output(c, transformed) for c in state.children
-                    ]
+                    results = []
+                    for c in state.children:
+                        if not allow_partial:
+                            results.append(await self._get_output(c, transformed))
+                            continue
+                        try:
+                            results.append(await self._get_output(c, transformed))
+                        except SeldonError as e:
+                            results.append(e)
+                child_outputs = []
+                for child, r in zip(state.children, results):
+                    if isinstance(r, BaseException):
+                        # allow-partial drops only branches rejected by an
+                        # open breaker; real execution failures still fail
+                        # the request (partial data, yes — silent data loss
+                        # from crashing nodes, no)
+                        if isinstance(r, BreakerOpen):
+                            dropped_branches.append(child.name)
+                            continue
+                        raise r
+                    child_outputs.append(r)
+                if state.children and not child_outputs and dropped_branches:
+                    raise SeldonError(
+                        f"combiner {state.name}: every branch dropped by open "
+                        f"circuit breakers ({', '.join(dropped_branches)})",
+                        status_code=503,
+                        reason="CIRCUIT_OPEN",
+                    )
             else:
                 child_outputs = [await self._get_output(state.children[branch], transformed)]
         else:
@@ -445,6 +543,9 @@ class GraphEngine:
             )
             for co in child_outputs:
                 self._merge_meta(merged, co.meta)
+            if dropped_branches:
+                merged.meta.tags[TAG_PARTIAL_RESPONSE] = True
+                merged.meta.tags[TAG_DROPPED_BRANCHES] = list(dropped_branches)
         elif len(child_outputs) == 1:
             merged = child_outputs[0]
         elif len(child_outputs) > 1:
@@ -467,15 +568,60 @@ class GraphEngine:
         self._record_path(out, state)
         return out
 
+    @staticmethod
+    def _subtree_available(state: UnitState) -> bool:
+        """Non-mutating: is every breaker-wrapped node in this subtree
+        currently accepting calls? Routers peek at this before committing a
+        request to a branch."""
+        stack = [state]
+        while stack:
+            s = stack.pop()
+            if s.breaker is not None and not s.breaker.available():
+                return False
+            stack.extend(s.children)
+        return True
+
+    def _healthy_branch(self, state: UnitState, branch: int) -> int:
+        """The routed branch if its subtree is healthy, else the lowest-index
+        sibling with no open breakers. All-unhealthy keeps the original
+        routing decision (it then fails with CIRCUIT_OPEN, which is the
+        honest answer)."""
+        if self._subtree_available(state.children[branch]):
+            return branch
+        for i, child in enumerate(state.children):
+            if i != branch and self._subtree_available(child):
+                return i
+        return branch
+
     async def _call(self, fn: Callable, state: UnitState, message: Any) -> SeldonMessage:
         comp = state.component
         if comp is None:
             raise SeldonError(f"Unit {state.name} has no component", status_code=500)
-        if getattr(comp, "is_async", False):
-            return await fn(comp, message)
-        result = fn(comp, message)
-        if inspect.isawaitable(result):
-            return await result
+        breaker = state.breaker
+        if breaker is not None and not breaker.allow():
+            raise BreakerOpen(state.name, breaker.retry_in_s())
+        try:
+            if getattr(comp, "is_async", False):
+                result = await fn(comp, message)
+            else:
+                result = fn(comp, message)
+                if inspect.isawaitable(result):
+                    result = await result
+        except BaseException as e:
+            # Every outcome must resolve a half-open probe, or the breaker
+            # wedges with its one probe slot held forever. Counting failures
+            # re-open; cancellation judges nothing (release the slot); any
+            # other error means the node RESPONDED (4xx and kin) — healthy.
+            if breaker is not None:
+                if failure_counts_for_breaker(e):
+                    breaker.record_failure()
+                elif isinstance(e, asyncio.CancelledError):
+                    breaker.release_probe()
+                else:
+                    breaker.record_success()
+            raise
+        if breaker is not None:
+            breaker.record_success()
         return result
 
     @staticmethod

@@ -130,13 +130,22 @@ class MicroBatcher:
             await asyncio.sleep(self.max_delay_s / 4 if self._groups else 0)
 
     async def _flush(self, key):
+        # Deadline hygiene: the flusher task inherits the contextvar context
+        # of whichever request first created it, and an inline flush runs in
+        # the triggering request's context. Either way a single request's
+        # deadline must not govern (or, worse, permanently poison) merged
+        # batches — execute them deadline-free. Per-row deadlines are not
+        # differentiated inside a merged batch (docs/resilience.md).
+        from seldon_core_tpu.runtime.resilience import deadline_scope
+
         group = self._groups.pop(key, [])
         if not group:
             return
         if len(group) == 1:
             p = group[0]
             try:
-                p.future.set_result(await self.engine.predict(p.msg))
+                with deadline_scope(None):
+                    p.future.set_result(await self.engine.predict(p.msg))
             except Exception as e:
                 if not p.future.done():
                     p.future.set_exception(e)
@@ -148,7 +157,8 @@ class MicroBatcher:
         self.batches += 1
         self.batched_requests += len(group)
         try:
-            out = await self.engine.predict(merged)
+            with deadline_scope(None):
+                out = await self.engine.predict(merged)
         except Exception as e:
             for p in group:
                 if not p.future.done():

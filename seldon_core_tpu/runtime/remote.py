@@ -22,6 +22,7 @@ from seldon_core_tpu.contracts.payload import (
     SeldonMessage,
     SeldonMessageList,
 )
+from seldon_core_tpu.runtime.resilience import DeadlineExceeded, current_deadline, effective_timeout
 
 logger = logging.getLogger(__name__)
 
@@ -122,12 +123,16 @@ class RemoteComponent(SeldonComponent):
         url = f"http://{self.endpoint.service_host}:{self.endpoint.service_port}{path}"
         last_err: Optional[Exception] = None
         for attempt in range(self.retries):
+            # each attempt (not just the first) is clamped to the remaining
+            # request budget: retries never extend past the deadline, and an
+            # exhausted budget raises 504 instead of starting network work
+            hop_timeout = effective_timeout(self.timeout_s)
             try:
                 async with session.post(
                     url,
                     json=payload,
                     timeout=aiohttp.ClientTimeout(
-                        total=self.timeout_s, connect=self.connect_timeout_s
+                        total=hop_timeout, connect=self.connect_timeout_s
                     ),
                 ) as resp:
                     body = await resp.text()
@@ -140,6 +145,11 @@ class RemoteComponent(SeldonComponent):
                     return json.loads(body)
             except (aiohttp.ClientError, asyncio.TimeoutError, json.JSONDecodeError) as e:
                 last_err = e
+                d = current_deadline()
+                if d is not None and d.expired:
+                    raise DeadlineExceeded(
+                        f"deadline exceeded during remote hop to {url}: {e}"
+                    ) from e
                 if attempt + 1 < self.retries:
                     await asyncio.sleep(0.05 * (2**attempt))
         raise SeldonError(
@@ -155,7 +165,7 @@ class RemoteComponent(SeldonComponent):
             f"{self.endpoint.service_host}:{self.endpoint.service_port}",
             method,
             request_msg,
-            timeout_s=self.grpc_timeout_s,
+            timeout_s=effective_timeout(self.grpc_timeout_s),
         )
 
     async def _call(self, rest_path: str, grpc_method: str, msg: Any) -> SeldonMessage:

@@ -1,0 +1,519 @@
+"""Resilience primitives: deadline budgets, circuit breakers, load shedding.
+
+The reference orchestrator's only robustness tools are per-hop retries and
+per-deployment timeout annotations (`InternalPredictionService.java:82-91`,
+mirrored in runtime/remote.py). This module adds the standard serving-system
+triad on top (Envoy/Finagle style), shared by every transport and the
+in-process graph engine:
+
+- **Deadline**: a request-level time budget threaded from the transport edge
+  (REST header ``Seldon-Deadline-Ms`` / the gRPC deadline) through engine
+  node execution into remote hops. Each hop gets ``min(per-hop timeout,
+  remaining budget)``; an exhausted budget short-circuits downstream nodes
+  with 504/``DEADLINE_EXCEEDED`` instead of executing them. Propagates via a
+  contextvar so graph wrappers (MicroBatcher, IPC drain) need no signature
+  changes.
+- **CircuitBreaker**: per-node closed -> open (after N consecutive failures)
+  -> half-open probe -> closed. Wraps remote and async node calls in the
+  engine; a ROUTER reroutes around an open child and a COMBINER drops open
+  branches when the graph allows partial responses.
+- **AdmissionController**: bounded in-flight + bounded queue at the
+  transport edge. Overflow sheds immediately (503 + ``Retry-After`` /
+  ``RESOURCE_EXHAUSTED``) so overload fails fast instead of building an
+  unbounded latency queue.
+
+Everything takes an injectable monotonic ``clock`` so the fault-injection
+harness (seldon_core_tpu.testing.faults) can drive state transitions
+deterministically — no wall-clock sleeps in tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any, Callable, Dict, Optional
+
+from seldon_core_tpu.contracts.payload import SeldonError
+
+# ---------------------------------------------------------------------------
+# Annotations (docs/resilience.md catalogs these)
+# ---------------------------------------------------------------------------
+ANNOTATION_DEADLINE_DEFAULT = "seldon.io/deadline-default-ms"
+ANNOTATION_BREAKER_FAILURES = "seldon.io/circuit-breaker-max-failures"
+ANNOTATION_BREAKER_RESET = "seldon.io/circuit-breaker-reset-ms"
+ANNOTATION_ALLOW_PARTIAL = "seldon.io/allow-partial"
+ANNOTATION_MAX_INFLIGHT = "seldon.io/max-inflight"
+ANNOTATION_MAX_QUEUE = "seldon.io/max-queue"
+ANNOTATION_RETRY_AFTER = "seldon.io/shed-retry-after-s"
+
+DEADLINE_HEADER = "Seldon-Deadline-Ms"
+DEADLINE_GRPC_METADATA = "seldon-deadline-ms"
+
+DEFAULT_BREAKER_FAILURES = 5
+DEFAULT_BREAKER_RESET_S = 30.0
+DEFAULT_RETRY_AFTER_S = 1
+
+
+def _parse_float(annotations: Dict[str, str], key: str, default: Optional[float]) -> Optional[float]:
+    try:
+        return float(annotations[key])
+    except (KeyError, TypeError, ValueError):
+        return default
+
+
+def _parse_int(annotations: Dict[str, str], key: str, default: int) -> int:
+    try:
+        return int(annotations[key])
+    except (KeyError, TypeError, ValueError):
+        return default
+
+
+# ---------------------------------------------------------------------------
+# Deadline budgets
+# ---------------------------------------------------------------------------
+
+
+class DeadlineExceeded(SeldonError):
+    """Request budget exhausted. Maps to HTTP 504 / gRPC DEADLINE_EXCEEDED."""
+
+    def __init__(self, message: str):
+        super().__init__(message, status_code=504, reason="DEADLINE_EXCEEDED")
+
+
+class Deadline:
+    """A monotonic-clock time budget for one request.
+
+    ``clock`` is any zero-arg callable returning monotonic seconds; the fault
+    harness passes a manually-advanced clock for deterministic tests.
+    """
+
+    __slots__ = ("budget_s", "clock", "deadline_t")
+
+    def __init__(self, budget_s: float, clock: Callable[[], float] = time.monotonic):
+        self.budget_s = float(budget_s)
+        self.clock = clock
+        self.deadline_t = clock() + self.budget_s
+
+    @classmethod
+    def from_ms(cls, ms: float, clock: Callable[[], float] = time.monotonic) -> "Deadline":
+        return cls(float(ms) / 1000.0, clock=clock)
+
+    def remaining_s(self) -> float:
+        return self.deadline_t - self.clock()
+
+    def remaining_ms(self) -> float:
+        return self.remaining_s() * 1000.0
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0.0
+
+    def check(self, where: str = "") -> None:
+        rem = self.remaining_s()
+        if rem <= 0.0:
+            at = f" at {where}" if where else ""
+            raise DeadlineExceeded(
+                f"deadline exceeded{at}: budget {self.budget_s * 1000:.0f}ms "
+                f"overrun by {-rem * 1000:.0f}ms"
+            )
+
+
+# The in-flight request's deadline. Set by transports (or engine.predict when
+# given an explicit deadline) and read by remote hops; contextvars propagate
+# through awaits within a task and through call_soon_threadsafe, covering the
+# REST app, the gRPC engine loop, and the sync _drive_sync path alike.
+DEADLINE: ContextVar[Optional[Deadline]] = ContextVar("seldon_deadline", default=None)
+
+
+def current_deadline() -> Optional[Deadline]:
+    return DEADLINE.get()
+
+
+@contextmanager
+def deadline_scope(deadline: Optional[Deadline]):
+    token = DEADLINE.set(deadline)
+    try:
+        yield deadline
+    finally:
+        DEADLINE.reset(token)
+
+
+def effective_timeout(per_hop_s: Optional[float], deadline: Optional[Deadline] = None) -> Optional[float]:
+    """``min(per-hop timeout, remaining budget)`` for one remote hop.
+
+    Raises DeadlineExceeded when the budget is already spent — callers must
+    not start network work they cannot finish in time.
+    """
+    if deadline is None:
+        deadline = current_deadline()
+    if deadline is None:
+        return per_hop_s
+    rem = deadline.remaining_s()
+    if rem <= 0.0:
+        raise DeadlineExceeded(
+            f"deadline exceeded before remote hop: budget "
+            f"{deadline.budget_s * 1000:.0f}ms already spent"
+        )
+    return rem if per_hop_s is None else min(per_hop_s, rem)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breakers
+# ---------------------------------------------------------------------------
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+_STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class BreakerOpen(SeldonError):
+    """Call rejected because the node's breaker is open."""
+
+    def __init__(self, node: str, retry_in_s: float):
+        super().__init__(
+            f"circuit breaker open for node {node!r} (retry in {max(retry_in_s, 0.0):.1f}s)",
+            status_code=503,
+            reason="CIRCUIT_OPEN",
+        )
+        self.node = node
+        self.retry_in_s = max(retry_in_s, 0.0)
+
+
+class CircuitBreaker:
+    """Per-node breaker: closed -> open after ``failure_threshold`` consecutive
+    failures -> half-open probe after ``reset_timeout_s`` -> closed on probe
+    success (re-open on probe failure).
+
+    Thread-safe: the engine may be driven from several event loops and the
+    IPC drain's inline threads at once. ``clock`` is mutable so tests can
+    swap in a fake clock post-build (``engine.unit_by_name(n).breaker.clock``).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        failure_threshold: int = DEFAULT_BREAKER_FAILURES,
+        reset_timeout_s: float = DEFAULT_BREAKER_RESET_S,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.name = name
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.clock = clock
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+        self._probe_inflight = False
+        self.transitions: Dict[str, int] = {CLOSED: 0, OPEN: 0, HALF_OPEN: 0}
+        self.rejected_total = 0
+        self.on_transition: Optional[Callable[[str, str], None]] = None
+        self._lock = threading.Lock()
+
+    # -- state machine --------------------------------------------------
+    def _transition(self, to: str) -> None:
+        self.state = to
+        self.transitions[to] += 1
+        if to == OPEN:
+            self.opened_at = self.clock()
+            self.consecutive_failures = 0
+        if to != HALF_OPEN:
+            self._probe_inflight = False
+        cb = self.on_transition
+        if cb is not None:
+            try:
+                cb(self.name, to)
+            except Exception:
+                pass  # observability must never fail the data path
+
+    def allow(self) -> bool:
+        """May a call proceed now? Consumes the half-open probe slot."""
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN:
+                if self.clock() - self.opened_at >= self.reset_timeout_s:
+                    self._transition(HALF_OPEN)
+                else:
+                    self.rejected_total += 1
+                    return False
+            # HALF_OPEN: exactly one probe at a time
+            if self._probe_inflight:
+                self.rejected_total += 1
+                return False
+            self._probe_inflight = True
+            return True
+
+    def available(self) -> bool:
+        """Non-mutating health check (routers peek before routing): would a
+        call be allowed without consuming the probe slot?"""
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN:
+                return self.clock() - self.opened_at >= self.reset_timeout_s
+            return not self._probe_inflight
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.consecutive_failures = 0
+            if self.state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self.state == HALF_OPEN:
+                self._transition(OPEN)  # failed probe: back to open
+                return
+            self.consecutive_failures += 1
+            if self.state == CLOSED and 0 < self.failure_threshold <= self.consecutive_failures:
+                self._transition(OPEN)
+
+    def release_probe(self) -> None:
+        """Probe outcome unknown (e.g. the call was cancelled): free the
+        half-open probe slot without judging the node, so the next call can
+        probe again instead of the breaker wedging half-open forever."""
+        with self._lock:
+            if self.state == HALF_OPEN:
+                self._probe_inflight = False
+
+    def retry_in_s(self) -> float:
+        with self._lock:
+            if self.state != OPEN:
+                return 0.0
+            return self.reset_timeout_s - (self.clock() - self.opened_at)
+
+    def state_code(self) -> int:
+        """0 closed, 1 half-open, 2 open (the metrics gauge encoding)."""
+        return _STATE_CODES[self.state]
+
+
+# ---------------------------------------------------------------------------
+# Admission control (load shedding)
+# ---------------------------------------------------------------------------
+
+
+class ShedError(SeldonError):
+    """Request shed at admission: server at capacity and queue full."""
+
+    def __init__(self, message: str, retry_after_s: float = DEFAULT_RETRY_AFTER_S):
+        super().__init__(message, status_code=503, reason="RESOURCE_EXHAUSTED")
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionController:
+    """Bounded in-flight limit + bounded FIFO queue with shed-on-full.
+
+    ``max_inflight <= 0`` disables admission control entirely (the default:
+    existing deployments keep today's unbounded behavior until they opt in).
+    Works for both async callers (REST handlers ``await acquire()``) and
+    thread-pool callers (gRPC servicers call ``acquire_sync()``): waiters of
+    both kinds share one FIFO so ordering is transport-fair.
+    """
+
+    def __init__(
+        self,
+        max_inflight: int = 0,
+        max_queue: int = 0,
+        retry_after_s: float = DEFAULT_RETRY_AFTER_S,
+    ):
+        self.max_inflight = int(max_inflight)
+        self.max_queue = int(max_queue)
+        self.retry_after_s = float(retry_after_s)
+        self.inflight = 0
+        self.shed_total = 0
+        self.admitted_total = 0
+        self._waiters: deque = deque()  # ("async", loop, future) | ("sync", event_box)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_annotations(
+        cls, annotations: Optional[Dict[str, str]], env: Optional[Dict[str, str]] = None
+    ) -> "AdmissionController":
+        """Annotations win over env vars (SELDON_MAX_INFLIGHT / SELDON_MAX_QUEUE
+        / SELDON_SHED_RETRY_AFTER_S); both absent means disabled."""
+        import os
+
+        env = dict(env if env is not None else os.environ)
+        ann = dict(annotations or {})
+
+        def pick(key: str, env_key: str, default: float) -> float:
+            for source, k in ((ann, key), (env, env_key)):
+                try:
+                    return float(source[k])
+                except (KeyError, TypeError, ValueError):
+                    continue
+            return default
+
+        return cls(
+            max_inflight=int(pick(ANNOTATION_MAX_INFLIGHT, "SELDON_MAX_INFLIGHT", 0)),
+            max_queue=int(pick(ANNOTATION_MAX_QUEUE, "SELDON_MAX_QUEUE", 0)),
+            retry_after_s=pick(ANNOTATION_RETRY_AFTER, "SELDON_SHED_RETRY_AFTER_S", DEFAULT_RETRY_AFTER_S),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_inflight > 0
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._waiters)
+
+    def _shed(self) -> ShedError:
+        self.shed_total += 1
+        return ShedError(
+            f"server at capacity: {self.inflight} in flight, "
+            f"{len(self._waiters)}/{self.max_queue} queued",
+            retry_after_s=self.retry_after_s,
+        )
+
+    def _try_admit_locked(self) -> bool:
+        if self.inflight < self.max_inflight:
+            self.inflight += 1
+            self.admitted_total += 1
+            return True
+        return False
+
+    async def acquire(self) -> None:
+        """Async admission: immediate slot, else queue, else ShedError."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._try_admit_locked():
+                return
+            if len(self._waiters) >= self.max_queue:
+                raise self._shed()
+            loop = asyncio.get_running_loop()
+            fut: asyncio.Future = loop.create_future()
+            self._waiters.append(("async", loop, fut))
+        try:
+            await fut
+        except asyncio.CancelledError:
+            with self._lock:
+                granted = fut.done() and not fut.cancelled()
+            if granted:
+                self.release()  # slot arrived as the client disconnected
+            raise
+
+    def acquire_sync(self, timeout_s: Optional[float] = None) -> None:
+        """Thread-blocking admission for thread-pool transports (gRPC)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._try_admit_locked():
+                return
+            if len(self._waiters) >= self.max_queue:
+                raise self._shed()
+            event = threading.Event()
+            entry = ("sync", event)
+            self._waiters.append(entry)
+        if not event.wait(timeout_s):
+            with self._lock:
+                try:
+                    self._waiters.remove(entry)
+                except ValueError:
+                    # grant raced the timeout: the slot is ours, give it back
+                    pass
+                else:
+                    raise self._shed()
+            self.release()
+            raise self._shed()
+
+    def release(self) -> None:
+        """Finish one admitted request; hand its slot to the oldest waiter."""
+        if not self.enabled:
+            return
+        with self._lock:
+            while self._waiters:
+                entry = self._waiters.popleft()
+                if entry[0] == "async":
+                    _, loop, fut = entry
+
+                    def grant(f=fut):
+                        if not f.done():
+                            f.set_result(None)
+                        else:
+                            self.release()  # waiter cancelled: pass it on
+
+                    try:
+                        loop.call_soon_threadsafe(grant)
+                        self.admitted_total += 1
+                        return  # slot transferred, inflight unchanged
+                    except RuntimeError:
+                        continue  # waiter's loop is gone; try the next waiter
+                else:
+                    _, event = entry
+                    event.set()
+                    self.admitted_total += 1
+                    return
+            self.inflight = max(self.inflight - 1, 0)
+
+
+# ---------------------------------------------------------------------------
+# Engine-level config
+# ---------------------------------------------------------------------------
+
+
+class ResilienceConfig:
+    """Per-graph resilience tuning, parsed from deployment annotations."""
+
+    __slots__ = (
+        "breaker_failures",
+        "breaker_reset_s",
+        "allow_partial",
+        "default_deadline_ms",
+        "clock",
+    )
+
+    def __init__(
+        self,
+        breaker_failures: int = DEFAULT_BREAKER_FAILURES,
+        breaker_reset_s: float = DEFAULT_BREAKER_RESET_S,
+        allow_partial: bool = False,
+        default_deadline_ms: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.breaker_failures = breaker_failures
+        self.breaker_reset_s = breaker_reset_s
+        self.allow_partial = allow_partial
+        self.default_deadline_ms = default_deadline_ms
+        self.clock = clock
+
+    @classmethod
+    def from_annotations(cls, annotations: Optional[Dict[str, str]]) -> "ResilienceConfig":
+        ann = dict(annotations or {})
+        reset_ms = _parse_float(ann, ANNOTATION_BREAKER_RESET, DEFAULT_BREAKER_RESET_S * 1000.0)
+        return cls(
+            breaker_failures=_parse_int(ann, ANNOTATION_BREAKER_FAILURES, DEFAULT_BREAKER_FAILURES),
+            breaker_reset_s=(reset_ms or 0.0) / 1000.0,
+            allow_partial=str(ann.get(ANNOTATION_ALLOW_PARTIAL, "")).lower() in ("true", "1", "yes"),
+            default_deadline_ms=_parse_float(ann, ANNOTATION_DEADLINE_DEFAULT, None),
+        )
+
+    def make_breaker(self, name: str) -> Optional[CircuitBreaker]:
+        if self.breaker_failures <= 0:
+            return None
+        return CircuitBreaker(
+            name,
+            failure_threshold=self.breaker_failures,
+            reset_timeout_s=self.breaker_reset_s,
+            clock=self.clock,
+        )
+
+
+def failure_counts_for_breaker(exc: BaseException) -> bool:
+    """Which errors trip a breaker: infrastructure failures (5xx, timeouts,
+    transport errors), not client errors (4xx), not the breaker's own
+    rejections — an open breaker must not feed back into itself — and not
+    cancellation: a client disconnecting says nothing about the node, and
+    impatient clients must not be able to open a healthy node's breaker."""
+    if isinstance(exc, (BreakerOpen, asyncio.CancelledError)):
+        return False
+    if isinstance(exc, SeldonError):
+        return exc.status_code >= 500
+    return True

@@ -1,0 +1,9 @@
+"""Deterministic test harnesses (fault injection, clocks)."""
+
+from seldon_core_tpu.testing.faults import (  # noqa: F401
+    FaultClock,
+    FaultSchedule,
+    FaultSpec,
+    FaultyComponent,
+    inject_faults,
+)

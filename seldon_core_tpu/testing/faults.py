@@ -1,0 +1,219 @@
+"""Deterministic fault injection for resilience tests.
+
+Everything here is seeded or explicitly scheduled — no wall-clock randomness
+and no real sleeps. Latency is injected by advancing a :class:`FaultClock`
+(the same clock object handed to Deadline/CircuitBreaker), so a test can
+"burn" 200ms of budget in zero wall time and still observe exact
+deadline-exceeded and breaker open/half-open/recovery transitions.
+
+Typical wiring::
+
+    clock = FaultClock()
+    schedule = FaultSchedule.flaps("EEEEEO")        # 5 errors then ok
+    comp = FaultyComponent(schedule, clock=clock)
+    engine = GraphEngine(spec, components={"m": comp},
+                         resilience=ResilienceConfig(breaker_failures=5,
+                                                     breaker_reset_s=1.0,
+                                                     clock=clock))
+    # ... drive predict(), advance clock, assert breaker transitions
+
+Schedules are per-call: call i consults ``schedule[i]`` (the last entry
+repeats once the schedule is exhausted, so a finite schedule describes an
+infinite behavior).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.payload import SeldonError
+
+
+class FaultClock:
+    """A manually-advanced monotonic clock. Pass the instance anywhere a
+    ``clock`` callable is expected (Deadline, CircuitBreaker,
+    ResilienceConfig) — calling it returns the current fake time."""
+
+    def __init__(self, start: float = 1000.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> float:
+        if seconds < 0:
+            raise ValueError("clocks only move forward")
+        self.t += seconds
+        return self.t
+
+
+@dataclass
+class FaultSpec:
+    """Behavior of one call: optional injected latency (FaultClock seconds),
+    then either success or a raised error."""
+
+    latency_s: float = 0.0
+    error: Optional[BaseException] = None
+
+    @classmethod
+    def ok(cls, latency_s: float = 0.0) -> "FaultSpec":
+        return cls(latency_s=latency_s)
+
+    @classmethod
+    def fail(cls, message: str = "injected fault", status_code: int = 503,
+             latency_s: float = 0.0) -> "FaultSpec":
+        return cls(
+            latency_s=latency_s,
+            error=SeldonError(message, status_code=status_code, reason="INJECTED_FAULT"),
+        )
+
+
+class FaultSchedule:
+    """A deterministic per-call schedule of FaultSpecs. Indexing past the end
+    repeats the final entry."""
+
+    def __init__(self, specs: Sequence[FaultSpec]):
+        if not specs:
+            raise ValueError("schedule needs at least one entry")
+        self.specs: List[FaultSpec] = list(specs)
+
+    def __getitem__(self, i: int) -> FaultSpec:
+        return self.specs[min(i, len(self.specs) - 1)]
+
+    def __len__(self) -> int:
+        return len(self.specs)
+
+    # -- constructors ---------------------------------------------------
+    @classmethod
+    def always_ok(cls, latency_s: float = 0.0) -> "FaultSchedule":
+        return cls([FaultSpec.ok(latency_s)])
+
+    @classmethod
+    def always_fail(cls, status_code: int = 503) -> "FaultSchedule":
+        return cls([FaultSpec.fail(status_code=status_code)])
+
+    @classmethod
+    def flaps(cls, pattern: str, latency_s: float = 0.0,
+              status_code: int = 503) -> "FaultSchedule":
+        """``pattern``: one char per call — 'E' error, 'O' ok. E.g.
+        ``"EEEEEO"`` fails five calls then succeeds forever (final entry
+        repeats)."""
+        specs = []
+        for ch in pattern:
+            if ch in ("E", "e", "F", "f"):
+                specs.append(FaultSpec.fail(status_code=status_code, latency_s=latency_s))
+            elif ch in ("O", "o", ".", "S", "s"):
+                specs.append(FaultSpec.ok(latency_s))
+            else:
+                raise ValueError(f"unknown flap char {ch!r} (use E/O)")
+        return cls(specs)
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        n: int,
+        error_rate: float = 0.0,
+        latency_s: float = 0.0,
+        latency_jitter_s: float = 0.0,
+        status_code: int = 503,
+    ) -> "FaultSchedule":
+        """n entries drawn from random.Random(seed): same seed, same
+        schedule, forever — CI-stable chaos."""
+        rng = random.Random(seed)
+        specs = []
+        for _ in range(n):
+            lat = latency_s + (rng.random() * latency_jitter_s if latency_jitter_s else 0.0)
+            if rng.random() < error_rate:
+                specs.append(FaultSpec.fail(status_code=status_code, latency_s=lat))
+            else:
+                specs.append(FaultSpec.ok(lat))
+        return cls(specs)
+
+
+class FaultyComponent(SeldonComponent):
+    """A graph node with scripted behavior.
+
+    Wraps an ``inner`` component (default: echo) and, per call, advances the
+    attached FaultClock by the scheduled latency then raises the scheduled
+    error or delegates. ``is_async=True`` (the default) makes the engine
+    treat it like a remote/async node — the class the resilience layer wraps
+    with breakers. ``calls`` records every invocation so tests can prove a
+    short-circuited node never executed.
+    """
+
+    def __init__(
+        self,
+        schedule: Optional[FaultSchedule] = None,
+        clock: Optional[FaultClock] = None,
+        inner: Optional[SeldonComponent] = None,
+        is_async: bool = True,
+        name: str = "faulty",
+    ):
+        super().__init__()
+        self.schedule = schedule or FaultSchedule.always_ok()
+        self.clock = clock
+        self.inner = inner
+        self.is_async = is_async
+        self.name = name
+        self.calls = 0
+        self.on_call: Optional[Callable[[int, FaultSpec], None]] = None
+
+    # -- fault application ---------------------------------------------
+    def _apply(self) -> None:
+        spec = self.schedule[self.calls]
+        self.calls += 1
+        if self.on_call is not None:
+            self.on_call(self.calls - 1, spec)
+        if spec.latency_s and self.clock is not None:
+            self.clock.advance(spec.latency_s)
+        if spec.error is not None:
+            raise spec.error
+
+    def _delegate(self, method: str, X, names, meta=None):
+        self._apply()
+        if self.inner is not None:
+            fn = getattr(self.inner, method, None)
+            if fn is not None:
+                return fn(X, names, meta=meta)
+        return X
+
+    # -- component surface (async: the engine's breaker-wrapped class) --
+    async def predict(self, X, names, meta=None):
+        return self._delegate("predict", X, names, meta)
+
+    async def transform_input(self, X, names, meta=None):
+        return self._delegate("transform_input", X, names, meta)
+
+    async def transform_output(self, X, names, meta=None):
+        return self._delegate("transform_output", X, names, meta)
+
+    async def route(self, X, names):
+        self._apply()
+        if self.inner is not None and hasattr(self.inner, "route"):
+            return self.inner.route(X, names)
+        return 0
+
+    async def aggregate(self, Xs, names):
+        self._apply()
+        if self.inner is not None and hasattr(self.inner, "aggregate"):
+            return self.inner.aggregate(Xs, names)
+        return np.mean([np.asarray(x) for x in Xs], axis=0)
+
+
+def inject_faults(
+    component: SeldonComponent,
+    schedule: FaultSchedule,
+    clock: Optional[FaultClock] = None,
+) -> FaultyComponent:
+    """Wrap an existing component with a fault schedule (its methods run only
+    when the scheduled call succeeds)."""
+    return FaultyComponent(schedule=schedule, clock=clock, inner=component)

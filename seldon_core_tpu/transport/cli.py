@@ -114,14 +114,17 @@ def run_microservice(args: argparse.Namespace) -> None:
     unit_id = os.environ.get("PREDICTIVE_UNIT_ID", "")
     api = (args.api or os.environ.get("API_TYPE", "REST")).upper()
     logger.info("serving %s as %s on port %d", args.interface_name, api, port)
+    annotations = load_annotations()
     if api == "REST":
         from seldon_core_tpu.transport.rest import make_component_app, serve
 
-        serve(make_component_app(component, unit_id=unit_id), host=args.host, port=port)
+        serve(make_component_app(component, unit_id=unit_id, annotations=annotations),
+              host=args.host, port=port)
     elif api == "GRPC":
         from seldon_core_tpu.transport.grpc_server import serve_component
 
-        serve_component(component, host=args.host, port=port, unit_id=unit_id)
+        serve_component(component, host=args.host, port=port, unit_id=unit_id,
+                        annotations=annotations)
     else:
         raise SystemExit(f"Unknown API type {api} (use REST or GRPC)")
 
@@ -145,7 +148,8 @@ def run_engine(args: argparse.Namespace) -> None:
     # Spec from file, ENGINE_PREDICTOR env, or the default SIMPLE_MODEL the
     # reference engine uses when unconfigured (`EnginePredictor.java:122-141`).
     spec = _load_spec(args.spec)
-    engine = GraphEngine(spec, annotations=load_annotations())
+    annotations = load_annotations()
+    engine = GraphEngine(spec, annotations=annotations)
     metrics = MetricsRegistry(predictor=spec.name)
     port = args.port or int(os.environ.get("ENGINE_SERVER_PORT", "8000"))
     logger.info("engine serving predictor %r on port %d", spec.name, port)
@@ -153,7 +157,8 @@ def run_engine(args: argparse.Namespace) -> None:
     if api == "GRPC":
         from seldon_core_tpu.transport.grpc_server import serve_engine
 
-        serve_engine(engine, host=args.host, port=port, metrics=metrics)
+        serve_engine(engine, host=args.host, port=port, metrics=metrics,
+                     annotations=annotations)
     elif api == "IPC":
         # native shared-memory data plane: N frontend processes attach as
         # IPCClient workers, this process owns the device (transport/ipc.py)
@@ -167,7 +172,8 @@ def run_engine(args: argparse.Namespace) -> None:
         logger.info("engine serving over IPC at %s (%d workers)", args.ipc_base, args.ipc_workers)
         asyncio.run(server.serve_forever())
     else:
-        serve(make_engine_app(engine, metrics=metrics), host=args.host, port=port)
+        serve(make_engine_app(engine, metrics=metrics, annotations=annotations),
+              host=args.host, port=port)
 
 
 def _load_spec(path: Optional[str]):

@@ -27,6 +27,13 @@ import grpc
 from seldon_core_tpu.components import dispatch
 from seldon_core_tpu.contracts.payload import SeldonError
 from seldon_core_tpu.metrics.registry import MetricsRegistry
+from seldon_core_tpu.runtime.resilience import (
+    DEADLINE_GRPC_METADATA,
+    AdmissionController,
+    Deadline,
+    ShedError,
+    deadline_scope,
+)
 from seldon_core_tpu.tracing import get_tracer
 from seldon_core_tpu.transport import proto_convert as pc
 from seldon_core_tpu.transport.proto import prediction_pb2 as pb
@@ -41,26 +48,68 @@ _SERVICE_PACKAGE = "seldon.protos"
 
 def _abort(context: grpc.ServicerContext, e: Exception):
     if isinstance(e, SeldonError):
-        code = grpc.StatusCode.INVALID_ARGUMENT if e.status_code < 500 else grpc.StatusCode.INTERNAL
+        # resilience status mapping: budget exhaustion is DEADLINE_EXCEEDED,
+        # admission sheds are RESOURCE_EXHAUSTED, breaker/unavailable 503s are
+        # UNAVAILABLE (retryable), other 5xx INTERNAL, 4xx INVALID_ARGUMENT
+        if e.status_code == 504 or e.reason == "DEADLINE_EXCEEDED":
+            code = grpc.StatusCode.DEADLINE_EXCEEDED
+        elif e.reason == "RESOURCE_EXHAUSTED":
+            code = grpc.StatusCode.RESOURCE_EXHAUSTED
+        elif e.status_code == 503:
+            code = grpc.StatusCode.UNAVAILABLE
+        elif e.status_code < 500:
+            code = grpc.StatusCode.INVALID_ARGUMENT
+        else:
+            code = grpc.StatusCode.INTERNAL
         context.abort(code, e.message)
     logger.exception("grpc handler error")
     context.abort(grpc.StatusCode.INTERNAL, str(e))
 
 
-def _component_methods(component: Any, unit_id: str) -> Dict[str, Dict[str, Callable]]:
+def _deadline_from_context(context: grpc.ServicerContext) -> Deadline | None:
+    """The client's gRPC deadline (context.time_remaining()), else the
+    ``seldon-deadline-ms`` metadata key for clients that cannot set one."""
+    try:
+        rem = context.time_remaining()
+    except Exception:
+        rem = None
+    if rem is not None and rem < 1e9:  # grpc reports a huge value for "none"
+        return Deadline(rem)
+    for key, value in context.invocation_metadata() or ():
+        if key == DEADLINE_GRPC_METADATA:
+            try:
+                ms = float(value)
+            except (TypeError, ValueError):
+                return None
+            return Deadline.from_ms(ms) if ms > 0 else None
+    return None
+
+
+def _component_methods(
+    component: Any, unit_id: str, admission: Optional[AdmissionController] = None
+) -> Dict[str, Dict[str, Callable]]:
     """method table: service -> rpc name -> (deserializer applied by handler)."""
+    admission = admission or AdmissionController()
 
     def wrap(fn, req_from, method_name):
         def handler(request, context):
             tracer = get_tracer()
             try:
-                with tracer.span("grpc:" + method_name):
-                    result = fn(component, req_from(request))
-                    if asyncio.iscoroutine(result):
-                        result = asyncio.run(result)
+                admission.acquire_sync()
+            except ShedError as e:
+                _abort(context, e)
+                return
+            try:
+                with deadline_scope(_deadline_from_context(context)):
+                    with tracer.span("grpc:" + method_name):
+                        result = fn(component, req_from(request))
+                        if asyncio.iscoroutine(result):
+                            result = asyncio.run(result)
                 return pc.message_to_proto(result)
             except Exception as e:  # noqa: BLE001
                 _abort(context, e)
+            finally:
+                admission.release()
 
         return handler
 
@@ -125,11 +174,13 @@ def make_component_server(
     unit_id: str = "",
     annotations: Optional[Dict[str, str]] = None,
     max_workers: int = 8,
+    admission: Optional[AdmissionController] = None,
 ) -> grpc.Server:
+    admission = admission or AdmissionController.from_annotations(annotations)
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers), options=_server_options(annotations)
     )
-    for h in _generic_handlers(_component_methods(component, unit_id)):
+    for h in _generic_handlers(_component_methods(component, unit_id, admission)):
         server.add_generic_rpc_handlers((h,))
     if port is not None:
         server.add_insecure_port(f"{host}:{port}")
@@ -146,11 +197,15 @@ def make_engine_server(
     loop: Optional[asyncio.AbstractEventLoop] = None,
     interceptors: Optional[Any] = None,
     server_credentials: Optional[grpc.ServerCredentials] = None,
+    admission: Optional[AdmissionController] = None,
 ) -> grpc.Server:
     """Seldon external service over the in-process graph engine. The engine is
     async; handlers submit onto the engine's event loop (or a private one).
-    ``server_credentials`` switches the listening port to TLS."""
+    ``server_credentials`` switches the listening port to TLS. ``admission``
+    bounds concurrent predictions (overflow aborts RESOURCE_EXHAUSTED);
+    defaults from annotations/env — disabled unless configured."""
     metrics = metrics or MetricsRegistry()
+    admission = admission or AdmissionController.from_annotations(annotations)
     own_loop = loop
     if own_loop is None:
         own_loop = asyncio.new_event_loop()
@@ -162,17 +217,33 @@ def make_engine_server(
     def run_coro(coro):
         return asyncio.run_coroutine_threadsafe(coro, own_loop).result()
 
+    async def _predict_with_deadline(msg, deadline):
+        # scope INSIDE the engine-loop task: the deadline contextvar must be
+        # visible to the engine and its remote hops on that loop
+        with deadline_scope(deadline):
+            return await engine.predict(msg)
+
     def predict(request, context):
         import time
 
         t0 = time.perf_counter()
         try:
+            admission.acquire_sync()
+        except ShedError as e:
+            _abort(context, e)
+            return
+        try:
+            deadline = _deadline_from_context(context)
             msg = pc.message_from_proto(request)
-            out = run_coro(engine.predict(msg))
+            out = run_coro(_predict_with_deadline(msg, deadline))
             metrics.observe_prediction(engine, out, time.perf_counter() - t0)
             return pc.message_to_proto(out)
         except Exception as e:  # noqa: BLE001
+            if getattr(e, "status_code", None) == 504:
+                metrics.observe_deadline_exceeded("grpc")
             _abort(context, e)
+        finally:
+            admission.release()
 
     def send_feedback(request, context):
         try:
@@ -212,15 +283,19 @@ def make_engine_server(
     return server
 
 
-def serve_component(component: Any, host: str = "0.0.0.0", port: int = 5000, unit_id: str = "") -> None:
-    server = make_component_server(component, port=port, host=host, unit_id=unit_id)
+def serve_component(component: Any, host: str = "0.0.0.0", port: int = 5000, unit_id: str = "",
+                    annotations: Optional[Dict[str, str]] = None) -> None:
+    server = make_component_server(component, port=port, host=host, unit_id=unit_id,
+                                   annotations=annotations)
     server.start()
     logger.info("gRPC component server on %s:%d", host, port)
     server.wait_for_termination()
 
 
-def serve_engine(engine: Any, host: str = "0.0.0.0", port: int = 5001, metrics=None) -> None:
-    server = make_engine_server(engine, port=port, host=host, metrics=metrics)
+def serve_engine(engine: Any, host: str = "0.0.0.0", port: int = 5001, metrics=None,
+                 annotations: Optional[Dict[str, str]] = None) -> None:
+    server = make_engine_server(engine, port=port, host=host, metrics=metrics,
+                                annotations=annotations)
     server.start()
     logger.info("gRPC engine server on %s:%d", host, port)
     server.wait_for_termination()

@@ -35,9 +35,41 @@ from seldon_core_tpu.contracts.payload import (
     SeldonMessageList,
 )
 from seldon_core_tpu.metrics.registry import MetricsRegistry
+from seldon_core_tpu.runtime.resilience import (
+    DEADLINE_HEADER,
+    AdmissionController,
+    Deadline,
+    ShedError,
+    current_deadline,
+    deadline_scope,
+)
 from seldon_core_tpu.tracing import get_tracer
 
 logger = logging.getLogger(__name__)
+
+
+def deadline_from_headers(request: web.Request) -> Optional[Deadline]:
+    """``Seldon-Deadline-Ms: <float>`` — the client's total budget for this
+    request. Missing/garbage headers mean no deadline (the engine may still
+    apply the deployment's ``seldon.io/deadline-default-ms``)."""
+    raw = request.headers.get(DEADLINE_HEADER)
+    if raw is None:
+        return None
+    try:
+        ms = float(raw)
+    except (TypeError, ValueError):
+        return None
+    if ms <= 0:
+        return None
+    return Deadline.from_ms(ms)
+
+
+def shed_response(e: ShedError) -> web.Response:
+    return web.json_response(
+        {"status": e.to_status().to_dict()},
+        status=503,
+        headers={"Retry-After": str(max(int(e.retry_after_s), 1))},
+    )
 
 
 async def parse_request(request: web.Request) -> dict:
@@ -104,26 +136,38 @@ def make_component_app(
     component: Any,
     unit_id: str = "",
     metrics: Optional[MetricsRegistry] = None,
+    admission: Optional[AdmissionController] = None,
+    annotations: Optional[dict] = None,
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or MetricsRegistry()
+    admission = admission or AdmissionController.from_annotations(annotations)
     tracer = get_tracer()
 
     def handler(fn: Callable, parser: Callable, method_name: str):
         async def handle(request: web.Request) -> web.Response:
             t0 = time.perf_counter()
             try:
+                await admission.acquire()
+            except ShedError as e:
+                metrics.observe_api_call(method_name, "503", time.perf_counter() - t0)
+                return shed_response(e)
+            try:
+                deadline = deadline_from_headers(request)
                 payload = parser(await parse_request(request))
-                with tracer.span(method_name):
-                    result = fn(component, payload)
-                    if asyncio.iscoroutine(result):
-                        result = await result
+                with deadline_scope(deadline):
+                    with tracer.span(method_name):
+                        result = fn(component, payload)
+                        if asyncio.iscoroutine(result):
+                            result = await result
                 metrics.observe_api_call(method_name, "200", time.perf_counter() - t0)
                 return _json(result)
             except Exception as e:
                 code = str(getattr(e, "status_code", 500))
                 metrics.observe_api_call(method_name, code, time.perf_counter() - t0)
                 return error_response(e)
+            finally:
+                admission.release()
 
         return handle
 
@@ -157,6 +201,7 @@ def make_component_app(
         return web.json_response(wrapper_spec())
 
     async def prom(request):
+        metrics.sync_resilience(admission=admission, transport="rest")
         return web.Response(body=metrics.expose(), content_type="text/plain")
 
     app.router.add_get("/health/status", health)
@@ -209,9 +254,10 @@ def _add_generate_routes(app: web.Application, component: Any,
             stream = bool(body.get("stream"))
             decode = getattr(component, "_tokenizer", None)
 
+            info: dict = {}
             if not stream:
                 if svc is not None:
-                    toks = await svc.submit(prompt, max_new)
+                    toks = await svc.submit(prompt, max_new, info=info)
                 else:
                     out = await asyncio.to_thread(
                         component.generate, [prompt], max_new_tokens=max_new,
@@ -223,7 +269,10 @@ def _add_generate_routes(app: web.Application, component: Any,
                 text = decode.decode(toks) if (decode is not None
                                                and isinstance(prompt, str)) else None
                 metrics.observe_api_call("generate", "200", time.perf_counter() - t0)
-                return web.json_response({"tokens": toks, "text": text})
+                out = {"tokens": toks, "text": text}
+                if info.get("truncated_prompt"):
+                    out["truncated_prompt"] = info["truncated_prompt"]
+                return web.json_response(out)
 
             if custom_sampling:
                 raise SeldonError(
@@ -247,7 +296,8 @@ def _add_generate_routes(app: web.Application, component: Any,
 
                 svc = await asyncio.to_thread(ensure_stream_service, component)
             fut = asyncio.ensure_future(svc.submit(prompt, max_new,
-                                                   on_token=on_token))
+                                                   on_token=on_token,
+                                                   info=info))
             try:
                 # Wait on the queue AND the future: a submit that fails before
                 # any token (closed batcher, bad prompt) never sends the None
@@ -271,8 +321,11 @@ def _add_generate_routes(app: web.Application, component: Any,
                 toks = await fut
                 text = decode.decode(toks) if (decode is not None
                                                and isinstance(prompt, str)) else None
+                done_evt = {"done": True, "tokens": toks, "text": text}
+                if info.get("truncated_prompt"):
+                    done_evt["truncated_prompt"] = info["truncated_prompt"]
                 await resp.write(
-                    f"data: {json.dumps({'done': True, 'tokens': toks, 'text': text})}\n\n".encode())
+                    f"data: {json.dumps(done_evt)}\n\n".encode())
                 await resp.write_eof()
                 metrics.observe_api_call("generate", "200", time.perf_counter() - t0)
                 return resp
@@ -307,11 +360,21 @@ def _add_generate_routes(app: web.Application, component: Any,
 # Engine app: whole predictor graph in-process
 # ---------------------------------------------------------------------------
 
-def make_engine_app(engine: Any, metrics: Optional[MetricsRegistry] = None) -> web.Application:
+def make_engine_app(
+    engine: Any,
+    metrics: Optional[MetricsRegistry] = None,
+    admission: Optional[AdmissionController] = None,
+    annotations: Optional[dict] = None,
+) -> web.Application:
     """engine: seldon_core_tpu.runtime.engine.GraphEngine (or compatible,
-    e.g. the batched engine wrapper)."""
+    e.g. the batched engine wrapper).
+
+    ``admission`` bounds concurrent predictions (overflow sheds with 503 +
+    Retry-After); defaults from annotations/env via
+    AdmissionController.from_annotations — disabled unless configured."""
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or MetricsRegistry()
+    admission = admission or AdmissionController.from_annotations(annotations)
     tracer = get_tracer()
     state = {"paused": False, "ready": True}
     app[web.AppKey("state", dict)] = state
@@ -366,17 +429,36 @@ def make_engine_app(engine: Any, metrics: Optional[MetricsRegistry] = None) -> w
             )
         t0 = time.perf_counter()
         try:
+            # admission BEFORE parsing: shedding must stay cheap when the
+            # server is already saturated
+            await admission.acquire()
+        except ShedError as e:
+            metrics.observe_api_call("predictions", "503", time.perf_counter() - t0)
+            return shed_response(e)
+        try:
+            deadline = deadline_from_headers(request)
             body = await parse_request(request)
             msg = SeldonMessage.from_dict(body)
-            with tracer.span("predictions"):
-                out = await engine.predict(msg)
+            with deadline_scope(deadline):
+                with tracer.span("predictions"):
+                    out = await engine.predict(msg)
+                d = current_deadline()
+                if d is not None:
+                    metrics.observe_remaining_budget(d.remaining_s())
             metrics.observe_prediction(engine, out, time.perf_counter() - t0)
             if log_requests or log_responses or logger_url:
                 _spawn_log(body, out.to_dict())
             return _json(out)
         except Exception as e:
-            metrics.observe_api_call("predictions", str(getattr(e, "status_code", 500)), time.perf_counter() - t0)
+            code = getattr(e, "status_code", 500)
+            if code == 504:
+                metrics.observe_deadline_exceeded("rest")
+            metrics.observe_api_call("predictions", str(code), time.perf_counter() - t0)
+            if isinstance(e, ShedError):
+                return shed_response(e)
             return error_response(e)
+        finally:
+            admission.release()
 
     async def feedback(request: web.Request) -> web.Response:
         t0 = time.perf_counter()
@@ -412,6 +494,7 @@ def make_engine_app(engine: Any, metrics: Optional[MetricsRegistry] = None) -> w
         return web.Response(text="unpaused")
 
     async def prom(request):
+        metrics.sync_resilience(engine=engine, admission=admission, transport="rest")
         return web.Response(body=metrics.expose(), content_type="text/plain")
 
     async def openapi(request):

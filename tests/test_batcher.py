@@ -107,6 +107,37 @@ def test_batcher_honors_sampling_config():
     assert len(outs | {tuple(a)}) > 1  # different rng seeds -> different samples
 
 
+def test_batcher_max_len_zero_means_default(server):
+    """max_len<=0 from a direct constructor caller means 'unset' — taking it
+    literally produced plen=min(...,-1) nonsense slicing (ADVICE.md r5)."""
+    b_default = ContinuousBatcher(server, max_slots=1, len_buckets=(8,))
+    for bad in (0, -4):
+        b = ContinuousBatcher(server, max_slots=1, max_len=bad, len_buckets=(8,))
+        assert b.max_len == b_default.max_len > 0
+
+
+def test_batcher_truncation_reported_via_info(server):
+    """Truncation changes outputs, so it must reach the client (response meta
+    via the transports), not only the server log."""
+
+    async def go():
+        batcher = ContinuousBatcher(server, max_slots=1, max_len=10, len_buckets=(8,))
+        info: dict = {}
+        long_prompt = list(range(1, 25))  # 24 tokens >> 9-token cap
+        await batcher.submit(long_prompt, max_new_tokens=2, info=info)
+        short_info: dict = {}
+        await batcher.submit([1, 2], max_new_tokens=2, info=short_info)
+        await batcher.close()
+        return info, short_info
+
+    info, short_info = asyncio.run(go())
+    rec = info["truncated_prompt"]
+    assert rec["prompt_tokens"] == 24
+    assert rec["kept_tokens"] < 24
+    assert rec["max_len"] == 10
+    assert "truncated_prompt" not in short_info  # untouched when it fits
+
+
 def test_batcher_rejects_after_close(server):
     async def go():
         batcher = ContinuousBatcher(server, max_slots=1, max_len=32, len_buckets=(8,))

@@ -418,6 +418,27 @@ def test_bandit_compiles_native():
     assert compile_edge_program(PredictorSpec.from_dict(bad)) is None
 
 
+def test_numpy_parity_probe_gates_seeded_native(monkeypatch):
+    """Seeded-native routing only enables when the installed numpy replays
+    the recorded 2.0.2 streams bit-exactly (ADVICE r5: an unpinned numpy
+    that changes distributions.c must not silently desync planes)."""
+    from seldon_core_tpu.runtime import edgeprogram as ep
+
+    # this image carries the known-good numpy: probe passes (and caches)
+    monkeypatch.setattr(ep, "_numpy_parity_cache", None)
+    assert ep.numpy_stream_parity_ok() is True
+
+    # simulate a drifted numpy: seeded graphs fall back, unseeded stay native
+    monkeypatch.setattr(ep, "_numpy_parity_cache", None)
+    monkeypatch.setattr(ep, "_NUMPY_PARITY_INTEGERS", (1, 2, 3, 4))
+    assert ep.numpy_stream_parity_ok() is False
+    seeded_ts = json.loads(json.dumps(TS_SPEC))
+    seeded_ts["graph"]["parameters"].append({"name": "seed", "value": "3", "type": "INT"})
+    assert compile_edge_program(PredictorSpec.from_dict(seeded_ts)) is None
+    assert compile_edge_program(PredictorSpec.from_dict(TS_SPEC)) is not None
+    monkeypatch.setattr(ep, "_numpy_parity_cache", None)  # drop the cached False
+
+
 def test_native_epsilon_greedy_parity_deterministic(edge):
     """epsilon=0 makes the route deterministic: native edge response must be
     byte-identical (minus puid) to the Python engine's, including the bandit

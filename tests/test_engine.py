@@ -348,3 +348,84 @@ def test_sync_path_degrades_on_missed_async_component():
     assert engine.has_async_nodes is True
     out2 = engine.predict_sync(tensor_msg([3.0], [1, 1]))
     assert out2.to_dict()["data"]["tensor"]["values"] == pytest.approx([6.0])
+
+
+def test_degrade_to_async_fires_exactly_once(monkeypatch):
+    """The degrade flip is permanent: the first missed-async request pays it
+    (and re-executes nodes upstream of the suspension — the documented
+    caveat), every later request goes straight to the event-loop path with
+    no further degrade."""
+
+    calls = {"degrade": 0, "upstream": 0, "sneaky": 0}
+
+    class Upstream(SeldonComponent):
+        def transform_input(self, X, names, meta=None):
+            calls["upstream"] += 1
+            return X
+
+    async def _apredict(X):
+        await asyncio.sleep(0)
+        return X + 1
+
+    class SneakyAsync(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            calls["sneaky"] += 1
+            return _apredict(X)
+
+    engine = GraphEngine(
+        spec({"name": "t", "type": "TRANSFORMER",
+              "children": [{"name": "m", "type": "MODEL"}]}),
+        components={"t": Upstream(), "m": SneakyAsync()},
+        fuse=False,
+    )
+    assert engine.has_async_nodes is False
+    original = engine._degrade_to_async
+
+    def counting_degrade(op):
+        calls["degrade"] += 1
+        original(op)
+
+    monkeypatch.setattr(engine, "_degrade_to_async", counting_degrade)
+
+    out = engine.predict_sync(tensor_msg([1.0], [1, 1]))
+    assert out.to_dict()["data"]["tensor"]["values"] == pytest.approx([2.0])
+    assert calls["degrade"] == 1
+    # the aborted inline attempt ran the upstream node once, the event-loop
+    # retry ran it again (documented double side effect, once per engine)
+    assert calls["upstream"] == 2
+
+    out2 = engine.predict_sync(tensor_msg([5.0], [1, 1]))
+    assert out2.to_dict()["data"]["tensor"]["values"] == pytest.approx([6.0])
+    assert calls["degrade"] == 1  # never again
+    assert calls["upstream"] == 3  # exactly once per subsequent request
+
+
+def test_feedback_sync_degrades_on_missed_async_component():
+    """send_feedback_sync shares the inline-drive path; a sync send_feedback
+    returning an awaitable must degrade, deliver, and keep serving."""
+
+    delivered = []
+
+    async def _afeedback(reward):
+        await asyncio.sleep(0)
+        delivered.append(reward)
+
+    class SneakyFeedback(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return X
+
+        def send_feedback(self, features, feature_names, reward, truth, routing=None):
+            return _afeedback(reward)
+
+    engine = GraphEngine(
+        spec({"name": "m", "type": "MODEL"}),
+        components={"m": SneakyFeedback()}, fuse=False)
+    assert engine.has_async_nodes is False
+    fb = Feedback(request=tensor_msg([1.0], [1, 1]), reward=0.5)
+    engine.send_feedback_sync(fb)
+    assert engine.has_async_nodes is True
+    # the documented degrade caveat: the aborted inline attempt may deliver
+    # upstream side effects twice; for a single node the retry redelivers
+    assert delivered and all(r == 0.5 for r in delivered)
+    engine.send_feedback_sync(Feedback(request=tensor_msg([2.0], [1, 1]), reward=1.0))
+    assert delivered[-1] == 1.0

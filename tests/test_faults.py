@@ -1,0 +1,284 @@
+"""Fault-injection harness + transport-level resilience tests.
+
+Covers acceptance criterion (c): an overloaded REST server sheds with 503 +
+Retry-After while admitted requests still complete, and shed counts / breaker
+state are visible on the metrics endpoint. Deterministic: seeded schedules,
+event-gated concurrency, no sleep over 100ms.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.graph import PredictorSpec
+from seldon_core_tpu.contracts.payload import SeldonError
+from seldon_core_tpu.metrics.registry import MetricsRegistry
+from seldon_core_tpu.runtime.engine import GraphEngine
+from seldon_core_tpu.runtime.resilience import (
+    AdmissionController,
+    BreakerOpen,
+    DeadlineExceeded,
+    ShedError,
+)
+from seldon_core_tpu.testing.faults import FaultClock, FaultSchedule, FaultSpec, FaultyComponent
+from seldon_core_tpu.transport.rest import make_engine_app
+
+pytestmark = pytest.mark.faults
+
+
+def spec(graph) -> PredictorSpec:
+    return PredictorSpec.from_dict({"name": "p", "graph": graph})
+
+
+# ---------------------------------------------------------------------------
+# Harness determinism
+# ---------------------------------------------------------------------------
+
+
+def test_fault_clock_is_manual():
+    clock = FaultClock(start=100.0)
+    assert clock() == 100.0
+    clock.advance(2.5)
+    assert clock() == 102.5
+    with pytest.raises(ValueError):
+        clock.advance(-1)
+
+
+def test_seeded_schedule_is_deterministic():
+    a = FaultSchedule.seeded(seed=42, n=50, error_rate=0.3, latency_s=0.01,
+                             latency_jitter_s=0.02)
+    b = FaultSchedule.seeded(seed=42, n=50, error_rate=0.3, latency_s=0.01,
+                             latency_jitter_s=0.02)
+    c = FaultSchedule.seeded(seed=43, n=50, error_rate=0.3)
+    pattern = lambda s: [(sp.error is None, sp.latency_s) for sp in s.specs]  # noqa: E731
+    assert pattern(a) == pattern(b)
+    assert pattern(a) != pattern(c)
+
+
+def test_schedule_repeats_final_entry():
+    s = FaultSchedule.flaps("EO")
+    assert s[0].error is not None
+    assert s[1].error is None
+    assert s[100].error is None  # last entry repeats forever
+
+
+def test_flap_schedule_drives_component():
+    comp = FaultyComponent(FaultSchedule.flaps("EOE"))
+
+    async def call():
+        return await comp.predict(np.array([[1.0]]), [])
+
+    with pytest.raises(SeldonError):
+        asyncio.run(call())
+    assert np.asarray(asyncio.run(call())).shape == (1, 1)
+    with pytest.raises(SeldonError):
+        asyncio.run(call())
+    assert comp.calls == 3
+
+
+# ---------------------------------------------------------------------------
+# REST transport: shedding + deadline header
+# ---------------------------------------------------------------------------
+
+
+class _Gate(SeldonComponent):
+    """Async component that parks until released — deterministic 'slow node'
+    for overload tests (no sleeps)."""
+
+    is_async = True
+
+    def __init__(self):
+        super().__init__()
+        self.entered: "asyncio.Event" = None  # bound in the serving loop
+        self.release: "asyncio.Event" = None
+
+    def bind(self):
+        self.entered = asyncio.Event()
+        self.release = asyncio.Event()
+
+    async def predict(self, X, names, meta=None):
+        self.entered.set()
+        await self.release.wait()
+        return X
+
+
+def test_rest_sheds_with_retry_after_while_inflight_completes():
+    """Acceptance (c): with max_inflight=1 and no queue, a second concurrent
+    request sheds 503 + Retry-After; the admitted request still completes;
+    the shed count lands on /metrics."""
+    gate = _Gate()
+    engine = GraphEngine(spec({"name": "m", "type": "MODEL"}), components={"m": gate})
+    metrics = MetricsRegistry(deployment="d", predictor="p")
+    admission = AdmissionController(max_inflight=1, max_queue=0, retry_after_s=7)
+    app = make_engine_app(engine, metrics=metrics, admission=admission)
+    body = {"data": {"ndarray": [[1.0]]}}
+
+    async def go():
+        gate.bind()
+        async with TestClient(TestServer(app)) as client:
+            first = asyncio.ensure_future(client.post("/api/v0.1/predictions", json=body))
+            await gate.entered.wait()  # request 1 is inside the graph
+            second = await client.post("/api/v0.1/predictions", json=body)
+            assert second.status == 503
+            assert second.headers["Retry-After"] == "7"
+            shed_body = await second.json()
+            assert shed_body["status"]["reason"] == "RESOURCE_EXHAUSTED"
+            gate.release.set()  # let the admitted request finish
+            resp1 = await first
+            assert resp1.status == 200
+            out = await resp1.json()
+            assert out["data"]["ndarray"] == [[1.0]]
+            # shed count + admission gauges visible on the metrics endpoint
+            m = await client.get("/metrics")
+            text = await m.text()
+            assert 'seldon_resilience_shed_total{deployment_name="d",predictor_name="p",transport="rest"} 1.0' in text
+            assert "seldon_resilience_inflight" in text
+
+    asyncio.run(go())
+
+
+def test_rest_queue_admits_after_release():
+    gate = _Gate()
+    engine = GraphEngine(spec({"name": "m", "type": "MODEL"}), components={"m": gate})
+    admission = AdmissionController(max_inflight=1, max_queue=1)
+    app = make_engine_app(engine, admission=admission)
+    body = {"data": {"ndarray": [[2.0]]}}
+
+    async def go():
+        gate.bind()
+        async with TestClient(TestServer(app)) as client:
+            first = asyncio.ensure_future(client.post("/api/v0.1/predictions", json=body))
+            await gate.entered.wait()
+            # second request queues (queue=1); third sheds
+            second = asyncio.ensure_future(client.post("/api/v0.1/predictions", json=body))
+            while admission.queue_depth() == 0:
+                await asyncio.sleep(0.001)
+            third = await client.post("/api/v0.1/predictions", json=body)
+            assert third.status == 503
+            gate.entered.clear()
+            gate.release.set()  # first completes; second admitted and parks
+            assert (await first).status == 200
+            await gate.entered.wait()
+            gate.release.set()
+            assert (await second).status == 200
+
+    asyncio.run(go())
+
+
+def test_rest_deadline_header_returns_504_and_counts():
+    """Budget expires between nodes: 504 + DEADLINE_EXCEEDED on the wire,
+    deadline counter on /metrics, downstream node never runs."""
+
+    class Slow(SeldonComponent):
+        is_async = True
+
+        async def transform_input(self, X, names, meta=None):
+            await asyncio.sleep(0.05)  # burns the 10ms budget (< 100ms cap)
+            return X
+
+    downstream = FaultyComponent(FaultSchedule.always_ok())
+    engine = GraphEngine(
+        spec({"name": "t", "type": "TRANSFORMER",
+              "children": [{"name": "m", "type": "MODEL"}]}),
+        components={"t": Slow(), "m": downstream},
+    )
+    metrics = MetricsRegistry(deployment="d", predictor="p")
+    app = make_engine_app(engine, metrics=metrics)
+
+    async def go():
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0]]}},
+                headers={"Seldon-Deadline-Ms": "10"},
+            )
+            assert resp.status == 504
+            out = await resp.json()
+            assert out["status"]["reason"] == "DEADLINE_EXCEEDED"
+            m = await client.get("/metrics")
+            text = await m.text()
+            assert 'seldon_resilience_deadline_exceeded_total{deployment_name="d",predictor_name="p",transport="rest"} 1.0' in text
+
+    asyncio.run(go())
+    assert downstream.calls == 0
+
+
+def test_rest_generous_deadline_header_succeeds():
+    class Echo(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return X
+
+    engine = GraphEngine(spec({"name": "m", "type": "MODEL"}), components={"m": Echo()})
+    app = make_engine_app(engine)
+
+    async def go():
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[3.0]]}},
+                headers={"Seldon-Deadline-Ms": "5000"},
+            )
+            assert resp.status == 200
+            return await resp.json()
+
+    out = asyncio.run(go())
+    assert out["data"]["ndarray"] == [[3.0]]
+
+
+# ---------------------------------------------------------------------------
+# gRPC status mapping
+# ---------------------------------------------------------------------------
+
+
+class _FakeContext:
+    """Records the abort; raises like grpc's real context.abort."""
+
+    def __init__(self, metadata=(), time_remaining=None):
+        self._metadata = tuple(metadata)
+        self._time_remaining = time_remaining
+        self.code = None
+        self.details = None
+
+    def abort(self, code, details):
+        self.code = code
+        self.details = details
+        raise RuntimeError("aborted")
+
+    def invocation_metadata(self):
+        return self._metadata
+
+    def time_remaining(self):
+        return self._time_remaining
+
+
+def test_grpc_abort_status_mapping():
+    import grpc
+
+    from seldon_core_tpu.transport.grpc_server import _abort
+
+    cases = [
+        (DeadlineExceeded("too slow"), grpc.StatusCode.DEADLINE_EXCEEDED),
+        (ShedError("full"), grpc.StatusCode.RESOURCE_EXHAUSTED),
+        (BreakerOpen("m", 5.0), grpc.StatusCode.UNAVAILABLE),
+        (SeldonError("bad", status_code=400), grpc.StatusCode.INVALID_ARGUMENT),
+        (SeldonError("boom", status_code=500), grpc.StatusCode.INTERNAL),
+    ]
+    for exc, want in cases:
+        ctx = _FakeContext()
+        with pytest.raises(RuntimeError):
+            _abort(ctx, exc)
+        assert ctx.code == want, exc
+
+
+def test_grpc_deadline_from_context():
+    from seldon_core_tpu.transport.grpc_server import _deadline_from_context
+
+    d = _deadline_from_context(_FakeContext(time_remaining=1.5))
+    assert d is not None and 0 < d.remaining_s() <= 1.5
+    d = _deadline_from_context(_FakeContext(metadata=[("seldon-deadline-ms", "250")]))
+    assert d is not None and 0 < d.remaining_ms() <= 250
+    assert _deadline_from_context(_FakeContext()) is None
+    assert _deadline_from_context(_FakeContext(metadata=[("seldon-deadline-ms", "x")])) is None

@@ -1,0 +1,570 @@
+"""Resilience-layer tests: deadline propagation, circuit breakers, load
+shedding, and graceful degradation — all driven by the deterministic
+fault-injection harness (seldon_core_tpu.testing.faults). No wall-clock
+randomness; no sleep exceeds 100ms; time moves by advancing a FaultClock.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.graph import PredictorSpec
+from seldon_core_tpu.contracts.payload import SeldonError, SeldonMessage
+from seldon_core_tpu.metrics.registry import MetricsRegistry
+from seldon_core_tpu.runtime.engine import (
+    TAG_DROPPED_BRANCHES,
+    TAG_PARTIAL_RESPONSE,
+    TAG_REROUTED,
+    GraphEngine,
+)
+from seldon_core_tpu.runtime.resilience import (
+    AdmissionController,
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    ResilienceConfig,
+    ShedError,
+    deadline_scope,
+    effective_timeout,
+    failure_counts_for_breaker,
+)
+from seldon_core_tpu.testing.faults import FaultClock, FaultSchedule, FaultSpec, FaultyComponent
+
+pytestmark = pytest.mark.faults
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def tensor_msg(values, shape):
+    return SeldonMessage.from_dict({"data": {"tensor": {"shape": shape, "values": values}}})
+
+
+def spec(graph) -> PredictorSpec:
+    return PredictorSpec.from_dict({"name": "p", "graph": graph})
+
+
+# ---------------------------------------------------------------------------
+# Deadline budgets
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expires_mid_graph_skips_downstream():
+    """(a) of the acceptance criteria: a budget that expires after the first
+    node returns 504/DEADLINE_EXCEEDED and the downstream node NEVER runs."""
+    clock = FaultClock()
+    slow = FaultyComponent(FaultSchedule.always_ok(latency_s=0.2), clock=clock)
+    downstream = FaultyComponent(FaultSchedule.always_ok(), clock=clock)
+    engine = GraphEngine(
+        spec({"name": "t", "type": "TRANSFORMER",
+              "children": [{"name": "m", "type": "MODEL"}]}),
+        components={"t": slow, "m": downstream},
+    )
+    with pytest.raises(SeldonError) as exc:
+        run(engine.predict(tensor_msg([1.0], [1, 1]),
+                           deadline=Deadline(0.1, clock=clock)))
+    assert exc.value.status_code == 504
+    assert exc.value.reason == "DEADLINE_EXCEEDED"
+    assert slow.calls == 1
+    assert downstream.calls == 0  # short-circuited, not executed
+
+
+def test_deadline_with_headroom_executes_whole_graph():
+    clock = FaultClock()
+    fast = FaultyComponent(FaultSchedule.always_ok(latency_s=0.01), clock=clock)
+    downstream = FaultyComponent(FaultSchedule.always_ok(), clock=clock)
+    engine = GraphEngine(
+        spec({"name": "t", "type": "TRANSFORMER",
+              "children": [{"name": "m", "type": "MODEL"}]}),
+        components={"t": fast, "m": downstream},
+    )
+    out = run(engine.predict(tensor_msg([1.0], [1, 1]),
+                             deadline=Deadline(1.0, clock=clock)))
+    assert downstream.calls == 1
+    assert out.data is not None
+
+
+def test_deadline_already_expired_executes_nothing():
+    clock = FaultClock()
+    node = FaultyComponent(FaultSchedule.always_ok(), clock=clock)
+    engine = GraphEngine(
+        spec({"name": "m", "type": "MODEL"}), components={"m": node})
+    d = Deadline(0.05, clock=clock)
+    clock.advance(0.06)
+    with pytest.raises(SeldonError) as exc:
+        run(engine.predict(tensor_msg([1.0], [1, 1]), deadline=d))
+    assert exc.value.status_code == 504
+    assert node.calls == 0
+
+
+def test_default_deadline_from_annotation():
+    clock = FaultClock()
+    slow = FaultyComponent(FaultSchedule.always_ok(latency_s=0.2), clock=clock)
+    downstream = FaultyComponent(FaultSchedule.always_ok(), clock=clock)
+    engine = GraphEngine(
+        spec({"name": "t", "type": "TRANSFORMER",
+              "children": [{"name": "m", "type": "MODEL"}]}),
+        components={"t": slow, "m": downstream},
+        resilience=ResilienceConfig(default_deadline_ms=100.0, clock=clock),
+    )
+    with pytest.raises(SeldonError) as exc:
+        run(engine.predict(tensor_msg([1.0], [1, 1])))
+    assert exc.value.reason == "DEADLINE_EXCEEDED"
+    assert downstream.calls == 0
+
+
+def test_effective_timeout_clamps_to_remaining_budget():
+    clock = FaultClock()
+    with deadline_scope(Deadline(2.0, clock=clock)):
+        assert effective_timeout(5.0) == pytest.approx(2.0)
+        assert effective_timeout(1.0) == pytest.approx(1.0)
+        assert effective_timeout(None) == pytest.approx(2.0)
+        clock.advance(1.5)
+        assert effective_timeout(5.0) == pytest.approx(0.5)
+        clock.advance(1.0)
+        with pytest.raises(DeadlineExceeded):
+            effective_timeout(5.0)
+    # no deadline in scope: per-hop timeout passes through untouched
+    assert effective_timeout(5.0) == 5.0
+    assert effective_timeout(None) is None
+
+
+# ---------------------------------------------------------------------------
+# Circuit breakers
+# ---------------------------------------------------------------------------
+
+
+def breaker_engine(schedule, clock, failures=3, reset_s=1.0):
+    comp = FaultyComponent(schedule, clock=clock)
+    engine = GraphEngine(
+        spec({"name": "m", "type": "MODEL"}),
+        components={"m": comp},
+        resilience=ResilienceConfig(
+            breaker_failures=failures, breaker_reset_s=reset_s, clock=clock),
+    )
+    return engine, comp
+
+
+def test_breaker_opens_rejects_half_opens_and_recovers():
+    """(b) of the acceptance criteria: full open -> half-open -> closed cycle
+    after the configured consecutive-failure threshold."""
+    clock = FaultClock()
+    # 3 errors trip the breaker; the probe (4th executed call) succeeds
+    engine, comp = breaker_engine(FaultSchedule.flaps("EEEO"), clock, failures=3)
+    breaker = dict(engine.breakers())["m"]
+
+    msg = tensor_msg([1.0], [1, 1])
+    for _ in range(3):
+        with pytest.raises(SeldonError, match="injected fault"):
+            run(engine.predict(msg))
+    assert breaker.state == "open"
+    assert comp.calls == 3
+
+    # while open: rejected without executing the component
+    with pytest.raises(SeldonError) as exc:
+        run(engine.predict(msg))
+    assert exc.value.reason == "CIRCUIT_OPEN"
+    assert exc.value.status_code == 503
+    assert comp.calls == 3
+    assert breaker.rejected_total == 1
+
+    # after the reset window: half-open probe executes and closes the breaker
+    clock.advance(1.1)
+    out = run(engine.predict(msg))
+    assert comp.calls == 4
+    assert breaker.state == "closed"
+    assert out.data is not None
+
+    # and stays closed for subsequent traffic
+    run(engine.predict(msg))
+    assert comp.calls == 5
+
+
+def test_breaker_failed_probe_reopens():
+    clock = FaultClock()
+    engine, comp = breaker_engine(FaultSchedule.always_fail(), clock, failures=2)
+    breaker = dict(engine.breakers())["m"]
+    msg = tensor_msg([1.0], [1, 1])
+    for _ in range(2):
+        with pytest.raises(SeldonError, match="injected fault"):
+            run(engine.predict(msg))
+    assert breaker.state == "open"
+    clock.advance(1.1)
+    with pytest.raises(SeldonError, match="injected fault"):
+        run(engine.predict(msg))  # the probe itself fails...
+    assert breaker.state == "open"  # ...and the breaker re-opens
+    assert comp.calls == 3
+    with pytest.raises(SeldonError) as exc:
+        run(engine.predict(msg))  # immediately rejected again
+    assert exc.value.reason == "CIRCUIT_OPEN"
+    assert comp.calls == 3
+
+
+def test_breaker_half_open_probe_4xx_does_not_wedge():
+    """A probe that draws a 4xx (node responded — healthy) must resolve the
+    probe slot: the node answered, so the breaker closes. Regression: neither
+    record ran, leaving _probe_inflight held forever (permanent 503s)."""
+    clock = FaultClock()
+    schedule = FaultSchedule(
+        [FaultSpec.fail(status_code=503)] * 2 + [FaultSpec.fail(status_code=400)]
+        + [FaultSpec.ok()])
+    engine, comp = breaker_engine(schedule, clock, failures=2)
+    breaker = dict(engine.breakers())["m"]
+    msg = tensor_msg([1.0], [1, 1])
+    for _ in range(2):
+        with pytest.raises(SeldonError):
+            run(engine.predict(msg))
+    assert breaker.state == "open"
+    clock.advance(1.1)
+    with pytest.raises(SeldonError) as exc:
+        run(engine.predict(msg))  # the probe: node responds with a 400
+    assert exc.value.status_code == 400
+    assert breaker.state == "closed"  # responded => healthy, not wedged
+    out = run(engine.predict(msg))  # traffic flows again
+    assert out.data is not None and comp.calls == 4
+
+
+def test_breaker_cancelled_probe_releases_slot():
+    """Cancellation judges nothing: the probe slot frees so the NEXT call can
+    probe, and the breaker stays half-open rather than wedging or re-opening."""
+    clock = FaultClock()
+    b = CircuitBreaker("n", failure_threshold=1, reset_timeout_s=1.0, clock=clock)
+    b.record_failure()
+    assert b.state == "open"
+    clock.advance(1.1)
+    assert b.allow()  # probe slot taken
+    assert not b.allow()
+    b.release_probe()  # probe cancelled mid-flight
+    assert b.state == "half_open"
+    assert b.allow()  # next call can probe immediately
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_cancellation_never_counts_as_breaker_failure():
+    assert not failure_counts_for_breaker(asyncio.CancelledError())
+    assert not failure_counts_for_breaker(BreakerOpen("m", 1.0))
+    assert failure_counts_for_breaker(TimeoutError())
+    assert failure_counts_for_breaker(SeldonError("x", status_code=503))
+    assert not failure_counts_for_breaker(SeldonError("x", status_code=400))
+
+
+def test_breaker_client_errors_do_not_trip():
+    clock = FaultClock()
+    schedule = FaultSchedule([FaultSpec.fail(status_code=400)] * 10)
+    engine, comp = breaker_engine(schedule, clock, failures=2)
+    breaker = dict(engine.breakers())["m"]
+    for _ in range(5):
+        with pytest.raises(SeldonError):
+            run(engine.predict(tensor_msg([1.0], [1, 1])))
+    assert breaker.state == "closed"  # 4xx never opens a breaker
+    assert comp.calls == 5
+
+
+def test_local_sync_nodes_get_no_breaker():
+    class Local(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return X
+
+    engine = GraphEngine(spec({"name": "m", "type": "MODEL"}), components={"m": Local()})
+    assert engine.breakers() == []
+
+
+def test_router_reroutes_around_open_branch():
+    clock = FaultClock()
+
+    class Pick0(SeldonComponent):
+        def route(self, X, names):
+            return 0
+
+    class Const(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.array([[42.0]])
+
+    flaky = FaultyComponent(FaultSchedule.always_fail(), clock=clock)
+    engine = GraphEngine(
+        spec({"name": "r", "type": "ROUTER", "children": [
+            {"name": "a", "type": "MODEL"}, {"name": "b", "type": "MODEL"}]}),
+        components={"r": Pick0(), "a": flaky, "b": Const()},
+        resilience=ResilienceConfig(breaker_failures=2, breaker_reset_s=60.0, clock=clock),
+    )
+    msg = tensor_msg([1.0], [1, 1])
+    for _ in range(2):
+        with pytest.raises(SeldonError, match="injected fault"):
+            run(engine.predict(msg))
+    assert dict(engine.breakers())["a"].state == "open"
+
+    # router still picks 0, but the engine reroutes to healthy branch 1
+    out = run(engine.predict(msg))
+    d = out.to_dict()
+    assert d["data"]["tensor"]["values"] == [42.0]
+    assert d["meta"]["routing"] == {"r": 1}
+    assert d["meta"]["tags"][TAG_REROUTED] == {"r": {"from": 0, "to": 1}}
+    assert flaky.calls == 2  # open branch never executed again
+
+
+def test_combiner_drops_open_branch_when_partial_allowed():
+    clock = FaultClock()
+    flaky = FaultyComponent(FaultSchedule.always_fail(), clock=clock)
+
+    class Const(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.array([[10.0, 20.0]])
+
+    graph = {
+        "name": "c", "type": "COMBINER", "implementation": "AVERAGE_COMBINER",
+        "children": [{"name": "m1", "type": "MODEL"}, {"name": "m2", "type": "MODEL"}],
+    }
+    engine = GraphEngine(
+        spec(graph),
+        components={"m1": flaky, "m2": Const()},
+        resilience=ResilienceConfig(
+            breaker_failures=2, breaker_reset_s=60.0, allow_partial=True, clock=clock),
+    )
+    msg = tensor_msg([1.0], [1, 1])
+    # real failures (breaker closed) still fail the whole request
+    for _ in range(2):
+        with pytest.raises(SeldonError, match="injected fault"):
+            run(engine.predict(msg))
+    assert dict(engine.breakers())["m1"].state == "open"
+
+    # open branch is dropped; the combiner averages the surviving branch
+    out = run(engine.predict(msg))
+    d = out.to_dict()
+    assert d["data"]["tensor"]["values"] == [10.0, 20.0]
+    assert d["meta"]["tags"][TAG_PARTIAL_RESPONSE] is True
+    assert d["meta"]["tags"][TAG_DROPPED_BRANCHES] == ["m1"]
+    assert flaky.calls == 2
+
+
+def test_combiner_open_branch_fails_request_without_allow_partial():
+    clock = FaultClock()
+    flaky = FaultyComponent(FaultSchedule.always_fail(), clock=clock)
+
+    class Const(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.array([[1.0]])
+
+    graph = {
+        "name": "c", "type": "COMBINER", "implementation": "AVERAGE_COMBINER",
+        "children": [{"name": "m1", "type": "MODEL"}, {"name": "m2", "type": "MODEL"}],
+    }
+    engine = GraphEngine(
+        spec(graph),
+        components={"m1": flaky, "m2": Const()},
+        resilience=ResilienceConfig(breaker_failures=2, breaker_reset_s=60.0, clock=clock),
+    )
+    msg = tensor_msg([1.0], [1, 1])
+    for _ in range(2):
+        with pytest.raises(SeldonError):
+            run(engine.predict(msg))
+    with pytest.raises(SeldonError) as exc:
+        run(engine.predict(msg))
+    assert exc.value.reason == "CIRCUIT_OPEN"
+
+
+def test_combiner_all_branches_open_raises():
+    clock = FaultClock()
+    f1 = FaultyComponent(FaultSchedule.always_fail(), clock=clock)
+    f2 = FaultyComponent(FaultSchedule.always_fail(), clock=clock)
+    graph = {
+        "name": "c", "type": "COMBINER", "implementation": "AVERAGE_COMBINER",
+        "children": [{"name": "m1", "type": "MODEL"}, {"name": "m2", "type": "MODEL"}],
+    }
+    engine = GraphEngine(
+        spec(graph),
+        components={"m1": f1, "m2": f2},
+        resilience=ResilienceConfig(
+            breaker_failures=1, breaker_reset_s=60.0, allow_partial=True, clock=clock),
+    )
+    msg = tensor_msg([1.0], [1, 1])
+    with pytest.raises(SeldonError):
+        run(engine.predict(msg))  # trips both breakers (threshold 1)
+    with pytest.raises(SeldonError) as exc:
+        run(engine.predict(msg))
+    assert exc.value.reason == "CIRCUIT_OPEN"
+    assert "every branch dropped" in exc.value.message
+
+
+# ---------------------------------------------------------------------------
+# Breaker unit-level state machine
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_state_machine_codes_and_transitions():
+    clock = FaultClock()
+    b = CircuitBreaker("n", failure_threshold=2, reset_timeout_s=5.0, clock=clock)
+    seen = []
+    b.on_transition = lambda name, to: seen.append((name, to))
+    assert b.allow() and b.state_code() == 0
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open" and b.state_code() == 2
+    assert not b.allow()
+    assert not b.available()
+    assert b.retry_in_s() == pytest.approx(5.0)
+    clock.advance(5.0)
+    assert b.available()  # peek does not consume the probe
+    assert b.allow()  # first probe
+    assert b.state == "half_open" and b.state_code() == 1
+    assert not b.allow()  # only one probe at a time
+    b.record_success()
+    assert b.state == "closed"
+    assert seen == [("n", "open"), ("n", "half_open"), ("n", "closed")]
+
+
+def test_breaker_disabled_with_zero_threshold():
+    cfg = ResilienceConfig(breaker_failures=0)
+    assert cfg.make_breaker("m") is None
+
+
+def test_resilience_config_from_annotations():
+    cfg = ResilienceConfig.from_annotations({
+        "seldon.io/circuit-breaker-max-failures": "7",
+        "seldon.io/circuit-breaker-reset-ms": "1500",
+        "seldon.io/allow-partial": "true",
+        "seldon.io/deadline-default-ms": "250",
+    })
+    assert cfg.breaker_failures == 7
+    assert cfg.breaker_reset_s == pytest.approx(1.5)
+    assert cfg.allow_partial is True
+    assert cfg.default_deadline_ms == pytest.approx(250.0)
+    # garbage/missing values keep defaults
+    cfg = ResilienceConfig.from_annotations({"seldon.io/circuit-breaker-max-failures": "x"})
+    assert cfg.breaker_failures == 5 and cfg.allow_partial is False
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admission_disabled_by_default():
+    a = AdmissionController()
+    assert not a.enabled
+    run(a.acquire())  # no-ops
+    a.acquire_sync()
+    a.release()
+
+
+def test_admission_sheds_when_full():
+    async def go():
+        a = AdmissionController(max_inflight=2, max_queue=0, retry_after_s=3)
+        await a.acquire()
+        await a.acquire()
+        with pytest.raises(ShedError) as exc:
+            await a.acquire()
+        assert exc.value.status_code == 503
+        assert exc.value.reason == "RESOURCE_EXHAUSTED"
+        assert exc.value.retry_after_s == 3
+        assert a.shed_total == 1
+        a.release()
+        await a.acquire()  # slot free again
+        assert a.inflight == 2
+
+    run(go())
+
+
+def test_admission_queue_grants_fifo():
+    async def go():
+        a = AdmissionController(max_inflight=1, max_queue=2)
+        await a.acquire()
+        order = []
+
+        async def waiter(tag):
+            await a.acquire()
+            order.append(tag)
+
+        w1 = asyncio.ensure_future(waiter("first"))
+        await asyncio.sleep(0)
+        w2 = asyncio.ensure_future(waiter("second"))
+        await asyncio.sleep(0)
+        assert a.queue_depth() == 2
+        with pytest.raises(ShedError):
+            await a.acquire()  # queue full
+        a.release()
+        await w1
+        a.release()
+        await w2
+        assert order == ["first", "second"]
+
+    run(go())
+
+
+def test_admission_sync_and_async_share_slots():
+    async def go():
+        a = AdmissionController(max_inflight=1, max_queue=1)
+        await a.acquire()
+        fut = asyncio.ensure_future(a.acquire())
+        await asyncio.sleep(0)  # async waiter occupies the one queue slot
+        with pytest.raises(ShedError):
+            a.acquire_sync(timeout_s=0.01)  # sync path sees the full queue
+        a.release()  # slot hands over to the queued async waiter
+        await fut
+        assert a.inflight == 1
+        a.release()
+        assert a.inflight == 0
+
+    run(go())
+
+
+def test_admission_from_annotations_and_env():
+    a = AdmissionController.from_annotations(
+        {"seldon.io/max-inflight": "8", "seldon.io/max-queue": "16"}, env={})
+    assert a.max_inflight == 8 and a.max_queue == 16 and a.enabled
+    a = AdmissionController.from_annotations(
+        None, env={"SELDON_MAX_INFLIGHT": "4", "SELDON_SHED_RETRY_AFTER_S": "2.5"})
+    assert a.max_inflight == 4 and a.retry_after_s == 2.5
+    a = AdmissionController.from_annotations(None, env={})
+    assert not a.enabled
+
+
+def test_microbatcher_flush_is_deadline_free():
+    """The flusher task snapshots the context of the request that created it;
+    a stale (even expired) deadline must not poison merged batches."""
+    from seldon_core_tpu.runtime.microbatch import MicroBatcher
+
+    class Echo(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.asarray(X)
+
+    engine = GraphEngine(spec({"name": "m", "type": "MODEL"}), components={"m": Echo()})
+    mb = MicroBatcher(engine, max_batch=2, max_delay_ms=1.0)
+    clock = FaultClock()
+    expired = Deadline(0.01, clock=clock)
+    clock.advance(1.0)
+
+    async def go():
+        with deadline_scope(expired):  # ambient context: an exhausted budget
+            a = asyncio.ensure_future(mb.predict(tensor_msg([1.0], [1, 1])))
+            b = asyncio.ensure_future(mb.predict(tensor_msg([2.0], [1, 1])))
+            return await asyncio.gather(a, b)
+
+    out_a, out_b = run(go())
+    assert out_a.data is not None and out_b.data is not None
+
+
+# ---------------------------------------------------------------------------
+# Metrics visibility
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_state_and_transitions_in_metrics():
+    clock = FaultClock()
+    engine, comp = breaker_engine(FaultSchedule.always_fail(), clock, failures=1)
+    registry = MetricsRegistry(deployment="d", predictor="p")
+    registry.sync_resilience(engine=engine)  # wires transition counters
+    with pytest.raises(SeldonError):
+        run(engine.predict(tensor_msg([1.0], [1, 1])))
+    with pytest.raises(SeldonError):
+        run(engine.predict(tensor_msg([1.0], [1, 1])))  # rejected by breaker
+    registry.sync_resilience(engine=engine)
+    text = registry.expose().decode()
+    assert 'seldon_resilience_breaker_state{deployment_name="d",node="m",predictor_name="p"} 2.0' in text
+    assert 'seldon_resilience_breaker_transitions_total{deployment_name="d",node="m",predictor_name="p",to="open"} 1.0' in text
+    assert 'seldon_resilience_breaker_rejected_total{deployment_name="d",node="m",predictor_name="p"} 1.0' in text
