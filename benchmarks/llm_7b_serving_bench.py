@@ -85,7 +85,8 @@ def main() -> None:
     kwargs = dict(model=model_name, init_random=True, seed=0,
                   max_new_tokens=max_new, len_buckets=len_buckets,
                   batch_buckets=(1, 8), temperature=0.0, eos_id=-1,
-                  continuous_batching=8, prefix_cache_size=8)
+                  continuous_batching=8, prefix_cache_size=8,
+                  kv_cache_dtype=os.environ.get("KV_CACHE_DTYPE", ""))
     if model_kwargs is not None:
         kwargs["model_kwargs"] = model_kwargs
     if quantize:
@@ -94,6 +95,18 @@ def main() -> None:
     server.load()
     report["load_s"] = round(time.perf_counter() - t0, 1)
     log("load_s", report["load_s"])
+
+    # per-token KV bytes alongside tok/s (ISSUE 2 satellite): bytes/step of
+    # KV read = batch * cache_len * bytes_per_token, the term DECODE_NOTES
+    # round 5 measured growing 2.71x from b1 to b8
+    from seldon_core_tpu.models.transformer import kv_cache_bytes_per_token
+
+    kv_per_tok = kv_cache_bytes_per_token(server._cfg, server.kv_cache_dtype)
+    report["kv_cache"] = {
+        "dtype": server.kv_cache_dtype,
+        "bytes_per_token": kv_per_tok,
+    }
+    log("kv_cache", report["kv_cache"])
 
     rng = np.random.default_rng(0)
     vocab = 31999 if on_tpu else 255
@@ -116,6 +129,9 @@ def main() -> None:
             "tok_per_s": round(n_tokens / med, 1),
             "ms_per_step": round(1e3 * med / max_new, 3),
             "compile_s": round(compile_s, 1),
+            "kv_bytes_per_token": kv_per_tok,
+            "kv_read_gb_per_step": round(
+                b * (plen + max_new) * kv_per_tok / 1e9, 3),
         }
         log(f"decode_b{b}", decode[f"b{b}"])
     if "A" in phases:

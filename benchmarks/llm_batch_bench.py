@@ -28,6 +28,8 @@ def main() -> None:
     ap.add_argument("--tpu", action="store_true")
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--kv-cache-dtype", default="", choices=("", "bf16", "int8"),
+                    help="KV-cache storage format (default bf16)")
     args = ap.parse_args()
 
     import jax
@@ -52,7 +54,8 @@ def main() -> None:
     server = LLMServer(model="transformer", model_kwargs=kwargs,
                        init_random=True, max_new_tokens=max_new,
                        len_buckets=(plen,), batch_buckets=(1,),
-                       temperature=0.0, eos_id=-1)
+                       temperature=0.0, eos_id=-1,
+                       kv_cache_dtype=args.kv_cache_dtype)
     server.load()
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, kwargs["vocab_size"] - 1, size=plen).tolist()
@@ -90,10 +93,18 @@ def main() -> None:
     svc.close()
 
     platform = jax.devices()[0].platform
+    # per-token KV bytes alongside tok/s so BENCH rounds can attribute
+    # bandwidth regressions (decode attention streams the whole static
+    # cache each step: bytes/step ~= slots * cache_len * bytes_per_token)
+    from seldon_core_tpu.models.transformer import kv_cache_bytes_per_token
+
+    kv_per_tok = kv_cache_bytes_per_token(server._cfg, server.kv_cache_dtype)
     entry = {
         "config": {"clients": args.clients, "slots": args.slots,
                    "max_new_tokens": max_new, "prompt_len": plen,
                    "model": kwargs},
+        "kv_cache": {"dtype": server.kv_cache_dtype,
+                     "bytes_per_token": kv_per_tok},
         "sequential": {"tok_per_s": round(seq_tokens / seq_s, 1),
                        "wall_s": round(seq_s, 2), "tokens": seq_tokens},
         "concurrent": {"tok_per_s": round(conc_tokens / conc_s, 1),

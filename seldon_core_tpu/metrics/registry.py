@@ -113,6 +113,38 @@ class MetricsRegistry:
             buckets=LATENCY_BUCKETS,
             registry=self.registry,
         )
+        # LLM decode-bandwidth observability (servers/llmserver.py
+        # llm_stats): resident KV bytes, slot occupancy, per-step KV read
+        # bytes, and a decode step-time histogram — the knobs the
+        # kv_cache_dtype / fused_norm optimizations move, exposed so the
+        # bandwidth win is visible at /metrics (benchmarks/DECODE_NOTES.md)
+        self._kv_cache_bytes = Gauge(
+            "seldon_llm_kv_cache_bytes",
+            "Resident KV-cache bytes (continuous-batching slot caches + "
+            "pinned prefix-cache entries)",
+            base,
+            registry=self.registry,
+        )
+        self._kv_occupancy = Gauge(
+            "seldon_llm_kv_cache_occupancy",
+            "Fraction of continuous-batching cache slots occupied (0-1)",
+            base,
+            registry=self.registry,
+        )
+        self._kv_bytes_per_step = Gauge(
+            "seldon_llm_kv_bytes_per_step",
+            "KV-cache bytes streamed from HBM per decode step (dense "
+            "attention reads the whole static cache every step)",
+            base,
+            registry=self.registry,
+        )
+        self._decode_step = Histogram(
+            "seldon_llm_decode_step_seconds",
+            "LLM decode step latency",
+            base,
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
         # breakers publish transitions through on_transition; remember which
         # are wired so scrape-time syncs are idempotent
         self._bound_breakers: set = set()
@@ -179,6 +211,27 @@ class MetricsRegistry:
             delta = admission.shed_total - shed._value.get()
             if delta > 0:
                 shed.inc(delta)
+
+    # ------------------------------------------------------------------
+    # LLM decode observability (servers/llmserver.py)
+    # ------------------------------------------------------------------
+    def sync_llm(self, component: Any) -> None:
+        """Refresh the KV-cache gauges from the component's ``llm_stats()``
+        snapshot and drain its pending decode step-time observations into
+        the histogram. Called at /metrics scrape time (like
+        sync_resilience); components without the surface are a no-op."""
+        stats_fn = getattr(component, "llm_stats", None)
+        if stats_fn is None:
+            return
+        stats = stats_fn()
+        self._kv_cache_bytes.labels(**self._base()).set(stats.get("kv_cache_bytes", 0))
+        self._kv_occupancy.labels(**self._base()).set(stats.get("kv_occupancy", 0.0))
+        self._kv_bytes_per_step.labels(**self._base()).set(
+            stats.get("kv_bytes_per_step", 0)
+        )
+        hist = self._decode_step.labels(**self._base())
+        for seconds in stats.get("decode_step_times_s", ()):
+            hist.observe(seconds)
 
     # ------------------------------------------------------------------
     def register_custom(self, response: SeldonMessage) -> None:

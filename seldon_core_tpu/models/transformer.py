@@ -36,6 +36,46 @@ with_sharding_constraint = nn_partitioning.with_sharding_constraint
 # exclude them; small enough that rotary angles stay finite.
 PAD_POS = 1 << 28
 
+# KV-cache storage formats. "bf16" stores K/V in the model compute dtype
+# (the historical layout, named for the production config); "int8" stores
+# symmetric per-head, per-position int8 values plus f32 scales — the decode
+# attention read then streams half the bytes (benchmarks/DECODE_NOTES.md:
+# KV reads are the term that grows 2.71x from b1 to b8).
+KV_CACHE_DTYPES = ("bf16", "int8")
+_KV_QMAX = 127.0
+
+
+def normalize_kv_cache_dtype(value) -> str:
+    """Canonical kv_cache_dtype ("bf16" or "int8"); raises ValueError on
+    anything else so misconfiguration fails at load() time, not inside jit."""
+    v = str(value or "bf16").strip().lower()
+    if v in ("bf16", "bfloat16", "model", "default"):
+        return "bf16"
+    if v == "int8":
+        return "int8"
+    raise ValueError(
+        f"unknown kv_cache_dtype {value!r}: expected one of {KV_CACHE_DTYPES}"
+    )
+
+
+def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization over the last (head_dim) axis:
+    x [..., hd] float -> (q int8 [..., hd], scale f32 [...]). One scale per
+    head per position — finer than per-tensor, so attention logits survive
+    outlier keys; zero vectors get scale 1 (dequantize to exact zeros)."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(amax > 0, amax / _KV_QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -128, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Inverse of quantize_kv, used INSIDE the attention read so XLA fuses
+    the convert+multiply into the consuming einsum (int8 stays the HBM
+    format; dequant happens on the fly in VMEM)."""
+    return q.astype(dtype) * scale[..., None].astype(dtype)
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -64,6 +104,14 @@ class TransformerConfig:
     # Any call that passes a KV cache (prefill/decode serving) uses the dense
     # path regardless — ring needs seq-sharded KV, caches are slot-indexed.
     attention_impl: str = "full"
+    # KV-cache storage: "bf16" (model dtype) or "int8" (quantized, per-head
+    # per-position scales). Attention dispatches on the cache STRUCTURE, so
+    # this field only picks the init_kv_caches default — one compiled module
+    # serves either layout.
+    kv_cache_dtype: str = "bf16"
+    # Fuse each block's residual-add + ffn RMSNorm into one Pallas pass
+    # (ops/fused_norm.py; falls back to the identical XLA expression off-TPU).
+    fused_norm: bool = False
     mesh: Any = None
 
     @property
@@ -122,8 +170,12 @@ class RMSNorm(nn.Module):
     eps: float = 1e-5
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x=None):
+        """x=None returns the bare weight (same param path, so fused callers
+        share checkpoints with the unfused graph)."""
         w = param_with_axes("weight", nn.initializers.ones_init(), (self.dim,), jnp.float32, axes=("embed",))
+        if x is None:
+            return w
         return rms_norm(x, w, self.eps)
 
 
@@ -134,7 +186,9 @@ class Attention(nn.Module):
     def __call__(self, x, positions, cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
                  cache_index: Optional[jnp.ndarray] = None):
         """x: [b, s, d]. With cache=(k_cache, v_cache, pos_cache) of
-        [b, max_len, kvh, hd] / [b, max_len], runs incremental decode and
+        [b, max_len, kvh, hd] / [b, max_len] — or the int8 layout
+        (k_q, k_scale, v_q, v_scale, pos_cache) with int8 values and
+        f32 [b, max_len, kvh] scales — runs incremental decode and
         returns (out, new_cache). cache_index is the write offset: a scalar
         (same slot for the whole batch — prefill) or a [b] vector (per-sequence
         slots — continuous batching decode, s must be 1). pos_cache holds each
@@ -171,7 +225,37 @@ class Attention(nn.Module):
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
 
-        if cache is not None:
+        if cache is not None and len(cache) == 5:
+            # int8 cache: (k_q, k_scale, v_q, v_scale, pos). Quantize-on-write
+            # (new K/V rows become int8 + per-head scales before the scatter),
+            # dequant fused into the attention read below.
+            kq_cache, ks_cache, vq_cache, vs_cache, pos_cache = cache
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            idx = jnp.asarray(cache_index, dtype=jnp.int32)
+            if idx.ndim == 0:
+                kq_cache = jax.lax.dynamic_update_slice(kq_cache, kq, (0, idx, 0, 0))
+                ks_cache = jax.lax.dynamic_update_slice(ks_cache, ks, (0, idx, 0))
+                vq_cache = jax.lax.dynamic_update_slice(vq_cache, vq, (0, idx, 0, 0))
+                vs_cache = jax.lax.dynamic_update_slice(vs_cache, vs, (0, idx, 0))
+                pos_cache = jax.lax.dynamic_update_slice(
+                    pos_cache, positions.astype(pos_cache.dtype), (0, idx)
+                )
+            else:
+                # per-sequence write offsets (continuous batching): s == 1
+                bidx = jnp.arange(b)
+                kq_cache = kq_cache.at[bidx, idx].set(kq[:, 0])
+                ks_cache = ks_cache.at[bidx, idx].set(ks[:, 0])
+                vq_cache = vq_cache.at[bidx, idx].set(vq[:, 0])
+                vs_cache = vs_cache.at[bidx, idx].set(vs[:, 0])
+                pos_cache = pos_cache.at[bidx, idx].set(positions[:, 0].astype(pos_cache.dtype))
+            # the int8 buffers are what streams from HBM; XLA fuses this
+            # convert+multiply into the attention einsums (VMEM dequant)
+            k_all = dequantize_kv(kq_cache, ks_cache, dt)
+            v_all = dequantize_kv(vq_cache, vs_cache, dt)
+            mask = pos_cache[:, None, :] <= positions[:, :, None]  # [b, s, kv]
+            new_cache = (kq_cache, ks_cache, vq_cache, vs_cache, pos_cache)
+        elif cache is not None:
             k_cache, v_cache, pos_cache = cache
             idx = jnp.asarray(cache_index, dtype=jnp.int32)
             if idx.ndim == 0:
@@ -284,8 +368,18 @@ class TransformerBlock(nn.Module):
         h, new_cache = Attention(cfg, name="attention")(
             RMSNorm(cfg.dim, cfg.norm_eps, name="attention_norm")(x), positions, cache, cache_index
         )
-        x = x + h
-        ffn_in = RMSNorm(cfg.dim, cfg.norm_eps, name="ffn_norm")(x)
+        ffn_norm = RMSNorm(cfg.dim, cfg.norm_eps, name="ffn_norm")
+        if cfg.fused_norm:
+            # residual-add + RMSNorm in one HBM pass (ops/fused_norm.py):
+            # collapses the per-layer norm chains the decode profile flags
+            # (~7.5 us each on [8, 2048] tensors — DECODE_NOTES.md). Off-TPU
+            # this lowers to the identical XLA expression.
+            from seldon_core_tpu.ops.fused_norm import fused_residual_rmsnorm
+
+            x, ffn_in = fused_residual_rmsnorm(x, h, ffn_norm(), cfg.norm_eps)
+        else:
+            x = x + h
+            ffn_in = ffn_norm(x)
         if cfg.n_experts > 0:
             f = MoEFFN(cfg, name="moe")(ffn_in)
         else:
@@ -326,11 +420,28 @@ class Transformer(nn.Module):
         return logits, new_caches
 
 
-def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int):
+def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int,
+                   kv_cache_dtype: Optional[str] = None):
     """Static-shape KV caches: one (k, v, pos) triple per layer —
     [b, max_len, kvh, hd] buffers plus a [b, max_len] position map whose empty
-    slots hold PAD_POS (never attended)."""
+    slots hold PAD_POS (never attended). With kv_cache_dtype="int8" each
+    layer is a (k_q, k_scale, v_q, v_scale, pos) 5-tuple: int8 values plus
+    f32 [b, max_len, kvh] per-head per-position scales (initialised to 1 so
+    empty slots dequantize to exact zeros)."""
+    kvd = normalize_kv_cache_dtype(kv_cache_dtype or cfg.kv_cache_dtype)
     shape = (batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    if kvd == "int8":
+        scale_shape = (batch, max_len, cfg.n_kv_heads)
+        return [
+            (
+                jnp.zeros(shape, dtype=jnp.int8),
+                jnp.ones(scale_shape, dtype=jnp.float32),
+                jnp.zeros(shape, dtype=jnp.int8),
+                jnp.ones(scale_shape, dtype=jnp.float32),
+                jnp.full((batch, max_len), PAD_POS, dtype=jnp.int32),
+            )
+            for _ in range(cfg.n_layers)
+        ]
     return [
         (
             jnp.zeros(shape, dtype=cfg.dtype),
@@ -341,13 +452,31 @@ def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int):
     ]
 
 
+def kv_cache_bytes_per_token(cfg: TransformerConfig,
+                             kv_cache_dtype: Optional[str] = None) -> int:
+    """HBM bytes one cached token position costs across all layers (K + V
+    values, int8 scales when quantized, and the int32 position map). Decode
+    attention reads the whole static cache every step, so
+    bytes/step ~= batch * cache_len * this. Reported by the LLM benches so
+    BENCH rounds can attribute bandwidth regressions."""
+    kvd = normalize_kv_cache_dtype(kv_cache_dtype or cfg.kv_cache_dtype)
+    per_pos = cfg.n_kv_heads * cfg.head_dim
+    if kvd == "int8":
+        per_layer = 2 * (per_pos * 1 + cfg.n_kv_heads * 4)  # int8 + f32 scale
+    else:
+        per_layer = 2 * per_pos * jnp.dtype(cfg.dtype).itemsize
+    return cfg.n_layers * (per_layer + 4)  # + int32 pos slot
+
+
 @register_model("transformer")
 def make_transformer(**kwargs):
     dtype = kwargs.pop("dtype", "bfloat16")
     scaling = kwargs.pop("rope_scaling", None)
     if isinstance(scaling, dict):  # normalize to a hashable config field
         scaling = tuple(sorted(scaling.items()))
-    cfg = TransformerConfig(dtype=jnp.dtype(dtype), rope_scaling=scaling, **kwargs)
+    kvd = normalize_kv_cache_dtype(kwargs.pop("kv_cache_dtype", "bf16"))
+    cfg = TransformerConfig(dtype=jnp.dtype(dtype), rope_scaling=scaling,
+                            kv_cache_dtype=kvd, **kwargs)
     return Transformer(cfg)
 
 

@@ -1,0 +1,161 @@
+"""Pallas TPU kernel: fused residual-add + RMSNorm for LLM decode.
+
+Why this kernel exists: the round-5 decode profile (benchmarks/
+DECODE_NOTES.md) attributes 18% of device time to ~899 RMSNorm-rooted
+fusion clusters averaging 7.5 us each on [8, 2048] tensors that should take
+<1 us of bandwidth — at batch 8 the decode step is per-op-overhead-bound,
+and the named lever is fewer/larger kernels per step. Each transformer
+block runs ``x = x + h`` followed by ``rms_norm(x)``: two HBM round trips
+of the activation. This kernel computes both in ONE pass — read x and h
+once, write the residual sum and the normed activation once, the f32
+mean-of-squares reduction entirely in VMEM.
+
+Numerics contract (bit-matching the unfused graph so the
+``TransformerConfig.fused_norm`` flag never changes tokens): the residual
+add happens in the model dtype, the norm in f32 over the added value, the
+weight multiply in f32, the result cast back to the model dtype — exactly
+``rms_norm(x + h, w, eps)`` from models/transformer.py.
+
+Follows the ops/pallas_int8.py probe/fallback pattern: ``interpret=True``
+runs the kernel body under the Pallas interpreter (CI parity tests, CPU);
+on TPU a one-time compile probe gates the compiled kernel, and every other
+platform — or a TPU whose probe fails — takes the equivalent XLA
+expression (``residual_rmsnorm_ref``), so the flag is safe to leave on.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def residual_rmsnorm_ref(x, h, weight, eps: float):
+    """Pure-XLA reference: (y, rms_norm(y, weight, eps)) with y = x + h.
+    Identical op chain to the unfused TransformerBlock path."""
+    import jax
+    import jax.numpy as jnp
+
+    y = x + h
+    y32 = y.astype(jnp.float32)
+    norm = y32 * jax.lax.rsqrt(jnp.mean(y32 * y32, axis=-1, keepdims=True) + eps)
+    return y, (norm * weight).astype(y.dtype)
+
+
+def _kernel(d_real: int, eps: float, x_ref, h_ref, w_ref, y_ref, o_ref):
+    import jax
+    import jax.numpy as jnp
+
+    y = x_ref[...] + h_ref[...]  # residual add in the model dtype
+    y_ref[...] = y
+    y32 = y.astype(jnp.float32)
+    # sum/d_real, not mean: the lane dim may be zero-padded to 128 and the
+    # padded columns must not dilute the divisor (zeros already add nothing
+    # to the sum)
+    ms = jnp.sum(y32 * y32, axis=-1, keepdims=True) * (1.0 / d_real)
+    normed = y32 * jax.lax.rsqrt(ms + eps)
+    o_ref[...] = (normed * w_ref[...].astype(jnp.float32)[None, :]).astype(o_ref.dtype)
+
+
+_TPU_COMPILE_STATUS: str | None = None
+
+
+def probe_tpu_compile(force: bool = False) -> str:
+    """Attempt one tiny fused_residual_rmsnorm Pallas compile+run on the TPU
+    backend and cache the outcome for this process ("ok" or "error: ...").
+    Backend Pallas support has flapped across rounds (see
+    ops/pallas_int8.py), so the serving path re-verifies on first TPU use
+    and falls back to the XLA expression when the kernel can't compile —
+    the fused_norm flag never surfaces a backend compile error."""
+    global _TPU_COMPILE_STATUS
+    if _TPU_COMPILE_STATUS is not None and not force:
+        return _TPU_COMPILE_STATUS
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.devices()[0].platform != "tpu":
+        _TPU_COMPILE_STATUS = "error: no TPU backend in this process"
+        return _TPU_COMPILE_STATUS
+    try:
+        x = jnp.zeros((8, 128), jnp.bfloat16)
+        w = jnp.ones((128,), jnp.float32)
+        y, o = fused_residual_rmsnorm(x, x, w, 1e-5, interpret=False, _probe=True)
+        np.asarray(o)
+        _TPU_COMPILE_STATUS = "ok"
+    except Exception as e:  # noqa: BLE001 — any compile/runtime failure gates the path
+        _TPU_COMPILE_STATUS = f"error: {type(e).__name__}: {str(e)[:300]}"
+    return _TPU_COMPILE_STATUS
+
+
+def fused_residual_rmsnorm(x, h, weight, eps: float,
+                           interpret: bool | None = None,
+                           _probe: bool = False):
+    """x, h: [..., d] activations; weight: [d] f32. Returns
+    (y, normed) = (x + h, rms_norm(x + h, weight, eps)), both in x.dtype.
+
+    On TPU the whole computation is one Pallas pass (one HBM read of x/h,
+    one write of each output); elsewhere — or with ``interpret=True`` — the
+    same kernel runs under the Pallas interpreter, and non-TPU production
+    platforms take the equivalent XLA expression.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    d = x.shape[-1]
+    assert h.shape == x.shape and weight.shape == (d,), (x.shape, h.shape, weight.shape)
+
+    platform = jax.devices()[0].platform
+    if interpret is None:
+        interpret = False
+    if not interpret and (
+        platform != "tpu" or (not _probe and probe_tpu_compile() != "ok")
+    ):
+        # the Pallas interpreter is a test/debug vehicle only; every non-TPU
+        # production platform — and a TPU backend whose compile probe failed
+        # — takes the equivalent XLA expression
+        return residual_rmsnorm_ref(x, h, weight, eps)
+
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, d)
+    h2 = h.reshape(-1, d)
+    m = x2.shape[0]
+    # sublane tile shrinks for small (decode) batches but stays a multiple
+    # of the min f32 tile (8); lane dim pads to 128 for Mosaic tiling
+    tm = 256 if m >= 256 else max(8, 1 << max(m - 1, 0).bit_length())
+    pm = -(-m // tm) * tm
+    pd = -(-d // 128) * 128
+    if (pm, pd) != (m, d):
+        x2 = jnp.pad(x2, ((0, pm - m), (0, pd - d)))
+        h2 = jnp.pad(h2, ((0, pm - m), (0, pd - d)))
+    w = weight.astype(jnp.float32)
+    if pd != d:
+        w = jnp.pad(w, (0, pd - d))
+
+    y, o = pl.pallas_call(
+        functools.partial(_kernel, d, float(eps)),
+        grid=(pm // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, pd), lambda i: (i, 0)),
+            pl.BlockSpec((tm, pd), lambda i: (i, 0)),
+            pl.BlockSpec((pd,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tm, pd), lambda i: (i, 0)),
+            pl.BlockSpec((tm, pd), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((pm, pd), x.dtype),
+            jax.ShapeDtypeStruct((pm, pd), x.dtype),
+        ],
+        interpret=interpret,
+    )(x2, h2, w)
+    if (pm, pd) != (m, d):
+        y, o = y[:m, :d], o[:m, :d]
+    return y.reshape(*lead, d), o.reshape(*lead, d)
+
+
+__all__ = [
+    "fused_residual_rmsnorm",
+    "residual_rmsnorm_ref",
+    "probe_tpu_compile",
+]

@@ -193,11 +193,25 @@ class ContinuousBatcher:
 
         from seldon_core_tpu.models.transformer import init_kv_caches
 
+        from functools import partial
+
         server, cfg = self.server, self.server._cfg
         module = server._module
-        self._caches = jax.jit(lambda: init_kv_caches(cfg, self.S, self.max_len))()
+        # slot caches inherit the server's KV storage format (int8 halves
+        # the per-step attention read traffic — the dominant b8 term in
+        # benchmarks/DECODE_NOTES.md)
+        self._caches = jax.jit(
+            lambda: init_kv_caches(cfg, self.S, self.max_len, server.kv_cache_dtype)
+        )()
+        self._cache_nbytes = sum(
+            int(getattr(leaf, "nbytes", 0)) for leaf in jax.tree.leaves(self._caches)
+        )
 
-        @jax.jit
+        # donate the big slot cache through both mutating jits (insert and
+        # the decode step): self._caches is reassigned from the output each
+        # time, so XLA aliases the buffers and updates in place instead of
+        # copying S x max_len of KV per call
+        @partial(jax.jit, donate_argnums=(0,))
         def insert(big, small, slot):
             return jax.tree.map(lambda b, s: b.at[slot].set(s[0]), big, small)
 
@@ -209,7 +223,7 @@ class ContinuousBatcher:
         # copy stays the resident one)
         deq = server._dequant
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(1,))
         def decode_step(params, caches, last_tok, next_pos, key, temperature):
             logits, caches = module.apply(
                 deq(params),
@@ -379,9 +393,12 @@ class ContinuousBatcher:
         slot.on_token = None
 
     def _step(self):
+        import time
+
         import jax
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
         self._rng, sub = jax.random.split(self._rng)
         self._caches, nxt = self._decode_step(
             self.server._params,
@@ -392,6 +409,10 @@ class ContinuousBatcher:
             self._temp,
         )
         nxt = np.asarray(nxt).astype(np.int32)
+        # np.asarray above blocked on the device, so this wall time is the
+        # real step latency; drained into the /metrics histogram at scrape
+        self.server._decode_step_times.append(time.perf_counter() - t0)
+        self.server._last_decode_kv_bytes = self._cache_nbytes
         for i, slot in enumerate(self._slots):
             if not slot.active:
                 continue

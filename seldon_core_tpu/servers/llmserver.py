@@ -141,6 +141,7 @@ class LLMServer(SeldonComponent):
         sequence_parallel: int = 0,
         quantize: str = "",
         param_dtype: str = "",
+        kv_cache_dtype: str = "",
         continuous_batching: int = 0,
         continuous_batching_max_len: int = 0,
         prefix_cache_size: int = 0,
@@ -176,6 +177,11 @@ class LLMServer(SeldonComponent):
         # dtype, or pass an explicit dtype, for configs where HBM residency
         # matters more than step time.
         self.param_dtype = param_dtype
+        # KV-cache storage: "bf16" (default — model dtype) or "int8"
+        # (quantize-on-write, per-head per-position scales; halves the KV
+        # read traffic that dominates the b8 decode step —
+        # benchmarks/DECODE_NOTES.md). Normalized + validated at load().
+        self.kv_cache_dtype = kv_cache_dtype
         # >0: serving transports route single-prompt /v1/generate (REST) and
         # jsonData {"prompt": ...} predicts (gRPC) through a shared
         # ContinuousBatcher with this many slots (runtime/batcher.py), so
@@ -204,6 +210,13 @@ class LLMServer(SeldonComponent):
         self._prefill_cache: Dict[Tuple[int, int], Any] = {}
         self._decode_cache: Dict[Tuple[int, int], Any] = {}
         self._request_count = 0
+        # decode observability (metrics.registry sync_llm drains these at
+        # /metrics scrape time): per-step wall times and the KV bytes the
+        # last decode streamed per step
+        from collections import deque
+
+        self._decode_step_times: Any = deque(maxlen=4096)
+        self._last_decode_kv_bytes = 0
 
     # ------------------------------------------------------------------
     def load(self) -> None:
@@ -213,6 +226,20 @@ class LLMServer(SeldonComponent):
         import jax.numpy as jnp
 
         from seldon_core_tpu.models import get_model
+        from seldon_core_tpu.models.transformer import normalize_kv_cache_dtype
+
+        # Validate dtype knobs HERE, with a clear ValueError, instead of
+        # letting an unknown string explode later inside a jitted cast or
+        # cache init (where the traceback names nothing actionable).
+        self.kv_cache_dtype = normalize_kv_cache_dtype(self.kv_cache_dtype)
+        if self.param_dtype and self.param_dtype != "auto":
+            try:
+                jnp.dtype(self.param_dtype)
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"unknown param_dtype {self.param_dtype!r}: expected '', "
+                    f"'auto', or a jax dtype name (e.g. 'bfloat16')"
+                ) from e
 
         cfg_kwargs = dict(self.model_kwargs)
         name = self.model_name
@@ -422,13 +449,24 @@ class LLMServer(SeldonComponent):
             return None
         kv = NamedSharding(self.mesh, P(dp, sp, tp, None))
         pos = NamedSharding(self.mesh, P(dp, sp))
+        if self.kv_cache_dtype == "int8":
+            # int8 layout adds f32 [b, max_len, kvh] scale planes, sharded
+            # alongside their values
+            scale = NamedSharding(self.mesh, P(dp, sp, tp))
+            return [(kv, scale, kv, scale, pos) for _ in range(self._cfg.n_layers)]
         return [(kv, kv, pos) for _ in range(self._cfg.n_layers)]
 
-    def _get_extend(self, b: int, slen: int, max_len: int):
+    def _get_extend(self, b: int, slen: int, max_len: int, donate: bool = False):
         """Suffix prefill: write ``slen`` tokens into an EXISTING cache at
         offset ``start`` (prefix-cache continuation). Padded slots carry
-        PAD_POS positions, so they are never attended."""
-        key = ("extend", b, slen, max_len)
+        PAD_POS positions, so they are never attended.
+
+        ``donate=True`` donates the input cache buffers to the output (the
+        scatter updates in place instead of copying the whole cache) — only
+        safe when the caller's caches are NOT shared, so the prefix-cache
+        continuation path (whose input caches stay live as a stored prefix
+        entry) keeps the copying default."""
+        key = ("extend", b, slen, max_len, donate)
         fn = self._prefill_cache.get(key)
         if fn is not None:
             return fn
@@ -437,7 +475,7 @@ class LLMServer(SeldonComponent):
         module = self._module
         deq = self._dequant
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(1,) if donate else ())
         def extend(params, caches, tokens, positions, start):
             logits, caches = module.apply(
                 deq(params), tokens, positions=positions, caches=caches,
@@ -467,14 +505,17 @@ class LLMServer(SeldonComponent):
             self._prefix_bytes = 0
 
     def _prefix_lookup(self, tokens: List[int], max_len: int):
-        """Longest cached prefix of ``tokens`` with a compatible cache size;
-        returns (prefix_len, caches, last_logits) or None. Exact full-prompt
-        hits return the stored logits so prefill is skipped entirely."""
+        """Longest cached prefix of ``tokens`` with a compatible cache size
+        AND kv_cache_dtype; returns (prefix_len, caches, last_logits) or
+        None. Exact full-prompt hits return the stored logits so prefill is
+        skipped entirely. The dtype check matters: a bf16 3-tuple cache fed
+        to an int8-configured decode (or vice versa) would be structurally
+        wrong, so a dtype flip must read as a miss, never a crash."""
         with self._prefix_lock:
             best = None
-            for key, (entry_max_len, caches, last_logits, _nb) in self._prefix_cache.items():
+            for key, (entry_max_len, entry_kvd, caches, last_logits, _nb) in self._prefix_cache.items():
                 k = len(key)
-                if entry_max_len != max_len or k > len(tokens):
+                if entry_max_len != max_len or entry_kvd != self.kv_cache_dtype or k > len(tokens):
                     continue
                 if list(key) == tokens[:k] and (best is None or k > best[0]):
                     best = (k, caches, last_logits)
@@ -500,16 +541,17 @@ class LLMServer(SeldonComponent):
         with self._prefix_lock:
             old = self._prefix_cache.pop(key, None)
             if old is not None:
-                self._prefix_bytes -= old[3]
-            self._prefix_cache[key] = (max_len, caches, last_logits, nbytes)
+                self._prefix_bytes -= old[-1]
+            self._prefix_cache[key] = (
+                max_len, self.kv_cache_dtype, caches, last_logits, nbytes)
             self._prefix_bytes += nbytes
             while self._prefix_cache and (
                 len(self._prefix_cache) > self.prefix_cache_size
                 or (self.prefix_cache_bytes
                     and self._prefix_bytes > self.prefix_cache_bytes)
             ):
-                _, (_, _, _, nb) = self._prefix_cache.popitem(last=False)
-                self._prefix_bytes -= nb
+                _, entry = self._prefix_cache.popitem(last=False)
+                self._prefix_bytes -= entry[-1]
 
     def _get_prefill(self, b: int, plen: int, max_len: int):
         key = (b, plen, max_len)
@@ -523,8 +565,10 @@ class LLMServer(SeldonComponent):
         module, cfg = self._module, self._cfg
         deq = self._dequant
 
+        kvd = self.kv_cache_dtype
+
         def prefill(params, tokens, positions):
-            caches = init_kv_caches(cfg, tokens.shape[0], max_len)
+            caches = init_kv_caches(cfg, tokens.shape[0], max_len, kvd)
             logits, caches = module.apply(
                 deq(params), tokens, positions=positions, caches=caches, cache_index=0
             )
@@ -540,8 +584,14 @@ class LLMServer(SeldonComponent):
         self._prefill_cache[key] = fn
         return fn
 
-    def _get_decode(self, b: int, max_len: int):
-        key = (b, max_len)
+    def _get_decode(self, b: int, max_len: int, donate: bool = True):
+        """Compiled decode scan. ``donate=True`` (default) donates the input
+        cache pytree to the output: XLA aliases the buffers, so the per-step
+        ``dynamic_update_slice`` writes reuse the prefill's cache in place
+        instead of copying the whole multi-GB cache into the scan carry.
+        generate() passes donate=False only when the caches are shared with
+        the prefix cache (a donated buffer is dead to later readers)."""
+        key = (b, max_len, donate)
         fn = self._decode_cache.get(key)
         if fn is not None:
             return fn
@@ -554,7 +604,8 @@ class LLMServer(SeldonComponent):
         deq = self._dequant
 
         def decode(params, caches, last_tok, true_len, n_steps, rng, temperature):
-            """last_tok [b], true_len [b]; returns tokens [b, n_steps]."""
+            """last_tok [b], true_len [b]; returns (tokens [b, n_steps],
+            final caches — returned so donation can alias input to output)."""
 
             def sample(logits, key):
                 greedy = jnp.argmax(logits, axis=-1)
@@ -582,12 +633,16 @@ class LLMServer(SeldonComponent):
                 return (caches, nxt, offset + 1, done, key), nxt
 
             done0 = jnp.zeros_like(last_tok, dtype=bool)
-            (_, _, _, _, _), toks = jax.lax.scan(
+            (caches, _, _, _, _), toks = jax.lax.scan(
                 step, (caches, last_tok, jnp.zeros_like(true_len), done0, rng), None,
                 length=n_steps,
             )
-            return toks.T  # [b, n_steps]
+            # the final caches are in the output ONLY so donate_argnums can
+            # alias the cache argument onto them (input_output_alias in the
+            # compiled HLO); generate() discards them
+            return toks.T, caches  # [b, n_steps], caches
 
+        donate_kw = dict(donate_argnums=(1,)) if donate else {}
         cache_shardings = self._cache_shardings(b, max_len)
         if cache_shardings is not None:
             # keep the scan carry on the prefill's sharded layout instead of
@@ -596,9 +651,10 @@ class LLMServer(SeldonComponent):
                 decode,
                 static_argnames=("n_steps",),
                 in_shardings=(None, cache_shardings, None, None, None, None),
+                **donate_kw,
             )
         else:
-            decode = partial(jax.jit, static_argnames=("n_steps",))(decode)
+            decode = partial(jax.jit, static_argnames=("n_steps",), **donate_kw)(decode)
         self._decode_cache[key] = decode
         return decode
 
@@ -683,12 +739,15 @@ class LLMServer(SeldonComponent):
             true_len[i] = L
             last_tok[i] = toks[-1]
 
-        decode = self._get_decode(nb, max_len)
-
         # Prefix cache: single-prompt requests skip recomputing the KV of a
         # previously-seen token prefix (e.g. a shared system prompt); only
         # the suffix prefills, at its own bucketed length.
         use_prefix = self.prefix_cache_size > 0 and n == 1 and nb == 1
+        # Donate the cache buffers into the decode scan (in-place
+        # dynamic_update_slice, no full-cache copy per call) — except when
+        # the same cache object lives on as a prefix-cache entry, which a
+        # donation would invalidate.
+        decode = self._get_decode(nb, max_len, donate=not use_prefix)
         hit = self._prefix_lookup(token_lists[0], max_len) if use_prefix else None
         if hit is not None and hit[0] == len(token_lists[0]):
             self._prefix_hits += 1
@@ -737,11 +796,19 @@ class LLMServer(SeldonComponent):
 
         out_tokens = [first_tok[:, None]]
         if max_new > 1:
-            toks = decode(
+            import time as _time
+
+            self._last_decode_kv_bytes = self._entry_nbytes(caches, None)
+            t0 = _time.perf_counter()
+            toks, _ = decode(
                 self._params, caches, jnp.asarray(first_tok), jnp.asarray(true_len),
                 max_new - 1, rng, jnp.asarray(temp, jnp.float32),
             )
-            out_tokens.append(np.asarray(toks))
+            toks = np.asarray(toks)  # blocks: the wall below covers device time
+            self._decode_step_times.append(
+                (_time.perf_counter() - t0) / (max_new - 1)
+            )
+            out_tokens.append(toks)
         all_toks = np.concatenate(out_tokens, axis=1)[:n]  # drop batch padding
 
         results_tokens: List[List[int]] = []
@@ -791,3 +858,31 @@ class LLMServer(SeldonComponent):
             out["prefix_cache_hits"] = self._prefix_hits
             out["prefix_cache_entries"] = len(self._prefix_cache)
         return out
+
+    def llm_stats(self) -> Dict[str, Any]:
+        """Decode-bandwidth observability snapshot, consumed by
+        MetricsRegistry.sync_llm at /metrics scrape time: resident KV bytes
+        (continuous-batching slot caches + pinned prefix entries), slot
+        occupancy, the KV bytes the last decode streamed per step, and the
+        decode step-time observations accumulated since the last scrape
+        (drained here — each is observed into the histogram exactly once)."""
+        drained: List[float] = []
+        while True:
+            try:
+                drained.append(self._decode_step_times.popleft())
+            except IndexError:
+                break
+        occupancy = 0.0
+        slot_bytes = 0
+        svc = getattr(self, "_batcher_service", None)
+        if svc is not None:
+            batcher = svc.batcher
+            occupancy = sum(1 for s in batcher._slots if s.active) / max(batcher.S, 1)
+            slot_bytes = self._entry_nbytes(batcher._caches, None)
+        return {
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "kv_cache_bytes": slot_bytes + self._prefix_bytes,
+            "kv_occupancy": occupancy,
+            "kv_bytes_per_step": self._last_decode_kv_bytes,
+            "decode_step_times_s": drained,
+        }

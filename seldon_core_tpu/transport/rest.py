@@ -202,6 +202,7 @@ def make_component_app(
 
     async def prom(request):
         metrics.sync_resilience(admission=admission, transport="rest")
+        metrics.sync_llm(component)
         return web.Response(body=metrics.expose(), content_type="text/plain")
 
     app.router.add_get("/health/status", health)
@@ -495,6 +496,8 @@ def make_engine_app(
 
     async def prom(request):
         metrics.sync_resilience(engine=engine, admission=admission, transport="rest")
+        for comp in getattr(engine, "_components", {}).values():
+            metrics.sync_llm(comp)
         return web.Response(body=metrics.expose(), content_type="text/plain")
 
     async def openapi(request):

@@ -12,6 +12,8 @@ import jax.numpy as jnp
 from seldon_core_tpu.ops.pallas_int8 import int8_dense, int8_matmul
 from seldon_core_tpu.ops.quantize import quantize_array
 
+pytestmark = pytest.mark.pallas
+
 
 def ref_matmul(x, q, scale):
     return np.asarray(x, np.float32) @ (np.asarray(q, np.float32) * np.asarray(scale)[None, :])
