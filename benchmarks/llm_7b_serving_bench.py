@@ -8,11 +8,14 @@ across phases):
      r4-llm7b rows, and the step-time basis for phase D's attribution.
   B. REST transport end-to-end: aiohttp `make_component_app` server, N in
      {1, 4, 8} concurrent HTTP clients on /v1/generate-style jsonData
-     prompts joining the shared ContinuousBatcher. NOTE: the batcher pays
-     one host sync per decode step and this harness reaches the chip over a
-     ~75 ms RTT tunnel, so the ABSOLUTE tok/s here is tunnel-bound; the
-     N-scaling ratio is the architecture claim (a co-located host pays ~us
-     per step dispatch).
+     prompts joining the shared ContinuousBatcher. The batcher now keeps
+     `decode_pipeline_depth` steps dispatched ahead of the host (PR 3);
+     the report carries the dispatch-ahead depth actually reached, the
+     dispatch-vs-sync split, and served_vs_direct (vs phase A's b8 row) —
+     the ratio VERDICT weak #1 measured at 0.11 pre-pipelining. This
+     harness reaches the chip over a ~75 ms RTT tunnel, so ABSOLUTE tok/s
+     is still tunnel-bound; DECODE_FUSE_STEPS=K amortizes the RTT over K
+     tokens per sync.
   C. prefix-cached multi-turn: turn-2 prompt = turn-1 prompt + answer +
      follow-up; prefill latency cold (cleared cache) vs cached (turn-1
      prefix KV reused, suffix-only extend). Median of repeats; the pair is
@@ -21,6 +24,11 @@ across phases):
      step at both batches, categorized with tpu_profile's parser — why
      does b8 cost 17.8 ms/step when b1 costs 12.5 on a weights-bound
      decode (r4 question).
+  E. LONG-prefix prefix-cache pair (VERDICT #7): a 1.5-2k-token shared
+     system prefix + short per-request suffix, cold full prefill vs
+     cached suffix-only extend, device-isolated (jitted-call medians
+     minus a measured dispatch floor — the round-5 methodology) so the
+     cache is measured where it actually matters.
 
 Writes benchmarks/report_llm_7b_serving.json and appends the attribution
 to DECODE_NOTES.md (by hand, from the printed table).
@@ -57,7 +65,7 @@ def log(key, value):
 def main() -> None:
     import jax
 
-    phases = "".join(sys.argv[1:]).upper() or "ABCD"
+    phases = "".join(sys.argv[1:]).upper() or "ABCDE"
     on_tpu = jax.devices()[0].platform == "tpu"
     report = {}
     if os.path.exists(REPORT):
@@ -86,7 +94,11 @@ def main() -> None:
                   max_new_tokens=max_new, len_buckets=len_buckets,
                   batch_buckets=(1, 8), temperature=0.0, eos_id=-1,
                   continuous_batching=8, prefix_cache_size=8,
-                  kv_cache_dtype=os.environ.get("KV_CACHE_DTYPE", ""))
+                  kv_cache_dtype=os.environ.get("KV_CACHE_DTYPE", ""),
+                  decode_pipeline_depth=int(
+                      os.environ.get("DECODE_PIPELINE_DEPTH", "2")),
+                  decode_fuse_steps=int(
+                      os.environ.get("DECODE_FUSE_STEPS", "0")))
     if model_kwargs is not None:
         kwargs["model_kwargs"] = model_kwargs
     if quantize:
@@ -145,6 +157,10 @@ def main() -> None:
     # ---- C. prefix-cached multi-turn prefill: cold vs cached -----------
     if "C" in phases:
         _prefix_multi_turn(server, report, rng, vocab, plen, max_new)
+
+    # ---- E. long-prefix pair: 1.5-2k shared system prefix --------------
+    if "E" in phases:
+        _prefix_long_system(server, report, rng, vocab, on_tpu)
 
     # ---- D. b8 vs b1 decode-step attribution ---------------------------
     if on_tpu and "D" in phases:
@@ -220,10 +236,26 @@ def _rest_batching(server, report, plen, max_new) -> None:
     base = serving["clients_1"]["tok_per_s"]
     serving["scaling_8_over_1"] = round(
         serving["clients_8"]["tok_per_s"] / base, 2) if base else None
+    # dispatch-ahead instrumentation (PR 3): proves the pipeline actually
+    # ran ahead of the host under transport load, plus the dispatch-vs-sync
+    # split so a TPU session can see where the step wall lives (one
+    # llm_stats() snapshot — it drains the same deques /metrics consumes)
+    if getattr(server, "_batcher_service", None) is not None:
+        from benchmarks._pipeline_stats import pipeline_report
+
+        serving["pipeline"] = pipeline_report(server)
+    # served-vs-direct: the VERDICT weak-#1 ratio (0.11 pre-pipelining),
+    # against the same-session phase-A b8 direct-decode row when present
+    direct = report.get("direct_decode", {}).get("b8", {}).get("tok_per_s")
+    if direct:
+        serving["served_vs_direct_b8"] = round(
+            serving["clients_8"]["tok_per_s"] / direct, 3)
     serving["note"] = (
-        "batcher pays one host sync per decode step over a ~75ms-RTT "
-        "tunnel; absolute tok/s is tunnel-bound, the N-scaling ratio is "
-        "the architecture claim")
+        "the batcher keeps pipeline_depth decode steps dispatched ahead of "
+        "the host (PR 3); over this harness's ~75ms-RTT tunnel absolute "
+        "tok/s is still RTT-bound — DECODE_FUSE_STEPS=K amortizes the RTT "
+        "over K tokens per sync; served_vs_direct_b8 is the architecture "
+        "claim (VERDICT weak #1: 0.11 before pipelining)")
     report["rest_continuous_batching"] = serving
     _write(report)
 
@@ -319,6 +351,99 @@ def _prefix_multi_turn(server, report, rng, vocab, plen, max_new) -> None:
         },
     }
     log("prefix_multi_turn", report["prefix_multi_turn"])
+    _write(report)
+
+
+def _prefix_long_system(server, report, rng, vocab, on_tpu) -> None:
+    """VERDICT #7: measure the prefix cache where it matters — a 1.5-2k
+    token shared system prefix with a short per-request suffix. Cold arm
+    prefills the full (prefix + suffix) prompt; cached arm runs only the
+    suffix extend against the stored prefix KV. Device-isolated via the
+    round-5 methodology: median jitted-call walls minus a measured
+    trivial-dispatch floor (wall through the ~75ms tunnel is dispatch-bound
+    and would hide the device-side ratio)."""
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.transformer import PAD_POS
+    from seldon_core_tpu.utils import bucket as _bucket_fn
+
+    # the long-prefix shape: past the top len_bucket on purpose (that is
+    # the point — short-bucket pairs were already phase C)
+    prefix_len = 1536 if on_tpu else 192
+    suffix_len = 64 if on_tpu else 16
+    if prefix_len + suffix_len + 8 > server._cfg.max_seq_len:
+        report["prefix_long_system"] = {
+            "skipped": f"model context {server._cfg.max_seq_len} too short "
+                       f"for a {prefix_len}-token prefix"}
+        _write(report)
+        return
+    system = rng.integers(1, vocab, size=prefix_len).tolist()
+    suffix = rng.integers(1, vocab, size=suffix_len).tolist()
+    full = system + suffix
+
+    def med_call(fn, *a, repeats=7):
+        fn(*a)  # warm (compile)
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*a))
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times))
+
+    noop = jax.jit(lambda x: x + 1)
+    floor = med_call(noop, jnp.zeros((8,), jnp.float32))
+
+    # a bucket snug around the full prompt, so the cold arm is not padded
+    # to 2x by the round-up-past-top-bucket rule
+    buckets = sorted(set(list(server.len_buckets)
+                         + [prefix_len, prefix_len + 2 * suffix_len]))
+    full_bucket = _bucket_fn(len(full), buckets)
+    mlen = full_bucket + 8
+
+    # cold: the whole prompt through one prefill at its bucket
+    toks = np.zeros((1, full_bucket), np.int32)
+    poss = np.full((1, full_bucket), PAD_POS, np.int32)
+    toks[0, :len(full)] = full
+    poss[0, :len(full)] = np.arange(len(full))
+    prefill = server._get_prefill(1, full_bucket, mlen)
+    cold_call = med_call(prefill, server._params, jnp.asarray(toks),
+                         jnp.asarray(poss))
+
+    # cached: prefill the system prefix ONCE (the shared entry), then time
+    # only the suffix extend every request pays
+    ptoks = np.zeros((1, prefix_len), np.int32)
+    ppos = np.full((1, prefix_len), PAD_POS, np.int32)
+    ptoks[0, :] = system
+    ppos[0, :] = np.arange(prefix_len)
+    pf = server._get_prefill(1, prefix_len, mlen)
+    _, prefix_caches = pf(server._params, jnp.asarray(ptoks), jnp.asarray(ppos))
+    sbucket = _bucket_fn(suffix_len, buckets)
+    stoks = np.zeros((1, sbucket), np.int32)
+    spos = np.full((1, sbucket), PAD_POS, np.int32)
+    stoks[0, :suffix_len] = suffix
+    spos[0, :suffix_len] = np.arange(prefix_len, prefix_len + suffix_len)
+    extend = server._get_extend(1, sbucket, mlen)
+    cached_call = med_call(extend, server._params, prefix_caches,
+                           jnp.asarray(stoks), jnp.asarray(spos),
+                           jnp.asarray(prefix_len, jnp.int32))
+
+    report["prefix_long_system"] = {
+        "prefix_tokens": prefix_len,
+        "suffix_tokens": suffix_len,
+        "dispatch_floor_s": round(floor, 4),
+        "cold_prefill_call_s": round(cold_call, 4),
+        "cached_extend_call_s": round(cached_call, 4),
+        "cold_minus_floor_s": round(cold_call - floor, 4),
+        "cached_minus_floor_s": round(cached_call - floor, 4),
+        "device_speedup": round(
+            (cold_call - floor) / max(cached_call - floor, 1e-9), 2),
+        "note": "shared system-prompt shape: every request re-paying the "
+                "full long-prefix prefill vs suffix-only extend against "
+                "the cached prefix KV; medians of 7, dispatch floor "
+                "subtracted (round-5 device-isolated methodology)",
+    }
+    log("prefix_long_system", report["prefix_long_system"])
     _write(report)
 
 

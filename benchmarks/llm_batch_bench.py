@@ -30,6 +30,11 @@ def main() -> None:
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--kv-cache-dtype", default="", choices=("", "bf16", "int8"),
                     help="KV-cache storage format (default bf16)")
+    ap.add_argument("--pipeline-depth", type=int, default=2,
+                    help="decode steps kept dispatched ahead of the host")
+    ap.add_argument("--fuse-steps", type=int, default=0,
+                    help="K fused device-side decode steps per host sync "
+                         "when the admit queue is empty (0 = off)")
     args = ap.parse_args()
 
     import jax
@@ -53,18 +58,23 @@ def main() -> None:
     plen = 128 if on_tpu else 24
     server = LLMServer(model="transformer", model_kwargs=kwargs,
                        init_random=True, max_new_tokens=max_new,
-                       len_buckets=(plen,), batch_buckets=(1,),
+                       len_buckets=(plen,), batch_buckets=(1, args.clients),
                        temperature=0.0, eos_id=-1,
-                       kv_cache_dtype=args.kv_cache_dtype)
+                       kv_cache_dtype=args.kv_cache_dtype,
+                       decode_pipeline_depth=args.pipeline_depth,
+                       decode_fuse_steps=args.fuse_steps)
     server.load()
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, kwargs["vocab_size"] - 1, size=plen).tolist()
                for _ in range(args.clients)]
 
     svc = BatcherService(server, max_slots=args.slots)
-    # warm both paths (compiles)
-    svc.submit_sync(prompts[0], 2)
-    server.generate([prompts[0]], max_new_tokens=2)
+    # warm both paths at FULL length (the decode scan compiles per static
+    # n_steps and the batcher's fused-K program only compiles once a
+    # request has >= K tokens of budget — a short warm call would leave
+    # compiles inside the timed windows)
+    svc.submit_sync(prompts[0], max_new)
+    server.generate([prompts[0]], max_new_tokens=max_new)
 
     # (a) sequential: one request at a time, per-request generate()
     t0 = time.perf_counter()
@@ -73,6 +83,17 @@ def main() -> None:
         out = server.generate([p], max_new_tokens=max_new)
         seq_tokens += len(out["tokens"][0])
     seq_s = time.perf_counter() - t0
+
+    # (a') direct: every prompt in ONE batched generate() — the raw
+    # device-side decode ceiling the served path is measured against
+    # (VERDICT weak #1 put the pre-pipelining batcher at 11% of this).
+    # Warm at the FULL max_new: the decode scan compiles per static
+    # n_steps, so a shorter warm call leaves the timed call paying compile
+    server.generate(prompts, max_new_tokens=max_new)
+    t0 = time.perf_counter()
+    out = server.generate(prompts, max_new_tokens=max_new)
+    direct_s = time.perf_counter() - t0
+    direct_tokens = sum(len(t) for t in out["tokens"])
 
     # (b) concurrent: all clients at once through the shared batch
     import threading
@@ -90,6 +111,12 @@ def main() -> None:
         t.join()
     conc_s = time.perf_counter() - t0
     conc_tokens = sum(results)
+    # pipeline instrumentation BEFORE close(): dispatch-ahead depth actually
+    # reached, and the dispatch/sync split the tentpole is about
+    from benchmarks._pipeline_stats import pipeline_report
+
+    server._batcher_service = svc  # llm_stats reads the hwm through it
+    pipeline = pipeline_report(server)
     svc.close()
 
     platform = jax.devices()[0].platform
@@ -107,16 +134,24 @@ def main() -> None:
                      "bytes_per_token": kv_per_tok},
         "sequential": {"tok_per_s": round(seq_tokens / seq_s, 1),
                        "wall_s": round(seq_s, 2), "tokens": seq_tokens},
+        "direct": {"tok_per_s": round(direct_tokens / direct_s, 1),
+                   "wall_s": round(direct_s, 2), "tokens": direct_tokens},
         "concurrent": {"tok_per_s": round(conc_tokens / conc_s, 1),
                        "wall_s": round(conc_s, 2), "tokens": conc_tokens},
         "speedup": round((conc_tokens / conc_s) / (seq_tokens / seq_s), 2),
+        # the tentpole ratio: served (batcher) vs raw batched decode — the
+        # number VERDICT weak #1 measured at 0.11 before pipelining
+        "served_vs_direct": round(
+            (conc_tokens / conc_s) / (direct_tokens / direct_s), 3),
+        "pipeline": pipeline,
     }
     if platform == "tpu":
         entry["note"] = (
-            "this harness reaches the chip over a ~75ms-RTT tunnel and the "
-            "batcher pays one host sync per decode step, so the absolute "
-            "tok/s is tunnel-bound; the speedup ratio is the architecture "
-            "claim (a co-located host pays ~us dispatch per step)")
+            "this harness reaches the chip over a ~75ms-RTT tunnel; the "
+            "batcher now keeps pipeline_depth decode steps dispatched ahead "
+            "of the host (one sync per drained step, overlapped with device "
+            "compute), so served_vs_direct is the architecture claim — "
+            "raise --fuse-steps to amortize the tunnel RTT over K tokens")
     out_path = os.path.join(HERE, "report_llm_concurrent.json")
     report = {"metric": "LLM serving throughput, N concurrent clients vs "
                         "sequential (shared ContinuousBatcher vs per-request "
@@ -135,6 +170,9 @@ def main() -> None:
         json.dump(report, f, indent=2)
     print(json.dumps({"sequential_tok_s": entry["sequential"]["tok_per_s"],
                       "concurrent_tok_s": entry["concurrent"]["tok_per_s"],
+                      "direct_tok_s": entry["direct"]["tok_per_s"],
+                      "served_vs_direct": entry["served_vs_direct"],
+                      "inflight_hwm": pipeline["inflight_hwm"],
                       "speedup": entry["speedup"], "platform": platform}))
 
 

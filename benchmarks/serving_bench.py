@@ -693,14 +693,93 @@ def bench_device(duration: float, workers: int = 1, spec_builder=None,
     }
 
 
+def vit_flops_per_image(patch: int, dim: int, depth: int, mlp_ratio: int,
+                        num_classes: int, image: int = 224) -> float:
+    """Dense FLOPs (mul+add = 2) for one ViT forward pass: patch embed +
+    per-block (qkv, qk^T, pv, proj, mlp) + head. ViT-B/16 at 224 lands at
+    ~35 GFLOP/img (17.6 GMACs), the usual published figure."""
+    s = (image // patch) ** 2 + 1
+    h = dim * mlp_ratio
+    per_block = (
+        2 * s * dim * 3 * dim        # qkv projection
+        + 2 * 2 * s * s * dim        # qk^T and probs@v
+        + 2 * s * dim * dim          # output projection
+        + 2 * 2 * s * dim * h        # mlp in + out
+    )
+    patch_embed = 2 * (image // patch) ** 2 * (patch * patch * 3) * dim
+    return depth * per_block + patch_embed + 2 * dim * num_classes
+
+
+def bench_vit(batch: int = 128, repeats: int = 7) -> dict:
+    """ViT-b128 serving forward (VERDICT #5): the MXU-friendly control for
+    the 22% ResNet MFU cap — after patchify a ViT is nothing but large
+    batched matmuls, so if the ResNet ceiling is conv/layout overhead this
+    number should clear it. Same median-of-repeats methodology as the
+    round-5 device-isolated timings (jitted call, block_until_ready,
+    median of 7)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from seldon_core_tpu.models import get_model
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # v5e-class bf16 peak, the MFU_NOTES.md denominator
+    peak_flops = 197e12
+    if on_tpu:
+        model_name, image, mdl_kw = "vit-b16", 224, {}
+        dims = dict(patch=16, dim=768, depth=12, mlp_ratio=4, num_classes=1000)
+    else:
+        # CPU rehearsal: same code path, tiny config + small batch
+        model_name, image, mdl_kw = "vit-tiny", 32, {}
+        batch = min(batch, 8)
+        dims = dict(patch=4, dim=32, depth=2, mlp_ratio=4, num_classes=10)
+    model = get_model(model_name, **mdl_kw)
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3), jnp.float32))
+    fwd = jax.jit(lambda p, x: model.apply(p, x))
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(batch, image, image, 3)).astype(np.float32))
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(fwd(params, x))  # compile + warm
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fwd(params, x))
+        times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    flops = vit_flops_per_image(image=image, **dims)
+    img_s = batch / med
+    return {
+        "metric": f"ViT serving forward ({model_name}, batch {batch}) — "
+                  f"MXU-friendly control for the ResNet MFU cap",
+        "platform": jax.devices()[0].platform,
+        "batch": batch,
+        "image": image,
+        "ms_per_batch": round(1e3 * med, 3),
+        "img_per_s": round(img_s, 1),
+        "compile_s": round(compile_s, 1),
+        "gflops_per_image": round(flops / 1e9, 2),
+        "mfu": round(img_s * flops / peak_flops, 4) if on_tpu else None,
+        "peak_flops": peak_flops if on_tpu else None,
+        "repeats": repeats,
+        "note": "median of 7 jitted block_until_ready calls; MFU vs the "
+                "197 TFLOP/s bf16 peak used in MFU_NOTES.md (None off-TPU "
+                "— the CPU run is a code-path rehearsal)",
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=30.0)
     ap.add_argument("--mode", default="native",
                     choices=["native", "ring", "bandit", "device", "outlier",
-                             "seq2seq", "overload", "all"])
+                             "seq2seq", "overload", "vit", "all"])
     args = ap.parse_args()
-    if not build_edge_binaries():
+    # the vit mode is a pure-JAX forward bench — no native edge needed
+    if args.mode != "vit" and not build_edge_binaries():
         raise SystemExit("native toolchain unavailable")
     outdir = os.path.join(REPO, "benchmarks")
     if args.mode in ("native", "all"):
@@ -770,6 +849,13 @@ def main() -> None:
             "failures_total": sum(r["failures"]
                                   for r in over["grpc_runs"] + over["runs"]),
         }))
+    if args.mode in ("vit", "all"):
+        vit = bench_vit()
+        with open(os.path.join(outdir, "report_vit_serving.json"), "w") as f:
+            json.dump(vit, f, indent=2)
+        print(json.dumps({"vit_img_s": vit["img_per_s"],
+                          "vit_ms_per_batch": vit["ms_per_batch"],
+                          "vit_mfu": vit["mfu"]}))
     if args.mode in ("seq2seq", "all"):
         s2s = bench_device(
             args.duration, spec_builder=seq2seq_device_spec,

@@ -145,6 +145,42 @@ class MetricsRegistry:
             buckets=LATENCY_BUCKETS,
             registry=self.registry,
         )
+        # Pipelined decode (runtime/batcher.py): the per-step wall above
+        # splits into dispatch (enqueue the compiled step, no sync) vs sync
+        # (host blocked on the oldest in-flight step's tokens); the gauge +
+        # lag histogram prove the host actually trails the device (depth
+        # >=2) instead of re-serializing — docs/performance.md
+        self._decode_dispatch = Histogram(
+            "seldon_llm_decode_dispatch_seconds",
+            "Decode step dispatch wall (enqueue-only; the host does not "
+            "wait for tokens)",
+            base,
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self._decode_sync = Histogram(
+            "seldon_llm_decode_sync_seconds",
+            "Host sync wall per drain (blocked reading the oldest "
+            "in-flight step's tokens)",
+            base,
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self._decode_steps_in_flight = Gauge(
+            "seldon_llm_decode_steps_in_flight",
+            "Decode steps currently dispatched ahead of the host (sampled "
+            "at scrape)",
+            base,
+            registry=self.registry,
+        )
+        self._decode_host_lag = Histogram(
+            "seldon_llm_decode_host_lag_steps",
+            "Steps the host trailed the device at each drain (>=2 means "
+            "the pipeline is actually ahead)",
+            base,
+            buckets=(0, 1, 2, 3, 4, 6, 8, 16, 32),
+            registry=self.registry,
+        )
         # breakers publish transitions through on_transition; remember which
         # are wired so scrape-time syncs are idempotent
         self._bound_breakers: set = set()
@@ -232,6 +268,18 @@ class MetricsRegistry:
         hist = self._decode_step.labels(**self._base())
         for seconds in stats.get("decode_step_times_s", ()):
             hist.observe(seconds)
+        disp = self._decode_dispatch.labels(**self._base())
+        for seconds in stats.get("decode_dispatch_times_s", ()):
+            disp.observe(seconds)
+        sync = self._decode_sync.labels(**self._base())
+        for seconds in stats.get("decode_sync_times_s", ()):
+            sync.observe(seconds)
+        lag = self._decode_host_lag.labels(**self._base())
+        for steps in stats.get("decode_host_lag_steps", ()):
+            lag.observe(steps)
+        self._decode_steps_in_flight.labels(**self._base()).set(
+            stats.get("decode_steps_in_flight", 0)
+        )
 
     # ------------------------------------------------------------------
     def register_custom(self, response: SeldonMessage) -> None:

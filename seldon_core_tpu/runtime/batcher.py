@@ -19,6 +19,26 @@ Design (all shapes static):
 The transformer's position-tracked cache (PAD_POS masking) is what makes the
 mixed-occupancy batch exact: each slot only attends to its own written
 positions.
+
+Pipelined decode (PR 3): the decode loop is device-resident. Per-slot token,
+position and rng-key state live in device arrays threaded through the
+compiled step (``LLMServer._get_decode_step``), so dispatching step N+1
+never waits for step N's tokens to land in Python. The host runs one step
+(or more) BEHIND the device: a consumer drains the oldest in-flight step's
+token array and does all bookkeeping there — EOS detection, ``n_new``
+accounting, ``on_token`` streaming, ``_finish``, admissions.
+
+EOS semantics under the lag: the device may run up to ``pipeline_depth``
+speculative steps past a sequence's EOS before the host sees it. Those
+trailing tokens are masked by a per-slot generation counter (a slot freed
+and re-admitted between dispatch and drain fails the ``gen`` check), and the
+trailing KV writes land in a slot that the next insert overwrites whole —
+the lag can only cost wasted compute, never wrong output
+(tests/test_batcher_pipeline.py holds token parity against ``generate()``).
+
+When the admit queue is empty, ``decode_fuse_steps`` K>1 fuses K steps into
+one device-side ``lax.scan`` between syncs (one dispatch + one host read
+per K tokens).
 """
 
 from __future__ import annotations
@@ -36,16 +56,46 @@ logger = logging.getLogger(__name__)
 
 class _Slot:
     __slots__ = ("future", "tokens", "true_len", "n_new", "max_new", "active",
-                 "on_token")
+                 "on_token", "gen", "disp_new")
 
     def __init__(self):
         self.active = False
         self.future: Optional[asyncio.Future] = None
         self.tokens: List[int] = []
         self.true_len = 0
-        self.n_new = 0
+        self.n_new = 0          # tokens the HOST has processed (drain side)
         self.max_new = 0
         self.on_token: Optional[Any] = None
+        # pipelining state: gen disambiguates a slot reused between a step's
+        # dispatch and its drain (trailing speculative tokens for the old
+        # occupant must be ignored, never credited to the new one);
+        # disp_new is the DISPATCH-side token count advanced when a step is
+        # enqueued, used to stop dispatching for exhausted slots and to
+        # clamp the fused-K block so it never overruns max_new/max_len
+        self.gen = 0
+        self.disp_new = 0
+
+    # cache positions are derived, never mirrored: after the prompt's L
+    # tokens the n-th generated token sits at position true_len + n - 1
+    def host_pos(self) -> int:
+        return self.true_len + self.n_new - 1
+
+    def dispatched_pos(self) -> int:
+        return self.true_len + self.disp_new - 1
+
+
+class _InFlight:
+    """One dispatched (possibly K-fused) decode step the host has not yet
+    drained: the device token array, the per-slot (index, gen) snapshot
+    taken at dispatch, and the dispatch timestamp."""
+
+    __slots__ = ("tokens", "k", "snapshot", "t_dispatch")
+
+    def __init__(self, tokens, k, snapshot, t_dispatch):
+        self.tokens = tokens
+        self.k = k
+        self.snapshot = snapshot
+        self.t_dispatch = t_dispatch
 
 
 class BatcherService:
@@ -74,19 +124,22 @@ class BatcherService:
 
     def submit_sync(self, prompt: Any, max_new_tokens: Optional[int] = None,
                     timeout_s: float = 600.0,
-                    info: Optional[dict] = None) -> List[int]:
+                    info: Optional[dict] = None,
+                    seed: Optional[int] = None) -> List[int]:
         self.submitted += 1
         return asyncio.run_coroutine_threadsafe(
-            self.batcher.submit(prompt, max_new_tokens, info=info), self._loop
+            self.batcher.submit(prompt, max_new_tokens, info=info, seed=seed),
+            self._loop
         ).result(timeout_s)
 
     async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None,
                      on_token: Optional[Any] = None,
-                     info: Optional[dict] = None) -> List[int]:
+                     info: Optional[dict] = None,
+                     seed: Optional[int] = None) -> List[int]:
         self.submitted += 1
         cfut = asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
-                                info=info),
+                                info=info, seed=seed),
             self._loop)
         return await asyncio.wrap_future(cfut)
 
@@ -147,6 +200,8 @@ class ContinuousBatcher:
         max_slots: int = 4,
         max_len: Optional[int] = None,
         len_buckets: Optional[Sequence[int]] = None,
+        pipeline_depth: Optional[int] = None,
+        fuse_steps: Optional[int] = None,
     ):
         server.load()
         self.server = server
@@ -181,10 +236,20 @@ class ContinuousBatcher:
         self._wakeup = asyncio.Event()
         self._closed = False
         self._task: Optional[asyncio.Task] = None
+        # dispatch-ahead pipeline: how many steps may be in flight before
+        # the host drains the oldest (>=2 overlaps host bookkeeping with
+        # device compute), and the fused-K knob (0/1 = single steps)
+        depth = pipeline_depth if pipeline_depth is not None else getattr(
+            server, "decode_pipeline_depth", 2)
+        self.pipeline_depth = max(int(depth), 1)
+        fuse = fuse_steps if fuse_steps is not None else getattr(
+            server, "decode_fuse_steps", 0)
+        self.fuse_steps = max(int(fuse), 0)
+        self._inflight: Any = deque()
+        self._inflight_hwm = 0       # max steps in flight ever reached
+        self._last_admit_inflight = 0  # steps in flight at the last admit
+        self._last_drain_t: Optional[float] = None
         self._build()
-        # host mirrors of per-slot decode state
-        self._last_tok = np.zeros((self.S,), np.int32)
-        self._next_pos = np.zeros((self.S,), np.int32)
 
     # ------------------------------------------------------------------
     def _build(self):
@@ -196,7 +261,6 @@ class ContinuousBatcher:
         from functools import partial
 
         server, cfg = self.server, self.server._cfg
-        module = server._module
         # slot caches inherit the server's KV storage format (int8 halves
         # the per-step attention read traffic — the dominant b8 term in
         # benchmarks/DECODE_NOTES.md)
@@ -217,48 +281,51 @@ class ContinuousBatcher:
 
         self._insert = insert
 
-        top_k = server.top_k
-        # int8 serving: dequant inside the jit exactly like the server's
-        # prefill/decode paths (XLA fuses it into the matmuls; the int8
-        # copy stays the resident one)
-        deq = server._dequant
+        # Per-slot admission update for the device-resident decode state
+        # (slot index is traced, so one compile serves every slot). The
+        # position and key arrays are donated — the host never reads them;
+        # last_tok is NOT donated because its buffer may alias a stacked
+        # token output the host still has to read (see _get_decode_step).
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def set_slot(last_tok, next_pos, keys, slot, tok, pos, key):
+            return (last_tok.at[slot].set(tok), next_pos.at[slot].set(pos),
+                    keys.at[slot].set(key))
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def decode_step(params, caches, last_tok, next_pos, key, temperature):
-            logits, caches = module.apply(
-                deq(params),
-                last_tok[:, None],
-                positions=next_pos[:, None],
-                caches=caches,
-                cache_index=next_pos,
-            )
-            lg = logits[:, -1].astype(jnp.float32)
-            greedy = jnp.argmax(lg, axis=-1)
-            k = min(top_k, lg.shape[-1])
-            topv, topi = jax.lax.top_k(lg, k)
-            draw = jax.random.categorical(key, topv / jnp.maximum(temperature, 1e-6))
-            sampled = jnp.take_along_axis(topi, draw[:, None], axis=-1)[:, 0]
-            return caches, jnp.where(temperature <= 0.0, greedy, sampled)
+        self._set_slot = set_slot
 
-        self._decode_step = decode_step
+        # device-resident per-slot decode state, threaded output->input
+        # through every dispatched step (the decode jit updates them; the
+        # host never round-trips them through NumPy)
+        self._last_tok = jnp.zeros((self.S,), jnp.int32)
+        self._next_pos = jnp.zeros((self.S,), jnp.int32)
+        self._keys = jnp.zeros((self.S, 2), jnp.uint32)
+
         self._rng = jax.random.PRNGKey(server.seed)
         self._temp = jnp.asarray(server.temperature, jnp.float32)
 
     # ------------------------------------------------------------------
     async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None,
                      on_token: Optional[Any] = None,
-                     info: Optional[dict] = None) -> List[int]:
+                     info: Optional[dict] = None,
+                     seed: Optional[int] = None) -> List[int]:
         """prompt: str or token sequence. Resolves to generated token ids.
 
         ``on_token(tok)`` (optional) fires for every generated token as it is
         decoded and ``on_token(None)`` once at completion — from a worker
         thread, so the callback must be thread-safe (streaming transports
-        bridge it onto their loop with call_soon_threadsafe).
+        bridge it onto their loop with call_soon_threadsafe). Under
+        pipelining the callback trails the device by up to
+        ``pipeline_depth`` steps (token ORDER is unchanged).
 
         ``info`` (optional dict) is filled in-place at admission with
         anything the caller should surface to the client — today the
         ``truncated_prompt`` record when the slot cache is smaller than the
-        prompt (transports attach it to the response meta)."""
+        prompt (transports attach it to the response meta).
+
+        ``seed`` (optional) pins this request's sampling rng to the same
+        chain ``generate(..., seed=seed)`` uses, so a seeded sampled request
+        decodes the identical token sequence through the batcher (each slot
+        carries its own per-request key device-side)."""
         if self._closed:
             raise RuntimeError("batcher closed")
         if isinstance(prompt, str):
@@ -271,10 +338,33 @@ class ContinuousBatcher:
         fut: asyncio.Future = self._loop.create_future()
         self._pending.append(
             (ids, int(max_new_tokens or self.server.max_new_tokens), fut,
-             on_token, info))
+             on_token, info, seed))
         self._ensure_running()
         self._wakeup.set()
         return await fut
+
+    def accommodates(self, prompt: Any,
+                     max_new_tokens: Optional[int] = None) -> bool:
+        """True when this batcher decodes the request IDENTICALLY to a
+        private ``generate()`` call: the prompt fits the fixed slot cache
+        at the same bucketed length generate() would use (no extra
+        truncation) and the token budget fits behind it (no clipping).
+        Transports use this to keep the seeded-reproducibility contract —
+        a seeded request that does NOT fit falls back to generate(), whose
+        cache is sized per request."""
+        if isinstance(prompt, str):
+            n = len(self.server._tokenizer.encode(prompt))
+        else:
+            n = int(np.asarray(prompt).size)
+        # _admit's exact prompt cap: beyond it the batcher keeps the tail
+        # (generate() only truncates past the model context, which is
+        # covered by the same min) — and the slot cache must leave the
+        # whole token budget behind the prompt (the batcher stops at the
+        # cache edge; generate() never clips)
+        plen = min(_bucket(n, self.len_buckets), self.server._cfg.max_seq_len,
+                   self.max_len - 1)
+        max_new = int(max_new_tokens or self.server.max_new_tokens)
+        return n <= plen and max_new <= self.max_len - n
 
     def _ensure_running(self):
         if self._task is None or self._task.done():
@@ -303,7 +393,9 @@ class ContinuousBatcher:
     # ------------------------------------------------------------------
     def _admit(self, ids: List[int], max_new: int, fut: asyncio.Future,
                on_token: Optional[Any] = None,
-               info: Optional[dict] = None) -> bool:
+               info: Optional[dict] = None,
+               seed: Optional[int] = None) -> bool:
+        import jax
         import jax.numpy as jnp
 
         from seldon_core_tpu.models.transformer import PAD_POS
@@ -351,12 +443,18 @@ class ContinuousBatcher:
         logits, cache1 = prefill(self.server._params, jnp.asarray(tokens), jnp.asarray(positions))
         self._caches = self._insert(self._caches, cache1, free)
         first_logits = np.asarray(logits[0, L - 1]).astype(np.float32)
+        # Per-request rng: an explicit seed reproduces generate(seed=...)'s
+        # exact chain (PRNGKey -> split for the first token -> split per
+        # decode step); otherwise derive an independent key from the
+        # batcher rng so concurrent requests don't share a stream.
+        if seed is not None:
+            key = jax.random.PRNGKey(int(seed))
+        else:
+            self._rng, key = jax.random.split(self._rng)
         if float(self._temp) <= 0.0:
             first = int(first_logits.argmax())
         else:
-            import jax
-
-            self._rng, sub = jax.random.split(self._rng)
+            key, sub = jax.random.split(key)
             k = min(self.server.top_k, first_logits.shape[-1])
             topi = np.argsort(first_logits)[-k:]
             draw = int(np.asarray(jax.random.categorical(
@@ -371,8 +469,17 @@ class ContinuousBatcher:
         slot.n_new = 1
         slot.tokens = [first]
         slot.on_token = on_token
-        self._last_tok[free] = first
-        self._next_pos[free] = L
+        slot.gen += 1          # invalidates in-flight tokens for the old occupant
+        slot.disp_new = 1      # the prefill-sampled first token counts
+        # thread the new slot's state into the device arrays; program order
+        # on the device stream puts this after every already-dispatched
+        # step, so in-flight steps still see (and waste compute on) the old
+        # state while step N+1 picks up the new occupant
+        self._last_tok, self._next_pos, self._keys = self._set_slot(
+            self._last_tok, self._next_pos, self._keys,
+            jnp.asarray(free, jnp.int32), jnp.asarray(first, jnp.int32),
+            jnp.asarray(L, jnp.int32), key)
+        self._last_admit_inflight = len(self._inflight)
         if on_token is not None and first != self.eos_id:
             on_token(first)
         if first == self.eos_id or max_new <= 1:
@@ -392,39 +499,102 @@ class ContinuousBatcher:
         slot.future = None
         slot.on_token = None
 
-    def _step(self):
+    # ------------------------------------------------------------------
+    # Pipelined decode: dispatch (producer) / drain (consumer)
+    # ------------------------------------------------------------------
+    def _dispatch_eligible(self) -> List[int]:
+        """Slots worth stepping: active AND not yet dispatched through their
+        token budget or cache length. A budget-exhausted slot still rides
+        along (static shapes — the whole batch steps), but when NO slot
+        needs tokens there is nothing to dispatch."""
+        return [
+            i for i, s in enumerate(self._slots)
+            if s.active and s.disp_new < s.max_new
+            and s.dispatched_pos() < self.max_len
+        ]
+
+    def _pick_k(self) -> int:
+        """Fused-step block size for the next dispatch. K>1 only when the
+        admit queue is empty (a fused block delays admission by K steps) and
+        every eligible slot has >= K steps of budget left (so the block
+        never overruns max_new or writes past the cache). Falling back to 1
+        instead of an arbitrary clamp keeps the compile count at two
+        programs (K=1 and K=fuse_steps)."""
+        if self.fuse_steps <= 1 or self._pending:
+            return 1
+        eligible = self._dispatch_eligible()
+        if not eligible:
+            return 1
+        room = min(
+            min(s.max_new - s.disp_new, self.max_len - s.dispatched_pos())
+            for s in (self._slots[i] for i in eligible)
+        )
+        return self.fuse_steps if room >= self.fuse_steps else 1
+
+    def _dispatch(self):
+        """Enqueue one (possibly K-fused) decode step on the device WITHOUT
+        waiting for its tokens: the state arrays are threaded from the
+        previous step's outputs, so the device runs ahead of the host."""
         import time
 
-        import jax
-        import jax.numpy as jnp
-
+        k = self._pick_k()
+        fn = self.server._get_decode_step(self.S, self.max_len, k)
         t0 = time.perf_counter()
-        self._rng, sub = jax.random.split(self._rng)
-        self._caches, nxt = self._decode_step(
-            self.server._params,
-            self._caches,
-            jnp.asarray(self._last_tok),
-            jnp.asarray(self._next_pos),
-            sub,
-            self._temp,
-        )
-        nxt = np.asarray(nxt).astype(np.int32)
-        # np.asarray above blocked on the device, so this wall time is the
-        # real step latency; drained into the /metrics histogram at scrape
-        self.server._decode_step_times.append(time.perf_counter() - t0)
+        (self._caches, self._last_tok, self._next_pos, self._keys,
+         toks) = fn(self.server._params, self._caches, self._last_tok,
+                    self._next_pos, self._keys, self._temp)
+        self.server._decode_dispatch_times.append(time.perf_counter() - t0)
+        snapshot = [(i, s.gen) for i, s in enumerate(self._slots) if s.active]
+        for i, _ in snapshot:
+            self._slots[i].disp_new += k
+        self._inflight.append(_InFlight(toks, k, snapshot, t0))
+        if len(self._inflight) > self._inflight_hwm:
+            self._inflight_hwm = len(self._inflight)
+
+    def _drain_one(self):
+        """Consume the OLDEST in-flight step: block until its tokens land,
+        then run all host bookkeeping (EOS, budgets, streaming callbacks,
+        slot release). Later steps stay dispatched while this runs — the
+        host trails the device, never the other way around."""
+        import time
+
+        rec: _InFlight = self._inflight.popleft()
+        # host lag in decode STEPS, not dispatch records: a fused record
+        # covers k steps, so depth 2 at K=8 is a 16-step lag
+        lag = rec.k + sum(r.k for r in self._inflight)
+        t0 = time.perf_counter()
+        arr = np.asarray(rec.tokens)  # [S, k] — the only per-step host sync
+        now = time.perf_counter()
+        self.server._decode_sync_times.append(now - t0)
+        self.server._decode_host_lag.append(lag)
+        # steady-state step time: interval since the previous drain (the
+        # pipeline overlaps dispatch+sync with device compute, so per-step
+        # wall is drain-to-drain), floored at this record's dispatch time so
+        # an idle gap doesn't inflate the histogram
+        base = rec.t_dispatch if self._last_drain_t is None else max(
+            self._last_drain_t, rec.t_dispatch)
+        per_step = max(now - base, 0.0) / rec.k
+        for _ in range(rec.k):
+            self.server._decode_step_times.append(per_step)
+        self._last_drain_t = now
         self.server._last_decode_kv_bytes = self._cache_nbytes
-        for i, slot in enumerate(self._slots):
-            if not slot.active:
-                continue
-            tok = int(nxt[i])
-            slot.tokens.append(tok)
-            slot.n_new += 1
-            self._last_tok[i] = tok
-            self._next_pos[i] += 1
-            if slot.on_token is not None and tok != self.eos_id:
-                slot.on_token(tok)
-            if tok == self.eos_id or slot.n_new >= slot.max_new or int(self._next_pos[i]) >= self.max_len:
-                self._finish(i)
+        for j in range(rec.k):
+            for i, gen in rec.snapshot:
+                slot = self._slots[i]
+                if not slot.active or slot.gen != gen:
+                    # trailing speculative token for a finished (or already
+                    # replaced) occupant — masked, never surfaced
+                    continue
+                if slot.n_new >= slot.max_new:
+                    continue  # budget-exhausted slot riding along
+                tok = int(arr[i, j])
+                slot.tokens.append(tok)
+                slot.n_new += 1
+                if slot.on_token is not None and tok != self.eos_id:
+                    slot.on_token(tok)
+                if (tok == self.eos_id or slot.n_new >= slot.max_new
+                        or slot.host_pos() >= self.max_len):
+                    self._finish(i)
 
     async def _run(self):
         try:
@@ -432,15 +602,24 @@ class ContinuousBatcher:
                 # admit as many pending requests as there are free slots
                 # (FIFO, peek-then-pop so a failed admit keeps the request);
                 # device work runs in a worker thread so the event loop (and
-                # co-hosted HTTP handlers) stays responsive during decode
+                # co-hosted HTTP handlers) stays responsive during decode.
+                # Admission happens while earlier steps are STILL IN FLIGHT
+                # — the insert/set_slot queue behind them in device program
+                # order, and the gen counter masks their stale tokens.
                 while self._pending:
-                    ids, max_new, fut, on_token, info = self._pending[0]
-                    if not await asyncio.to_thread(self._admit, ids, max_new, fut,
-                                                   on_token, info):
+                    ids, max_new, fut, on_token, info, seed = self._pending[0]
+                    if not await asyncio.to_thread(self._admit, ids, max_new,
+                                                   fut, on_token, info, seed):
                         break  # no free slot — decode until one frees up
                     self._pending.popleft()
-                if any(s.active for s in self._slots):
-                    await asyncio.to_thread(self._step)
+                # producer: keep the device pipeline_depth steps ahead of
+                # the host — dispatch is enqueue-only, no sync
+                while (len(self._inflight) < self.pipeline_depth
+                       and self._dispatch_eligible()):
+                    await asyncio.to_thread(self._dispatch)
+                # consumer: drain the oldest step one (or more) behind
+                if self._inflight:
+                    await asyncio.to_thread(self._drain_one)
                     continue
                 if self._closed:
                     return
@@ -454,6 +633,7 @@ class ContinuousBatcher:
             # device/compile failure: fail every in-flight and queued request
             # instead of leaving their futures hanging
             logger.exception("batcher loop died: %s", e)
+            self._inflight.clear()
             for slot in self._slots:
                 if slot.active:
                     if slot.on_token is not None:
@@ -467,7 +647,7 @@ class ContinuousBatcher:
                     slot.active = False
                     slot.future = None
             while self._pending:
-                _, _, fut, on_token, _ = self._pending.popleft()
+                _, _, fut, on_token, _, _ = self._pending.popleft()
                 if on_token is not None:
                     try:
                         on_token(None)

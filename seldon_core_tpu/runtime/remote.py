@@ -64,6 +64,39 @@ def config_from_annotations(annotations: Optional[dict]) -> dict:
     }
 
 
+# Remote hops are small request/response JSON bodies on loopback or
+# intra-cluster links: Nagle buffering on such writes adds up to an RTT of
+# idle wait per hop for nothing (the round-5 loopback profile shows ~15 ms
+# per engine->node hop, VERDICT weak #3). aiohttp in this tree does NOT set
+# TCP_NODELAY on client sockets, so flip it at connection setup; keep-alive
+# stays on (force_close=False) so sequential calls reuse one connection —
+# tests/test_remote_keepalive.py pins both behaviours.
+KEEPALIVE_TIMEOUT_S = 30.0
+
+
+def _make_connector():
+    """TCPConnector with TCP_NODELAY applied to every new connection and
+    keep-alive long enough to survive inter-request gaps. Falls back to the
+    stock connector if aiohttp's private connection hook moves."""
+    import aiohttp
+
+    try:
+        from aiohttp.tcp_helpers import tcp_nodelay
+
+        class _NoDelayConnector(aiohttp.TCPConnector):
+            async def _wrap_create_connection(self, *args, **kwargs):
+                transport, proto = await super()._wrap_create_connection(
+                    *args, **kwargs)
+                tcp_nodelay(transport, True)
+                return transport, proto
+
+        return _NoDelayConnector(keepalive_timeout=KEEPALIVE_TIMEOUT_S)
+    except (ImportError, AttributeError):  # pragma: no cover - aiohttp drift
+        logger.warning("aiohttp private API moved; remote hops run without "
+                       "explicit TCP_NODELAY")
+        return aiohttp.TCPConnector(keepalive_timeout=KEEPALIVE_TIMEOUT_S)
+
+
 class RemoteComponent(SeldonComponent):
     """A graph node reached over the network; implements the *_raw contract so
     dispatch passes full messages through untouched."""
@@ -112,7 +145,7 @@ class RemoteComponent(SeldonComponent):
             self._sessions = {
                 k: s for k, s in self._sessions.items() if not s.closed and k != id(loop)
             }
-            session = aiohttp.ClientSession()
+            session = aiohttp.ClientSession(connector=_make_connector())
             self._sessions[id(loop)] = session
         return session
 

@@ -144,6 +144,8 @@ class LLMServer(SeldonComponent):
         kv_cache_dtype: str = "",
         continuous_batching: int = 0,
         continuous_batching_max_len: int = 0,
+        decode_pipeline_depth: int = 2,
+        decode_fuse_steps: int = 0,
         prefix_cache_size: int = 0,
         prefix_cache_bytes: int = 0,
         seed: int = 0,
@@ -190,6 +192,14 @@ class LLMServer(SeldonComponent):
         # cache length for the batcher's slot KV (0 = sized from the
         # len_buckets; see ContinuousBatcher.__init__)
         self.continuous_batching_max_len = int(continuous_batching_max_len) or None
+        # Decode pipelining (runtime/batcher.py): how many decode steps the
+        # batcher keeps dispatched ahead of the host (>=2 hides the
+        # dispatch+sync round trip that serialized the served decode at 11%
+        # of direct throughput — docs/performance.md "Decode pipelining"),
+        # and how many steps to fuse into one device-side lax.scan between
+        # host syncs when the admit queue is empty (0/1 = off).
+        self.decode_pipeline_depth = int(decode_pipeline_depth)
+        self.decode_fuse_steps = int(decode_fuse_steps)
         # Prefix caching (opt-in): single-prompt requests reuse the KV cache
         # of the longest previously-prefilled token prefix (shared system
         # prompts prefill once); entries are LRU-evicted past this size.
@@ -217,6 +227,12 @@ class LLMServer(SeldonComponent):
 
         self._decode_step_times: Any = deque(maxlen=4096)
         self._last_decode_kv_bytes = 0
+        # pipelined-decode observability (batcher): per-call dispatch wall
+        # (enqueue only, no sync), per-drain host sync wall, and the number
+        # of steps in flight observed at each drain (host lag)
+        self._decode_dispatch_times: Any = deque(maxlen=4096)
+        self._decode_sync_times: Any = deque(maxlen=4096)
+        self._decode_host_lag: Any = deque(maxlen=4096)
 
     # ------------------------------------------------------------------
     def load(self) -> None:
@@ -240,6 +256,16 @@ class LLMServer(SeldonComponent):
                     f"unknown param_dtype {self.param_dtype!r}: expected '', "
                     f"'auto', or a jax dtype name (e.g. 'bfloat16')"
                 ) from e
+        if self.decode_pipeline_depth < 1:
+            raise ValueError(
+                f"decode_pipeline_depth={self.decode_pipeline_depth} must be "
+                f">= 1 (1 = serial dispatch-then-sync, >=2 pipelines)"
+            )
+        if self.decode_fuse_steps < 0:
+            raise ValueError(
+                f"decode_fuse_steps={self.decode_fuse_steps} must be >= 0 "
+                f"(0/1 = no fusing)"
+            )
 
         cfg_kwargs = dict(self.model_kwargs)
         name = self.model_name
@@ -590,7 +616,11 @@ class LLMServer(SeldonComponent):
         ``dynamic_update_slice`` writes reuse the prefill's cache in place
         instead of copying the whole multi-GB cache into the scan carry.
         generate() passes donate=False only when the caches are shared with
-        the prefix cache (a donated buffer is dead to later readers)."""
+        the prefix cache (a donated buffer is dead to later readers). The
+        token/position arrays canNOT be donated here — the scan returns only
+        (tokens, caches), so they have no matching output buffer; the
+        pipelined per-step variant (``_get_decode_step``) is the one that
+        threads and donates that state."""
         key = (b, max_len, donate)
         fn = self._decode_cache.get(key)
         if fn is not None:
@@ -657,6 +687,69 @@ class LLMServer(SeldonComponent):
             decode = partial(jax.jit, static_argnames=("n_steps",), **donate_kw)(decode)
         self._decode_cache[key] = decode
         return decode
+
+    def _get_decode_step(self, slots: int, max_len: int, k: int = 1):
+        """Compiled pipelined decode step for the ContinuousBatcher: runs
+        ``k`` decode micro-steps device-side (``lax.scan``) over ``slots``
+        cache slots, with the sampling state IN the loop — per-slot rng
+        keys, last token and next position all live on device and are
+        threaded from output to input across calls, so the host never
+        round-trips token/position state through NumPy between steps.
+
+        Returns ``(caches, last_tok, next_pos, keys, tokens[slots, k])``.
+        The cache pytree, position array and key array are donated (the
+        per-step scatter updates in place; the caller reassigns from the
+        outputs). ``last_tok`` is deliberately NOT donated: the stacked
+        ``tokens`` output can alias the final-token carry buffer (reshape
+        bitcasts), and the host reads ``tokens`` while the next step — which
+        would invalidate a donated ``last_tok`` — is already in flight.
+
+        Per-slot sampling reproduces generate()'s chain exactly (split then
+        top-k categorical per step, one key per sequence), so a slot seeded
+        like a generate() request emits identical tokens — the parity bar in
+        tests/test_batcher_pipeline.py."""
+        key = ("pipestep", slots, max_len, k)
+        fn = self._decode_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        module = self._module
+        top_k = self.top_k
+        deq = self._dequant
+
+        @partial(jax.jit, donate_argnums=(1, 3, 4))
+        def decode_step(params, caches, last_tok, next_pos, keys, temperature):
+            def sample(keys, lg):
+                greedy = jnp.argmax(lg, axis=-1)
+                kk = min(top_k, lg.shape[-1])
+                topv, topi = jax.lax.top_k(lg, kk)
+
+                def one(key, tv):
+                    key, sub = jax.random.split(key)
+                    return key, jax.random.categorical(
+                        sub, tv / jnp.maximum(temperature, 1e-6))
+
+                keys, draw = jax.vmap(one)(keys, topv)
+                sampled = jnp.take_along_axis(topi, draw[:, None], axis=-1)[:, 0]
+                return keys, jnp.where(temperature <= 0.0, greedy, sampled)
+
+            def step(carry, _):
+                caches, tok, pos, keys = carry
+                logits, caches = module.apply(
+                    deq(params), tok[:, None], positions=pos[:, None],
+                    caches=caches, cache_index=pos,
+                )
+                keys, nxt = sample(keys, logits[:, -1].astype(jnp.float32))
+                return (caches, nxt, pos + 1, keys), nxt
+
+            (caches, tok, pos, keys), toks = jax.lax.scan(
+                step, (caches, last_tok, next_pos, keys), None, length=k)
+            return caches, tok, pos, keys, toks.T  # tokens [slots, k]
+
+        self._decode_cache[key] = decode_step
+        return decode_step
 
     # ------------------------------------------------------------------
     def generate(
@@ -866,23 +959,43 @@ class LLMServer(SeldonComponent):
         occupancy, the KV bytes the last decode streamed per step, and the
         decode step-time observations accumulated since the last scrape
         (drained here — each is observed into the histogram exactly once)."""
-        drained: List[float] = []
-        while True:
-            try:
-                drained.append(self._decode_step_times.popleft())
-            except IndexError:
-                break
+        def drain(dq) -> List[float]:
+            out: List[float] = []
+            while True:
+                try:
+                    out.append(dq.popleft())
+                except IndexError:
+                    return out
+
         occupancy = 0.0
         slot_bytes = 0
+        in_flight = 0
+        inflight_hwm = 0
+        depth = self.decode_pipeline_depth
+        fuse = self.decode_fuse_steps
         svc = getattr(self, "_batcher_service", None)
         if svc is not None:
             batcher = svc.batcher
             occupancy = sum(1 for s in batcher._slots if s.active) / max(batcher.S, 1)
             slot_bytes = self._entry_nbytes(batcher._caches, None)
+            in_flight = len(batcher._inflight)
+            inflight_hwm = batcher._inflight_hwm
+            depth = batcher.pipeline_depth
+            fuse = batcher.fuse_steps
         return {
             "kv_cache_dtype": self.kv_cache_dtype,
             "kv_cache_bytes": slot_bytes + self._prefix_bytes,
             "kv_occupancy": occupancy,
             "kv_bytes_per_step": self._last_decode_kv_bytes,
-            "decode_step_times_s": drained,
+            "decode_step_times_s": drain(self._decode_step_times),
+            # pipelined decode: dispatch (enqueue-only) vs sync (host block)
+            # split, current/high-water steps-in-flight, and the host lag
+            # observed at each drain (steps the host trails the device)
+            "decode_dispatch_times_s": drain(self._decode_dispatch_times),
+            "decode_sync_times_s": drain(self._decode_sync_times),
+            "decode_host_lag_steps": drain(self._decode_host_lag),
+            "decode_steps_in_flight": in_flight,
+            "decode_inflight_hwm": inflight_hwm,
+            "decode_pipeline_depth": depth,
+            "decode_fuse_steps": fuse,
         }

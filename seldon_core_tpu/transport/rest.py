@@ -246,19 +246,29 @@ def _add_generate_routes(app: web.Application, component: Any,
             prompt = body.get("prompt")
             if prompt is None:
                 raise SeldonError("body needs 'prompt' or 'prompts'", status_code=400)
-            # Per-request sampling params can't join a shared batch (the
-            # batcher decodes every slot with the server's temperature/rng),
-            # so requests carrying them get a private generate() — same
-            # output as with batching disabled, never silently different.
-            custom_sampling = ("temperature" in body or "seed" in body)
+            # A per-request TEMPERATURE can't join a shared batch (the
+            # batcher decodes every slot with the server's temperature), so
+            # those requests get a private generate() — same output as with
+            # batching disabled, never silently different. A per-request
+            # SEED now joins fine: each slot carries its own device rng on
+            # the exact generate(seed=...) chain (runtime/batcher.py,
+            # parity-tested in tests/test_batcher_pipeline.py) — UNLESS the
+            # request would not fit the fixed slot cache (truncated prompt /
+            # clipped budget), where only the private per-request-sized
+            # generate() can honor the seeded-reproducibility contract.
+            custom_sampling = "temperature" in body
             svc = None if custom_sampling else get_batcher_service(component)
+            if svc is not None and "seed" in body and not await asyncio.to_thread(
+                    svc.batcher.accommodates, prompt, max_new):
+                svc = None
             stream = bool(body.get("stream"))
             decode = getattr(component, "_tokenizer", None)
 
             info: dict = {}
             if not stream:
                 if svc is not None:
-                    toks = await svc.submit(prompt, max_new, info=info)
+                    toks = await svc.submit(prompt, max_new, info=info,
+                                            seed=body.get("seed"))
                 else:
                     out = await asyncio.to_thread(
                         component.generate, [prompt], max_new_tokens=max_new,
@@ -277,8 +287,25 @@ def _add_generate_routes(app: web.Application, component: Any,
 
             if custom_sampling:
                 raise SeldonError(
-                    "streaming with per-request temperature/seed is not "
-                    "supported; set them on the server", status_code=400)
+                    "streaming with per-request temperature is not "
+                    "supported; set it on the server", status_code=400)
+            if "seed" in body:
+                # streaming has no generate() fallback, so a seeded prompt
+                # that exceeds the slot cache (truncation / budget clip)
+                # cannot honor the reproducibility contract — reject before
+                # the SSE response starts
+                from seldon_core_tpu.runtime.batcher import ensure_stream_service
+
+                s_svc = svc if svc is not None else await asyncio.to_thread(
+                    ensure_stream_service, component)
+                if not await asyncio.to_thread(
+                        s_svc.batcher.accommodates, prompt, max_new):
+                    raise SeldonError(
+                        "seeded streaming prompt exceeds the batcher slot "
+                        "cache and would not reproduce generate(seed=...); "
+                        "raise continuous_batching_max_len or drop stream",
+                        status_code=400)
+                svc = s_svc
 
             # SSE streaming: one event per token as the shared batch decodes
             resp = web.StreamResponse(headers={
@@ -298,7 +325,8 @@ def _add_generate_routes(app: web.Application, component: Any,
                 svc = await asyncio.to_thread(ensure_stream_service, component)
             fut = asyncio.ensure_future(svc.submit(prompt, max_new,
                                                    on_token=on_token,
-                                                   info=info))
+                                                   info=info,
+                                                   seed=body.get("seed")))
             try:
                 # Wait on the queue AND the future: a submit that fails before
                 # any token (closed batcher, bad prompt) never sends the None

@@ -1,0 +1,227 @@
+"""Pipelined decode correctness (ISSUE 3 tentpole): the device-resident
+decode loop must (a) keep >=2 steps dispatched ahead of the host sync —
+never silently re-serialize — and (b) change NOTHING about the tokens:
+parity against ``generate()`` under greedy AND seeded-sampled decode, EOS
+handled on the trailing speculative step, admissions landing while steps
+are in flight."""
+
+import asyncio
+
+import pytest
+
+from seldon_core_tpu.runtime.batcher import ContinuousBatcher
+from seldon_core_tpu.servers.llmserver import LLMServer
+
+KW = dict(vocab_size=96, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+          ffn_dim=64, max_seq_len=96)
+
+
+def make_server(**extra) -> LLMServer:
+    base = dict(model="transformer", model_kwargs=KW, init_random=True,
+                max_new_tokens=8, len_buckets=(16,), batch_buckets=(1, 4),
+                temperature=0.0, eos_id=-1, seed=3)
+    base.update(extra)
+    s = LLMServer(**base)
+    s.load()
+    return s
+
+
+@pytest.fixture(scope="module")
+def server():
+    return make_server()
+
+
+@pytest.fixture(scope="module")
+def sampled_server():
+    return make_server(temperature=0.8, top_k=20, seed=5)
+
+
+def run_batch(server, prompts, *, n=8, seeds=None, **batcher_kw):
+    async def go():
+        b = ContinuousBatcher(server, **batcher_kw)
+        outs = await asyncio.gather(*[
+            b.submit(p, max_new_tokens=n,
+                     seed=None if seeds is None else seeds[i])
+            for i, p in enumerate(prompts)])
+        stats = {"hwm": b._inflight_hwm,
+                 "admit_inflight": b._last_admit_inflight}
+        await b.close()
+        return outs, stats
+
+    return asyncio.run(go())
+
+
+def test_pipelined_greedy_parity_with_generate(server):
+    prompts = [[5, 9, 17], [40, 3, 22, 8, 11], [7], [60, 61, 62, 63],
+               [12, 13], [80, 2, 5]]
+    expected = [server.generate([p], max_new_tokens=8)["tokens"][0]
+                for p in prompts]
+    outs, stats = run_batch(server, prompts, max_slots=3, max_len=32,
+                            len_buckets=(8,), pipeline_depth=3)
+    assert outs == expected
+    assert stats["hwm"] >= 2, "pipeline never got >=2 steps in flight"
+
+
+def test_pipelined_seeded_sampled_parity_with_generate(sampled_server):
+    """A seeded request through the batcher must decode the IDENTICAL token
+    sequence generate() produces for the same seed: per-slot device rng
+    follows the same PRNGKey -> split-per-step chain."""
+    prompts = [[5, 9, 17, 2], [40, 3, 22], [7, 7, 7, 7, 7]]
+    seeds = [42, 1234, 7]
+    expected = [sampled_server.generate([p], max_new_tokens=8, seed=s)["tokens"][0]
+                for p, s in zip(prompts, seeds)]
+    outs, _ = run_batch(sampled_server, prompts, seeds=seeds, max_slots=3,
+                        max_len=40, len_buckets=(8,), pipeline_depth=2)
+    assert outs == expected
+
+
+def test_dispatch_ahead_depth_reached_before_first_sync():
+    """Instrumentation guard against silent re-serialization: with depth 3
+    and a long decode through the REAL service path, the in-flight
+    high-water mark must reach >=2 — i.e. step N+1 was dispatched before
+    step N's host sync — and the dispatch/sync split plus host-lag
+    observations must reach llm_stats() for /metrics."""
+    from seldon_core_tpu.runtime.batcher import BatcherService
+
+    s = make_server(decode_pipeline_depth=3, continuous_batching=2,
+                    continuous_batching_max_len=48)
+    svc = BatcherService(s, max_slots=2)
+    s._batcher_service = svc
+    try:
+        out = svc.submit_sync([3, 1, 4, 1, 5], 16)
+        assert len(out) == 16
+        assert svc.batcher._inflight_hwm >= 2
+        st = s.llm_stats()
+        assert st["decode_inflight_hwm"] >= 2
+        assert st["decode_dispatch_times_s"] and st["decode_sync_times_s"]
+        assert max(st["decode_host_lag_steps"]) >= 2
+    finally:
+        svc.close()
+
+
+def test_eos_on_trailing_speculative_step(server):
+    """Pick an eos_id the model actually emits mid-stream (from a no-EOS
+    run), then decode with it under depth 3: the device runs speculative
+    steps past the EOS before the host sees it, and those trailing tokens
+    must be masked — output identical to generate() with the same eos_id."""
+    probe = server.generate([[5, 9, 17]], max_new_tokens=8)["tokens"][0]
+    eos = probe[3]  # 4th generated token => EOS fires mid-decode
+    s = make_server(eos_id=eos)
+    expected = s.generate([[5, 9, 17]], max_new_tokens=8)["tokens"][0]
+    assert len(expected) < 8  # the chosen eos really truncates
+    outs, _ = run_batch(s, [[5, 9, 17]], max_slots=2, max_len=32,
+                        len_buckets=(8,), pipeline_depth=3)
+    assert outs[0] == expected
+
+
+def test_mid_stream_admit_with_steps_in_flight(server):
+    """A request admitted while >=2 steps are in flight must decode exactly
+    its solo tokens (gen-counter masking + device-order insert), and the
+    first request must be unaffected."""
+    p1, p2 = [5, 9, 17, 33], [2, 4]
+    e1 = server.generate([p1], max_new_tokens=24)["tokens"][0]
+    e2 = server.generate([p2], max_new_tokens=6)["tokens"][0]
+
+    async def go():
+        b = ContinuousBatcher(server, max_slots=2, max_len=64,
+                              len_buckets=(8,), pipeline_depth=3)
+        t1 = asyncio.ensure_future(b.submit(p1, max_new_tokens=24))
+        # wait until the pipeline is demonstrably ahead
+        for _ in range(400):
+            if b._inflight_hwm >= 2 and any(s.active for s in b._slots):
+                break
+            await asyncio.sleep(0.005)
+        t2 = asyncio.ensure_future(b.submit(p2, max_new_tokens=6))
+        o1, o2 = await asyncio.gather(t1, t2)
+        admit_inflight = b._last_admit_inflight
+        hwm = b._inflight_hwm
+        await b.close()
+        return o1, o2, admit_inflight, hwm
+
+    o1, o2, admit_inflight, hwm = asyncio.run(go())
+    assert o1 == e1
+    assert o2 == e2
+    assert hwm >= 2
+    # the second admit landed while the pipeline had steps in flight
+    assert admit_inflight >= 1
+
+
+def test_fused_steps_parity(server):
+    """decode_fuse_steps=4: K device-side steps per host sync, same
+    tokens — and the host-lag metric counts STEPS, not dispatch records
+    (a fused record covers k steps)."""
+    prompts = [[5, 9, 17], [40, 3, 22, 8, 11]]
+    expected = [server.generate([p], max_new_tokens=12)["tokens"][0]
+                for p in prompts]
+    server._decode_host_lag.clear()
+    outs, _ = run_batch(server, prompts, n=12, max_slots=2, max_len=40,
+                        len_buckets=(8,), pipeline_depth=2, fuse_steps=4)
+    assert outs == expected
+    # depth 2 of K=4 blocks => the host trailed by >4 steps at some drain
+    assert max(server._decode_host_lag) > 4
+
+
+def test_fused_steps_respect_eos_and_budget(server):
+    """A fused block may overshoot a sequence's EOS device-side; the host
+    must still cut at the first EOS, and max_new that is not a multiple of
+    K must come back exact (K falls back to 1 near the budget edge)."""
+    probe = server.generate([[5, 9, 17]], max_new_tokens=10)["tokens"][0]
+    eos = probe[4]
+    s = make_server(eos_id=eos)
+    expected = s.generate([[5, 9, 17]], max_new_tokens=10)["tokens"][0]
+    outs, _ = run_batch(s, [[5, 9, 17]], n=10, max_slots=1, max_len=40,
+                        len_buckets=(8,), pipeline_depth=2, fuse_steps=3)
+    assert outs[0] == expected
+
+
+def test_streaming_callback_order_preserved(server):
+    """on_token fires per token in decode order (trailing the device) and
+    the None sentinel still terminates the stream."""
+    expected = server.generate([[8, 6, 7]], max_new_tokens=8)["tokens"][0]
+    events = []
+
+    async def go():
+        b = ContinuousBatcher(server, max_slots=2, max_len=32,
+                              len_buckets=(8,), pipeline_depth=3)
+        out = await b.submit([8, 6, 7], max_new_tokens=8,
+                             on_token=events.append)
+        await b.close()
+        return out
+
+    out = asyncio.run(go())
+    assert out == expected
+    assert events[-1] is None
+    assert events[:-1] == expected
+
+
+def test_pipeline_depth_one_is_serial_equivalent(server):
+    """depth=1 (dispatch then immediately sync) must still match — the
+    pipelined machinery with no lookahead is the old serial loop."""
+    prompts = [[11, 5], [9, 9, 9]]
+    expected = [server.generate([p], max_new_tokens=6)["tokens"][0]
+                for p in prompts]
+    outs, _ = run_batch(server, prompts, n=6, max_slots=2, max_len=32,
+                        len_buckets=(8,), pipeline_depth=1)
+    assert outs == expected
+
+
+def test_depth_and_fuse_knobs_validated_at_load():
+    with pytest.raises(ValueError, match="decode_pipeline_depth"):
+        make_server(decode_pipeline_depth=0)
+    with pytest.raises(ValueError, match="decode_fuse_steps"):
+        make_server(decode_fuse_steps=-1)
+
+
+@pytest.mark.slow
+def test_fused_k_sweep_parity(server):
+    """Every fused-K variant (and its interaction with depth) holds token
+    parity — slow: compiles one program per (K, depth) pair."""
+    prompts = [[5, 9, 17], [40, 3, 22, 8, 11], [7]]
+    expected = [server.generate([p], max_new_tokens=12)["tokens"][0]
+                for p in prompts]
+    for k in (2, 3, 4, 6):
+        for depth in (1, 2, 3):
+            outs, _ = run_batch(server, prompts, n=12, max_slots=2,
+                                max_len=48, len_buckets=(8,),
+                                pipeline_depth=depth, fuse_steps=k)
+            assert outs == expected, (k, depth)

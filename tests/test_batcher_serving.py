@@ -112,6 +112,102 @@ def test_concurrent_rest_generate_token_parity(rest_client, batched_component,
     assert svc.submitted - before == len(PROMPTS)
 
 
+def test_rest_seeded_request_joins_batch():
+    """A seed-only request no longer bypasses the shared batcher: per-slot
+    device rng reproduces generate(seed=...)'s chain exactly (PR 3), so the
+    request joins the batch AND returns the seeded tokens. A per-request
+    TEMPERATURE still takes the private-generate path. Own component/app:
+    the direct generate() calls here must not perturb the shared fixture's
+    request-count tags."""
+    from seldon_core_tpu.transport.rest import make_component_app
+
+    comp = LLMServer(model="transformer", model_kwargs=KW, init_random=True,
+                     max_new_tokens=6, len_buckets=(16,), batch_buckets=(1, 4),
+                     temperature=0.7, top_k=20, eos_id=-1, seed=3,
+                     continuous_batching=2)
+    comp.load()
+    expected = comp.generate([PROMPTS[0]], seed=77)["tokens"][0]
+    app = make_component_app(comp)
+    loop = asyncio.new_event_loop()
+    runner = web.AppRunner(app)
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(runner.setup())
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        run.port = s.getsockname()[1]
+        loop.run_until_complete(web.SockSite(runner, s).start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    try:
+        out = _post(run.port, "/v1/generate", {"prompt": PROMPTS[0], "seed": 77})
+        svc = comp._batcher_service
+        assert svc is not None and svc.submitted == 1  # THROUGH the batcher
+        assert out["tokens"] == expected
+        before = svc.submitted
+        _post(run.port, "/v1/generate",
+              {"prompt": PROMPTS[1], "temperature": 0.2})
+        assert svc.submitted == before  # private generate(), not the batcher
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+
+
+def test_rest_seeded_oversized_prompt_falls_back_to_generate():
+    """A seeded request whose prompt exceeds the fixed slot cache must NOT
+    join the batcher (which would truncate and break the seeded-
+    reproducibility contract): it falls back to the private generate(),
+    whose cache is sized per request — same tokens as generate(seed=...)."""
+    from seldon_core_tpu.transport.rest import make_component_app
+
+    comp = LLMServer(model="transformer", model_kwargs=KW, init_random=True,
+                     max_new_tokens=4, len_buckets=(16,), batch_buckets=(1, 4),
+                     temperature=0.7, top_k=20, eos_id=-1, seed=3,
+                     continuous_batching=2, continuous_batching_max_len=12)
+    comp.load()
+    long_prompt = "x" * 40  # 40 byte-tokens >> the 12-token slot cache
+    expected = comp.generate([long_prompt], seed=9)["tokens"][0]
+    app = make_component_app(comp)
+    loop = asyncio.new_event_loop()
+    runner = web.AppRunner(app)
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(runner.setup())
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        run.port = s.getsockname()[1]
+        loop.run_until_complete(web.SockSite(runner, s).start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    try:
+        out = _post(run.port, "/v1/generate",
+                    {"prompt": long_prompt, "seed": 9})
+        assert out["tokens"] == expected
+        svc = getattr(comp, "_batcher_service", None)
+        # the request must have bypassed the batcher (private generate)
+        assert svc is None or svc.submitted == 0
+        # a FITTING seeded prompt still joins the batch
+        short = "ab"
+        want = comp.generate([short], seed=5)["tokens"][0]
+        out = _post(run.port, "/v1/generate", {"prompt": short, "seed": 5})
+        assert out["tokens"] == want
+        svc = comp._batcher_service
+        assert svc is not None and svc.submitted == 1
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+
+
 def test_rest_generate_batch_path(rest_client, solo_tokens):
     out = _post(rest_client, "/v1/generate", {"prompts": PROMPTS[:2]})
     assert out["tokens"] == [solo_tokens[0], solo_tokens[1]]

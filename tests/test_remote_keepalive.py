@@ -1,0 +1,69 @@
+"""Remote-hop transport tuning (ISSUE 3 satellite, VERDICT weak #3): the
+engine->node HTTP client must (a) reuse ONE TCP connection across
+sequential calls — keep-alive actually firing, not a fresh handshake per
+hop — and (b) run with TCP_NODELAY so small JSON bodies are not Nagle-
+buffered behind an RTT of idle wait."""
+
+import asyncio
+import socket
+
+from aiohttp import web
+
+from seldon_core_tpu.contracts.graph import Endpoint
+from seldon_core_tpu.contracts.payload import SeldonMessage
+from seldon_core_tpu.runtime.remote import RemoteComponent
+
+
+def _run_remote_calls(n_calls: int):
+    """Serve /predict in-loop, drive N sequential predict_raw calls through
+    one RemoteComponent, and report (distinct server transports seen,
+    client-side NODELAY flag read from the pooled connection)."""
+    transports = set()
+
+    async def handler(request):
+        transports.add(id(request.transport))
+        body = await request.json()
+        return web.json_response(body)
+
+    async def go():
+        app = web.Application()
+        app.router.add_post("/predict", handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        site = web.SockSite(runner, s)
+        await site.start()
+
+        comp = RemoteComponent(
+            Endpoint(service_host="127.0.0.1", service_port=port, type="REST"))
+        try:
+            msg = SeldonMessage.from_dict({"data": {"ndarray": [[1.0, 2.0]]}})
+            for _ in range(n_calls):
+                out = await comp.predict_raw(msg)
+                assert out.data is not None
+            # client-side: the pooled keep-alive connection must carry
+            # TCP_NODELAY (set at connection creation by _make_connector)
+            session = next(iter(comp._sessions.values()))
+            nodelay = None
+            for conns in session.connector._conns.values():
+                for proto, _ts in conns:
+                    sock = proto.transport.get_extra_info("socket")
+                    if sock is not None:
+                        nodelay = sock.getsockopt(
+                            socket.IPPROTO_TCP, socket.TCP_NODELAY)
+            return nodelay
+        finally:
+            await comp.close()
+            await runner.cleanup()
+
+    return asyncio.run(go()), transports
+
+
+def test_one_connection_serves_sequential_calls():
+    nodelay, transports = _run_remote_calls(6)
+    assert len(transports) == 1, (
+        f"{len(transports)} TCP connections for 6 sequential calls — "
+        f"keep-alive reuse is broken")
+    assert nodelay == 1, "pooled remote connection is missing TCP_NODELAY"
