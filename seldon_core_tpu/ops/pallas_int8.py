@@ -75,6 +75,7 @@ def probe_tpu_compile(force: bool = False) -> str:
         x = jnp.zeros((8, 128), jnp.bfloat16)
         q = jnp.zeros((128, 128), jnp.int8)
         s = jnp.ones((128,), jnp.float32)
+        # graftlint: allow-host-sync-in-hot-path(one-time startup probe: the sync is the point — prove the kernel compiles AND runs before enabling the compiled path)
         np.asarray(int8_matmul(x, q, s, interpret=False, _probe=True))
         _TPU_COMPILE_STATUS = "ok"
     except Exception as e:  # noqa: BLE001 — any compile/runtime failure gates the path

@@ -129,12 +129,22 @@ def quantized_matmul(x, qt: QuantizedTensor, out_dtype=None):
 
 
 def quantized_bytes(params: Any) -> int:
-    """HBM footprint of the (possibly mixed) tree — for reporting."""
+    """HBM footprint of the (possibly mixed) tree — for reporting.
+
+    Metadata-only on purpose: sizing from shape/dtype never touches the
+    buffers, where the old ``np.asarray(leaf)`` pulled the ENTIRE tree
+    (gigabytes at 7B) through the host just to read ``.size`` — a
+    device->host sync per leaf (graftlint: host-sync-in-hot-path).
+    """
+    import math
+
     import jax
 
     _register_pytree()
     total = 0
     for leaf in jax.tree.leaves(params):
-        arr = np.asarray(leaf)
-        total += arr.size * arr.dtype.itemsize
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", None)
+        itemsize = np.dtype(dtype).itemsize if dtype is not None else 8
+        total += math.prod(shape) * itemsize
     return total

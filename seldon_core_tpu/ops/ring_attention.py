@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from seldon_core_tpu.parallel.compat import axis_size, shard_map
+
 NEG_INF = jnp.finfo(jnp.float32).min
 
 
@@ -73,7 +75,7 @@ def _ring_attention_local(q, k, v, q_pos, kv_pos, axis_name: Optional[str], caus
     if axis_name is None:
         m, l, acc = _block_attention(q, k, v, q_pos, kv_pos, m, l, acc, scale, causal)
     else:
-        n = jax.lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         perm = [(i, (i + 1) % n) for i in range(n)]
 
         def step(i, carry):
@@ -117,11 +119,11 @@ def ring_attention(
     qkv_spec = P(ba, seq_axis, ha, None)
     pos_spec = P(ba, seq_axis)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_ring_attention_local, axis_name=seq_axis, causal=causal),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec, pos_spec),
         out_specs=qkv_spec,
-        check_vma=False,
+        check_rep=False,
     )
     return fn(q, k, v, q_positions, kv_positions)

@@ -26,16 +26,9 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map as _shard_map
-
-    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
-        # jax>=0.8 renamed check_rep -> check_vma
-        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                          check_vma=check_rep)
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
+
+from seldon_core_tpu.parallel.compat import shard_map
 
 
 def stack_stage_params(per_stage_params: list) -> Any:

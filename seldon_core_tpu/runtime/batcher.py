@@ -442,6 +442,7 @@ class ContinuousBatcher:
         prefill = self.server._get_prefill(1, plen, self.max_len)
         logits, cache1 = prefill(self.server._params, jnp.asarray(tokens), jnp.asarray(positions))
         self._caches = self._insert(self._caches, cache1, free)
+        # graftlint: allow-host-sync-in-hot-path(admission-time sync, once per request not per token: the first sampled token must reach the host to seed slot bookkeeping before the slot joins the pipelined batch)
         first_logits = np.asarray(logits[0, L - 1]).astype(np.float32)
         # Per-request rng: an explicit seed reproduces generate(seed=...)'s
         # exact chain (PRNGKey -> split for the first token -> split per
@@ -457,6 +458,7 @@ class ContinuousBatcher:
             key, sub = jax.random.split(key)
             k = min(self.server.top_k, first_logits.shape[-1])
             topi = np.argsort(first_logits)[-k:]
+            # graftlint: allow-host-sync-in-hot-path(admission-time sample of the prefill token, once per request; generate()'s exact rng chain requires drawing it here)
             draw = int(np.asarray(jax.random.categorical(
                 sub, jnp.asarray(first_logits[topi]) / max(float(self._temp), 1e-6))))
             first = int(topi[draw])
@@ -563,6 +565,7 @@ class ContinuousBatcher:
         # covers k steps, so depth 2 at K=8 is a 16-step lag
         lag = rec.k + sum(r.k for r in self._inflight)
         t0 = time.perf_counter()
+        # graftlint: allow-host-sync-in-hot-path(the consumer's deliberate drain sync: the host reads tokens one pipeline_depth BEHIND the device, so this blocks on the oldest step only while newer steps keep the chip busy — docs/performance.md)
         arr = np.asarray(rec.tokens)  # [S, k] — the only per-step host sync
         now = time.perf_counter()
         self.server._decode_sync_times.append(now - t0)

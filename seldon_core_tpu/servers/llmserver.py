@@ -777,6 +777,7 @@ class LLMServer(SeldonComponent):
                 token_lists.append(self._tokenizer.encode(p))
                 text_mode.append(True)
             else:
+                # graftlint: allow-host-sync-in-hot-path(prompt ingress: p is caller-supplied host tokens, never a device array)
                 token_lists.append([int(t) for t in np.asarray(p).ravel()])
                 text_mode.append(False)
         if not token_lists:
@@ -860,12 +861,14 @@ class LLMServer(SeldonComponent):
                 self._params, caches, jnp.asarray(stoks), jnp.asarray(spos),
                 jnp.asarray(p0, jnp.int32),
             )
+            # graftlint: allow-host-sync-in-hot-path(generate() is the synchronous API: the first sampled token is drawn on the host once per request, before decode dispatch)
             first_logits = np.asarray(logits[:, L - 1]).astype(np.float32)
             self._prefix_store(token_lists[0], max_len, caches, first_logits)
         else:
             prefill = self._get_prefill(nb, plen, max_len)
             logits, caches = prefill(self._params, jnp.asarray(tokens), jnp.asarray(positions))
             # next-token logits live at each sequence's last real slot
+            # graftlint: allow-host-sync-in-hot-path(generate() is the synchronous API: first-token sampling happens on the host once per request)
             first_logits = np.asarray(
                 logits[jnp.arange(nb), jnp.asarray(true_len) - 1]
             ).astype(np.float32)
@@ -884,6 +887,7 @@ class LLMServer(SeldonComponent):
             rng, sub = jax.random.split(rng)
             topv = np.sort(first_logits, axis=-1)[:, -k:]
             topi = np.argsort(first_logits, axis=-1)[:, -k:]
+            # graftlint: allow-host-sync-in-hot-path(once-per-request first-token sample on generate()'s rng chain — the per-token path stays device-resident)
             draw = np.asarray(jax.random.categorical(sub, jnp.asarray(topv) / max(temp, 1e-6)))
             first_tok = topi[np.arange(nb), draw].astype(np.int32)
 
@@ -897,6 +901,7 @@ class LLMServer(SeldonComponent):
                 self._params, caches, jnp.asarray(first_tok), jnp.asarray(true_len),
                 max_new - 1, rng, jnp.asarray(temp, jnp.float32),
             )
+            # graftlint: allow-host-sync-in-hot-path(generate()'s one deliberate result sync: the whole fused decode ran device-side; callers that must not block use the pipelined batcher instead)
             toks = np.asarray(toks)  # blocks: the wall below covers device time
             self._decode_step_times.append(
                 (_time.perf_counter() - t0) / (max_new - 1)
@@ -936,6 +941,7 @@ class LLMServer(SeldonComponent):
                 seed=X.get("seed"),
             )
             return {"texts": out["texts"], "tokens": out["tokens"]}
+        # graftlint: allow-host-sync-in-hot-path(request ingress: X is the transport's host payload, never a device array)
         arr = np.atleast_2d(np.asarray(X, dtype=np.int64))
         prompts = [row[row >= 0] for row in arr]  # -1 right-padding
         out = self.generate(prompts)

@@ -448,6 +448,7 @@ class ModelExecutor:
                                set_segments=seg_hook)
         for key, arr in solo:
             try:
+                # graftlint: allow-host-sync-in-hot-path(IPC worker must materialize the result to copy it into the shared-memory ring — the sync IS the response write)
                 finish(key, np.asarray(call(arr)))
             except Exception as e:
                 fail(key, e)

@@ -1,0 +1,3 @@
+# Repo-local developer tooling (not shipped in the wheel — see
+# [tool.setuptools.packages.find] in pyproject.toml). `python -m
+# tools.graftlint` is the static-analysis entry point.

@@ -1,0 +1,27 @@
+"""graftlint — repo-native static analysis for the TPU serving tree.
+
+Five checkers encode the invariants this codebase has paid wall-clock to
+rediscover (docs/static-analysis.md has the postmortem table):
+
+* host-sync-in-hot-path — device->host syncs on the serving path (PR 3)
+* use-after-donate     — reads of buffers donated to XLA (PR 2)
+* blocking-in-async    — event-loop stalls that defeat resilience deadlines
+* jit-purity           — host side effects inside traced bodies
+* metrics-drift        — metric names that don't round-trip the registry
+
+CLI: ``python -m tools.graftlint seldon_core_tpu/`` (exit 0 = clean).
+Library: ``run_lint(paths, baseline_path=...)``.
+"""
+
+from tools.graftlint.core import (  # noqa: F401
+    Finding,
+    RULES,
+    apply_baseline,
+    load_baseline,
+    load_project,
+    run_lint,
+    save_baseline,
+)
+
+__all__ = ["Finding", "RULES", "run_lint", "load_project", "load_baseline",
+           "save_baseline", "apply_baseline"]

@@ -1,0 +1,24 @@
+"""graftlint checker registry — one module per rule.
+
+A checker is any object with a ``rule`` string and a
+``run(project) -> list[Finding]`` method; ``all_checkers()`` is the
+single place the CLI and tests enumerate them.
+"""
+
+from __future__ import annotations
+
+from tools.graftlint.checkers.hostsync import HostSyncChecker
+from tools.graftlint.checkers.donation import DonationChecker
+from tools.graftlint.checkers.asyncblock import AsyncBlockChecker
+from tools.graftlint.checkers.jitpurity import JitPurityChecker
+from tools.graftlint.checkers.metricsdrift import MetricsDriftChecker
+
+
+def all_checkers():
+    return [
+        HostSyncChecker(),
+        DonationChecker(),
+        AsyncBlockChecker(),
+        JitPurityChecker(),
+        MetricsDriftChecker(),
+    ]

@@ -1,0 +1,95 @@
+"""blocking-in-async: synchronous stalls inside ``async def``.
+
+The resilience layer (runtime/resilience.py) budgets deadlines assuming
+the event loop keeps turning: a breaker can only trip, a deadline can
+only fire, and an admission queue can only shed if the loop is alive to
+observe time passing. One ``time.sleep`` or sync ``requests`` call inside
+a coroutine freezes EVERY in-flight request on that loop for its full
+duration — deadlines are then enforced late or not at all.
+
+Flags, lexically inside an ``async def`` (a sync ``def`` nested within is
+a separate execution context and is skipped):
+
+* ``time.sleep(...)``            -> ``await asyncio.sleep(...)``
+* ``requests.*(...)``            -> aiohttp, or ``asyncio.to_thread``
+* ``socket.*(...)`` constructors/resolvers (socket, create_connection,
+  getaddrinfo, gethostbyname)    -> loop.getaddrinfo / open_connection
+* ``subprocess.*(...)``          -> ``asyncio.create_subprocess_exec``
+* ``.result()`` / ``.join()`` on concurrent futures or threads is NOT
+  flagged (receiver types are unknowable statically); the four module
+  roots above are the unambiguous offenders.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graftlint.core import Finding, Module, Project, dotted, make_finding
+
+RULE = "blocking-in-async"
+
+SOCKET_BLOCKING = {"socket", "create_connection", "getaddrinfo",
+                   "gethostbyname", "gethostbyaddr", "getfqdn"}
+
+FIXES = {
+    "time.sleep": "await asyncio.sleep(...)",
+    "requests": "aiohttp (or asyncio.to_thread for a one-off)",
+    "socket": "loop.getaddrinfo / asyncio.open_connection",
+    "subprocess": "asyncio.create_subprocess_exec/_shell",
+}
+
+
+def _blocking_reason(call: ast.Call):
+    d = dotted(call.func)
+    if d is None:
+        return None
+    if d == "time.sleep":
+        return "time.sleep", FIXES["time.sleep"]
+    root = d.split(".", 1)[0]
+    if root == "requests":
+        return d, FIXES["requests"]
+    if root == "socket" and d.split(".")[-1] in SOCKET_BLOCKING:
+        return d, FIXES["socket"]
+    if root == "subprocess":
+        return d, FIXES["subprocess"]
+    return None
+
+
+class AsyncBlockChecker:
+    rule = RULE
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in project.modules:
+            self._walk(module.tree, module, in_async=False, qualname="",
+                       findings=findings)
+        return findings
+
+    def _walk(self, node, module: Module, in_async: bool, qualname: str,
+              findings: List[Finding]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.AsyncFunctionDef):
+                q = f"{qualname}.{child.name}" if qualname else child.name
+                self._walk(child, module, True, q, findings)
+            elif isinstance(child, ast.FunctionDef):
+                # nested sync def: runs whenever it is CALLED, which may be
+                # off-loop (asyncio.to_thread) — do not flag its body
+                q = f"{qualname}.{child.name}" if qualname else child.name
+                self._walk(child, module, False, q, findings)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{qualname}.{child.name}" if qualname else child.name
+                self._walk(child, module, in_async, q, findings)
+            else:
+                if in_async and isinstance(child, ast.Call):
+                    hit = _blocking_reason(child)
+                    if hit is not None:
+                        what, fix = hit
+                        findings.append(make_finding(
+                            module, RULE, child,
+                            f"{what}() blocks the event loop inside "
+                            f"'async def' — every in-flight coroutine on "
+                            "this loop stalls and resilience deadlines "
+                            f"fire late. Use {fix}.",
+                            qualname))
+                self._walk(child, module, in_async, qualname, findings)

@@ -1,0 +1,284 @@
+"""use-after-donate: reading a buffer after XLA was told it may reuse it.
+
+The PR 2 hazard class: ``jax.jit(..., donate_argnums=...)`` lets XLA alias
+an input buffer into the output (in-place KV-cache updates at serving
+scale depend on it), but the Python name still points at the now-invalid
+buffer. Reading it afterwards is undefined — on CPU it often *works*,
+then corrupts silently on TPU where the aliasing actually fires. Every
+``donate_argnums`` site had to be hand-audited in PR 2; this checker is
+that audit, mechanized.
+
+Per-module pass 1 collects donating callables:
+
+* ``name = jax.jit(f, donate_argnums=(0, 2))`` (also ``self.attr = ...``)
+* ``@partial(jax.jit, donate_argnums=(1,))`` / ``@jax.jit(donate_argnums=…)``
+  decorated defs
+* inline ``jax.jit(f, donate_argnums=…)(args…)`` calls
+
+Per-function pass 2 is a statement-ordered walk: a plain-name (or dotted
+``self.attr``) argument at a donated position becomes DEAD at the call;
+any later read before a rebind is a finding. A rebind on the same
+statement (``caches = step(caches)`` — the threading idiom) clears the
+taint, so the canonical donate-and-rethread pattern is clean. A loop whose
+body donates a name without rebinding it is flagged at the donation site:
+iteration 2 would feed a dead buffer back into the jit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.graftlint.core import Finding, Module, Project, dotted, make_finding
+
+RULE = "use-after-donate"
+
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _donate_positions(call: ast.Call) -> Optional[FrozenSet[int]]:
+    """Donated argnums from a jax.jit(...) call node, None if not donating."""
+    if (dotted(call.func) or "") not in JIT_NAMES:
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return frozenset([v.value])
+            if isinstance(v, (ast.Tuple, ast.List)):
+                nums = set()
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                        nums.add(elt.value)
+                return frozenset(nums)
+            return frozenset()  # dynamic donate spec: positions unknown
+    return None
+
+
+def _partial_jit_donations(call: ast.Call) -> Optional[FrozenSet[int]]:
+    """Donations from ``partial(jax.jit, donate_argnums=...)``."""
+    name = dotted(call.func) or ""
+    if name not in ("partial", "functools.partial"):
+        return None
+    if not call.args or (dotted(call.args[0]) or "") not in JIT_NAMES:
+        return None
+    return _donate_positions(ast.Call(func=call.args[0], args=[],
+                                      keywords=call.keywords)) or frozenset()
+
+
+def _collect_donators(tree: ast.Module) -> Dict[str, FrozenSet[int]]:
+    """dotted-name (terminal form) -> donated positions.
+
+    Attribute targets are keyed by their terminal attr (``self._insert`` and
+    ``batcher._insert`` both hit key ``._insert``) — a heuristic, but
+    donation-site names are distinctive in practice.
+    """
+    table: Dict[str, FrozenSet[int]] = {}
+
+    def record(target: ast.AST, positions: FrozenSet[int]):
+        d = dotted(target)
+        if d is None:
+            return
+        if "." in d:
+            table["." + d.rsplit(".", 1)[1]] = positions
+        else:
+            table[d] = positions
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            value = node.value
+            pos = _donate_positions(value) if isinstance(value, ast.Call) else None
+            if pos:
+                for t in node.targets:
+                    record(t, pos)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                pos = _donate_positions(dec)
+                if pos is None:
+                    pos = _partial_jit_donations(dec)
+                if pos:
+                    table[node.name] = pos
+                    table["." + node.name] = pos
+    return table
+
+
+def _lookup(table: Dict[str, FrozenSet[int]], func: ast.AST) -> Optional[FrozenSet[int]]:
+    d = dotted(func)
+    if d is None:
+        return None
+    if d in table:
+        return table[d]
+    if "." in d:
+        return table.get("." + d.rsplit(".", 1)[1])
+    return None
+
+
+class DonationChecker:
+    rule = RULE
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in project.modules:
+            donators = _collect_donators(module.tree)
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._check_function(node, module, donators, findings)
+        return findings
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _own_nodes(stmt) -> List[ast.AST]:
+        """The expressions belonging to THIS statement — compound bodies
+        are walked by the recursion, not here (walking them early would
+        apply pre-loop state to in-loop code)."""
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.target, stmt.iter]
+        if isinstance(stmt, (ast.While, ast.If)):
+            return [stmt.test]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            nodes: List[ast.AST] = [i.context_expr for i in stmt.items]
+            nodes += [i.optional_vars for i in stmt.items if i.optional_vars]
+            return nodes
+        if isinstance(stmt, ast.Try):
+            return []
+        return [stmt]
+
+    def _check_function(self, fn, module: Module, donators, findings):
+        # dead: dotted name -> (donated_to, at_line)
+        dead: Dict[str, Tuple[str, int]] = {}
+
+        def own_walk(stmt):
+            for root in self._own_nodes(stmt):
+                yield from ast.walk(root)
+
+        def donations_in(stmt) -> List[Tuple[ast.Call, str, List[str]]]:
+            out = []
+            for node in own_walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                pos = _lookup(donators, node.func)
+                if pos is None:
+                    inline = (_donate_positions(node.func)
+                              if isinstance(node.func, ast.Call) else None)
+                    if not inline:
+                        continue
+                    pos = inline
+                names = []
+                for p in sorted(pos):
+                    if p < len(node.args):
+                        d = dotted(node.args[p])
+                        if d is not None:
+                            names.append(d)
+                if names:
+                    out.append((node, dotted(node.func) or "<jit>", names))
+            return out
+
+        def reads_in(stmt, skip_args: Set[int]) -> List[Tuple[str, ast.AST]]:
+            out = []
+            for node in own_walk(stmt):
+                if not isinstance(getattr(node, "ctx", None), ast.Load):
+                    continue  # Store/Del targets are rebinds, not reads
+                d = dotted(node)
+                if d is not None and d in dead and id(node) not in skip_args:
+                    out.append((d, node))
+            return out
+
+        def binds_in(stmt) -> List[str]:
+            targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                targets = list(stmt.targets)
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                targets = [stmt.target]
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                targets = [stmt.target]
+            out = []
+            for t in targets:
+                for node in ast.walk(t):
+                    d = dotted(node)
+                    if d is not None:
+                        out.append(d)
+            return out
+
+        def walk(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # separate scope, analyzed on its own
+                donations = donations_in(stmt)
+                donated_arg_ids: Set[int] = set()
+                for call, _, _ in donations:
+                    pos = _lookup(donators, call.func) or frozenset()
+                    for p in pos:
+                        if p < len(call.args):
+                            for sub in ast.walk(call.args[p]):
+                                donated_arg_ids.add(id(sub))
+                # 1) reads of already-dead names (the donating call's own
+                #    donated args are exempt — that's the donation itself)
+                for name, node in reads_in(stmt, donated_arg_ids):
+                    to, at = dead[name]
+                    findings.append(make_finding(
+                        module, RULE, node,
+                        f"{name!r} is read here but was donated to {to}() at "
+                        f"line {at}: donate_argnums lets XLA reuse the buffer, "
+                        "so this read is undefined once aliasing fires "
+                        "(the PR 2 use-after-donate class). Rebind the name "
+                        "from the call's output or drop the donation.",
+                        fn.name))
+                    del dead[name]  # one finding per donation event
+                # 2) new donations
+                for call, to, names in donations:
+                    for name in names:
+                        dead[name] = (to, call.lineno)
+                # 3) rebinds clear the taint
+                for name in binds_in(stmt):
+                    dead.pop(name, None)
+                # recurse
+                is_loop = isinstance(stmt, (ast.For, ast.AsyncFor, ast.While))
+                before = dict(dead)
+                for attr in ("body", "orelse", "finalbody"):
+                    inner = getattr(stmt, attr, None)
+                    if inner:
+                        walk(inner)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    walk(handler.body)
+                if is_loop:
+                    # loop-carried hazard: donated in the body, never rebound
+                    # before the next iteration reads it again
+                    for name, (to, at) in list(dead.items()):
+                        if name in before and dead[name] == before.get(name):
+                            continue  # was already dead before the loop
+                        body_src = stmt.body
+                        rebound = any(name in binds_in(s)
+                                      for s in _flat_stmts(body_src))
+                        read_again = any(
+                            name == d for s in _flat_stmts(body_src)
+                            for d in _read_names(s))
+                        if not rebound and read_again:
+                            findings.append(Finding(
+                                RULE, module.relpath, at,
+                                f"{name!r} is donated to {to}() inside this "
+                                "loop but never rebound before the next "
+                                "iteration reads it again — iteration 2 feeds "
+                                "a dead buffer back into the jit.",
+                                fn.name,
+                                module.lines[at - 1] if at <= len(module.lines) else ""))
+                            del dead[name]
+
+        def _flat_stmts(stmts):
+            for s in stmts:
+                yield s
+                for attr in ("body", "orelse", "finalbody"):
+                    inner = getattr(s, attr, None)
+                    if inner:
+                        yield from _flat_stmts(inner)
+                for handler in getattr(s, "handlers", []) or []:
+                    yield from _flat_stmts(handler.body)
+
+        def _read_names(stmt):
+            for node in ast.walk(stmt):
+                d = dotted(node)
+                if d is not None:
+                    yield d
+
+        walk(fn.body)

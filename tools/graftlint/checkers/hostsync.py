@@ -1,0 +1,291 @@
+"""host-sync-in-hot-path: device->host synchronization on the serving path.
+
+The PR 3 postmortem class: one ``np.asarray(nxt)`` inside the continuous
+batcher's decode loop serialized host and device and served 7B at 11% of
+direct decode. Any call that materializes a device value on the host
+(``np.asarray``/``np.array``/``jax.device_get``/``.block_until_ready()``,
+and ``float()/int()/bool()/.item()`` applied to device values) blocks the
+Python thread until the device stream drains — on the decode path that
+is a full pipeline stall per token.
+
+Scope: files under the hot-path packages (``runtime/``, ``servers/``,
+``ops/``, ``transport/``). Within them a finding fires when
+
+* a STRONG sync call (np.asarray / np.array / jax.device_get /
+  .block_until_ready()) appears inside a hot-named function (decode /
+  prefill / extend / generate / predict / step / drain / dispatch /
+  sample / forward / attention / transform — the serving verbs), OR its
+  argument is device-tainted anywhere in a hot-path file;
+* a WEAK sync call (float / int / bool / .item()) has a device-tainted
+  argument (these four are pervasive on host values, so the bare
+  hot-function heuristic would drown the signal).
+
+Device taint is a per-function, statement-ordered dataflow: an expression
+is device-valued when it mentions ``jnp.*``/``jax.*``/``lax.*``, calls a
+function whose name carries a device verb (jit/decode/prefill/extend/
+step/apply/scan/vmap/pmap/sample/matmul/kernel/forward), or reads a name
+previously assigned from such an expression. A top-level ``np.*`` call
+launders taint — its result already lives on the host.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from tools.graftlint.core import Finding, Module, Project, dotted, make_finding
+
+RULE = "host-sync-in-hot-path"
+
+HOT_DIRS = ("runtime", "servers", "ops", "transport")
+
+HOT_FN_RE = re.compile(
+    r"(decode|prefill|extend|generate|predict|step|drain|dispatch|sample"
+    r"|forward|attention|transform)", re.IGNORECASE)
+
+DEVICE_FN_RE = re.compile(
+    r"(jit|decode|prefill|extend|step|apply|scan|vmap|pmap|sample|matmul"
+    r"|kernel|forward)", re.IGNORECASE)
+
+# bare .decode()/.encode() are bytes/str/tokenizer methods (host), not the
+# decode-step device verb — only COMPOUND names (decode_step, _get_decode)
+# count as device producers
+HOST_METHOD_TERMINALS = {"decode", "encode"}
+
+STRONG_FUNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                "jax.device_get"}
+WEAK_BUILTINS = {"float", "int", "bool"}
+DEVICE_ROOTS = ("jnp", "jax", "lax")
+
+
+def _is_hot_file(module: Module) -> bool:
+    return any(p in HOT_DIRS for p in module.parts[:-1])
+
+
+def _terminal_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+HOST_BUILTINS = {"int", "float", "bool", "str", "len", "list", "tuple", "range"}
+
+
+def _call_root(call: ast.Call) -> str:
+    """Root module of a (possibly method-chained) call: the base of
+    ``np.asarray(x).astype(y)`` is ``np``."""
+    func = call.func
+    while isinstance(func, ast.Attribute):
+        if isinstance(func.value, ast.Call):
+            return _call_root(func.value)
+        func = func.value
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _launders(value: ast.AST) -> bool:
+    """True when ``value`` is a call whose result is a HOST value no matter
+    what went in: np.*/numpy.* (asarray pulls the device value over) and
+    the scalar builtins."""
+    if not isinstance(value, ast.Call):
+        return False
+    root = _call_root(value)
+    return root in ("np", "numpy") or root in HOST_BUILTINS
+
+
+class _Taint:
+    """Per-function device-taint state over dotted names."""
+
+    def __init__(self, tainted: Optional[Set[str]] = None):
+        self.names: Set[str] = set(tainted or ())
+
+    def expr_is_device(self, node: ast.AST) -> bool:
+        """Recursive walk that stops at laundering calls: anything beneath
+        an np.*/builtin call already got synced there, so its RESULT is a
+        host value for the purposes of the enclosing expression."""
+        if isinstance(node, ast.Call):
+            if _launders(node):
+                return False
+            name = _terminal_name(node.func)
+            if name and name not in HOST_METHOD_TERMINALS \
+                    and DEVICE_FN_RE.search(name):
+                return True
+        d = dotted(node)
+        if d is not None:
+            if d in self.names:
+                return True
+            root = d.split(".", 1)[0]
+            if root in DEVICE_ROOTS and "." in d:
+                return True
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                return False  # a clean dotted chain; no deeper structure
+        return any(self.expr_is_device(c) for c in ast.iter_child_nodes(node))
+
+    def _outermost_targets(self, t: ast.AST):
+        """Yield the dotted names an assignment target rebinds — only the
+        OUTERMOST chains (``self._rng, key = ...`` rebinds ``self._rng``
+        and ``key``, never bare ``self``)."""
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                yield from self._outermost_targets(elt)
+        elif isinstance(t, ast.Starred):
+            yield from self._outermost_targets(t.value)
+        elif isinstance(t, ast.Subscript):
+            d = dotted(t.value)
+            if d is not None:
+                yield d
+        else:
+            d = dotted(t)
+            if d is not None:
+                yield d
+
+    def assign(self, targets: List[ast.AST], value: Optional[ast.AST]):
+        device = value is not None and not _launders(value) \
+            and self.expr_is_device(value)
+        for t in targets:
+            for d in self._outermost_targets(t):
+                if device:
+                    self.names.add(d)
+                else:
+                    self.names.discard(d)
+
+
+def _own_nodes(stmt: ast.stmt):
+    """The expressions belonging to THIS statement — compound bodies are
+    handled by the block recursion, which sees the correctly-ordered taint
+    state (walking them early would apply pre-block taint to in-block
+    code and flag values laundered to host inside the block)."""
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.target, stmt.iter]
+    if isinstance(stmt, (ast.While, ast.If)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        nodes = [i.context_expr for i in stmt.items]
+        nodes += [i.optional_vars for i in stmt.items if i.optional_vars]
+        return nodes
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def _sync_calls(stmt: ast.stmt):
+    """(call, kind, subject) for every sync-inducing call in the
+    statement's OWN expressions (see _own_nodes). kind is 'strong' |
+    'weak'; subject is the expression whose deviceness matters (the
+    argument, or the receiver for methods)."""
+    for root in _own_nodes(stmt):
+        yield from _sync_calls_in(root)
+
+
+def _sync_calls_in(root: ast.AST):
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func) or ""
+        term = _terminal_name(node.func)
+        if d in STRONG_FUNCS:
+            yield node, "strong", (node.args[0] if node.args else None)
+        elif term == "block_until_ready" and isinstance(node.func, ast.Attribute):
+            yield node, "strong", node.func.value
+        elif term == "item" and isinstance(node.func, ast.Attribute) and not node.args:
+            yield node, "weak", node.func.value
+        elif isinstance(node.func, ast.Name) and node.func.id in WEAK_BUILTINS \
+                and len(node.args) == 1:
+            yield node, "weak", node.args[0]
+
+
+class HostSyncChecker:
+    rule = RULE
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in project.modules:
+            if not _is_hot_file(module):
+                continue
+            findings.extend(self._check_module(module))
+        return findings
+
+    def _check_module(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        seen = set()  # (line, kind) — one finding per sync site
+
+        def check_function(fn, qualname: str, hot_stack: bool):
+            hot = hot_stack or bool(HOT_FN_RE.search(fn.name))
+            taint = _Taint()
+            self._walk_block(fn.body, module, qualname, hot, taint,
+                             findings, seen, check_function)
+
+        for node in module.tree.body:
+            self._top_level(node, module, findings, seen, check_function, "")
+        return findings
+
+    def _top_level(self, node, module, findings, seen, check_function, prefix):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            q = f"{prefix}.{node.name}" if prefix else node.name
+            check_function(node, q, False)
+        elif isinstance(node, ast.ClassDef):
+            q = f"{prefix}.{node.name}" if prefix else node.name
+            for child in node.body:
+                self._top_level(child, module, findings, seen, check_function, q)
+
+    def _walk_block(self, stmts, module, qualname, hot, taint, findings,
+                    seen, check_function):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: inherits hotness, fresh taint scope
+                q = f"{qualname}.{stmt.name}"
+                nested_hot = hot or bool(HOT_FN_RE.search(stmt.name))
+                inner = _Taint()
+                self._walk_block(stmt.body, module, q, nested_hot, inner,
+                                 findings, seen, check_function)
+                continue
+            self._check_stmt(stmt, module, qualname, hot, taint, findings, seen)
+            # descend into compound statements with the same taint scope
+            for attr in ("body", "orelse", "finalbody"):
+                inner = getattr(stmt, attr, None)
+                if inner:
+                    self._walk_block(inner, module, qualname, hot, taint,
+                                     findings, seen, check_function)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._walk_block(handler.body, module, qualname, hot, taint,
+                                 findings, seen, check_function)
+
+    def _check_stmt(self, stmt, module, qualname, hot, taint, findings, seen):
+        # flag first (against taint state BEFORE this statement's bindings)
+        for call, kind, subject in _sync_calls(stmt):
+            device = subject is not None and taint.expr_is_device(subject)
+            fire = device or (kind == "strong" and hot)
+            if not fire:
+                continue
+            key = (call.lineno, kind, ast.dump(call.func))
+            if key in seen:
+                continue
+            seen.add(key)
+            what = dotted(call.func) or _terminal_name(call.func)
+            why = ("device-valued argument" if device
+                   else f"inside hot-path function {qualname!r}")
+            findings.append(make_finding(
+                module, RULE, call,
+                f"{what}() forces a device->host sync ({why}); on the "
+                "serving path this blocks until the device stream drains "
+                "(the PR 3 decode-loop stall class). Move it off the hot "
+                "path, keep the value device-resident, or annotate why "
+                "this sync is deliberate.",
+                qualname))
+        # then update taint from this statement's own bindings
+        if isinstance(stmt, ast.Assign):
+            taint.assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            taint.assign([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            taint.assign([stmt.target], stmt.value)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            taint.assign([stmt.target], stmt.iter)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    taint.assign([item.optional_vars], item.context_expr)

@@ -1,0 +1,167 @@
+"""jit-purity: host side effects inside traced function bodies.
+
+``jax.jit``/``lax.scan``/``vmap`` TRACE the Python function once per
+shape signature, then replay the compiled program. A side effect in the
+body (mutating a global or attribute, recording a metric, ``print``,
+reading ``time.time``) executes at trace time only — silently absent on
+every subsequent call, or worse, it captures a tracer. A metrics
+``record_*`` call in a decode body records exactly one sample per
+compile, which reads as "decode ran once" on the dashboard while the
+chip serves millions of steps.
+
+Pass 1 collects the module's traced functions: defs decorated with
+``jax.jit`` / ``partial(jax.jit, ...)`` / ``jax.vmap`` / ``jax.pmap``,
+names passed to ``jax.jit(f)`` / ``vmap(f)`` / ``pmap(f)`` /
+``shard_map(f, ...)``, and bodies handed to ``lax.scan`` /
+``lax.fori_loop`` / ``lax.while_loop`` / ``lax.map``. Pass 2 flags, in
+each traced body (nested defs included — they trace too):
+
+* ``global`` / ``nonlocal`` declarations
+* assignments to attributes (``obj.attr = ...``, ``+=`` included)
+* ``print(...)``
+* ``time.time/perf_counter/monotonic`` and ``datetime.now``
+* metric recording: calls whose terminal name starts with ``record_`` or
+  ``observe_``, or metric-object methods ``.inc()`` / ``.observe()``
+  (``.set()`` is exempt — ``x.at[i].set(v)`` is the functional-update
+  idiom, not a side effect)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from tools.graftlint.core import Finding, Module, Project, dotted, make_finding
+
+RULE = "jit-purity"
+
+TRACER_WRAPPERS = {"jax.jit", "jit", "jax.vmap", "vmap", "jax.pmap", "pmap",
+                   "shard_map", "jax.shard_map", "pjit", "jax.pjit"}
+# wrapper -> argument positions holding the traced body
+BODY_ARG_POSITIONS = {
+    "lax.scan": (0,), "jax.lax.scan": (0,),
+    "lax.map": (0,), "jax.lax.map": (0,),
+    "lax.fori_loop": (2,), "jax.lax.fori_loop": (2,),
+    "lax.while_loop": (0, 1), "jax.lax.while_loop": (0, 1),
+    "lax.cond": (1, 2), "jax.lax.cond": (1, 2),
+}
+
+TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+              "datetime.now", "datetime.datetime.now", "datetime.utcnow"}
+
+
+def _is_tracer_wrapper(func: ast.AST) -> bool:
+    d = dotted(func)
+    if d in TRACER_WRAPPERS:
+        return True
+    # partial(jax.jit, ...) used as decorator or factory
+    if isinstance(func, ast.Call):
+        name = dotted(func.func) or ""
+        if name in ("partial", "functools.partial") and func.args:
+            return (dotted(func.args[0]) or "") in TRACER_WRAPPERS
+        return name in TRACER_WRAPPERS
+    return False
+
+
+def _collect_traced(tree: ast.Module):
+    """(traced function-def nodes, traced lambda nodes)."""
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    traced: Set[int] = set()
+    traced_nodes = []
+
+    def mark(fnode: ast.AST):
+        if isinstance(fnode, ast.Lambda):
+            if id(fnode) not in traced:
+                traced.add(id(fnode))
+                traced_nodes.append(fnode)
+        else:
+            name = dotted(fnode)
+            target = defs.get(name) if name else None
+            if target is not None and id(target) not in traced:
+                traced.add(id(target))
+                traced_nodes.append(target)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_tracer_wrapper(dec):
+                    if id(node) not in traced:
+                        traced.add(id(node))
+                        traced_nodes.append(node)
+        elif isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            if name in TRACER_WRAPPERS or _is_tracer_wrapper(node.func):
+                if node.args:
+                    mark(node.args[0])
+            elif name in BODY_ARG_POSITIONS:
+                for p in BODY_ARG_POSITIONS[name]:
+                    if p < len(node.args):
+                        mark(node.args[p])
+    return traced_nodes
+
+
+class JitPurityChecker:
+    rule = RULE
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in project.modules:
+            for fnode in _collect_traced(module.tree):
+                name = getattr(fnode, "name", "<lambda>")
+                body = fnode.body if isinstance(fnode.body, list) else [
+                    ast.Expr(value=fnode.body)]
+                for stmt in body:
+                    self._check(stmt, module, name, findings)
+        return findings
+
+    def _check(self, node, module: Module, qualname: str, findings):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Global, ast.Nonlocal)):
+                kind = "global" if isinstance(sub, ast.Global) else "nonlocal"
+                findings.append(make_finding(
+                    module, RULE, sub,
+                    f"'{kind} {', '.join(sub.names)}' inside traced function "
+                    f"{qualname!r}: the mutation runs once at TRACE time, "
+                    "not per call — the compiled program never sees it.",
+                    qualname))
+            elif isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute):
+                        findings.append(make_finding(
+                            module, RULE, t,
+                            f"attribute mutation '{dotted(t) or t.attr} = ...' "
+                            f"inside traced function {qualname!r}: executes "
+                            "at trace time only (and may capture a tracer "
+                            "into host state). Return the value instead.",
+                            qualname))
+            elif isinstance(sub, ast.Call):
+                d = dotted(sub.func) or ""
+                term = sub.func.attr if isinstance(sub.func, ast.Attribute) \
+                    else (sub.func.id if isinstance(sub.func, ast.Name) else "")
+                if term == "print" or d == "print":
+                    findings.append(make_finding(
+                        module, RULE, sub,
+                        f"print() inside traced function {qualname!r}: fires "
+                        "once per compile, not per call — use jax.debug.print "
+                        "for traced values.", qualname))
+                elif d in TIME_CALLS:
+                    findings.append(make_finding(
+                        module, RULE, sub,
+                        f"{d}() inside traced function {qualname!r}: reads "
+                        "the clock at TRACE time and bakes the constant into "
+                        "the compiled program. Time on the host, around the "
+                        "call.", qualname))
+                elif term.startswith(("record_", "observe_")) or (
+                        isinstance(sub.func, ast.Attribute)
+                        and term in ("inc", "observe")):
+                    findings.append(make_finding(
+                        module, RULE, sub,
+                        f"metrics call '{d or term}()' inside traced function "
+                        f"{qualname!r}: records one sample per COMPILE, not "
+                        "per step — the series silently flatlines. Record "
+                        "from the host loop around the jit.", qualname))

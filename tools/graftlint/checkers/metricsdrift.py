@@ -1,0 +1,151 @@
+"""metrics-drift: metric names must round-trip through the registry.
+
+``metrics/registry.py`` is the single source of truth for every
+Prometheus series this system emits: dashboards
+(observability/dashboards.py), alerts, and the benchmark reports all
+join on those literal names. Drift is silent — a renamed series keeps
+serving requests while every panel that referenced the old name reads
+empty, which in production looks exactly like an outage that isn't
+happening.
+
+Three conditions, all anchored on the scanned tree's
+``metrics/registry.py`` (absent registry => the checker is inert):
+
+1. a ``Counter/Gauge/Histogram/Summary`` constructed OUTSIDE the
+   registry module — metric declarations must live in one place;
+2. a metric-name string literal (``seldon_*`` with a series-ish suffix)
+   anywhere in the tree that no registry declaration matches — a
+   dashboard/alert referencing a series that will never exist;
+3. a registry declaration whose bound attribute is never read anywhere
+   else in the tree — a series that exists but nothing ever records
+   ("declared and vice versa" from the rule card: record => declared,
+   declared => recorded).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import Finding, Module, Project, dotted, make_finding
+
+RULE = "metrics-drift"
+
+CONSTRUCTORS = {"Counter", "Gauge", "Histogram", "Summary",
+                "prometheus_client.Counter", "prometheus_client.Gauge",
+                "prometheus_client.Histogram", "prometheus_client.Summary"}
+
+REGISTRY_SUFFIX = "metrics/registry.py"
+
+# what counts as "a metric name literal" when scanning for references:
+# the seldon_ prefix plus a unit/series suffix — tight enough to skip
+# label names (seldon_deployment_id) and contextvars (seldon_deadline)
+METRIC_NAME_RE = re.compile(
+    r"^seldon_[a-z0-9_]+_(total|seconds|bytes|state|occupancy|per_step"
+    r"|in_flight|inflight|steps|step|depth)$")
+
+
+def _find_registry(project: Project) -> Optional[Module]:
+    for m in project.modules:
+        if m.relpath.replace("\\", "/").endswith(REGISTRY_SUFFIX):
+            return m
+    return None
+
+
+def _constructor_calls(tree: ast.Module):
+    """(call, name_literal_or_None, assigned_attr_or_name_or_None)."""
+    out = []
+    assigned_by_call: Dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            for t in node.targets:
+                d = dotted(t)
+                if d is not None:
+                    assigned_by_call[id(node.value)] = d.rsplit(".", 1)[-1]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and (dotted(node.func) or "") in CONSTRUCTORS:
+            name = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                name = node.args[0].value
+            out.append((node, name, assigned_by_call.get(id(node))))
+    return out
+
+
+class MetricsDriftChecker:
+    rule = RULE
+
+    def run(self, project: Project) -> List[Finding]:
+        registry = _find_registry(project)
+        if registry is None:
+            return []
+        findings: List[Finding] = []
+
+        declared: Set[str] = set()
+        # attr/name a declaration is bound to -> (metric name, decl node)
+        bindings: List[Tuple[str, str, ast.AST]] = []
+        for call, name, bound in _constructor_calls(registry.tree):
+            if name is not None:
+                declared.add(name)
+            if bound is not None:
+                bindings.append((bound, name or "<dynamic>", call))
+
+        for module in project.modules:
+            is_registry = module is registry
+            # 1. constructors outside the registry
+            ctor_name_args = set()
+            if not is_registry:
+                for call, name, _ in _constructor_calls(module.tree):
+                    label = f" {name!r}" if name else ""
+                    if call.args:
+                        ctor_name_args.add(id(call.args[0]))
+                    findings.append(make_finding(
+                        module, RULE, call,
+                        f"Prometheus metric{label} constructed outside "
+                        f"{registry.relpath} — declare it in the registry so "
+                        "dashboards/alerts have one source of truth.",
+                        self._enclosing(module, call)))
+            # 2. metric-name literals that match nothing declared (a
+            # constructor's own name arg is already covered by finding 1)
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                        and METRIC_NAME_RE.match(node.value) \
+                        and node.value not in declared \
+                        and id(node) not in ctor_name_args \
+                        and not is_registry:
+                    findings.append(make_finding(
+                        module, RULE, node,
+                        f"metric name {node.value!r} is referenced here but "
+                        f"declared nowhere in {registry.relpath} — the series "
+                        "will never exist and every panel joining on it reads "
+                        "empty.", self._enclosing(module, node)))
+
+        # 3. declared but never recorded: the bound attr/name must be READ
+        #    (not just assigned) somewhere in the tree
+        used: Set[str] = set()
+        for module in project.modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+                    used.add(node.attr)
+                elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                    used.add(node.id)
+        for bound, name, call in bindings:
+            if bound not in used:
+                findings.append(make_finding(
+                    registry, RULE, call,
+                    f"metric {name!r} is declared (bound to {bound!r}) but "
+                    "that binding is never read anywhere in the tree — a "
+                    "series that exists and flatlines forever. Record it or delete "
+                    "the declaration.", "MetricsRegistry"))
+        return findings
+
+    @staticmethod
+    def _enclosing(module: Module, node: ast.AST) -> str:
+        line = getattr(node, "lineno", 0)
+        best = ""
+        for n in ast.walk(module.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.lineno <= line <= (n.end_lineno or n.lineno):
+                best = n.name
+        return best

@@ -1,0 +1,341 @@
+"""graftlint core: findings, suppressions, baseline, and the runner.
+
+The linter is stdlib-only (ast + json + re) on purpose: it runs in CI
+before any heavy import and must never need jax/numpy to parse the tree.
+
+Suppression syntax (docs/static-analysis.md):
+
+    x = np.asarray(toks)  # graftlint: allow-host-sync-in-hot-path(drain sync: the one deliberate per-step read)
+
+The comment may sit on the finding line or on the line directly above it
+(for lines too long to carry the comment). The reason inside the parens
+is MANDATORY — an empty reason is itself a finding (``bad-suppression``),
+so suppressions stay auditable.
+
+Baseline (``tools/graftlint/baseline.json``): grandfathered findings keyed
+by a content fingerprint (rule | path | enclosing function | normalized
+source line) so entries survive unrelated line drift but die with the code
+they describe. Regenerate with ``--update-baseline`` (each entry's
+``reason`` must then be filled in by hand — the CLI refuses a baseline
+with empty reasons).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+RULES = (
+    "host-sync-in-hot-path",
+    "use-after-donate",
+    "blocking-in-async",
+    "jit-purity",
+    "metrics-drift",
+)
+
+# internal rules that cannot be suppressed or baselined
+META_RULES = ("bad-suppression", "parse-error")
+
+SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*allow-([a-z0-9-]+)\(([^)]*)\)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # relative, forward slashes
+    line: int
+    message: str
+    function: str = ""  # enclosing function qualname ("" at module level)
+    snippet: str = ""
+
+    def fingerprint(self) -> str:
+        norm = " ".join(self.snippet.split())
+        key = f"{self.rule}|{self.path}|{self.function}|{norm}"
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        fn = f" [{self.function}]" if self.function else ""
+        return f"{loc}: {self.rule}{fn}: {self.message}\n    {self.snippet.strip()}"
+
+
+@dataclass
+class Module:
+    """One parsed source file plus its suppression table."""
+
+    path: str  # absolute
+    relpath: str  # as reported in findings / baseline
+    source: str
+    tree: ast.Module
+    lines: List[str]
+    # line -> [(rule, reason)] — covers the comment's own line and the next
+    suppressions: Dict[int, List[Tuple[str, str]]] = field(default_factory=dict)
+
+    @property
+    def parts(self) -> Tuple[str, ...]:
+        return tuple(self.relpath.replace("\\", "/").split("/"))
+
+
+@dataclass
+class Project:
+    modules: List[Module]
+    errors: List[Finding]  # parse-error / bad-suppression findings
+
+
+def _parse_suppressions(lines: Sequence[str], relpath: str):
+    table: Dict[int, List[Tuple[str, str]]] = {}
+    bad: List[Finding] = []
+    for i, text in enumerate(lines, start=1):
+        for m in SUPPRESS_RE.finditer(text):
+            rule, reason = m.group(1), m.group(2).strip()
+            if rule not in RULES:
+                bad.append(Finding(
+                    "bad-suppression", relpath, i,
+                    f"unknown rule {rule!r} in graftlint suppression "
+                    f"(known: {', '.join(RULES)})",
+                    snippet=text))
+                continue
+            if not reason:
+                bad.append(Finding(
+                    "bad-suppression", relpath, i,
+                    f"suppression for {rule!r} has no reason — the reason "
+                    "inside allow-<rule>(...) is mandatory",
+                    snippet=text))
+                continue
+            # a suppression covers its own line, and — when the comment
+            # stands alone — the first following non-comment line
+            table.setdefault(i, []).append((rule, reason))
+            if text.split("#", 1)[0].strip() == "":
+                j = i + 1
+                while j <= len(lines) and lines[j - 1].strip().startswith("#"):
+                    j += 1
+                table.setdefault(j, []).append((rule, reason))
+    return table, bad
+
+
+def load_project(paths: Sequence[str]) -> Project:
+    """Parse every ``*.py`` under the given files/directories.
+
+    relpath convention: files under a directory root are reported relative
+    to the root's PARENT (so scanning ``seldon_core_tpu/`` yields
+    ``seldon_core_tpu/runtime/batcher.py``) — this keeps baselines portable
+    between checkouts and fixture trees.
+    """
+    modules: List[Module] = []
+    errors: List[Finding] = []
+    for root in paths:
+        root = os.path.abspath(root)
+        if os.path.isfile(root):
+            # a bare basename would lose the package path — hot-dir scoping
+            # and baseline fingerprints both key on it — so single files are
+            # reported relative to the cwd (the repo root in CI and normal
+            # dev use), falling back to the basename only for outside files
+            cwd = os.getcwd()
+            if root.startswith(cwd + os.sep):
+                rel = os.path.relpath(root, cwd).replace(os.sep, "/")
+            else:
+                rel = os.path.basename(root)
+            file_list = [(root, rel)]
+        else:
+            base = os.path.dirname(root)
+            file_list = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git", ".venv", "node_modules"))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        full = os.path.join(dirpath, fn)
+                        file_list.append(
+                            (full, os.path.relpath(full, base).replace(os.sep, "/")))
+        for full, rel in file_list:
+            try:
+                with open(full, "r", encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=rel)
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                errors.append(Finding("parse-error", rel, getattr(e, "lineno", 0) or 0,
+                                      f"could not parse: {e}"))
+                continue
+            lines = source.splitlines()
+            supp, bad = _parse_suppressions(lines, rel)
+            errors.extend(bad)
+            modules.append(Module(full, rel, source, tree, lines, supp))
+    return Project(modules, errors)
+
+
+# ----------------------------------------------------------------------
+# shared AST helpers used by several checkers
+# ----------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_functions(tree: ast.Module):
+    """Yield (qualname, node) for every function/async function, nested
+    included (qualname is dotted through enclosing defs/classes)."""
+    out = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out.append((q, child))
+                walk(child, q)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                walk(child, q)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def snippet_at(module: Module, line: int) -> str:
+    if 1 <= line <= len(module.lines):
+        return module.lines[line - 1]
+    return ""
+
+
+def make_finding(module: Module, rule: str, node: ast.AST, message: str,
+                 function: str = "") -> Finding:
+    line = getattr(node, "lineno", 0) or 0
+    return Finding(rule, module.relpath, line, message, function,
+                   snippet_at(module, line))
+
+
+# ----------------------------------------------------------------------
+# baseline
+# ----------------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry. Raises ValueError on malformed/reason-less
+    entries so a hand-edited baseline can't silently disable itself."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("entries", [])
+    table: Dict[str, dict] = {}
+    for e in entries:
+        fp = e.get("fingerprint")
+        if not fp or not isinstance(fp, str):
+            raise ValueError(f"baseline entry missing fingerprint: {e!r}")
+        if not str(e.get("reason", "")).strip():
+            raise ValueError(
+                f"baseline entry {fp} has no reason — every grandfathered "
+                "finding must say why it is allowed")
+        e.setdefault("count", 1)
+        table[fp] = e
+    return table
+
+
+def save_baseline(path: str, findings: Sequence[Finding],
+                  keep_reasons: Optional[Dict[str, dict]] = None) -> None:
+    """Write ``findings`` as the new baseline. ``keep_reasons`` (an existing
+    baseline table from load_baseline) preserves the hand-written reason of
+    any entry whose fingerprint is still live — regeneration must never
+    erase the audit trail."""
+    keep_reasons = keep_reasons or {}
+    counts: Dict[str, dict] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in counts:
+            counts[fp]["count"] += 1
+        else:
+            counts[fp] = {
+                "fingerprint": fp,
+                "rule": f.rule,
+                "path": f.path,
+                "function": f.function,
+                "snippet": " ".join(f.snippet.split()),
+                "count": 1,
+                "reason": keep_reasons.get(fp, {}).get(
+                    "reason", "TODO: justify or fix before committing"),
+            }
+    payload = {
+        "_comment": "graftlint grandfathered findings — see docs/static-analysis.md. "
+                    "Entries die with the code they fingerprint; never add one "
+                    "without a reason.",
+        "entries": sorted(counts.values(), key=lambda e: (e["path"], e["rule"], e["snippet"])),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Dict[str, dict]):
+    """Split findings into (reported, absorbed). Each baseline entry absorbs
+    at most ``count`` matching findings — a site that multiplies beyond its
+    grandfathered count resurfaces."""
+    budget = {fp: e.get("count", 1) for fp, e in baseline.items()}
+    reported: List[Finding] = []
+    absorbed: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            absorbed.append(f)
+        else:
+            reported.append(f)
+    return reported, absorbed
+
+
+# ----------------------------------------------------------------------
+# runner
+# ----------------------------------------------------------------------
+
+def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
+             rules: Optional[Sequence[str]] = None):
+    """Run all (or the selected) checkers.
+
+    Returns (reported, absorbed, suppressed) finding lists. ``reported``
+    non-empty => the tree fails the gate. Suppressions never apply to the
+    meta rules (bad-suppression / parse-error).
+    """
+    from tools.graftlint.checkers import all_checkers
+
+    project = load_project(paths)
+    findings: List[Finding] = list(project.errors)
+    active = set(rules or RULES)
+    unknown = active - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+    for checker in all_checkers():
+        if checker.rule in active:
+            findings.extend(checker.run(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    by_module = {m.relpath: m for m in project.modules}
+    suppressed: List[Finding] = []
+    surviving: List[Finding] = []
+    for f in findings:
+        mod = by_module.get(f.path)
+        if f.rule in RULES and mod is not None:
+            rules_here = [r for r, _ in mod.suppressions.get(f.line, [])]
+            if f.rule in rules_here:
+                suppressed.append(f)
+                continue
+        surviving.append(f)
+
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    # meta findings are never baselined
+    base_eligible = [f for f in surviving if f.rule in RULES]
+    meta = [f for f in surviving if f.rule not in RULES]
+    reported, absorbed = apply_baseline(base_eligible, baseline)
+    reported = meta + reported
+    reported.sort(key=lambda f: (f.path, f.line, f.rule))
+    return reported, absorbed, suppressed
