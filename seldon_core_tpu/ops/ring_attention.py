@@ -77,6 +77,10 @@ def _ring_attention_local(q, k, v, q_pos, kv_pos, axis_name: Optional[str], caus
     else:
         n = axis_size(axis_name)
         perm = [(i, (i + 1) % n) for i in range(n)]
+        # exactly 3 rotating buffers (k, v, kv positions) => exactly 3
+        # collective-permutes in the compiled loop body — a CI-enforced
+        # budget (tools/hlolint ops.ring_attention_seq8); a new rotating
+        # carry must update that contract alongside this code
 
         def step(i, carry):
             k_blk, v_blk, kvp, m, l, acc = carry

@@ -11,6 +11,10 @@ imports from HERE so the resolution happens exactly once:
 The shim keeps the OLD keyword name (``check_rep``) as its public surface
 — the tree predates the rename — and translates when running on a JAX
 that wants ``check_vma``.
+
+Routing through this module is ENFORCED: graftlint's ``compat-drift``
+rule flags any direct ``jax.shard_map`` / ``jax.experimental.shard_map``
+/ ``jax.lax.axis_size`` use outside this file (docs/static-analysis.md).
 """
 
 from __future__ import annotations

@@ -274,7 +274,12 @@ class ContinuousBatcher:
         # donate the big slot cache through both mutating jits (insert and
         # the decode step): self._caches is reassigned from the output each
         # time, so XLA aliases the buffers and updates in place instead of
-        # copying S x max_len of KV per call
+        # copying S x max_len of KV per call. These donations are verified
+        # at the COMPILED level (input_output_alias) by the batcher.insert /
+        # batcher.set_slot / llm.decode_step_s4 contracts in tools/hlolint —
+        # a cache-structure change that silently breaks the aliasing fails
+        # CI, not a 7B perf round. (small is NOT donated: its 1-slot buffers
+        # can alias no output, XLA would just drop it.)
         @partial(jax.jit, donate_argnums=(0,))
         def insert(big, small, slot):
             return jax.tree.map(lambda b, s: b.at[slot].set(s[0]), big, small)

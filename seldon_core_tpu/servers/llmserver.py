@@ -707,7 +707,11 @@ class LLMServer(SeldonComponent):
         Per-slot sampling reproduces generate()'s chain exactly (split then
         top-k categorical per step, one key per sequence), so a slot seeded
         like a generate() request emits identical tokens — the parity bar in
-        tests/test_batcher_pipeline.py."""
+        tests/test_batcher_pipeline.py. The donation/transfer/dtype shape of
+        the COMPILED step is pinned by the llm.decode_step_s4 contract in
+        tools/hlolint (docs/static-analysis.md): changing the carry
+        structure here must keep every donated leaf aliasable or CI goes
+        red on the dropped donation."""
         key = ("pipestep", slots, max_len, k)
         fn = self._decode_cache.get(key)
         if fn is not None:

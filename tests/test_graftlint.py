@@ -459,6 +459,92 @@ def test_metricsdrift_inert_without_registry(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# compat-drift
+# ---------------------------------------------------------------------------
+
+def test_compatdrift_fires_on_direct_shard_map(tmp_path):
+    """The PR 4 version-drift class: every direct route to shard_map —
+    old experimental path, promoted path, from-import — must fire."""
+    root = write_tree(tmp_path / "pkg", {"ops/ring.py": """
+        import jax
+        from jax.experimental.shard_map import shard_map as old_sm
+
+        def a(f, mesh, specs):
+            return old_sm(f, mesh=mesh, in_specs=specs, out_specs=specs)
+
+        def b(f, mesh, specs):
+            return jax.shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)
+
+        def c(f, mesh, specs):
+            return jax.experimental.shard_map.shard_map(
+                f, mesh=mesh, in_specs=specs, out_specs=specs)
+    """})
+    reported, _, _ = lint(root)
+    cd = [f for f in reported if f.rule == "compat-drift"]
+    assert len(cd) >= 3, "\n".join(f.render() for f in reported)
+    assert all("compat" in f.message for f in cd)
+
+
+def test_compatdrift_fires_on_axis_size(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"parallel/pipeline.py": """
+        import jax
+        from jax import lax
+
+        def stage_count():
+            return jax.lax.axis_size("stages")
+
+        def stage_count2():
+            return lax.axis_size("stages")
+    """})
+    reported, _, _ = lint(root)
+    cd = [f for f in reported if f.rule == "compat-drift"]
+    assert len(cd) == 2
+    assert all("axis_size" in f.message for f in cd)
+
+
+def test_compatdrift_shim_file_is_exempt(tmp_path):
+    """parallel/compat.py IS the one place allowed to touch the raw APIs."""
+    root = write_tree(tmp_path / "pkg", {"parallel/compat.py": """
+        try:
+            from jax import shard_map as _impl
+        except ImportError:
+            from jax.experimental.shard_map import shard_map as _impl
+
+        def axis_size(name):
+            import jax
+            impl = getattr(jax.lax, "axis_size", None)
+            return impl(name) if impl is not None else jax.lax.psum(1, name)
+    """})
+    reported, _, _ = lint(root)
+    assert not [f for f in reported if f.rule == "compat-drift"], \
+        "\n".join(f.render() for f in reported)
+
+
+def test_compatdrift_compat_imports_are_clean(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"ops/ring.py": """
+        from seldon_core_tpu.parallel.compat import axis_size, shard_map
+
+        def a(f, mesh, specs):
+            return shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)
+    """})
+    reported, _, _ = lint(root)
+    assert not [f for f in reported if f.rule == "compat-drift"]
+
+
+def test_compatdrift_suppressed_with_reason(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"ops/ring.py": """
+        import jax
+
+        def a(f, mesh, specs):
+            # graftlint: allow-compat-drift(version-probe test fixture, exercises the raw API deliberately)
+            return jax.shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)
+    """})
+    reported, _, suppressed = lint(root)
+    assert not [f for f in reported if f.rule == "compat-drift"]
+    assert any(f.rule == "compat-drift" for f in suppressed)
+
+
+# ---------------------------------------------------------------------------
 # CLI, baseline mechanics, and the enforcement acceptance criteria
 # ---------------------------------------------------------------------------
 
@@ -585,6 +671,19 @@ def test_real_baseline_reasons_are_filled_in():
     data = json.loads(open(BASELINE).read())
     for e in data["entries"]:
         assert e["reason"].strip() and "TODO" not in e["reason"], e
+
+
+def test_real_baseline_count_only_decreases():
+    """Ratchet: the grandfathered-finding count may only go DOWN. PR 4
+    shipped 9 entries; the PR 5 burn-down moved the TensorProto wire codec
+    out of the servers/ hot dir (5 entries died with the code) and
+    inlined the two tfproxy ingress/egress suppressions, leaving the two
+    host-side MLflow sites. Raising this bound requires deleting this
+    comment and justifying the growth in review — which is the point."""
+    data = json.loads(open(BASELINE).read())
+    assert len(data["entries"]) <= 2, (
+        "graftlint baseline grew — fix the finding or suppress it inline "
+        "with a reason instead of grandfathering it")
 
 
 # ---------------------------------------------------------------------------

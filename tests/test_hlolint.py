@@ -1,0 +1,411 @@
+"""hlolint self-tests: every contract kind proven to go RED on a mutated
+fixture (drop a donation, insert a host callback, widen a KV dtype,
+inflate a budget, add a collective), plus the waiver/baseline mechanics
+and the CLI the CI gate relies on.
+
+Fixtures are tiny synthetic jits — no model load — so everything here is
+tier-1 except the full-registry run (marked slow; CI runs the real gate
+as its own step anyway)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from functools import partial
+
+import numpy as np
+import pytest
+
+from tools.hlolint.core import (
+    Contract,
+    apply_baseline,
+    collective_counts_from_text,
+    load_baseline,
+    opcode_counts_from_text,
+    run_contracts,
+    save_budgets,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGETS = os.path.join(REPO, "tools", "hlolint", "budgets.json")
+
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+def run_one(contract, **kw):
+    reported, absorbed, waived, diff, measured = run_contracts([contract], **kw)
+    return reported, absorbed, waived, diff, measured
+
+
+def checks_of(findings):
+    return [f.check for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# alias: donation must survive into input_output_alias
+# ---------------------------------------------------------------------------
+
+def _build_donating(donate: bool):
+    def build():
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,) if donate else ())
+        def step(cache, tok):
+            return cache.at[0].set(tok), tok + 1
+
+        return step, (_sds((4, 8), "float32"), _sds((8,), "float32"))
+
+    return build
+
+
+def test_alias_dropped_donation_fires():
+    """Mutation: remove donate_argnums from the decode step — the alias
+    contract must go red."""
+    c = Contract("fix.alias", "t", _build_donating(donate=False), donated=(0,))
+    reported, *_ = run_one(c)
+    assert checks_of(reported) == ["alias"]
+    assert "input_output_alias" in reported[0].message
+
+
+def test_alias_live_donation_is_clean():
+    c = Contract("fix.alias", "t", _build_donating(donate=True), donated=(0,))
+    reported, *_ = run_one(c)
+    assert reported == []
+
+
+def test_alias_degraded_donation_fires():
+    """The reason this check reads COMPILED HLO instead of the source: the
+    jit below DOES declare donate_argnums=(0, 1), but arg 0's buffer can
+    alias no output (shape mismatch), so XLA silently drops it — an AST
+    walk sees a donation, the compiled module shows a copy."""
+
+    def build():
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(small, big):
+            return big.at[0].set(small)
+
+        return step, (_sds((8,), "float32"), _sds((4, 8), "float32"))
+
+    c = Contract("fix.alias2", "t", build, donated=(0, 1))
+    reported, *_ = run_one(c)
+    assert checks_of(reported) == ["alias"]
+    assert reported[0].detail == "arg0"  # the big buffer's donation held
+
+
+# ---------------------------------------------------------------------------
+# transfer: no host round-trips inside the compiled hot function
+# ---------------------------------------------------------------------------
+
+def test_transfer_host_callback_fires():
+    """Mutation: a jax.debug.print-style host callback inside the step."""
+
+    def build():
+        import jax
+
+        @jax.jit
+        def step(x):
+            jax.debug.print("x={x}", x=x)
+            return x * 2
+
+        return step, (_sds((4,), "float32"),)
+
+    c = Contract("fix.transfer", "t", build)
+    reported, *_ = run_one(c)
+    assert "transfer" in checks_of(reported)
+    assert any("callback" in f.message for f in reported)
+
+
+def test_opcode_parsing_sees_tuple_typed_instructions():
+    """send/recv/infeed are ALWAYS tuple-typed in HLO text, and the
+    all-reduce combiner can merge same-shape collectives into one
+    tuple-shaped op — the instruction parser must not be blind to either
+    (review regression: a single-shape-only regex silently passed every
+    send/recv)."""
+    hlo = "\n".join([
+        "  %s = (f32[], u32[], token[]) send(f32[] %x, token[] %t), channel_id=1",
+        "  %r = (f32[4]{0}, token[]) recv(token[] %t), channel_id=2",
+        "  %i = (f32[2]{0}, token[]) infeed(token[] %t)",
+        "  %ar = (f32[4]{0}, f32[4]{0}) all-reduce(f32[4]{0} %a, f32[4]{0} %b), to_apply=%add",
+        "  ROOT %d = f32[4]{0} dot(f32[4]{0} %a, f32[4]{0} %b)",
+    ])
+    counts = opcode_counts_from_text(hlo)
+    assert counts == {"send": 1, "recv": 1, "infeed": 1, "all-reduce": 1,
+                      "dot": 1}
+    assert collective_counts_from_text(hlo) == {"all-reduce": 1}
+
+
+def test_transfer_pure_step_is_clean():
+    def build():
+        import jax
+
+        return jax.jit(lambda x: x * 2), (_sds((4,), "float32"),)
+
+    reported, *_ = run_one(Contract("fix.transfer", "t", build))
+    assert reported == []
+
+
+# ---------------------------------------------------------------------------
+# dtype: forbidden signatures + output dtypes
+# ---------------------------------------------------------------------------
+
+def _build_kv_read(widen: bool):
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def read(cache, q):
+            kv = cache.astype(jnp.float32) if widen else cache
+            return jnp.einsum("ld,d->l", kv, q.astype(kv.dtype))
+
+        return read, (_sds((64, 16), "bfloat16"), _sds((16,), "bfloat16"))
+
+    return build
+
+
+KV_F32 = (r"tensor<64x16xf32>", "full-cache f32 materialization")
+
+
+def test_dtype_widened_kv_fires():
+    """Mutation: upcast the whole KV buffer to f32 before the read."""
+    c = Contract("fix.dtype", "t", _build_kv_read(widen=True),
+                 forbid_dtypes=(KV_F32,))
+    reported, *_ = run_one(c)
+    assert checks_of(reported) == ["dtype"]
+    assert "forbidden dtype" in reported[0].message
+
+
+def test_dtype_native_kv_read_is_clean():
+    c = Contract("fix.dtype", "t", _build_kv_read(widen=False),
+                 forbid_dtypes=(KV_F32,))
+    reported, *_ = run_one(c)
+    assert reported == []
+
+
+def test_dtype_widened_output_fires():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        # mutation: the final cast back to the model dtype was dropped
+        return jax.jit(lambda x: (x.astype(jnp.float32) * 2.0)), (
+            _sds((4, 8), "bfloat16"),)
+
+    c = Contract("fix.outdtype", "t", build, out_dtypes=((0, "bf16"),))
+    reported, *_ = run_one(c)
+    assert checks_of(reported) == ["dtype"]
+    assert "output 0 is f32" in reported[0].message
+
+
+# ---------------------------------------------------------------------------
+# collective: exact count-per-kind budget
+# ---------------------------------------------------------------------------
+
+def _build_permute():
+    def build():
+        import jax
+        import numpy as _np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from seldon_core_tpu.parallel.compat import shard_map
+
+        mesh = Mesh(_np.array(jax.devices()[:8]), ("x",))
+        perm = [(i, (i + 1) % 8) for i in range(8)]
+        fn = shard_map(lambda a: jax.lax.ppermute(a, "x", perm),
+                       mesh=mesh, in_specs=(P("x"),), out_specs=P("x"))
+        return jax.jit(fn), (_sds((8, 4), "float32"),)
+
+    return build
+
+
+def test_collective_unbudgeted_fires(eight_devices):
+    """Mutation: a permute appears where the contract budgets none — the
+    'stray reshard in the decode step' class."""
+    c = Contract("fix.coll", "t", _build_permute(), collectives={})
+    reported, *_ = run_one(c)
+    assert checks_of(reported) == ["collective"]
+    assert "collective-permute" in reported[0].detail
+
+
+def test_collective_exact_budget_is_clean(eight_devices):
+    c = Contract("fix.coll", "t", _build_permute(),
+                 collectives={"collective-permute": 1})
+    reported, *_ = run_one(c)
+    assert reported == []
+
+
+def test_collective_missing_also_fires(eight_devices):
+    """The budget is exact in both directions: a vanished collective means
+    the compiled program is not the one the contract describes."""
+
+    def build():
+        import jax
+
+        return jax.jit(lambda x: x + 1), (_sds((8, 4), "float32"),)
+
+    c = Contract("fix.coll", "t", build,
+                 collectives={"collective-permute": 1})
+    reported, *_ = run_one(c)
+    assert checks_of(reported) == ["collective"]
+    assert "missing" in reported[0].message
+
+
+# ---------------------------------------------------------------------------
+# cost: tolerance band around the committed budget
+# ---------------------------------------------------------------------------
+
+def _cost_contract():
+    def build():
+        import jax
+
+        return jax.jit(lambda a, b: a @ b), (
+            _sds((32, 32), "float32"), _sds((32, 32), "float32"))
+
+    return Contract("fix.cost", "t", build, cost=True)
+
+
+def test_cost_missing_budget_fires():
+    reported, *_ = run_one(_cost_contract(), budgets={"entries": {}})
+    assert checks_of(reported) == ["cost"]
+    assert reported[0].detail == "missing-budget"
+
+
+def test_cost_inflated_budget_fires_then_rebaseline_clears(tmp_path):
+    """Mutation: the compiled cost drifts far past the committed budget ->
+    red; --update-budgets writes the measured snapshot -> green."""
+    budgets = {"tolerance": 0.2,
+               "entries": {"fix.cost": {"flops": 1.0, "bytes_accessed": 1.0}}}
+    reported, _, _, diff, measured = run_one(_cost_contract(), budgets=budgets)
+    assert sorted(f.detail for f in reported) == ["bytes_accessed", "flops"]
+    assert "fix.cost" in diff and diff["fix.cost"]["flops"]["budget"] == 1.0
+
+    path = str(tmp_path / "budgets.json")
+    save_budgets(path, measured, previous=budgets)
+    rebased = json.loads(open(path).read())
+    assert rebased["tolerance"] == 0.2  # survives re-baseline
+    reported2, *_ = run_one(_cost_contract(), budgets=rebased)
+    assert reported2 == []
+
+
+# ---------------------------------------------------------------------------
+# waiver + baseline mechanics
+# ---------------------------------------------------------------------------
+
+def test_waiver_with_reason_suppresses_and_empty_reason_fires():
+    c = Contract("fix.alias", "t", _build_donating(donate=False), donated=(0,),
+                 waivers={"alias:arg0": "known CPU-only fixture"})
+    reported, _, waived, *_ = run_one(c)
+    assert reported == [] and len(waived) == 1
+
+    c2 = Contract("fix.alias", "t", _build_donating(donate=False), donated=(0,),
+                  waivers={"alias:arg0": "   "})
+    reported2, *_ = run_one(c2)
+    assert "bad-waiver" in checks_of(reported2)
+    assert "alias" in checks_of(reported2)  # empty reason does NOT suppress
+
+
+def test_baseline_absorbs_by_fingerprint_and_dies_with_the_detail():
+    c = Contract("fix.alias", "t", _build_donating(donate=False), donated=(0,))
+    reported, *_ = run_one(c)
+    fp = reported[0].fingerprint()
+    baseline = {fp: {"fingerprint": fp, "reason": "grandfathered", "count": 1}}
+    reported2, absorbed, *_ = run_one(c, baseline=baseline)
+    assert reported2 == [] and len(absorbed) == 1
+    # a different detail (another contract name) must NOT be absorbed
+    c3 = Contract("fix.alias_v2", "t", _build_donating(donate=False), donated=(0,))
+    reported3, absorbed3, *_ = run_one(c3, baseline=baseline)
+    assert len(reported3) == 1 and absorbed3 == []
+
+
+def test_baseline_without_reason_is_rejected(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"entries": [{"fingerprint": "abc", "reason": ""}]}))
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(str(p))
+
+
+def test_build_error_is_a_finding_not_a_crash():
+    def build():
+        raise RuntimeError("model too big for this host")
+
+    reported, *_ = run_one(Contract("fix.broken", "t", build))
+    assert checks_of(reported) == ["build-error"]
+    # meta findings can never be baselined away
+    fp = reported[0].fingerprint()
+    still, absorbed = apply_baseline(
+        reported, {fp: {"fingerprint": fp, "reason": "nope", "count": 1}})
+    assert len(still) == 1 and absorbed == []
+
+
+# ---------------------------------------------------------------------------
+# the committed registry artifacts + CLI
+# ---------------------------------------------------------------------------
+
+def test_budgets_json_covers_every_cost_contract():
+    from tools.hlolint.contracts import all_contracts
+
+    budgets = json.loads(open(BUDGETS).read())
+    entries = budgets.get("entries", {})
+    for c in all_contracts():
+        if c.cost:
+            assert c.name in entries, (
+                f"{c.name} has cost=True but no committed budget — run "
+                "--update-budgets and commit the reviewed snapshot")
+            assert entries[c.name].get("flops", 0) > 0
+
+
+def test_registry_waivers_all_carry_reasons():
+    from tools.hlolint.contracts import all_contracts
+
+    for c in all_contracts():
+        for key, reason in c.waivers.items():
+            assert str(reason).strip(), f"{c.name} waiver {key!r} has no reason"
+
+
+def cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.hlolint", *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_list_and_usage_errors():
+    res = cli("--list")
+    assert res.returncode == 0
+    assert "llm.decode_step_s4" in res.stdout
+    assert cli("--contracts", "no.such.contract").returncode == 2
+    assert cli("--checks", "no-such-check").returncode == 2
+    assert cli("no/such/path").returncode == 2
+
+
+def test_cli_single_cheap_contract_enforcing():
+    """The fused_norm contract end-to-end through the CLI (no model load:
+    this is the fast smoke of the real gate; CI runs the full registry)."""
+    res = cli("--contracts", "ops.fused_norm", "--format", "json")
+    assert res.returncode == 0, res.stdout + res.stderr
+    payload = json.loads(res.stdout)
+    assert payload["findings"] == []
+    assert "ops.fused_norm" in payload["budget_diff"]
+
+
+@pytest.mark.slow
+def test_full_registry_is_green():
+    """The CI gate, in-process: every committed contract holds on the real
+    tree with the committed budgets."""
+    from tools.hlolint.contracts import all_contracts
+    from tools.hlolint.core import load_budgets
+
+    reported, absorbed, waived, diff, _ = run_contracts(
+        all_contracts(), budgets=load_budgets(BUDGETS))
+    assert reported == [], "\n".join(f.render() for f in reported)
+    # the enforcement is real: the registry carries a reasoned waiver
+    # (the TP sampling all-gathers) that absorbs an actual finding
+    assert waived, "expected the decode_scan_tp2 all-gather waiver to fire"

@@ -6,11 +6,37 @@ import numpy as np
 from seldon_core_tpu.analytics import Seq2SeqOutlierDetector
 
 
+def _ulp_distance(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Element-wise distance in float32 ULPs (units in the last place)."""
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    spacing = np.spacing(np.maximum(np.abs(a), np.abs(b)))
+    return np.abs(a - b) / spacing
+
+
 def test_seq2seq_stacked_matches_solo():
     """stack_segments parity: framing windows per segment makes stacked
-    scoring bit-identical to solo scoring for every segment, including
-    tail-padded ones (rows not a multiple of timesteps), and padding the
-    window batch to a compile bucket must not change scores."""
+    scoring SEMANTICALLY identical to solo scoring for every segment,
+    including tail-padded ones (rows not a multiple of timesteps), and
+    padding the window batch to a compile bucket must not change which
+    rows land in which window.
+
+    Root cause of the tolerance (this test originally asserted bit
+    equality): solo calls score their windows in small batches (the 6-row
+    segment frames to 2 windows -> the W=2 compile bucket) while the
+    stacked call scores ALL segments' windows in one batch (7 windows ->
+    the W=8 bucket). XLA compiles one program per window-batch bucket, and
+    the GRU matmuls pick batch-shape-dependent tilings/FMA contractions,
+    so the f32 accumulations of IDENTICAL window rows can round
+    differently in the last bit — across jax/XLA upgrades this drifted
+    between exactly-equal and one-ULP-off. The stacking protocol
+    guarantees window CONTENT identity (no window straddles a request
+    boundary); it never promised bit-identical floats across two different
+    compiled programs. Principled bound: the per-window reduction touches
+    timesteps*features*hidden terms, each reassociation step costs at most
+    1 ULP, and observed drift is ~1-2 ULP — 4 ULPs separates codegen
+    noise (<=4) from mis-framing (a straddled window moves scores by many
+    orders of magnitude more, asserted below)."""
     rng = np.random.default_rng(11)
     det = Seq2SeqOutlierDetector(timesteps=4, hidden_dim=8, seed=1)
     det.fit(rng.normal(size=(40, 3)), epochs=10)
@@ -22,14 +48,20 @@ def test_seq2seq_stacked_matches_solo():
     stacked = np.asarray(det.score(np.concatenate(batches, axis=0)))
     off = 0
     for b, s in zip(batches, solo):
-        np.testing.assert_array_equal(stacked[off:off + b.shape[0]], s)
+        got = stacked[off:off + b.shape[0]]
+        assert got.shape == s.shape
+        assert _ulp_distance(got, s).max() <= 4, (
+            f"stacked segment at rows [{off}, {off + b.shape[0]}) drifted "
+            f"beyond codegen noise: {got} vs solo {s}")
         off += b.shape[0]
 
-    # consume-once: the next plain call is solo semantics again
+    # consume-once: the next plain call is solo semantics again — its
+    # windows straddle the old request boundaries, so scores must differ
+    # MACROSCOPICALLY (far beyond the ULP band above); anything less means
+    # the segment list leaked into the plain call
     plain = np.asarray(det.score(np.concatenate(batches, axis=0)))
     assert plain.shape == stacked.shape
-    with np.testing.assert_raises(AssertionError):
-        np.testing.assert_array_equal(plain, stacked)  # boundaries differ
+    assert np.max(np.abs(plain - stacked) / np.abs(stacked)) > 1e-4
 
 
 def test_seq2seq_stale_segment_counts_fall_back_to_solo():

@@ -13,14 +13,14 @@ import numpy as np
 import pytest
 
 from seldon_core_tpu.contracts.payload import SeldonError
-from seldon_core_tpu.servers.tfproxy import (
-    TFServingProxy,
+from seldon_core_tpu.codec.tensorproto import (
     _iter_fields,
     _varint,
     decode_predict_response,
     decode_tensor_proto,
     encode_predict_request,
 )
+from seldon_core_tpu.servers.tfproxy import TFServingProxy
 
 
 def test_tensor_proto_roundtrip_f32_f64():
@@ -168,7 +168,7 @@ def test_tensor_proto_int_roundtrip():
     """DT_INT32/DT_INT64 decode (ADVICE r4: previously silently decoded to
     an empty float32 array). The encoder itself emits these for token-id
     inputs, so encode->decode must round-trip, negatives included."""
-    from seldon_core_tpu.servers.tfproxy import (
+    from seldon_core_tpu.codec.tensorproto import (
         decode_tensor_proto, encode_predict_request, _iter_fields)
 
     def tensor_bytes(req: bytes) -> bytes:
@@ -189,7 +189,7 @@ def test_tensor_proto_int_roundtrip():
 
 def test_tensor_proto_unsupported_dtype_raises():
     from seldon_core_tpu.contracts.payload import SeldonError
-    from seldon_core_tpu.servers.tfproxy import _tag, _varint, decode_tensor_proto
+    from seldon_core_tpu.codec.tensorproto import _tag, _varint, decode_tensor_proto
 
     buf = _tag(1, 0) + _varint(7)  # DT_STRING: not decodable here
     with pytest.raises(SeldonError, match="dtype 7"):

@@ -12,6 +12,7 @@ from tools.graftlint.checkers.donation import DonationChecker
 from tools.graftlint.checkers.asyncblock import AsyncBlockChecker
 from tools.graftlint.checkers.jitpurity import JitPurityChecker
 from tools.graftlint.checkers.metricsdrift import MetricsDriftChecker
+from tools.graftlint.checkers.compatdrift import CompatDriftChecker
 
 
 def all_checkers():
@@ -21,4 +22,5 @@ def all_checkers():
         AsyncBlockChecker(),
         JitPurityChecker(),
         MetricsDriftChecker(),
+        CompatDriftChecker(),
     ]

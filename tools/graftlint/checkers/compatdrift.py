@@ -1,0 +1,101 @@
+"""compat-drift: version-sensitive JAX APIs must route through the shim.
+
+The PR 4 postmortem class: ``shard_map`` moved twice under this tree
+(``jax.experimental.shard_map.shard_map`` -> ``jax.shard_map``, renaming
+``check_rep`` to ``check_vma`` on the way) and ``jax.lax.axis_size`` only
+exists on newer releases. Five ring-attention tests sat red for a whole
+round because one module imported the old path directly. The resolution
+lives in exactly one place — ``parallel/compat.py`` — and this checker
+makes the shim impossible to bypass: any direct import or dotted use of
+the moved APIs outside the shim file is a finding.
+
+Flagged anywhere in the scanned tree (not just hot dirs — version drift
+breaks cold paths just as hard):
+
+- ``from jax.experimental.shard_map import ...`` / ``import
+  jax.experimental.shard_map``
+- ``from jax import shard_map`` / ``jax.shard_map(...)`` /
+  ``jax.experimental.shard_map.shard_map(...)``
+- ``from jax.lax import axis_size`` / ``jax.lax.axis_size(...)`` /
+  ``lax.axis_size(...)``
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graftlint.core import Finding, Module, Project, dotted, make_finding
+
+RULE = "compat-drift"
+
+SHIM = "parallel/compat.py"
+
+# dotted names whose appearance (call or bare reference) is drift
+_BANNED_DOTTED = {
+    "jax.shard_map": "shard_map",
+    "jax.experimental.shard_map": "shard_map",
+    "jax.experimental.shard_map.shard_map": "shard_map",
+    "jax.lax.axis_size": "axis_size",
+    "lax.axis_size": "axis_size",
+}
+
+_MSG = {
+    "shard_map": (
+        "direct shard_map use bypasses the version shim — the API moved "
+        "twice (jax.experimental.shard_map -> jax.shard_map, check_rep -> "
+        "check_vma); import it from seldon_core_tpu.parallel.compat instead"
+    ),
+    "axis_size": (
+        "jax.lax.axis_size only exists on newer JAX — use "
+        "seldon_core_tpu.parallel.compat.axis_size (psum(1, axis) fallback) "
+        "instead"
+    ),
+}
+
+
+def _is_shim(module: Module) -> bool:
+    return module.relpath.replace("\\", "/").endswith(SHIM)
+
+
+class CompatDriftChecker:
+    rule = RULE
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in project.modules:
+            if _is_shim(module):
+                continue
+            findings.extend(self._check_module(module))
+        return findings
+
+    def _check_module(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        seen = set()
+
+        def flag(node, api: str, function: str = ""):
+            key = (getattr(node, "lineno", 0), api)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(make_finding(module, RULE, node, _MSG[api], function))
+
+        # imports (module level or nested — graftlint reports the line)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                mod = node.module
+                names = {a.name for a in node.names}
+                if mod == "jax.experimental.shard_map" or (
+                        mod in ("jax", "jax.experimental") and "shard_map" in names):
+                    flag(node, "shard_map")
+                if mod == "jax.lax" and "axis_size" in names:
+                    flag(node, "axis_size")
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax.experimental.shard_map":
+                        flag(node, "shard_map")
+            else:
+                d = dotted(node)
+                if d in _BANNED_DOTTED:
+                    flag(node, _BANNED_DOTTED[d])
+        return findings

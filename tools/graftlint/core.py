@@ -36,6 +36,7 @@ RULES = (
     "blocking-in-async",
     "jit-purity",
     "metrics-drift",
+    "compat-drift",
 )
 
 # internal rules that cannot be suppressed or baselined

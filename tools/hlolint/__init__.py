@@ -1,0 +1,38 @@
+"""hlolint — compiled-artifact contract checking for the serving hot paths.
+
+graftlint (tools/graftlint) guards the SOURCE: host syncs, use-after-donate,
+impure jit bodies. The perf invariants of the decode path, though, live in
+what XLA actually compiles — a donation silently degrades to a copy when
+buffer shapes mismatch, a dtype upcast sneaks f32 into the int8 KV read, a
+stray reshard adds all-gathers to the TP decode step. None of that is
+visible to an AST walk. hlolint lowers the serving-critical jitted
+functions to StableHLO / optimized HLO and enforces a declared contract
+per function (docs/static-analysis.md):
+
+- ``alias``      every donated argument's buffers appear in the compiled
+                 module's ``input_output_alias`` (donation actually fired);
+- ``transfer``   zero host transfers (infeed/outfeed/send/recv, host
+                 callbacks) inside the compiled hot function;
+- ``dtype``      forbidden dtype/shape signatures never appear in the
+                 lowered module (the int8 KV path never materializes f32
+                 KV tensors), and declared output dtypes hold;
+- ``collective`` the compiled collective set matches the declared
+                 count-per-kind budget exactly — anything extra fails;
+- ``cost``       HLO cost analysis (flops / bytes accessed) stays inside
+                 a tolerance band around the committed budgets.json.
+
+``python -m tools.hlolint seldon_core_tpu/`` exits 0 = every contract
+holds. Same enforcement posture as graftlint: findings are fatal unless
+waived in the contract registry (with a reason, next to the contract) or
+grandfathered in tools/hlolint/baseline.json (with a reason).
+"""
+
+from tools.hlolint.core import (  # noqa: F401
+    CHECKS,
+    Contract,
+    Finding,
+    load_baseline,
+    load_budgets,
+    run_contracts,
+    save_budgets,
+)

@@ -1,0 +1,167 @@
+"""hlolint CLI.
+
+    python -m tools.hlolint [paths...]
+        [--contracts name1,name2] [--checks alias,cost,...]
+        [--budgets FILE] [--update-budgets]
+        [--baseline FILE | --no-baseline]
+        [--budget-diff FILE] [--format text|json] [--list] [--verbose]
+
+Exit codes: 0 every contract holds, 1 findings, 2 usage/configuration
+error. The positional paths are a sanity anchor (the tree the contracts
+compile from must exist); contract selection is by --contracts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    # contract construction is jax-free (builders are lazy), so --list and
+    # usage errors stay instant; the platform pin below runs only before
+    # the first real lowering
+    from tools.hlolint.contracts import all_contracts, ensure_platform
+    from tools.hlolint.core import (
+        CHECKS, load_baseline, load_budgets, run_contracts, save_budgets)
+
+    here = os.path.dirname(__file__)
+    default_budgets = os.path.join(here, "budgets.json")
+    default_baseline = os.path.join(here, "baseline.json")
+
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.hlolint",
+        description="compiled-artifact contract checking "
+                    "(docs/static-analysis.md)")
+    parser.add_argument("paths", nargs="*", default=["seldon_core_tpu"],
+                        help="tree the contracts compile from "
+                             "(default: seldon_core_tpu)")
+    parser.add_argument("--contracts", default=None,
+                        help="comma-separated subset of contract names")
+    parser.add_argument("--checks", default=None,
+                        help="comma-separated subset of: " + ", ".join(CHECKS))
+    parser.add_argument("--budgets", default=None,
+                        help=f"cost budgets JSON (default: {default_budgets})")
+    parser.add_argument("--update-budgets", action="store_true",
+                        help="write the measured compiled costs to the "
+                             "budgets file (review the diff before committing)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline JSON (default: {default_baseline} "
+                             "when it exists)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline: report every finding")
+    parser.add_argument("--budget-diff", default=None,
+                        help="write the budget-vs-compiled cost diff as JSON "
+                             "(CI uploads this as an artifact on failure)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--list", action="store_true",
+                        help="list contract names and exit")
+    parser.add_argument("--verbose", action="store_true",
+                        help="also list waived/baselined findings")
+    args = parser.parse_args(argv)
+
+    contracts = all_contracts()
+    if args.list:
+        for c in contracts:
+            print(f"{c.name}: {c.description}")
+        return 0
+
+    for p in (args.paths or ["seldon_core_tpu"]):
+        if not os.path.exists(p):
+            print(f"hlolint: path does not exist: {p}", file=sys.stderr)
+            return 2
+
+    if args.contracts:
+        wanted = [c.strip() for c in args.contracts.split(",") if c.strip()]
+        by_name = {c.name: c for c in contracts}
+        unknown = [w for w in wanted if w not in by_name]
+        if unknown:
+            print(f"hlolint: unknown contract(s): {', '.join(unknown)} "
+                  f"(have: {', '.join(sorted(by_name))})", file=sys.stderr)
+            return 2
+        contracts = [by_name[w] for w in wanted]
+
+    checks = None
+    if args.checks:
+        checks = [c.strip() for c in args.checks.split(",") if c.strip()]
+        unknown_checks = set(checks) - set(CHECKS)
+        if unknown_checks:
+            print(f"hlolint: unknown check(s): "
+                  f"{', '.join(sorted(unknown_checks))}", file=sys.stderr)
+            return 2
+
+    budgets_path = args.budgets or default_budgets
+    budgets = {}
+    if os.path.exists(budgets_path):
+        budgets = load_budgets(budgets_path)
+    elif args.budgets and not args.update_budgets:
+        print(f"hlolint: budgets file not found: {args.budgets}",
+              file=sys.stderr)
+        return 2
+
+    baseline = {}
+    if not args.no_baseline:
+        baseline_path = args.baseline or (
+            default_baseline if os.path.exists(default_baseline) else None)
+        if args.baseline and not os.path.exists(args.baseline):
+            print(f"hlolint: baseline not found: {args.baseline}",
+                  file=sys.stderr)
+            return 2
+        if baseline_path:
+            try:
+                baseline = load_baseline(baseline_path)
+            except ValueError as e:
+                print(f"hlolint: {e}", file=sys.stderr)
+                return 2
+
+    # Pin the lowering environment BEFORE jax (imported transitively by the
+    # contract builders) initializes its backend: the budgets are snapshots
+    # of the CPU + virtual-8-mesh environment, the same one CI tests use.
+    ensure_platform()
+    try:
+        reported, absorbed, waived, budget_diff, measured = run_contracts(
+            contracts, budgets=budgets, baseline=baseline, checks=checks)
+    except ValueError as e:
+        print(f"hlolint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_budgets:
+        save_budgets(budgets_path, measured, previous=budgets)
+        print(f"hlolint: wrote {len(measured)} cost budget(s) to "
+              f"{budgets_path} — review the diff before committing")
+        # still report the non-cost findings so --update-budgets cannot
+        # green-wash a broken alias/transfer/dtype/collective contract
+        reported = [f for f in reported if f.check != "cost"]
+
+    if args.budget_diff:
+        with open(args.budget_diff, "w", encoding="utf-8") as f:
+            json.dump(budget_diff, f, indent=2)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in reported],
+            "baselined": len(absorbed),
+            "waived": len(waived),
+            "budget_diff": budget_diff,
+        }, indent=2))
+    else:
+        for f in reported:
+            print(f.render())
+        if args.verbose:
+            for f in waived:
+                print(f"[waived]    {f.render()}")
+            for f in absorbed:
+                print(f"[baselined] {f.render()}")
+        print(f"hlolint: {len(reported)} finding(s) over {len(contracts)} "
+              f"contract(s) ({len(waived)} waived, {len(absorbed)} baselined)",
+              file=sys.stderr)
+    return 1 if reported else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `--list | head` is normal usage, not an error
+        sys.exit(0)

@@ -1,0 +1,355 @@
+"""The hlolint contract registry: the serving-critical jitted functions and
+their declared compiled-form contracts.
+
+Contracts compile a PRODUCTION-SHAPED configuration at test dims: the
+bf16-compute transformer with the int8 KV cache (the PR 2 serving layout)
+at llama-tiny sizes, on the CPU backend with the virtual 8-device mesh —
+the same lowering environment as CI's unit tests. Budgets in budgets.json
+are snapshots of THIS environment; the contracts are about structure
+(aliases, transfers, dtypes, collective sets) and relative cost, which is
+what survives the CPU-for-TPU substitution.
+
+Shared fixtures are lazy singletons: one base server feeds the prefill /
+extend / decode / decode-step / batcher contracts so the registry costs a
+handful of tiny compiles, not a model load per contract.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, List
+
+from tools.hlolint.core import Contract
+
+# tiny-but-production-shaped dims, shared by every LLM contract
+PLEN = 16          # prompt bucket
+MAX_LEN = 24       # cache length (prompt bucket + decode headroom)
+SLOTS = 4          # continuous-batcher slots
+N_STEPS = 7        # decode scan length (max_new_tokens - 1)
+KV_HEADS = 2       # llama-tiny n_kv_heads
+HEAD_DIM = 16      # llama-tiny head_dim
+
+
+def ensure_platform() -> None:
+    """Pin the lowering environment BEFORE jax initializes: CPU backend
+    with 8 virtual devices (the CI mesh). Mirrors tests/conftest.py — the
+    axon TPU plugin ignores JAX_PLATFORMS, so the config update after
+    import is required too."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+_STATE: Dict[str, object] = {}
+
+
+def _base_server():
+    """bf16 compute + int8 KV llama-tiny LLMServer — the serving layout the
+    PR 2/3 perf work targets, at test dims."""
+    if "server" not in _STATE:
+        ensure_platform()
+        from seldon_core_tpu.servers.llmserver import LLMServer
+
+        s = LLMServer(
+            model="llama-tiny", model_kwargs={"dtype": "bfloat16"},
+            init_random=True, max_new_tokens=N_STEPS + 1,
+            len_buckets=(PLEN,), batch_buckets=(1, SLOTS), seed=7,
+            kv_cache_dtype="int8",
+        )
+        s.load()
+        _STATE["server"] = s
+    return _STATE["server"]
+
+
+def _tp_server():
+    """tensor_parallel=2 over the virtual 8-mesh: the TP decode contract."""
+    if "tp_server" not in _STATE:
+        ensure_platform()
+        from seldon_core_tpu.servers.llmserver import LLMServer
+
+        s = LLMServer(
+            model="llama-tiny", model_kwargs={"dtype": "bfloat16"},
+            init_random=True, max_new_tokens=N_STEPS + 1,
+            len_buckets=(PLEN,), batch_buckets=(1,), seed=7,
+            kv_cache_dtype="int8", tensor_parallel=2,
+        )
+        s.load()
+        _STATE["tp_server"] = s
+    return _STATE["tp_server"]
+
+
+def _batcher():
+    if "batcher" not in _STATE:
+        from seldon_core_tpu.runtime.batcher import ContinuousBatcher
+
+        _STATE["batcher"] = ContinuousBatcher(
+            _base_server(), max_slots=SLOTS, max_len=MAX_LEN)
+    return _STATE["batcher"]
+
+
+def _cache_specs(batch: int):
+    """ShapeDtypeStruct pytree of the int8 KV caches — the checks only
+    need shapes/dtypes, so nothing is materialized."""
+    import jax
+
+    from seldon_core_tpu.models.transformer import init_kv_caches
+
+    s = _base_server()
+    return jax.eval_shape(
+        lambda: init_kv_caches(s._cfg, batch, MAX_LEN, s.kv_cache_dtype))
+
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, jnp.dtype(dtype))
+
+
+# full-KV-cache dtype signatures in the LOWERED module: an f32 tensor of
+# the whole cache shape means the int8 path materialized a dequantized
+# (or upcast) copy of the cache — the exact regression the int8 KV work
+# exists to prevent. bf16 full-cache tensors are the expected dequant
+# target and are allowed.
+def _f32_cache_sig(batch: int) -> str:
+    return rf"tensor<{batch}x{MAX_LEN}x{KV_HEADS}x{HEAD_DIM}xf32>"
+
+
+F32_CACHE_WHY = (
+    "a full-cache f32 tensor in the int8 KV path means the quantized "
+    "cache was dequantized/upcast wholesale (2-4x the HBM traffic the "
+    "int8 layout bought back)"
+)
+
+
+# ----------------------------------------------------------------------
+# builders
+# ----------------------------------------------------------------------
+
+def _build_prefill():
+    s = _base_server()
+    fn = s._get_prefill(1, PLEN, MAX_LEN)
+    return fn, (s._params, _sds((1, PLEN), "int32"), _sds((1, PLEN), "int32"))
+
+
+def _build_extend():
+    s = _base_server()
+    fn = s._get_extend(1, PLEN, MAX_LEN, donate=True)
+    return fn, (s._params, _cache_specs(1), _sds((1, PLEN), "int32"),
+                _sds((1, PLEN), "int32"), _sds((), "int32"))
+
+
+def _build_decode_scan():
+    s = _base_server()
+    fn = s._get_decode(1, MAX_LEN, donate=True)
+    return fn, (s._params, _cache_specs(1), _sds((1,), "int32"),
+                _sds((1,), "int32"), N_STEPS, _sds((2,), "uint32"),
+                _sds((), "float32"))
+
+
+def _build_decode_step():
+    s = _base_server()
+    fn = s._get_decode_step(SLOTS, MAX_LEN, 1)
+    return fn, (s._params, _cache_specs(SLOTS), _sds((SLOTS,), "int32"),
+                _sds((SLOTS,), "int32"), _sds((SLOTS, 2), "uint32"),
+                _sds((), "float32"))
+
+
+def _build_decode_scan_tp2():
+    import jax
+
+    s = _tp_server()
+    fn = s._get_decode(1, MAX_LEN, donate=True)
+    from seldon_core_tpu.models.transformer import init_kv_caches
+
+    caches = jax.eval_shape(
+        lambda: init_kv_caches(s._cfg, 1, MAX_LEN, s.kv_cache_dtype))
+    return fn, (s._params, caches, _sds((1,), "int32"), _sds((1,), "int32"),
+                N_STEPS, _sds((2,), "uint32"), _sds((), "float32"))
+
+
+def _build_batcher_insert():
+    b = _batcher()
+    import jax
+
+    from seldon_core_tpu.models.transformer import init_kv_caches
+
+    s = _base_server()
+    small = jax.eval_shape(
+        lambda: init_kv_caches(s._cfg, 1, MAX_LEN, s.kv_cache_dtype))
+    return b._insert, (b._caches, small, _sds((), "int32"))
+
+
+def _build_batcher_set_slot():
+    b = _batcher()
+    return b._set_slot, (b._last_tok, b._next_pos, b._keys,
+                         _sds((), "int32"), _sds((), "int32"),
+                         _sds((), "int32"), _sds((2,), "uint32"))
+
+
+def _build_jaxserver_predict():
+    ensure_platform()
+    import jax.numpy as jnp
+
+    if "jaxserver" not in _STATE:
+        import jax
+
+        from seldon_core_tpu.models import get_model
+        from seldon_core_tpu.servers.jaxserver import JAXServer, export_checkpoint
+
+        m = get_model("mlp", features=(16,), num_classes=4)
+        params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+        # held in _STATE so the checkpoint dir is removed at interpreter
+        # exit instead of leaking one temp dir per hlolint run
+        tmp = tempfile.TemporaryDirectory(prefix="hlolint-jaxserver-")
+        _STATE["jaxserver_tmp"] = tmp
+        export_checkpoint(tmp.name, "mlp", params,
+                          kwargs={"features": (16,), "num_classes": 4},
+                          input_shape=[8], use_orbax=False)
+        js = JAXServer(model_uri=tmp.name, batch_buckets=(4,))
+        js.load()
+        _STATE["jaxserver"] = js
+    js = _STATE["jaxserver"]
+    return js._apply, (js._params, _sds((4, 8), "float32"))
+
+
+def _build_fused_norm():
+    ensure_platform()
+    import jax
+
+    from seldon_core_tpu.ops.fused_norm import fused_residual_rmsnorm
+
+    fn = jax.jit(lambda x, h, w: fused_residual_rmsnorm(x, h, w, 1e-5))
+    return fn, (_sds((8, 2048), "bfloat16"), _sds((8, 2048), "bfloat16"),
+                _sds((2048,), "float32"))
+
+
+def _build_ring_attention():
+    ensure_platform()
+    import jax
+
+    from seldon_core_tpu.ops.ring_attention import ring_attention
+    from seldon_core_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"seq": 8})
+    fn = jax.jit(lambda q, k, v, p: ring_attention(q, k, v, p, p, mesh=mesh))
+    qkv = _sds((1, 64, 4, HEAD_DIM), "bfloat16")
+    return fn, (qkv, qkv, qkv, _sds((1, 64), "int32"))
+
+
+# ----------------------------------------------------------------------
+# the registry
+# ----------------------------------------------------------------------
+
+def all_contracts() -> List[Contract]:
+    return [
+        Contract(
+            name="llm.prefill_b1",
+            description="LLMServer prefill (b=1, plen=16) into the int8 cache",
+            build=_build_prefill,
+            check_transfers=True,
+            forbid_dtypes=((_f32_cache_sig(1), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="llm.extend_b1",
+            description="LLMServer suffix prefill (donating variant): the "
+                        "scatter must update the cache in place",
+            build=_build_extend,
+            donated=(1,),
+            forbid_dtypes=((_f32_cache_sig(1), F32_CACHE_WHY),),
+            collectives={},
+        ),
+        Contract(
+            name="llm.decode_scan_b1",
+            description="LLMServer fused decode scan (b=1): generate()'s "
+                        "device-side token loop",
+            build=_build_decode_scan,
+            donated=(1,),
+            forbid_dtypes=((_f32_cache_sig(1), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="llm.decode_step_s4",
+            description="ContinuousBatcher pipelined decode step (S=4, k=1): "
+                        "THE hot function of served decode",
+            build=_build_decode_step,
+            donated=(1, 3, 4),
+            forbid_dtypes=((_f32_cache_sig(SLOTS), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="llm.decode_scan_tp2",
+            description="decode scan under tensor_parallel=2 on the virtual "
+                        "8-mesh: the TP collective budget",
+            build=_build_decode_scan_tp2,
+            donated=(1,),
+            # GSPMD entry params are per-device shapes; dtype matching is
+            # the shard-stable way to verify the cache donation survived
+            alias_by_dtype=True,
+            # 2 layers x (attention wo + ffn down) psums + the logits psum.
+            # Anything beyond this set is a reshard the sharding annotations
+            # never asked for.
+            collectives={"all-reduce": 5},
+            waivers={
+                "collective:all-gather":
+                    "sampling epilogue, not a cache reshard: top-k over the "
+                    "vocab-sharded logits gathers [1,256] candidate scores "
+                    "plus two [1,2] partial-result rows per step — bytes, "
+                    "not the KV cache (first enforcing run, 2026-08)",
+            },
+        ),
+        Contract(
+            name="batcher.insert",
+            description="ContinuousBatcher slot insert: the big slot cache "
+                        "must be donated through the scatter",
+            build=_build_batcher_insert,
+            donated=(0,),
+            collectives={},
+        ),
+        Contract(
+            name="batcher.set_slot",
+            description="ContinuousBatcher per-slot admission update of the "
+                        "device-resident decode state",
+            build=_build_batcher_set_slot,
+            donated=(1, 2),
+            collectives={},
+        ),
+        Contract(
+            name="jaxserver.predict_b4",
+            description="JAXServer jitted apply (tiny MLP checkpoint, "
+                        "bucket=4): the generic predict hot path",
+            build=_build_jaxserver_predict,
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="ops.fused_norm",
+            description="fused residual+RMSNorm ([8,2048] bf16): the decode "
+                        "block epilogue",
+            build=_build_fused_norm,
+            # both outputs (residual sum, normed activation) must stay in
+            # the model dtype — the f32 norm INTERNALS are the contract,
+            # f32 OUTPUTS would double the block's activation traffic
+            out_dtypes=((0, "bf16"), (1, "bf16")),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="ops.ring_attention_seq8",
+            description="ring attention over the 8-way 'seq' mesh: one "
+                        "rotating ppermute per buffer (k, v, positions)",
+            build=_build_ring_attention,
+            collectives={"collective-permute": 3},
+            cost=True,
+        ),
+    ]
