@@ -121,12 +121,17 @@ class BatcherService:
 
         self.batcher = asyncio.run_coroutine_threadsafe(make(), self._loop).result()
         self.submitted = 0
+        # submit() runs on transport loops and submit_sync() on gRPC worker
+        # threads at once; the counter bump is a read-modify-write, and
+        # unlocked concurrent increments lose updates
+        self._stats_lock = threading.Lock()
 
     def submit_sync(self, prompt: Any, max_new_tokens: Optional[int] = None,
                     timeout_s: float = 600.0,
                     info: Optional[dict] = None,
                     seed: Optional[int] = None) -> List[int]:
-        self.submitted += 1
+        with self._stats_lock:
+            self.submitted += 1
         return asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, info=info, seed=seed),
             self._loop
@@ -136,7 +141,8 @@ class BatcherService:
                      on_token: Optional[Any] = None,
                      info: Optional[dict] = None,
                      seed: Optional[int] = None) -> List[int]:
-        self.submitted += 1
+        with self._stats_lock:
+            self.submitted += 1
         cfut = asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
                                 info=info, seed=seed),

@@ -289,8 +289,11 @@ class CircuitBreaker:
             return self.reset_timeout_s - (self.clock() - self.opened_at)
 
     def state_code(self) -> int:
-        """0 closed, 1 half-open, 2 open (the metrics gauge encoding)."""
-        return _STATE_CODES[self.state]
+        """0 closed, 1 half-open, 2 open (the metrics gauge encoding).
+        Locked like every other state read: the gauge scrape runs on the
+        metrics thread while transitions happen on the serving path."""
+        with self._lock:
+            return _STATE_CODES[self.state]
 
 
 # ---------------------------------------------------------------------------
@@ -365,6 +368,10 @@ class AdmissionController:
             return len(self._waiters)
 
     def _shed(self) -> ShedError:
+        """Build (and count) one shed. Callers hold ``self._lock``: the
+        counter bump is a read-modify-write and the message reads the
+        waiter queue — unlocked, concurrent sheds lose counts
+        (tests/test_schedules.py replays the exact interleaving)."""
         self.shed_total += 1
         return ShedError(
             f"server at capacity: {self.inflight} in flight, "
@@ -418,11 +425,15 @@ class AdmissionController:
                     self._waiters.remove(entry)
                 except ValueError:
                     # grant raced the timeout: the slot is ours, give it back
-                    pass
+                    granted = True
                 else:
-                    raise self._shed()
-            self.release()
-            raise self._shed()
+                    granted = False
+                    err = self._shed()
+            if not granted:
+                raise err
+            self.release()  # outside the lock: release() takes it itself
+            with self._lock:
+                raise self._shed()
 
     def release(self) -> None:
         """Finish one admitted request; hand its slot to the oldest waiter."""

@@ -247,6 +247,7 @@ class LLMServer(SeldonComponent):
         # Validate dtype knobs HERE, with a clear ValueError, instead of
         # letting an unknown string explode later inside a jitted cast or
         # cache init (where the traceback names nothing actionable).
+        # racelint: allow-unguarded-shared-state(load()-time config normalization: runs once, before any serving thread or batcher loop exists — nothing can interleave with it)
         self.kv_cache_dtype = normalize_kv_cache_dtype(self.kv_cache_dtype)
         if self.param_dtype and self.param_dtype != "auto":
             try:
@@ -547,6 +548,9 @@ class LLMServer(SeldonComponent):
                     best = (k, caches, last_logits)
             if best is not None:
                 self._prefix_cache.move_to_end(tuple(tokens[: best[0]]))
+                # hit accounting lives under the same lock as the cache it
+                # describes (concurrent generate() calls race the bump)
+                self._prefix_hits += 1
             return best
 
     def _prefix_store(self, tokens: List[int], max_len: int, caches, last_logits):
@@ -848,10 +852,8 @@ class LLMServer(SeldonComponent):
         decode = self._get_decode(nb, max_len, donate=not use_prefix)
         hit = self._prefix_lookup(token_lists[0], max_len) if use_prefix else None
         if hit is not None and hit[0] == len(token_lists[0]):
-            self._prefix_hits += 1
             _, caches, first_logits = hit
         elif hit is not None:
-            self._prefix_hits += 1
             p0, caches, _ = hit
             suffix = token_lists[0][p0:]
             L = len(suffix)
@@ -878,11 +880,16 @@ class LLMServer(SeldonComponent):
             ).astype(np.float32)
             if use_prefix:
                 self._prefix_store(token_lists[0], max_len, caches, first_logits)
-        # explicit seed => reproducible; otherwise vary per request
+        # explicit seed => reproducible; otherwise vary per request. The
+        # fetch-and-increment is atomic under the lock: two concurrent
+        # unseeded generate() calls must not share an rng chain (and the
+        # count must not lose updates)
+        with self._prefix_lock:
+            request_index = self._request_count
+            self._request_count += 1
         rng = jax.random.PRNGKey(
-            int(seed) if seed is not None else self.seed + self._request_count
+            int(seed) if seed is not None else self.seed + request_index
         )
-        self._request_count += 1
 
         if temp <= 0.0:
             first_tok = first_logits.argmax(-1).astype(np.int32)
@@ -956,10 +963,13 @@ class LLMServer(SeldonComponent):
         return padded
 
     def tags(self) -> Dict[str, Any]:
-        out = {"llm_requests": self._request_count}
-        if self.prefix_cache_size:
-            out["prefix_cache_hits"] = self._prefix_hits
-            out["prefix_cache_entries"] = len(self._prefix_cache)
+        # request/prefix-cache accounting mutates under _prefix_lock on the
+        # serving path; the stats scrape reads it under the same lock
+        with self._prefix_lock:
+            out = {"llm_requests": self._request_count}
+            if self.prefix_cache_size:
+                out["prefix_cache_hits"] = self._prefix_hits
+                out["prefix_cache_entries"] = len(self._prefix_cache)
         return out
 
     def llm_stats(self) -> Dict[str, Any]:
@@ -992,9 +1002,11 @@ class LLMServer(SeldonComponent):
             inflight_hwm = batcher._inflight_hwm
             depth = batcher.pipeline_depth
             fuse = batcher.fuse_steps
+        with self._prefix_lock:
+            prefix_bytes = self._prefix_bytes
         return {
             "kv_cache_dtype": self.kv_cache_dtype,
-            "kv_cache_bytes": slot_bytes + self._prefix_bytes,
+            "kv_cache_bytes": slot_bytes + prefix_bytes,
             "kv_occupancy": occupancy,
             "kv_bytes_per_step": self._last_decode_kv_bytes,
             "decode_step_times_s": drain(self._decode_step_times),

@@ -1,0 +1,461 @@
+"""Deterministic thread-interleaving harness (racelint's dynamic half).
+
+The static analysis (tools/racelint) *claims* an access can interleave
+with a guarded writer; this module lets a test *prove* it — or prove the
+fix — by running real code under a virtual scheduler that decides every
+context switch, records the decision sequence, and replays it exactly.
+
+How it works
+------------
+Each task spawned on a :class:`DeterministicScheduler` runs in a real
+``threading.Thread``, but only ONE thread is ever runnable: every traced
+thread installs a ``sys.settrace`` hook that, at each preemption point
+(every line — or every BYTECODE for ``granularity="opcode"``, which is
+what catches ``x += 1`` lost updates: the preemption lands between the
+LOAD and the STORE), parks the thread and hands control back to the
+scheduler. The scheduler picks the next thread from
+
+- a **recorded schedule** (exact replay),
+- a **seeded RNG** (deterministic chaos: same seed, same interleaving),
+- or the **lowest-index runnable** (the canonical schedule the
+  :func:`explore` DFS perturbs).
+
+Execution is fully serialized, so given the same code and the same
+choice sequence the run is bit-for-bit deterministic. A thread that
+blocks inside a real ``threading.Lock`` simply stops reporting back; the
+scheduler notices, marks it BLOCKED, and schedules someone else — when
+the lock is released the thread re-parks at its next preemption point
+and rejoins the runnable set. If every live thread is BLOCKED, that is a
+real deadlock and :class:`DeadlockError` reports it (this is how a
+racelint ``lock-order-inversion`` finding is demonstrated, not just
+asserted).
+
+Time is the existing :class:`~seldon_core_tpu.testing.faults.FaultClock`:
+the scheduler owns one and hands it to the code under test (breaker
+reset timeouts, deadlines), so timed state machines advance by explicit
+``scheduler.clock.advance(...)`` — never wall time.
+
+Typical race hunt (tests/test_schedules.py)::
+
+    def scenario(sched):
+        adm = AdmissionController(max_inflight=1)
+        sched.spawn(hammer, adm, name="t0")
+        sched.spawn(hammer, adm, name="t1")
+        return adm
+
+    bad = find_race(scenario, lambda adm: adm.shed_total == 2,
+                    granularity="opcode", max_schedules=300)
+    # bad is None once the code is fixed; pre-fix it is a replayable
+    # RecordedSchedule whose .choices pin the exact interleaving.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from seldon_core_tpu.testing.faults import FaultClock
+
+# thread states
+_NEW = "new"
+_READY = "ready"        # parked at a preemption point, waiting for the token
+_RUNNING = "running"    # holds the token
+_BLOCKED = "blocked"    # granted the token but never reported back (real lock)
+_DONE = "done"
+
+
+class DeadlockError(RuntimeError):
+    """Every live thread is blocked on a real synchronization primitive."""
+
+
+class ScheduleDivergence(RuntimeError):
+    """A replayed schedule named a thread that is not runnable — the code
+    under test changed since the schedule was recorded."""
+
+
+@dataclass
+class RecordedSchedule:
+    """The replayable artifact of one run: at each preemption point, which
+    thread ran (``choices``) and which were runnable (``choice_sets`` —
+    the DFS's branching structure). JSON-friendly on purpose: a failing
+    schedule can be pinned into a regression test as a list of names."""
+
+    choices: List[str] = field(default_factory=list)
+    choice_sets: List[List[str]] = field(default_factory=list)
+    steps: int = 0
+    deadlocked: bool = False
+
+    def to_list(self) -> List[str]:
+        return list(self.choices)
+
+
+class _Task:
+    __slots__ = ("name", "fn", "args", "kwargs", "thread", "state", "gate",
+                 "error", "result")
+
+    def __init__(self, name, fn, args, kwargs):
+        self.name = name
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.thread: Optional[threading.Thread] = None
+        self.state = _NEW
+        self.gate = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.result: Any = None
+
+
+class DeterministicScheduler:
+    """One virtual-scheduler run. Construct, ``spawn`` tasks, ``run()``.
+
+    Parameters
+    ----------
+    seed:        pick threads via ``random.Random(seed)`` (deterministic).
+    schedule:    a recorded choice list (or RecordedSchedule) to replay
+                 exactly; after it is exhausted, scheduling falls back to
+                 lowest-index runnable.
+    granularity: ``"line"`` or ``"opcode"`` — opcode-level preemption is
+                 what interleaves WITHIN ``x += 1``.
+    trace_filter: predicate(filename) choosing which code is preemptible.
+                 Default: files under the ``seldon_core_tpu`` package plus
+                 the spawned function's own module (so test-local replicas
+                 of historical bugs are traced too).
+    max_steps:   hard cap on preemption points (livelock backstop).
+    clock:       a FaultClock (a fresh one by default) — hand it to the
+                 code under test.
+    stall_s:     how long the scheduler waits for a granted thread to
+                 report back before declaring it BLOCKED. Lock-induced
+                 blocking is a function of the schedule, so the choice
+                 sequence is machine-independent as long as every traced
+                 step finishes within stall_s; a step that outruns it
+                 (GC pause, cold import inside the code under test) can
+                 shift one choice point. Replays tolerate this: a forced
+                 thread that is slow rather than lock-blocked gets a
+                 grace window to park before divergence is declared.
+    """
+
+    def __init__(
+        self,
+        seed: Optional[int] = None,
+        schedule: Optional[Any] = None,
+        granularity: str = "line",
+        trace_filter: Optional[Callable[[str], bool]] = None,
+        max_steps: int = 200_000,
+        clock: Optional[FaultClock] = None,
+        stall_s: float = 0.2,
+    ):
+        if granularity not in ("line", "opcode"):
+            raise ValueError("granularity must be 'line' or 'opcode'")
+        if seed is not None:
+            import random
+
+            self._rng: Optional[Any] = random.Random(seed)
+        else:
+            self._rng = None
+        if isinstance(schedule, RecordedSchedule):
+            schedule = schedule.to_list()
+        self._forced: List[str] = list(schedule or [])
+        self.granularity = granularity
+        self.trace_filter = trace_filter
+        self.max_steps = int(max_steps)
+        self.clock = clock if clock is not None else FaultClock()
+        self.stall_s = float(stall_s)
+        self.record = RecordedSchedule()
+        self._tasks: List[_Task] = []
+        self._by_thread: Dict[int, _Task] = {}
+        self._mu = threading.Lock()
+        self._wake = threading.Condition(self._mu)
+        self._traced_files: set = set()
+        self._started = False
+        self._last: Optional[str] = None
+
+    # -- task management -----------------------------------------------
+    def spawn(self, fn: Callable, *args, name: Optional[str] = None,
+              **kwargs) -> str:
+        if self._started:
+            raise RuntimeError("spawn() before run(): the schedule space "
+                               "must be fixed up front for replay to work")
+        name = name or f"t{len(self._tasks)}"
+        if any(t.name == name for t in self._tasks):
+            raise ValueError(f"duplicate task name {name!r}")
+        code = getattr(fn, "__code__", None)
+        if code is not None:
+            self._traced_files.add(code.co_filename)
+        self._tasks.append(_Task(name, fn, args, kwargs))
+        return name
+
+    def results(self) -> Dict[str, Any]:
+        return {t.name: t.result for t in self._tasks}
+
+    def errors(self) -> Dict[str, BaseException]:
+        return {t.name: t.error for t in self._tasks if t.error is not None}
+
+    # -- tracing --------------------------------------------------------
+    def _should_trace(self, filename: str) -> bool:
+        if self.trace_filter is not None:
+            return self.trace_filter(filename)
+        return filename in self._traced_files or (
+            ("seldon_core_tpu" in filename) and "testing" not in filename)
+
+    def _trace(self, frame, event, arg):
+        if event != "call":
+            return None
+        if not self._should_trace(frame.f_code.co_filename):
+            return None
+        if self.granularity == "opcode":
+            frame.f_trace_opcodes = True
+        return self._local_trace
+
+    def _local_trace(self, frame, event, arg):
+        if event == ("opcode" if self.granularity == "opcode" else "line"):
+            self._preempt()
+        return self._local_trace
+
+    # -- thread side ----------------------------------------------------
+    def _bootstrap(self, task: _Task):
+        # self-registration BEFORE the first traced frame: _preempt looks
+        # the task up by thread ident, and the spawner cannot know the
+        # ident until after start() — registering there races the thread
+        # reaching its first preemption point
+        self._by_thread[threading.get_ident()] = task
+        sys.settrace(self._trace)
+        try:
+            task.result = task.fn(*task.args, **task.kwargs)
+        except BaseException as e:  # noqa: BLE001 — surfaced via errors()
+            task.error = e
+        finally:
+            sys.settrace(None)
+            with self._mu:
+                task.state = _DONE
+                self._wake.notify_all()
+
+    def _preempt(self):
+        task = self._by_thread.get(threading.get_ident())
+        if task is None:
+            return
+        with self._mu:
+            task.state = _READY
+            task.gate.clear()
+            self._wake.notify_all()
+        task.gate.wait()
+
+    # -- scheduler side -------------------------------------------------
+    def _pick(self, ready: List[_Task]) -> _Task:
+        names = [t.name for t in ready]
+        i = len(self.record.choices)
+        if i < len(self._forced):
+            want = self._forced[i]
+            for t in ready:
+                if t.name == want:
+                    self._note(t, names)
+                    return t
+            # The forced thread may just be SLOW (marked BLOCKED because a
+            # traced step outran stall_s on a loaded machine) rather than
+            # truly lock-blocked: give it a grace window to park before
+            # declaring the prefix infeasible, so replays are not
+            # wall-clock sensitive. A genuinely lock-blocked thread cannot
+            # park here — its holder is parked waiting for this decision —
+            # so the wait expires and the divergence is real.
+            alive = any(t.name == want and t.state != _DONE
+                        for t in self._tasks)
+            if alive:
+                deadline = self._now() + max(self.stall_s * 4, 0.4)
+                while self._now() < deadline:
+                    self._wake.wait(self.stall_s)
+                    for t in self._tasks:
+                        if t.name == want and t.state == _READY:
+                            self._note(t, [t.name])
+                            return t
+            raise ScheduleDivergence(
+                f"replay step {i}: schedule says {want!r} but runnable "
+                f"threads are {names} — the code under test no longer "
+                "matches the recording (or the prefix is infeasible "
+                "under this code's lock states)")
+        if self._rng is not None:
+            t = self._rng.choice(ready)
+        else:
+            # canonical default: INERTIA — keep running the thread that ran
+            # last (CHESS-style preemption bounding). Each forced flip in a
+            # DFS prefix is then exactly one preemption, so the classic
+            # lost-update interleaving (A loads, B runs to completion, A
+            # stores) is reachable with a single flip instead of a deep
+            # chain of them.
+            t = None
+            if self._last is not None:
+                for cand in ready:
+                    if cand.name == self._last:
+                        t = cand
+                        break
+            if t is None:
+                t = ready[0]  # lowest spawn index
+        self._note(t, names)
+        return t
+
+    def _note(self, task: _Task, names: List[str]):
+        self.record.choices.append(task.name)
+        self.record.choice_sets.append(names)
+        self._last = task.name
+
+    def run(self) -> RecordedSchedule:
+        """Drive every task to completion (or deadlock). Returns the
+        recorded schedule; task exceptions are collected in ``errors()``
+        (assertion failures inside tasks are NOT re-raised here — race
+        tests usually assert on shared state afterwards)."""
+        self._started = True
+        for task in self._tasks:
+            task.thread = threading.Thread(
+                target=self._bootstrap, args=(task,),
+                name=f"sched-{task.name}", daemon=True)
+        with self._mu:
+            for task in self._tasks:
+                task.state = _READY  # parked "before the first line"
+        for task in self._tasks:
+            task.thread.start()
+        # No quiesce wait needed: every task is READY up front ("parked
+        # before its first line"), so the first grant means "run from the
+        # top to the first preemption point" — Event semantics make an
+        # early gate.set() safe even if the thread has not parked yet.
+        while True:
+            with self._mu:
+                live = [t for t in self._tasks if t.state not in (_DONE,)]
+                if not live:
+                    break
+                ready = [t for t in self._tasks if t.state == _READY]
+                if not ready:
+                    # grace period: a BLOCKED thread whose lock was just
+                    # released by the previous grant needs a moment to wake
+                    # from the kernel wait and park at its next preemption
+                    # point — declaring deadlock instantly would be a false
+                    # positive. A real deadlock pays this wait once.
+                    deadline = self._now() + max(self.stall_s * 4, 0.2)
+                    while self._now() < deadline:
+                        self._wake.wait(self.stall_s)
+                        ready = [t for t in self._tasks if t.state == _READY]
+                        live = [t for t in self._tasks if t.state != _DONE]
+                        if ready or not live:
+                            break
+                    if not live:
+                        break
+                if not ready:
+                    blocked = [t.name for t in live]
+                    self.record.deadlocked = True
+                    raise DeadlockError(
+                        f"all live threads blocked on real sync primitives: "
+                        f"{blocked} after {self.record.steps} steps — a "
+                        "lock cycle or a wait nobody will signal")
+                if self.record.steps >= self.max_steps:
+                    raise RuntimeError(
+                        f"schedule exceeded max_steps={self.max_steps} "
+                        "(livelock, or raise the cap)")
+                task = self._pick(ready)
+                task.state = _RUNNING
+                self.record.steps += 1
+                task.gate.set()
+                # wait for the granted thread to park again, finish, or
+                # stop reporting (=> blocked on a real primitive)
+                deadline = self._now() + self.stall_s
+                while task.state == _RUNNING:
+                    remaining = deadline - self._now()
+                    if remaining <= 0:
+                        # stopped reporting: blocked inside a real lock.
+                        # When the holder releases it, the thread runs to
+                        # its next preemption point and flips itself back
+                        # to READY in _preempt().
+                        task.state = _BLOCKED
+                        break
+                    self._wake.wait(remaining)
+        return self.record
+
+    def _now(self) -> float:
+        import time
+
+        return time.monotonic()
+
+def run_schedule(scenario: Callable[[DeterministicScheduler], Any],
+                 schedule: Optional[Sequence[str]] = None,
+                 seed: Optional[int] = None,
+                 granularity: str = "line",
+                 max_steps: int = 200_000,
+                 clock: Optional[FaultClock] = None,
+                 stall_s: float = 0.2):
+    """One scheduled run. ``scenario(sched)`` spawns tasks and returns the
+    shared object under test; returns ``(shared, record, sched)``."""
+    sched = DeterministicScheduler(
+        seed=seed, schedule=list(schedule) if schedule else None,
+        granularity=granularity, max_steps=max_steps, clock=clock,
+        stall_s=stall_s)
+    shared = scenario(sched)
+    record = sched.run()
+    return shared, record, sched
+
+
+def explore(scenario: Callable[[DeterministicScheduler], Any],
+            max_schedules: int = 200,
+            granularity: str = "line",
+            max_steps: int = 200_000,
+            stall_s: float = 0.2):
+    """Bounded DFS over the interleaving space (stateless model checking).
+
+    Runs the canonical schedule first, then systematically perturbs the
+    earliest-yet-unperturbed choice point: for each recorded decision
+    with >1 runnable thread, re-runs with the prefix forced to each
+    alternative. Yields ``(shared, record, sched)`` per schedule, at most
+    ``max_schedules`` of them. Exhaustive when the space is smaller than
+    the budget; a breadth-leaning sample otherwise.
+    """
+    tried: set = set()
+    frontier: List[List[str]] = [[]]
+    produced = 0
+    while frontier and produced < max_schedules:
+        prefix = frontier.pop(0)
+        key = tuple(prefix)
+        if key in tried:
+            continue
+        tried.add(key)
+        sched = DeterministicScheduler(
+            schedule=prefix, granularity=granularity, max_steps=max_steps,
+            stall_s=stall_s)
+        shared = scenario(sched)
+        try:
+            record = sched.run()
+        except DeadlockError:
+            record = sched.record
+        except ScheduleDivergence:
+            # infeasible prefix: the forced thread is lock-blocked at that
+            # point in THIS interleaving (prefixes are recorded from runs
+            # with different lock states). Not an error — just a branch
+            # that does not exist; count it against the budget and move on.
+            produced += 1
+            continue
+        produced += 1
+        yield shared, record, sched
+        # expand: alternatives at every choice point from len(prefix) on
+        for i in range(len(prefix), len(record.choices)):
+            options = record.choice_sets[i]
+            if len(options) <= 1:
+                continue
+            for alt in options:
+                if alt == record.choices[i]:
+                    continue
+                frontier.append(record.choices[:i] + [alt])
+
+
+def find_race(scenario: Callable[[DeterministicScheduler], Any],
+              invariant: Callable[[Any], bool],
+              max_schedules: int = 200,
+              granularity: str = "line",
+              max_steps: int = 200_000,
+              stall_s: float = 0.2) -> Optional[RecordedSchedule]:
+    """Search the bounded schedule space for an interleaving that violates
+    ``invariant(shared)`` (or errors/deadlocks a task). Returns the first
+    failing RecordedSchedule — replay it with
+    ``run_schedule(scenario, schedule=found.to_list())`` — or None if
+    every explored schedule upholds the invariant."""
+    for shared, record, sched in explore(
+            scenario, max_schedules=max_schedules, granularity=granularity,
+            max_steps=max_steps, stall_s=stall_s):
+        if record.deadlocked or sched.errors() or not invariant(shared):
+            return record
+    return None

@@ -18,6 +18,41 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# --thread-excepthook-strict: background-thread exceptions fail the test
+# that was running when they fired, instead of scrolling past as console
+# noise. pytest's threadexception plugin already hooks
+# threading.excepthook per test and downgrades a dead thread to
+# PytestUnhandledThreadExceptionWarning; this flag escalates that warning
+# to an error. The serving runtime leans on daemon threads (batcher loop,
+# ipc drain, persistence) whose deaths are otherwise silent — CI runs the
+# tier-1 suite with this flag (plus `python -X dev`) so a swallowed
+# background traceback goes RED. Opt a test out with
+# @pytest.mark.allow_thread_exceptions when the death is the point.
+# ---------------------------------------------------------------------------
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--thread-excepthook-strict", action="store_true", default=False,
+        help="fail a test when a background thread dies with an unhandled "
+             "exception during it (escalates pytest's unhandled-thread-"
+             "exception warning to an error)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if not config.getoption("--thread-excepthook-strict"):
+        return
+    strict = pytest.mark.filterwarnings(
+        "error::pytest.PytestUnhandledThreadExceptionWarning")
+    lenient = pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    for item in items:
+        # marker-applied filters win over ini ones; applying per item keeps
+        # the opt-out marker working
+        item.add_marker(lenient if item.get_closest_marker(
+            "allow_thread_exceptions") else strict)
+
 
 @pytest.fixture(scope="session")
 def eight_devices():

@@ -1,0 +1,160 @@
+"""Concurrent-stress tier-1 tests for the resilience state machines.
+
+The schedule harness (tests/test_schedules.py) proves exact
+interleavings; this file is the complementary blunt instrument: N real
+threads hammering the REAL CircuitBreaker / AdmissionController with no
+scheduler in the way, checking the invariants that must survive any
+interleaving the OS produces:
+
+- counters never go negative and never lose so many updates that
+  accounting breaks (every operation is counted exactly once);
+- the in-flight gauge returns to zero once every caller releases;
+- the admission queue drains;
+- the breaker never double-opens for one failure burst and never wedges
+  half-open with a lost probe slot.
+
+Jax-free and quick (a few hundred ms of real threading) — tier-1.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from seldon_core_tpu.runtime.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    AdmissionController,
+    CircuitBreaker,
+    ShedError,
+)
+from seldon_core_tpu.testing.faults import FaultClock
+
+pytestmark = pytest.mark.faults
+
+N_THREADS = 8
+N_OPS = 200
+
+
+def _run_all(workers):
+    threads = [threading.Thread(target=w, name=f"stress-{i}")
+               for i, w in enumerate(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "stress worker wedged"
+
+
+def test_admission_counters_consistent_under_stress():
+    adm = AdmissionController(max_inflight=3, max_queue=4)
+    admitted = [0] * N_THREADS
+    shed = [0] * N_THREADS
+    errors = []
+
+    def worker(i):
+        def run():
+            for _ in range(N_OPS):
+                try:
+                    adm.acquire_sync(timeout_s=0.05)
+                except ShedError:
+                    shed[i] += 1
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+                else:
+                    admitted[i] += 1
+                    adm.release()
+        return run
+
+    _run_all([worker(i) for i in range(N_THREADS)])
+    assert not errors
+    # every op resolved exactly one way, and the controller agrees
+    assert sum(admitted) + sum(shed) == N_THREADS * N_OPS
+    assert adm.shed_total == sum(shed)
+    assert adm.shed_total >= 0 and adm.admitted_total >= 0
+    # in-flight gauge returns to zero and the queue drains
+    assert adm.inflight == 0
+    assert adm.queue_depth() == 0
+
+
+def test_admission_inflight_never_exceeds_limit():
+    adm = AdmissionController(max_inflight=2, max_queue=N_THREADS)
+    high_water = []
+    hw_lock = threading.Lock()
+
+    def run():
+        for _ in range(50):
+            try:
+                adm.acquire_sync(timeout_s=1.0)
+            except ShedError:
+                continue
+            with hw_lock:
+                high_water.append(adm.inflight)
+            adm.release()
+
+    _run_all([run] * N_THREADS)
+    assert adm.inflight == 0
+    assert high_water and max(high_water) <= 2
+
+
+def test_breaker_counters_consistent_under_stress():
+    clock = FaultClock()
+    breaker = CircuitBreaker("stress", failure_threshold=5,
+                             reset_timeout_s=1e9, clock=clock)
+    allowed = [0] * N_THREADS
+    rejected = [0] * N_THREADS
+
+    def worker(i):
+        def run():
+            for k in range(N_OPS):
+                if breaker.allow():
+                    allowed[i] += 1
+                    (breaker.record_failure if k % 3 else breaker.record_success)()
+                else:
+                    rejected[i] += 1
+        return run
+
+    _run_all([worker(i) for i in range(N_THREADS)])
+    # every rejection was counted exactly once, none went missing
+    assert breaker.rejected_total == sum(rejected)
+    assert breaker.rejected_total >= 0
+    assert breaker.consecutive_failures >= 0
+    assert breaker.state in (CLOSED, OPEN, HALF_OPEN)
+    # transition accounting is an exact event count: with a huge reset
+    # timeout the breaker can only ever CLOSE from half-open probes, and
+    # those are impossible here, so opens can exceed closes by at most 1
+    opens, closes = breaker.transitions[OPEN], breaker.transitions[CLOSED]
+    assert 0 <= opens - closes <= 1
+
+
+def test_breaker_probe_slot_never_leaks_under_stress():
+    """Open -> eligible: exactly one allow() wins the half-open probe per
+    cycle; record_failure re-opens; repeat. The probe slot must neither
+    leak (two Trues per cycle) nor wedge (zero Trues forever)."""
+    clock = FaultClock()
+    breaker = CircuitBreaker("probe", failure_threshold=1,
+                             reset_timeout_s=1.0, clock=clock)
+    breaker.record_failure()  # OPEN
+    clock.advance(1.0)        # make round 1's probe eligible
+    wins = []
+    wins_lock = threading.Lock()
+    rounds = 30
+    barrier = threading.Barrier(N_THREADS)
+
+    def run():
+        for _ in range(rounds):
+            barrier.wait(timeout=30)
+            got = breaker.allow()
+            with wins_lock:
+                if got:
+                    wins.append(1)
+            barrier.wait(timeout=30)
+            if got:
+                breaker.record_failure()  # probe fails -> OPEN again
+                clock.advance(1.0)        # eligible for the next round
+
+    _run_all([run] * N_THREADS)
+    assert len(wins) == rounds  # exactly one winner per round
+    assert breaker.state == OPEN

@@ -1,0 +1,369 @@
+"""Deterministic-interleaving tests: the dynamic half of racelint.
+
+Every static race finding from the PR 6 burn-down ships with either a
+replayable failing schedule here (bug reconstructed -> schedule found ->
+fix proven) or a reasoned waiver in the lint layer. The harness
+(seldon_core_tpu/testing/schedules.py) runs REAL classes — the fixed
+AdmissionController / CircuitBreaker below are the production objects,
+not doubles; only the PRE-fix variants are reconstructions (the same
+idiom tests/test_graftlint.py uses for its historical bugs).
+
+Tier-1 and jax-free: the resilience state machines are pure Python.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from seldon_core_tpu.runtime.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    AdmissionController,
+    CircuitBreaker,
+    ShedError,
+)
+from seldon_core_tpu.testing.faults import FaultClock
+from seldon_core_tpu.testing.schedules import (
+    DeterministicScheduler,
+    ScheduleDivergence,
+    find_race,
+    run_schedule,
+)
+
+pytestmark = pytest.mark.faults  # CI's must-run resilience tier
+
+STALL = 0.03  # tests stage small scenarios; fast stall detection keeps
+              # lock-heavy exploration cheap
+
+
+# ---------------------------------------------------------------------------
+# harness mechanics
+# ---------------------------------------------------------------------------
+
+
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+
+
+def _two_bumps(sched):
+    c = _Counter()
+    sched.spawn(c.bump, name="a")
+    sched.spawn(c.bump, name="b")
+    return c
+
+
+def test_opcode_exploration_finds_lost_update():
+    """x += 1 from two threads: line-level preemption cannot interleave
+    inside the statement, opcode-level must."""
+    bad = find_race(_two_bumps, lambda c: c.n == 2,
+                    granularity="opcode", max_schedules=100, stall_s=STALL)
+    assert bad is not None
+    shared, rec, _ = run_schedule(_two_bumps, schedule=bad.to_list(),
+                                  granularity="opcode", stall_s=STALL)
+    assert shared.n == 1  # the lost update, replayed
+
+
+def test_replay_is_deterministic():
+    bad = find_race(_two_bumps, lambda c: c.n == 2,
+                    granularity="opcode", max_schedules=100, stall_s=STALL)
+    assert bad is not None
+    runs = []
+    for _ in range(3):
+        shared, rec, _ = run_schedule(_two_bumps, schedule=bad.to_list(),
+                                      granularity="opcode", stall_s=STALL)
+        runs.append((shared.n, tuple(rec.choices)))
+    assert runs[0] == runs[1] == runs[2]
+    assert runs[0][0] == 1
+
+
+def test_locked_counter_survives_same_exploration():
+    class Locked(_Counter):
+        def __init__(self):
+            super().__init__()
+            self._lock = threading.Lock()
+
+        def bump(self):
+            with self._lock:
+                self.n += 1
+
+    def scenario(sched):
+        c = Locked()
+        sched.spawn(c.bump, name="a")
+        sched.spawn(c.bump, name="b")
+        return c
+
+    assert find_race(scenario, lambda c: c.n == 2, granularity="opcode",
+                     max_schedules=60, stall_s=STALL) is None
+
+
+def test_divergent_replay_raises():
+    bad = find_race(_two_bumps, lambda c: c.n == 2,
+                    granularity="opcode", max_schedules=100, stall_s=STALL)
+    assert bad is not None
+    wrong = ["zz"] + bad.to_list()
+    with pytest.raises(ScheduleDivergence):
+        run_schedule(_two_bumps, schedule=wrong, granularity="opcode",
+                     stall_s=STALL)
+
+
+def test_deadlock_detected_from_lock_order_inversion():
+    """The dynamic proof of racelint's lock-order-inversion rule: AB vs BA
+    acquisition deadlocks under some schedule, and the harness finds and
+    names it instead of hanging."""
+
+    class Inverted:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def ab(self):
+            with self.a:
+                with self.b:
+                    pass
+
+        def ba(self):
+            with self.b:
+                with self.a:
+                    pass
+
+    def scenario(sched):
+        o = Inverted()
+        sched.spawn(o.ab, name="ab")
+        sched.spawn(o.ba, name="ba")
+        return o
+
+    found = find_race(scenario, lambda o: True, granularity="line",
+                      max_schedules=100, stall_s=STALL)
+    assert found is not None and found.deadlocked
+
+
+def test_seeded_schedules_are_reproducible():
+    rec1 = run_schedule(_two_bumps, seed=7, granularity="opcode",
+                        stall_s=STALL)[1]
+    rec2 = run_schedule(_two_bumps, seed=7, granularity="opcode",
+                        stall_s=STALL)[1]
+    assert rec1.choices == rec2.choices
+
+
+def test_scheduler_integrates_fault_clock():
+    """The virtual scheduler owns a FaultClock; timed state machines under
+    test advance on it deterministically — no wall-clock sleeps."""
+    clock = FaultClock()
+    breaker = CircuitBreaker("n", failure_threshold=1, reset_timeout_s=5.0,
+                             clock=clock)
+
+    def fail_then_recover(sched_clock):
+        breaker.record_failure()          # -> OPEN
+        assert breaker.allow() is False   # still open at t
+        sched_clock.advance(5.0)
+        assert breaker.allow() is True    # half-open probe granted
+        breaker.record_success()          # -> CLOSED
+
+    sched = DeterministicScheduler(clock=clock, stall_s=STALL)
+    sched.spawn(fail_then_recover, sched.clock, name="t")
+    sched.run()
+    assert not sched.errors()
+    assert breaker.state == CLOSED
+    assert breaker.transitions[OPEN] == 1
+    assert breaker.transitions[HALF_OPEN] == 1
+
+
+# ---------------------------------------------------------------------------
+# the PR 6 burn-down races, reconstructed pre-fix and proven post-fix
+# ---------------------------------------------------------------------------
+
+
+class PreFixShedAdmission(AdmissionController):
+    """Reconstruction of the pre-PR-6 AdmissionController bug: on the
+    acquire_sync timeout path where a grant raced the timeout, the code
+    ran ``self.release()`` then ``raise self._shed()`` with NO lock held —
+    so the ``shed_total += 1`` inside _shed could interleave with any
+    other shed and lose updates (racelint: unguarded-shared-state)."""
+
+    def timeout_tail(self):
+        self.release()
+        return self._shed()  # pre-fix: called with no lock held
+
+
+def _prefix_shed_scenario(sched):
+    adm = PreFixShedAdmission(max_inflight=1, max_queue=0)
+    adm.acquire_sync()  # occupy the slot so sheds are live accounting
+    sched.spawn(adm.timeout_tail, name="w0")
+    sched.spawn(adm.timeout_tail, name="w1")
+    return adm
+
+
+def test_prefix_shed_lost_update_found_and_replayable():
+    """The acceptance race: exploration finds a schedule where two
+    concurrent pre-fix sheds record only one, and the recorded schedule
+    replays the corruption deterministically."""
+    bad = find_race(_prefix_shed_scenario, lambda adm: adm.shed_total == 2,
+                    granularity="opcode", max_schedules=150, stall_s=STALL)
+    assert bad is not None, "pre-fix _shed must lose an update under some schedule"
+    for _ in range(2):
+        adm, rec, sched = run_schedule(
+            _prefix_shed_scenario, schedule=bad.to_list(),
+            granularity="opcode", stall_s=STALL)
+        assert not sched.errors()
+        assert adm.shed_total == 1  # two sheds, one counted: the bug
+
+
+def _fixed_shed_scenario(sched):
+    # the REAL class, through the REAL overloaded-acquire path: slot
+    # taken, queue disabled -> both callers shed immediately
+    adm = AdmissionController(max_inflight=1, max_queue=0)
+    adm.acquire_sync()
+
+    def caller():
+        with pytest.raises(ShedError):
+            adm.acquire_sync()
+
+    sched.spawn(caller, name="w0")
+    sched.spawn(caller, name="w1")
+    return adm
+
+
+def test_fixed_shed_survives_exploration():
+    assert find_race(_fixed_shed_scenario, lambda adm: adm.shed_total == 2,
+                     granularity="opcode", max_schedules=80,
+                     stall_s=STALL) is None
+
+
+def test_fixed_timeout_path_sheds_consistently():
+    """The exact code path of the historical bug (acquire_sync timeout with
+    waiters queued), post-fix, under exploration: every shed is counted
+    and the waiter queue drains."""
+
+    def scenario(sched):
+        adm = AdmissionController(max_inflight=1, max_queue=2)
+        adm.acquire_sync()
+
+        def waiter():
+            with pytest.raises(ShedError):
+                adm.acquire_sync(timeout_s=0)  # enqueue, expire, shed
+
+        sched.spawn(waiter, name="w0")
+        sched.spawn(waiter, name="w1")
+        return adm
+
+    def ok(adm):
+        return (adm.shed_total == 2 and adm.queue_depth() == 0
+                and adm.inflight == 1)
+
+    assert find_race(scenario, ok, granularity="line",
+                     max_schedules=60, stall_s=STALL) is None
+
+
+class PreFixStatsCounter:
+    """Reconstruction of the pre-PR-6 BatcherService.submitted bug: the
+    per-request counter bumped from the REST loop and the gRPC worker
+    threads with no lock (the fix guards it with _stats_lock)."""
+
+    def __init__(self):
+        self.submitted = 0
+
+    def submit_sync(self):
+        self.submitted += 1
+
+    def submit(self):
+        self.submitted += 1
+
+
+def test_prefix_batcher_counter_races_and_fix_holds():
+    def buggy(sched):
+        svc = PreFixStatsCounter()
+        sched.spawn(svc.submit_sync, name="grpc")
+        sched.spawn(svc.submit, name="rest")
+        return svc
+
+    bad = find_race(buggy, lambda s: s.submitted == 2,
+                    granularity="opcode", max_schedules=100, stall_s=STALL)
+    assert bad is not None
+    svc, _, _ = run_schedule(buggy, schedule=bad.to_list(),
+                             granularity="opcode", stall_s=STALL)
+    assert svc.submitted == 1
+
+    class Fixed(PreFixStatsCounter):
+        def __init__(self):
+            super().__init__()
+            self._stats_lock = threading.Lock()
+
+        def submit_sync(self):
+            with self._stats_lock:
+                self.submitted += 1
+
+        submit = submit_sync
+
+    def fixed(sched):
+        svc = Fixed()
+        sched.spawn(svc.submit_sync, name="grpc")
+        sched.spawn(svc.submit, name="rest")
+        return svc
+
+    assert find_race(fixed, lambda s: s.submitted == 2,
+                     granularity="opcode", max_schedules=60,
+                     stall_s=STALL) is None
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker state machine under adversarial schedules
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_transitions_consistent_under_exploration():
+    """Two threads race record_failure around the threshold: whatever the
+    interleaving, the breaker must end OPEN exactly once, with the
+    failure counter reset — no double-open, no lost transition."""
+
+    def scenario(sched):
+        b = CircuitBreaker("n", failure_threshold=2, reset_timeout_s=30.0)
+
+        def hammer():
+            b.record_failure()
+            b.record_failure()
+
+        sched.spawn(hammer, name="f0")
+        sched.spawn(hammer, name="f1")
+        return b
+
+    def ok(b):
+        # post-OPEN failures legitimately re-count toward the next
+        # threshold; the invariant is exactly-one OPEN transition
+        return b.state == OPEN and b.transitions[OPEN] == 1
+
+    assert find_race(scenario, ok, granularity="line",
+                     max_schedules=80, stall_s=STALL) is None
+
+
+def test_breaker_single_probe_under_exploration():
+    """Half-open must admit exactly one probe no matter how allow() calls
+    interleave (the _probe_inflight slot)."""
+    clock = FaultClock()
+
+    def scenario(sched):
+        b = CircuitBreaker("n", failure_threshold=1, reset_timeout_s=1.0,
+                           clock=clock)
+        b.record_failure()      # OPEN at t
+        clock.advance(1.0)      # eligible for half-open
+        results = []
+        b._results = results    # carried for the invariant
+
+        def prober():
+            results.append(b.allow())
+
+        sched.spawn(prober, name="p0")
+        sched.spawn(prober, name="p1")
+        return b
+
+    def ok(b):
+        return sorted(b._results) == [False, True] and b.state == HALF_OPEN
+
+    assert find_race(scenario, ok, granularity="line",
+                     max_schedules=80, stall_s=STALL) is None

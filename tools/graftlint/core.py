@@ -42,7 +42,16 @@ RULES = (
 # internal rules that cannot be suppressed or baselined
 META_RULES = ("bad-suppression", "parse-error")
 
-SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*allow-([a-z0-9-]+)\(([^)]*)\)")
+
+def suppress_re(tool: str) -> "re.Pattern[str]":
+    """The inline-suppression pattern for one lint layer. graftlint and
+    racelint share the machinery but answer to different comment tags, so
+    a `# racelint: allow-...` line never silences a graftlint finding (and
+    vice versa)."""
+    return re.compile(rf"#\s*{tool}:\s*allow-([a-z0-9-]+)\(([^)]*)\)")
+
+
+SUPPRESS_RE = suppress_re("graftlint")
 
 
 @dataclass
@@ -88,17 +97,22 @@ class Project:
     errors: List[Finding]  # parse-error / bad-suppression findings
 
 
-def _parse_suppressions(lines: Sequence[str], relpath: str):
+def _parse_suppressions(lines: Sequence[str], relpath: str,
+                        pattern: Optional["re.Pattern[str]"] = None,
+                        known_rules: Optional[Sequence[str]] = None,
+                        tool: str = "graftlint"):
+    pattern = pattern if pattern is not None else SUPPRESS_RE
+    known = tuple(known_rules if known_rules is not None else RULES)
     table: Dict[int, List[Tuple[str, str]]] = {}
     bad: List[Finding] = []
     for i, text in enumerate(lines, start=1):
-        for m in SUPPRESS_RE.finditer(text):
+        for m in pattern.finditer(text):
             rule, reason = m.group(1), m.group(2).strip()
-            if rule not in RULES:
+            if rule not in known:
                 bad.append(Finding(
                     "bad-suppression", relpath, i,
-                    f"unknown rule {rule!r} in graftlint suppression "
-                    f"(known: {', '.join(RULES)})",
+                    f"unknown rule {rule!r} in {tool} suppression "
+                    f"(known: {', '.join(known)})",
                     snippet=text))
                 continue
             if not reason:
@@ -119,13 +133,19 @@ def _parse_suppressions(lines: Sequence[str], relpath: str):
     return table, bad
 
 
-def load_project(paths: Sequence[str]) -> Project:
+def load_project(paths: Sequence[str],
+                 suppress: Optional["re.Pattern[str]"] = None,
+                 known_rules: Optional[Sequence[str]] = None,
+                 tool: str = "graftlint") -> Project:
     """Parse every ``*.py`` under the given files/directories.
 
     relpath convention: files under a directory root are reported relative
     to the root's PARENT (so scanning ``seldon_core_tpu/`` yields
     ``seldon_core_tpu/runtime/batcher.py``) — this keeps baselines portable
     between checkouts and fixture trees.
+
+    ``suppress``/``known_rules``/``tool`` retarget the suppression-comment
+    syntax for sibling lint layers (racelint) that share this loader.
     """
     modules: List[Module] = []
     errors: List[Finding] = []
@@ -164,7 +184,8 @@ def load_project(paths: Sequence[str]) -> Project:
                                       f"could not parse: {e}"))
                 continue
             lines = source.splitlines()
-            supp, bad = _parse_suppressions(lines, rel)
+            supp, bad = _parse_suppressions(lines, rel, suppress, known_rules,
+                                            tool)
             errors.extend(bad)
             modules.append(Module(full, rel, source, tree, lines, supp))
     return Project(modules, errors)
@@ -299,33 +320,19 @@ def apply_baseline(findings: Sequence[Finding], baseline: Dict[str, dict]):
 # runner
 # ----------------------------------------------------------------------
 
-def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
-             rules: Optional[Sequence[str]] = None):
-    """Run all (or the selected) checkers.
-
-    Returns (reported, absorbed, suppressed) finding lists. ``reported``
-    non-empty => the tree fails the gate. Suppressions never apply to the
-    meta rules (bad-suppression / parse-error).
-    """
-    from tools.graftlint.checkers import all_checkers
-
-    project = load_project(paths)
-    findings: List[Finding] = list(project.errors)
-    active = set(rules or RULES)
-    unknown = active - set(RULES)
-    if unknown:
-        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
-    for checker in all_checkers():
-        if checker.rule in active:
-            findings.extend(checker.run(project))
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
-
+def finalize_findings(project: Project, findings: Sequence[Finding],
+                      known_rules: Sequence[str],
+                      baseline_path: Optional[str]):
+    """The shared tail of every lint layer's run: apply inline
+    suppressions (never to meta rules), split off the baseline, sort.
+    Returns (reported, absorbed, suppressed)."""
+    known = set(known_rules)
     by_module = {m.relpath: m for m in project.modules}
     suppressed: List[Finding] = []
     surviving: List[Finding] = []
-    for f in findings:
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
         mod = by_module.get(f.path)
-        if f.rule in RULES and mod is not None:
+        if f.rule in known and mod is not None:
             rules_here = [r for r, _ in mod.suppressions.get(f.line, [])]
             if f.rule in rules_here:
                 suppressed.append(f)
@@ -334,9 +341,84 @@ def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
 
     baseline = load_baseline(baseline_path) if baseline_path else {}
     # meta findings are never baselined
-    base_eligible = [f for f in surviving if f.rule in RULES]
-    meta = [f for f in surviving if f.rule not in RULES]
+    base_eligible = [f for f in surviving if f.rule in known]
+    meta_findings = [f for f in surviving if f.rule not in known]
     reported, absorbed = apply_baseline(base_eligible, baseline)
-    reported = meta + reported
+    reported = meta_findings + reported
     reported.sort(key=lambda f: (f.path, f.line, f.rule))
     return reported, absorbed, suppressed
+
+
+def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
+             rules: Optional[Sequence[str]] = None, meta: bool = True):
+    """Run all (or the selected) checkers.
+
+    Returns (reported, absorbed, suppressed) finding lists. ``reported``
+    non-empty => the tree fails the gate. Suppressions never apply to the
+    meta rules (bad-suppression / parse-error). ``meta=False`` drops the
+    parse/suppression errors — only the parallel runner uses it, so the
+    shared meta findings are counted once, not once per worker.
+    """
+    from tools.graftlint.checkers import all_checkers
+
+    project = load_project(paths)
+    findings: List[Finding] = list(project.errors) if meta else []
+    active = set(rules or RULES)
+    unknown = active - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+    for checker in all_checkers():
+        if checker.rule in active:
+            findings.extend(checker.run(project))
+    return finalize_findings(project, findings, RULES, baseline_path)
+
+
+def parallel_by_rule(worker, paths: Sequence[str],
+                     baseline_path: Optional[str],
+                     rules: Optional[Sequence[str]], jobs: int,
+                     all_rules: Sequence[str], serial_fn):
+    """Shared --jobs implementation: split the rule set across worker
+    processes and merge. Rule-level partitioning is semantically
+    identical to the serial run: every checker is whole-tree
+    (metrics-drift cross-references the registry globally, racelint's
+    lock graph is global — file-level chunking would break both),
+    baseline fingerprints embed the rule so per-group baseline
+    application cannot double-absorb, and the meta findings (parse
+    errors, bad suppressions) are emitted by exactly one group.
+    ``worker`` must be a module-level function (ProcessPool pickling)
+    taking (paths, baseline_path, rule_group, meta).
+    """
+    active = list(rules or all_rules)
+    unknown = set(active) - set(all_rules)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+    jobs = max(1, min(int(jobs), len(active)))
+    if jobs == 1:
+        return serial_fn(paths, baseline_path=baseline_path, rules=active)
+    groups = [active[i::jobs] for i in range(jobs)]
+    from concurrent.futures import ProcessPoolExecutor
+
+    work = [(list(paths), baseline_path, g, i == 0)
+            for i, g in enumerate(groups)]
+    merged = ([], [], [])
+    with ProcessPoolExecutor(max_workers=jobs) as pool:
+        for result in pool.map(worker, work):
+            for acc, part in zip(merged, result):
+                acc.extend(part)
+    for acc in merged:
+        acc.sort(key=lambda f: (f.path, f.line, f.rule))
+    return merged
+
+
+def _parallel_worker(args):
+    """Module-level so ProcessPoolExecutor can pickle it. Runs one rule
+    group and returns plain finding lists."""
+    paths, baseline_path, rule_group, meta = args
+    return run_lint(paths, baseline_path=baseline_path, rules=rule_group,
+                    meta=meta)
+
+
+def run_lint_parallel(paths: Sequence[str], baseline_path: Optional[str],
+                      rules: Optional[Sequence[str]], jobs: int):
+    return parallel_by_rule(_parallel_worker, paths, baseline_path, rules,
+                            jobs, RULES, run_lint)

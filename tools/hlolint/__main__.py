@@ -55,6 +55,10 @@ def main(argv=None) -> int:
     parser.add_argument("--budget-diff", default=None,
                         help="write the budget-vs-compiled cost diff as JSON "
                              "(CI uploads this as an artifact on failure)")
+    parser.add_argument("--jobs", type=int, default=1, metavar="N",
+                        help="lower+compile contract artifacts in N threads "
+                             "(checks still run serially in declaration "
+                             "order, so output is identical to --jobs 1)")
     parser.add_argument("--format", choices=("text", "json"), default="text")
     parser.add_argument("--list", action="store_true",
                         help="list contract names and exit")
@@ -122,7 +126,8 @@ def main(argv=None) -> int:
     ensure_platform()
     try:
         reported, absorbed, waived, budget_diff, measured = run_contracts(
-            contracts, budgets=budgets, baseline=baseline, checks=checks)
+            contracts, budgets=budgets, baseline=baseline, checks=checks,
+            jobs=args.jobs)
     except ValueError as e:
         print(f"hlolint: {e}", file=sys.stderr)
         return 2

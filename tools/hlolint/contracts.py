@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 from typing import Dict, List
 
 from tools.hlolint.core import Contract
@@ -47,50 +48,58 @@ def ensure_platform() -> None:
 
 
 _STATE: Dict[str, object] = {}
+# --jobs builds contract artifacts in a thread pool; the lazy fixtures
+# below are check-then-act on _STATE, so unlocked concurrent builders
+# would each load (and compile) their own server. RLock because fixtures
+# nest (_batcher builds on _base_server).
+_STATE_LOCK = threading.RLock()
 
 
 def _base_server():
     """bf16 compute + int8 KV llama-tiny LLMServer — the serving layout the
     PR 2/3 perf work targets, at test dims."""
-    if "server" not in _STATE:
-        ensure_platform()
-        from seldon_core_tpu.servers.llmserver import LLMServer
+    with _STATE_LOCK:
+        if "server" not in _STATE:
+            ensure_platform()
+            from seldon_core_tpu.servers.llmserver import LLMServer
 
-        s = LLMServer(
-            model="llama-tiny", model_kwargs={"dtype": "bfloat16"},
-            init_random=True, max_new_tokens=N_STEPS + 1,
-            len_buckets=(PLEN,), batch_buckets=(1, SLOTS), seed=7,
-            kv_cache_dtype="int8",
-        )
-        s.load()
-        _STATE["server"] = s
-    return _STATE["server"]
+            s = LLMServer(
+                model="llama-tiny", model_kwargs={"dtype": "bfloat16"},
+                init_random=True, max_new_tokens=N_STEPS + 1,
+                len_buckets=(PLEN,), batch_buckets=(1, SLOTS), seed=7,
+                kv_cache_dtype="int8",
+            )
+            s.load()
+            _STATE["server"] = s
+        return _STATE["server"]
 
 
 def _tp_server():
     """tensor_parallel=2 over the virtual 8-mesh: the TP decode contract."""
-    if "tp_server" not in _STATE:
-        ensure_platform()
-        from seldon_core_tpu.servers.llmserver import LLMServer
+    with _STATE_LOCK:
+        if "tp_server" not in _STATE:
+            ensure_platform()
+            from seldon_core_tpu.servers.llmserver import LLMServer
 
-        s = LLMServer(
-            model="llama-tiny", model_kwargs={"dtype": "bfloat16"},
-            init_random=True, max_new_tokens=N_STEPS + 1,
-            len_buckets=(PLEN,), batch_buckets=(1,), seed=7,
-            kv_cache_dtype="int8", tensor_parallel=2,
-        )
-        s.load()
-        _STATE["tp_server"] = s
-    return _STATE["tp_server"]
+            s = LLMServer(
+                model="llama-tiny", model_kwargs={"dtype": "bfloat16"},
+                init_random=True, max_new_tokens=N_STEPS + 1,
+                len_buckets=(PLEN,), batch_buckets=(1,), seed=7,
+                kv_cache_dtype="int8", tensor_parallel=2,
+            )
+            s.load()
+            _STATE["tp_server"] = s
+        return _STATE["tp_server"]
 
 
 def _batcher():
-    if "batcher" not in _STATE:
-        from seldon_core_tpu.runtime.batcher import ContinuousBatcher
+    with _STATE_LOCK:  # nests into _base_server's hold: RLock
+        if "batcher" not in _STATE:
+            from seldon_core_tpu.runtime.batcher import ContinuousBatcher
 
-        _STATE["batcher"] = ContinuousBatcher(
-            _base_server(), max_slots=SLOTS, max_len=MAX_LEN)
-    return _STATE["batcher"]
+            _STATE["batcher"] = ContinuousBatcher(
+                _base_server(), max_slots=SLOTS, max_len=MAX_LEN)
+        return _STATE["batcher"]
 
 
 def _cache_specs(batch: int):
@@ -197,25 +206,26 @@ def _build_jaxserver_predict():
     ensure_platform()
     import jax.numpy as jnp
 
-    if "jaxserver" not in _STATE:
-        import jax
+    with _STATE_LOCK:
+        if "jaxserver" not in _STATE:
+            import jax
 
-        from seldon_core_tpu.models import get_model
-        from seldon_core_tpu.servers.jaxserver import JAXServer, export_checkpoint
+            from seldon_core_tpu.models import get_model
+            from seldon_core_tpu.servers.jaxserver import JAXServer, export_checkpoint
 
-        m = get_model("mlp", features=(16,), num_classes=4)
-        params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
-        # held in _STATE so the checkpoint dir is removed at interpreter
-        # exit instead of leaking one temp dir per hlolint run
-        tmp = tempfile.TemporaryDirectory(prefix="hlolint-jaxserver-")
-        _STATE["jaxserver_tmp"] = tmp
-        export_checkpoint(tmp.name, "mlp", params,
-                          kwargs={"features": (16,), "num_classes": 4},
-                          input_shape=[8], use_orbax=False)
-        js = JAXServer(model_uri=tmp.name, batch_buckets=(4,))
-        js.load()
-        _STATE["jaxserver"] = js
-    js = _STATE["jaxserver"]
+            m = get_model("mlp", features=(16,), num_classes=4)
+            params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+            # held in _STATE so the checkpoint dir is removed at interpreter
+            # exit instead of leaking one temp dir per hlolint run
+            tmp = tempfile.TemporaryDirectory(prefix="hlolint-jaxserver-")
+            _STATE["jaxserver_tmp"] = tmp
+            export_checkpoint(tmp.name, "mlp", params,
+                              kwargs={"features": (16,), "num_classes": 4},
+                              input_shape=[8], use_orbax=False)
+            js = JAXServer(model_uri=tmp.name, batch_buckets=(4,))
+            js.load()
+            _STATE["jaxserver"] = js
+        js = _STATE["jaxserver"]
     return js._apply, (js._params, _sds((4, 8), "float32"))
 
 

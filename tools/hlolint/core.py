@@ -421,12 +421,19 @@ def run_contracts(
     budgets: Optional[dict] = None,
     baseline: Optional[Dict[str, dict]] = None,
     checks: Optional[Sequence[str]] = None,
+    jobs: int = 1,
 ):
     """Lower+compile each contract and run its declared checks.
 
     Returns (reported, absorbed, waived, budget_diff, measured_costs).
     ``reported`` non-empty => the gate fails. ``measured_costs`` holds the
     compiled cost of every cost-checked contract (for --update-budgets).
+
+    ``jobs > 1`` builds the Artifacts (the expensive lower+compile step)
+    in a thread pool — XLA compilation releases the GIL, and the lazy
+    contract fixtures are lock-guarded (tools/hlolint/contracts.py) —
+    then runs the checks serially in declaration order, so findings and
+    budget diffs are byte-identical to the serial run.
     """
     active = set(checks or CHECKS)
     unknown = active - set(CHECKS)
@@ -439,6 +446,26 @@ def run_contracts(
     budget_diff: Dict[str, dict] = {}
     measured: Dict[str, Dict[str, float]] = {}
 
+    prebuilt: Dict[str, object] = {}
+    if jobs > 1 and len(contracts) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        def build(c):
+            try:
+                return Artifact(c)
+            except Exception as e:  # noqa: BLE001 — reported per contract below
+                return e
+
+        # The first contract is built alone to warm the shared lazy
+        # fixtures (base server, TP server, batcher): building it inside
+        # the pool would just park every worker on the fixture lock
+        # behind one loader, paying thread overhead for no overlap.
+        prebuilt[contracts[0].name] = build(contracts[0])
+        rest = contracts[1:]
+        with ThreadPoolExecutor(max_workers=min(int(jobs), len(rest))) as pool:
+            for c, art in zip(rest, pool.map(build, rest)):
+                prebuilt[c.name] = art
+
     for contract in contracts:
         for key, reason in contract.waivers.items():
             if not str(reason).strip():
@@ -448,7 +475,11 @@ def run_contracts(
                     "mandatory, it is the audit trail",
                     detail=key))
         try:
-            art = Artifact(contract)
+            art = prebuilt.get(contract.name)
+            if art is None:
+                art = Artifact(contract)
+            elif isinstance(art, Exception):
+                raise art
         except Exception as e:  # noqa: BLE001 — any build/lower/compile failure is the finding
             findings.append(Finding(
                 contract.name, "build-error",

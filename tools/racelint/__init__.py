@@ -1,0 +1,8 @@
+"""racelint — lock-discipline and shared-state race analysis.
+
+The third enforcing lint layer: graftlint guards the source, hlolint
+guards the compiled artifact, racelint guards the CONCURRENCY of the
+serving runtime (docs/static-analysis.md). Stdlib-only, like graftlint.
+"""
+
+from tools.racelint.core import RULES, run_lint, run_lint_parallel  # noqa: F401

@@ -1,11 +1,11 @@
-"""graftlint CLI.
+"""racelint CLI.
 
-    python -m tools.graftlint [paths...]
+    python -m tools.racelint [paths...]
         [--baseline FILE | --no-baseline] [--update-baseline]
-        [--rules r1,r2] [--format text|json] [--verbose]
+        [--rules r1,r2] [--jobs N] [--format text|json] [--verbose]
 
-Exit codes: 0 clean, 1 findings (or a baseline that no longer matches
-anything when --update-baseline pruned it), 2 usage/configuration error.
+Exit codes: 0 clean, 1 findings, 2 usage/configuration error — the same
+contract as graftlint/hlolint (docs/static-analysis.md).
 """
 
 from __future__ import annotations
@@ -15,16 +15,17 @@ import json
 import os
 import sys
 
-from tools.graftlint.core import (
-    RULES, load_baseline, run_lint, run_lint_parallel, save_baseline)
+from tools.graftlint.core import load_baseline, save_baseline
+from tools.racelint.core import RULES, run_lint, run_lint_parallel
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
-        prog="python -m tools.graftlint",
-        description="repo-native static analysis (docs/static-analysis.md)")
+        prog="python -m tools.racelint",
+        description="lock-discipline and shared-state race analysis "
+                    "(docs/static-analysis.md)")
     parser.add_argument("paths", nargs="*", default=["seldon_core_tpu"],
                         help="files or directories to scan "
                              "(default: seldon_core_tpu)")
@@ -40,8 +41,8 @@ def main(argv=None) -> int:
                         help="comma-separated subset of: " + ", ".join(RULES))
     parser.add_argument("--jobs", type=int, default=1, metavar="N",
                         help="run rule groups in N worker processes "
-                             "(CI uses this to keep lint wall time flat as "
-                             "layers are added; 1 = serial)")
+                             "(CI uses this to keep three lint layers "
+                             "inside the old two-layer wall time)")
     parser.add_argument("--format", choices=("text", "json"), default="text")
     parser.add_argument("--verbose", action="store_true",
                         help="also list suppressed/baselined findings")
@@ -50,7 +51,7 @@ def main(argv=None) -> int:
     paths = args.paths or ["seldon_core_tpu"]
     for p in paths:
         if not os.path.exists(p):
-            print(f"graftlint: path does not exist: {p}", file=sys.stderr)
+            print(f"racelint: path does not exist: {p}", file=sys.stderr)
             return 2
 
     baseline_path = None
@@ -59,7 +60,7 @@ def main(argv=None) -> int:
             DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
         if args.baseline and not os.path.exists(args.baseline) \
                 and not args.update_baseline:
-            print(f"graftlint: baseline not found: {args.baseline}",
+            print(f"racelint: baseline not found: {args.baseline}",
                   file=sys.stderr)
             return 2
 
@@ -73,23 +74,20 @@ def main(argv=None) -> int:
             reported, absorbed, suppressed = run_lint(
                 paths, baseline_path=live_baseline, rules=rules)
     except ValueError as e:
-        print(f"graftlint: {e}", file=sys.stderr)
+        print(f"racelint: {e}", file=sys.stderr)
         return 2
 
     if args.update_baseline:
-        # Regenerate from the FULL finding set (reported + still-absorbed):
-        # saving only the unabsorbed remainder would erase every live
-        # grandfathered entry and its hand-written reason. Reasons of
-        # entries whose fingerprint is still live are carried over.
+        # regenerate from the FULL set (reported + still-absorbed) so live
+        # grandfathered entries and their hand-written reasons survive
         target = args.baseline or DEFAULT_BASELINE
         keep = {}
-        if baseline_path and os.path.exists(baseline_path):
-            keep = load_baseline(baseline_path)
+        if live_baseline:
+            keep = load_baseline(live_baseline)
         entries = [f for f in reported if f.rule in RULES] + absorbed
         save_baseline(target, entries, keep_reasons=keep)
-        fresh = sum(1 for f in entries
-                    if keep.get(f.fingerprint()) is None)
-        print(f"graftlint: wrote {len(entries)} finding(s) to {target} "
+        fresh = sum(1 for f in entries if keep.get(f.fingerprint()) is None)
+        print(f"racelint: wrote {len(entries)} finding(s) to {target} "
               f"({fresh} new — fill in each new entry's reason before "
               "committing)")
         return 0
@@ -108,9 +106,9 @@ def main(argv=None) -> int:
                 print(f"[suppressed] {f.render()}")
             for f in absorbed:
                 print(f"[baselined]  {f.render()}")
-        tail = (f"graftlint: {len(reported)} finding(s)"
-                f" ({len(suppressed)} suppressed, {len(absorbed)} baselined)")
-        print(tail, file=sys.stderr)
+        print(f"racelint: {len(reported)} finding(s)"
+              f" ({len(suppressed)} suppressed, {len(absorbed)} baselined)",
+              file=sys.stderr)
     return 1 if reported else 0
 
 

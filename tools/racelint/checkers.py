@@ -1,0 +1,331 @@
+"""racelint rules over the concurrency model (tools/racelint/model.py).
+
+Four rules, each encoding a failure class the serving runtime has to
+survive (docs/static-analysis.md has the full catalog):
+
+- ``unguarded-shared-state``   — a class practices a lock discipline on an
+  attribute (some accesses under ``with self._lock``) but not everywhere,
+  or mutates shared state read-modify-write from several execution
+  contexts with no lock at all. In a continuous batcher these are silent
+  token corruption, not crashes.
+- ``lock-order-inversion``     — the lock-acquisition graph (lock A held
+  while acquiring B) contains a cycle, or a non-reentrant lock is
+  re-acquired while held (an immediate self-deadlock).
+- ``await-with-lock-held``     — ``await`` inside ``with <threading
+  lock>``: the coroutine parks holding a lock that event-loop neighbors
+  and worker threads block on; one slow awaitable freezes them all.
+- ``unbounded-shutdown-wait``  — timeout-less ``.wait()`` / ``.join()`` /
+  ``.result()`` on a shutdown path: a wedged background thread makes
+  ``close()`` hang forever instead of failing loudly.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, List, Sequence, Set, Tuple
+
+from tools.graftlint.core import Finding, snippet_at
+from tools.racelint.model import (
+    CTX_CALLER,
+    CTX_INIT,
+    LockEdge,
+    ModuleModel,
+    interprocedural_edges,
+    lexical_edges,
+)
+
+SHUTDOWN_FN_RE = re.compile(
+    r"(^|_)(close|stop|shutdown|halt|terminate|finalize|cleanup|teardown|quit)"
+    r"(_|$)|^__(exit|del)__$|atexit")
+
+
+def _finding(module, rule, line, message, function) -> Finding:
+    return Finding(rule, module.relpath, line, message, function,
+                   snippet_at(module, line))
+
+
+def _short_lock(lock_id: str) -> str:
+    return lock_id.split(":", 1)[1] if ":" in lock_id else lock_id
+
+
+# ---------------------------------------------------------------------------
+# unguarded-shared-state
+# ---------------------------------------------------------------------------
+
+
+class UnguardedSharedStateChecker:
+    rule = "unguarded-shared-state"
+
+    def run(self, models: Sequence[ModuleModel]) -> List[Finding]:
+        out: List[Finding] = []
+        for mm in models:
+            for cm in mm.classes:
+                if cm.active:
+                    out.extend(self._check_scope(
+                        mm, cm.funcs, cm.qualname, is_module=False))
+            if mm.locks:
+                out.extend(self._check_scope(
+                    mm, mm.funcs, "<module>", is_module=True))
+        return out
+
+    def _check_scope(self, mm, funcs, scope_name, is_module) -> List[Finding]:
+        out: List[Finding] = []
+        by_attr: Dict[str, list] = {}
+        for unit in funcs.values():
+            if unit.ctxs == {CTX_INIT}:
+                continue  # constructor-only code is single-threaded
+            for a in unit.accesses:
+                by_attr.setdefault(a.attr, []).append(a)
+        for attr, accesses in sorted(by_attr.items()):
+            writes = [a for a in accesses if a.kind in ("write", "rmw")]
+            if not writes:
+                continue  # effectively immutable after __init__
+            guarded = [a for a in accesses if a.held()]
+            unguarded = [a for a in accesses if not a.held()]
+            label = attr if is_module else f"self.{attr}"
+            # discipline is anchored on guarded WRITES: a read that merely
+            # happens inside some locked region (a config attr consulted
+            # under the prefix-cache lock) declares nothing about the attr
+            guarded_writes = [a for a in guarded if a.kind in ("write", "rmw")]
+            if guarded_writes and unguarded:
+                locks = Counter(
+                    lock for a in guarded for lock in a.held())
+                lock_name, n_guard = locks.most_common(1)[0]
+                seen: Set[Tuple[str, int]] = set()
+                for a in unguarded:
+                    key = (a.func.qualname, a.line)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    verb = "written" if a.kind in ("write", "rmw") else "read"
+                    out.append(_finding(
+                        mm.module, self.rule, a.line,
+                        f"{scope_name}.{attr}: {label} is guarded by "
+                        f"{_short_lock(lock_name)} at {n_guard} of "
+                        f"{len(accesses)} access sites but {verb} here with "
+                        "no lock held — the inferred discipline says this "
+                        "access can interleave with a guarded writer. Take "
+                        "the lock, or annotate why this site is safe.",
+                        a.func.qualname))
+            else:
+                # no guarded writes: no declared discipline. Only the
+                # lost-update class fires — an unlocked read-modify-write
+                # reachable from two or more execution contexts.
+                ctxs = set()
+                for a in accesses:
+                    ctxs |= a.func.ctxs
+                ctxs.discard(CTX_INIT)
+                # `caller` is self-concurrent: a concurrency-active class's
+                # public surface can be entered from two transport threads
+                # at once. `thread`/`loop` alone are sequential (one spawned
+                # worker, one event loop) until a second context joins.
+                if len(ctxs) < 2 and CTX_CALLER not in ctxs:
+                    continue
+                seen = set()
+                for a in unguarded:
+                    if a.kind != "rmw":
+                        continue
+                    key = (a.func.qualname, a.line)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(_finding(
+                        mm.module, self.rule, a.line,
+                        f"{scope_name}.{attr}: unlocked read-modify-write "
+                        f"of {label}, reachable from concurrent execution "
+                        f"contexts ({', '.join(sorted(ctxs))}) — concurrent "
+                        "increments lose updates (check-then-act / "
+                        "load-add-store is not atomic across preemption). "
+                        "Guard it with a lock, or annotate why the "
+                        "contexts cannot overlap.",
+                        a.func.qualname))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# lock-order-inversion
+# ---------------------------------------------------------------------------
+
+
+class LockOrderChecker:
+    rule = "lock-order-inversion"
+
+    def run(self, models: Sequence[ModuleModel]) -> List[Finding]:
+        # the acquisition graph is GLOBAL: a cycle may span classes and
+        # modules (engine holds breaker lock, breaker callback re-enters
+        # a metrics lock, ...)
+        edges: List[LockEdge] = []
+        for mm in models:
+            edges.extend(lexical_edges(mm.module))
+            for cm in mm.classes:
+                edges.extend(interprocedural_edges(cm))
+
+        out: List[Finding] = []
+        seen: Set[Tuple[str, str, int]] = set()
+        graph: Dict[str, Set[str]] = {}
+        for e in edges:
+            if e.held == e.acquired:
+                key = (e.held, e.acquired, e.line)
+                if key in seen:
+                    continue
+                seen.add(key)
+                via = f" (via call to {e.via_call}())" if e.via_call else ""
+                out.append(_finding(
+                    e.module, self.rule, e.line,
+                    f"re-acquiring {_short_lock(e.held)} while already "
+                    f"holding it{via} — threading.Lock is not reentrant, "
+                    "this deadlocks the first time the path executes. Use "
+                    "a _locked variant of the callee, or an RLock if "
+                    "reentrancy is genuinely needed.",
+                    e.func.qualname))
+            else:
+                graph.setdefault(e.held, set()).add(e.acquired)
+
+        for cycle in self._cycles(graph):
+            cyc_set = set(cycle)
+            names = " -> ".join(_short_lock(c) for c in cycle + [cycle[0]])
+            for e in edges:
+                if e.held in cyc_set and e.acquired in cyc_set \
+                        and e.held != e.acquired:
+                    key = (e.held, e.acquired, e.line)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    via = f" (via call to {e.via_call}())" if e.via_call else ""
+                    out.append(_finding(
+                        e.module, self.rule, e.line,
+                        f"lock-order inversion: acquiring "
+                        f"{_short_lock(e.acquired)} while holding "
+                        f"{_short_lock(e.held)}{via} completes the cycle "
+                        f"[{names}] — two threads taking the cycle from "
+                        "different ends deadlock. Pick one global order "
+                        "and acquire in it everywhere.",
+                        e.func.qualname))
+        return out
+
+    @staticmethod
+    def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+        """Strongly-connected components with more than one node
+        (Tarjan, iterative)."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        counter = [0]
+        sccs: List[List[str]] = []
+
+        def strongconnect(v: str):
+            work = [(v, iter(sorted(graph.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(graph.get(w, ())))))
+                        advanced = True
+                        break
+                    elif w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        sccs.append(sorted(comp))
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        return sccs
+
+
+# ---------------------------------------------------------------------------
+# await-with-lock-held
+# ---------------------------------------------------------------------------
+
+
+class AwaitWithLockChecker:
+    rule = "await-with-lock-held"
+
+    def run(self, models: Sequence[ModuleModel]) -> List[Finding]:
+        out: List[Finding] = []
+        for mm in models:
+            scopes = list(mm.classes) + [None]
+            for scope in scopes:
+                funcs = scope.funcs if scope is not None else mm.funcs
+                for unit in funcs.values():
+                    for site in unit.awaits:
+                        if not site.locks:
+                            continue
+                        names = ", ".join(sorted(
+                            _short_lock(l) for l in site.locks))
+                        out.append(_finding(
+                            mm.module, self.rule, site.line,
+                            f"await while holding {names} (a THREADING "
+                            "lock, not an asyncio one): the coroutine can "
+                            "park here indefinitely with the lock held, "
+                            "blocking every thread and loop-neighbor that "
+                            "needs it — and if the awaited work needs the "
+                            "same lock, the loop deadlocks. Release "
+                            "before awaiting, or use asyncio.Lock.",
+                            unit.qualname))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# unbounded-shutdown-wait
+# ---------------------------------------------------------------------------
+
+
+class ShutdownWaitChecker:
+    rule = "unbounded-shutdown-wait"
+
+    def run(self, models: Sequence[ModuleModel]) -> List[Finding]:
+        out: List[Finding] = []
+        for mm in models:
+            scopes = list(mm.classes) + [None]
+            for scope in scopes:
+                funcs = scope.funcs if scope is not None else mm.funcs
+                for unit in funcs.values():
+                    if not SHUTDOWN_FN_RE.search(unit.name):
+                        continue
+                    for site in unit.waits:
+                        recv = f"{site.receiver}." if site.receiver else ""
+                        out.append(_finding(
+                            mm.module, self.rule, site.line,
+                            f"{recv}{site.method}() without a timeout on "
+                            f"the shutdown path {unit.qualname!r}: if the "
+                            "other side is wedged (a hung device call, a "
+                            "dead worker), shutdown hangs forever and the "
+                            "process needs a SIGKILL. Pass a timeout and "
+                            "surface the stall instead.",
+                            unit.qualname))
+        return out
+
+
+def all_checkers():
+    return [
+        UnguardedSharedStateChecker(),
+        LockOrderChecker(),
+        AwaitWithLockChecker(),
+        ShutdownWaitChecker(),
+    ]

@@ -1,0 +1,77 @@
+"""racelint runner: suppressions, baseline, and rule dispatch.
+
+Shares graftlint's machinery (tools/graftlint/core.py): the same Finding
+fingerprinting, the same shrink-only baseline with mandatory reasons, the
+same one-line suppression syntax — just answering to a different comment
+tag so the layers cannot silence each other:
+
+    self.submitted += 1  # racelint: allow-unguarded-shared-state(reason...)
+
+Baseline: ``tools/racelint/baseline.json``, same format and semantics as
+graftlint's (entries die with the code they fingerprint; the count
+ratchet in tests/test_racelint.py means it may only shrink).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from tools.graftlint.core import (
+    Finding,
+    finalize_findings,
+    load_baseline,
+    load_project,
+    parallel_by_rule,
+    save_baseline,
+    suppress_re,
+)
+
+RULES = (
+    "unguarded-shared-state",
+    "lock-order-inversion",
+    "await-with-lock-held",
+    "unbounded-shutdown-wait",
+)
+
+META_RULES = ("bad-suppression", "parse-error")
+
+SUPPRESS_RE = suppress_re("racelint")
+
+__all__ = ["RULES", "run_lint", "run_lint_parallel", "load_baseline",
+           "save_baseline"]
+
+
+def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
+             rules: Optional[Sequence[str]] = None, meta: bool = True):
+    """Returns (reported, absorbed, suppressed); ``reported`` non-empty
+    fails the gate. Same contract as graftlint's run_lint."""
+    from tools.racelint.checkers import all_checkers
+    from tools.racelint.model import build_models
+
+    project = load_project(paths, suppress=SUPPRESS_RE, known_rules=RULES,
+                           tool="racelint")
+    findings: List[Finding] = list(project.errors) if meta else []
+    active = set(rules or RULES)
+    unknown = active - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+    models = build_models(project)
+    for checker in all_checkers():
+        if checker.rule in active:
+            findings.extend(checker.run(models))
+    return finalize_findings(project, findings, RULES, baseline_path)
+
+
+def _parallel_worker(args):
+    paths, baseline_path, rule_group, meta = args
+    return run_lint(paths, baseline_path=baseline_path, rules=rule_group,
+                    meta=meta)
+
+
+def run_lint_parallel(paths: Sequence[str], baseline_path: Optional[str],
+                      rules: Optional[Sequence[str]], jobs: int):
+    """--jobs: rule groups across worker processes (the shared
+    graftlint-core scheme — whole-tree checkers, rule-scoped baseline
+    fingerprints, meta findings from exactly one group)."""
+    return parallel_by_rule(_parallel_worker, paths, baseline_path, rules,
+                            jobs, RULES, run_lint)
