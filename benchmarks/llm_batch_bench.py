@@ -35,6 +35,17 @@ def main() -> None:
     ap.add_argument("--fuse-steps", type=int, default=0,
                     help="K fused device-side decode steps per host sync "
                          "when the admit queue is empty (0 = off)")
+    ap.add_argument("--kv-cache-layout", default="",
+                    choices=("", "paged", "dense"),
+                    help="batcher KV layout (default paged)")
+    ap.add_argument("--kv-page-size", type=int, default=0,
+                    help="tokens per KV page for the paged layout "
+                         "(0 = default 64)")
+    ap.add_argument("--kv-pool-pages", type=int, default=0,
+                    help="total pages in the global pool (0 = fully "
+                         "provisioned; smaller oversubscribes)")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked admission prefill size (0 = default 256)")
     args = ap.parse_args()
 
     import jax
@@ -61,6 +72,10 @@ def main() -> None:
                        len_buckets=(plen,), batch_buckets=(1, args.clients),
                        temperature=0.0, eos_id=-1,
                        kv_cache_dtype=args.kv_cache_dtype,
+                       kv_cache_layout=args.kv_cache_layout,
+                       kv_page_size=args.kv_page_size,
+                       kv_pool_pages=args.kv_pool_pages,
+                       prefill_chunk=args.prefill_chunk,
                        decode_pipeline_depth=args.pipeline_depth,
                        decode_fuse_steps=args.fuse_steps)
     server.load()
@@ -131,7 +146,12 @@ def main() -> None:
                    "max_new_tokens": max_new, "prompt_len": plen,
                    "model": kwargs},
         "kv_cache": {"dtype": server.kv_cache_dtype,
-                     "bytes_per_token": kv_per_tok},
+                     "layout": server.kv_cache_layout,
+                     "bytes_per_token": kv_per_tok,
+                     # paged pool accounting (zeros when dense): resident
+                     # HBM is pool pages, not slots x max_len
+                     "pages": {k: v for k, v in server.llm_stats().items()
+                               if k.startswith("kv_page")}},
         "sequential": {"tok_per_s": round(seq_tokens / seq_s, 1),
                        "wall_s": round(seq_s, 2), "tokens": seq_tokens},
         "direct": {"tok_per_s": round(direct_tokens / direct_s, 1),

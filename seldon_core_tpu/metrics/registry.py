@@ -138,6 +138,43 @@ class MetricsRegistry:
             base,
             registry=self.registry,
         )
+        # Paged KV pool (runtime/batcher.py PageAllocator): the in-use/total
+        # page pair is the oversubscription headroom gauge — in_use nearing
+        # total means admissions queue and the exhaustion shed path is about
+        # to bite; fragmentation is the slack between tokens written and
+        # page tokens held (the page-size knob's overhead term) —
+        # docs/performance.md "Paged KV cache"
+        self._kv_pages_in_use = Gauge(
+            "seldon_llm_kv_pages_in_use",
+            "KV pages currently allocated to slots (paged layout)",
+            base,
+            registry=self.registry,
+        )
+        self._kv_pages_total = Gauge(
+            "seldon_llm_kv_pages_total",
+            "Total KV pages in the global pool (incl. the 2 reserved pages)",
+            base,
+            registry=self.registry,
+        )
+        self._kv_page_fragmentation = Gauge(
+            "seldon_llm_kv_page_fragmentation",
+            "Internal fragmentation of allocated KV pages "
+            "(1 - tokens written / page tokens held, 0-1)",
+            base,
+            registry=self.registry,
+        )
+        # Page-exhaustion sheds 503 from INSIDE the serving loop (LIFO
+        # victim / unservable admission, runtime/batcher.py PageAllocator),
+        # a path that never touches the AdmissionController — without its
+        # own counter these sheds are invisible to an operator alerting on
+        # seldon_resilience_shed_total while clients see RESOURCE_EXHAUSTED
+        self._kv_page_sheds = Counter(
+            "seldon_llm_kv_page_sheds_total",
+            "Requests shed (503 + Retry-After / RESOURCE_EXHAUSTED) by KV "
+            "page-pool exhaustion",
+            base,
+            registry=self.registry,
+        )
         self._decode_step = Histogram(
             "seldon_llm_decode_step_seconds",
             "LLM decode step latency",
@@ -265,6 +302,22 @@ class MetricsRegistry:
         self._kv_bytes_per_step.labels(**self._base()).set(
             stats.get("kv_bytes_per_step", 0)
         )
+        self._kv_pages_in_use.labels(**self._base()).set(
+            stats.get("kv_pages_in_use", 0)
+        )
+        self._kv_pages_total.labels(**self._base()).set(
+            stats.get("kv_pages_total", 0)
+        )
+        self._kv_page_fragmentation.labels(**self._base()).set(
+            stats.get("kv_page_fragmentation", 0.0)
+        )
+        # counter catch-up from the allocator's own tally (sheds happen on
+        # the decode hot path, counted locally — same idiom as
+        # seldon_resilience_shed_total)
+        page_sheds = self._kv_page_sheds.labels(**self._base())
+        delta = stats.get("kv_page_sheds", 0) - page_sheds._value.get()
+        if delta > 0:
+            page_sheds.inc(delta)
         hist = self._decode_step.labels(**self._base())
         for seconds in stats.get("decode_step_times_s", ()):
             hist.observe(seconds)

@@ -44,6 +44,23 @@ PAD_POS = 1 << 28
 KV_CACHE_DTYPES = ("bf16", "int8")
 _KV_QMAX = 127.0
 
+# KV-cache layouts. "dense" is the historical per-slot [b, max_len, ...]
+# allocation; "paged" stores KV in a global pool of fixed-size pages
+# ([pages, page_size, ...]) addressed through per-sequence block tables —
+# the vLLM/PagedAttention design (Kwon et al., SOSP 2023), which bills HBM
+# for pages actually written instead of max_len per slot.
+KV_CACHE_LAYOUTS = ("dense", "paged")
+
+# Reserved page ids in every paged pool. NULL_PAGE backs unallocated
+# block-table tail entries: its position row is PAD_POS forever (writes
+# through a NULL entry are redirected device-side), so gathering it always
+# reads as "masked, never attended". TRASH_PAGE absorbs garbage writes —
+# inactive batcher slots ride along in the static-shape decode step, and
+# their stale writes must land somewhere no live block table points.
+NULL_PAGE = 0
+TRASH_PAGE = 1
+RESERVED_PAGES = 2
+
 
 def normalize_kv_cache_dtype(value) -> str:
     """Canonical kv_cache_dtype ("bf16" or "int8"); raises ValueError on
@@ -55,6 +72,19 @@ def normalize_kv_cache_dtype(value) -> str:
         return "int8"
     raise ValueError(
         f"unknown kv_cache_dtype {value!r}: expected one of {KV_CACHE_DTYPES}"
+    )
+
+
+def normalize_kv_cache_layout(value) -> str:
+    """Canonical kv_cache_layout ("dense" or "paged"); raises ValueError on
+    anything else so misconfiguration fails at load() time, not inside jit."""
+    v = str(value or "paged").strip().lower()
+    if v in ("paged", "page", "block"):
+        return "paged"
+    if v in ("dense", "slot", "flat"):
+        return "dense"
+    raise ValueError(
+        f"unknown kv_cache_layout {value!r}: expected one of {KV_CACHE_LAYOUTS}"
     )
 
 
@@ -179,12 +209,63 @@ class RMSNorm(nn.Module):
         return rms_norm(x, w, self.eps)
 
 
+def paged_write_targets(block_tables: jnp.ndarray, positions: jnp.ndarray,
+                        page_size: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(page, offset) pool coordinates for writing each token's KV.
+
+    ``block_tables``: [b, n_pages] page ids; ``positions``: [b, s] absolute
+    token positions (PAD_POS for padding). Tokens whose position falls past
+    the table, or whose table entry is NULL_PAGE (unallocated — the host
+    failed to provision, or an inactive batcher slot riding along in the
+    static-shape step), are redirected to TRASH_PAGE: the null page's
+    PAD_POS position row is a device-side invariant no write may break."""
+    p = positions.astype(jnp.int32)
+    n_pages = block_tables.shape[1]
+    page_idx = p // page_size
+    valid = (p >= 0) & (page_idx < n_pages)
+    entry = jnp.take_along_axis(
+        block_tables, jnp.clip(page_idx, 0, n_pages - 1), axis=1)
+    entry = jnp.where(valid & (entry != NULL_PAGE), entry, TRASH_PAGE)
+    return entry, p % page_size
+
+
+def gather_paged_view(cache, block_tables: jnp.ndarray, dtype):
+    """Gather a paged pool back into the per-sequence logical view:
+    (k_all, v_all, pos_view) of [b, n_pages*page_size, kvh, hd] / [b, L].
+
+    The ONE copy of the block-table read semantics: both the attention
+    fallback below and ops/paged_attention.py's ``paged_attention_ref``
+    (the kernel's parity oracle) address the pool through this gather, so
+    a change to the page addressing can never desynchronize them. int8
+    pools (5-tuple) dequantize here — the gather moves bytes, never
+    arithmetic, so the view feeds any downstream einsum exactly as the
+    dense layout would."""
+    bt = jnp.asarray(block_tables, jnp.int32)
+    b = bt.shape[0]
+    ps = cache[0].shape[1]
+    L = bt.shape[1] * ps
+    if len(cache) == 5:
+        kq_pool, ks_pool, vq_pool, vs_pool, pos_pool = cache
+        kvh, hd = kq_pool.shape[2], kq_pool.shape[3]
+        k_all = dequantize_kv(kq_pool[bt].reshape(b, L, kvh, hd),
+                              ks_pool[bt].reshape(b, L, kvh), dtype)
+        v_all = dequantize_kv(vq_pool[bt].reshape(b, L, kvh, hd),
+                              vs_pool[bt].reshape(b, L, kvh), dtype)
+    else:
+        k_pool, v_pool, pos_pool = cache
+        kvh, hd = k_pool.shape[2], k_pool.shape[3]
+        k_all = k_pool[bt].reshape(b, L, kvh, hd)
+        v_all = v_pool[bt].reshape(b, L, kvh, hd)
+    return k_all, v_all, pos_pool[bt].reshape(b, L)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x, positions, cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
-                 cache_index: Optional[jnp.ndarray] = None):
+                 cache_index: Optional[jnp.ndarray] = None,
+                 block_tables: Optional[jnp.ndarray] = None):
         """x: [b, s, d]. With cache=(k_cache, v_cache, pos_cache) of
         [b, max_len, kvh, hd] / [b, max_len] — or the int8 layout
         (k_q, k_scale, v_q, v_scale, pos_cache) with int8 values and
@@ -194,6 +275,16 @@ class Attention(nn.Module):
         slots — continuous batching decode, s must be 1). pos_cache holds each
         slot's absolute position (PAD_POS when empty), so causal masking is
         exact under right-padding: empty/pad slots are never attended.
+
+        With ``block_tables`` ([b, n_pages] int32) the cache tuple is a PAGED
+        pool — [pages, page_size, kvh, hd] buffers (same bf16 3-tuple / int8
+        5-tuple structure, leading dims [pages, page_size] instead of
+        [b, max_len]) shared by all sequences. Each token writes at the pool
+        coordinate its block table maps its position to, and attention reads
+        gather the per-sequence logical view back through the table — the
+        gathered view feeds the IDENTICAL masked einsum as the dense path,
+        so paged and dense decode are bit-exact (tests/test_paged_kv.py).
+        cache_index is ignored (positions alone address the pool).
         Without a cache: full causal attention, returns (out, (k, v))."""
         cfg = self.cfg
         b, s, _ = x.shape
@@ -225,7 +316,43 @@ class Attention(nn.Module):
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
 
-        if cache is not None and len(cache) == 5:
+        use_paged_kernel = False
+        if cache is not None and block_tables is not None:
+            # Paged pool: write each token's K/V at the (page, offset) its
+            # block table maps its position to; read by gathering the pages
+            # back into the per-sequence logical [b, n_pages*ps, ...] view.
+            bt = jnp.asarray(block_tables, jnp.int32)
+            ps = cache[0].shape[1]
+            entry, off = paged_write_targets(bt, positions, ps)
+            if len(cache) == 5:
+                kq_pool, ks_pool, vq_pool, vs_pool, pos_pool = cache
+                kq, ks = quantize_kv(k)
+                vq, vs = quantize_kv(v)
+                kq_pool = kq_pool.at[entry, off].set(kq)
+                ks_pool = ks_pool.at[entry, off].set(ks)
+                vq_pool = vq_pool.at[entry, off].set(vq)
+                vs_pool = vs_pool.at[entry, off].set(vs)
+                pos_pool = pos_pool.at[entry, off].set(
+                    positions.astype(pos_pool.dtype))
+                new_cache = (kq_pool, ks_pool, vq_pool, vs_pool, pos_pool)
+            else:
+                k_pool, v_pool, pos_pool = cache
+                k_pool = k_pool.at[entry, off].set(k.astype(k_pool.dtype))
+                v_pool = v_pool.at[entry, off].set(v.astype(v_pool.dtype))
+                pos_pool = pos_pool.at[entry, off].set(
+                    positions.astype(pos_pool.dtype))
+                new_cache = (k_pool, v_pool, pos_pool)
+            from seldon_core_tpu.ops.paged_attention import paged_kernel_viable
+
+            use_paged_kernel = s == 1 and paged_kernel_viable()
+            if not use_paged_kernel:
+                # pure-gather fallback: reconstruct the logical view and fall
+                # through to the SAME masked einsum the dense layout uses —
+                # paged == dense bit-for-bit (masked positions contribute
+                # exact zeros).
+                k_all, v_all, pos_view = gather_paged_view(new_cache, bt, dt)
+                mask = pos_view[:, None, :] <= positions[:, :, None]
+        elif cache is not None and len(cache) == 5:
             # int8 cache: (k_q, k_scale, v_q, v_scale, pos). Quantize-on-write
             # (new K/V rows become int8 + per-head scales before the scatter),
             # dequant fused into the attention read below.
@@ -280,7 +407,14 @@ class Attention(nn.Module):
             mask = positions[:, None, :] <= positions[:, :, None]  # [b, s, kv]
             new_cache = (k, v)
 
-        if cache is None and cfg.attention_impl == "ring":
+        if use_paged_kernel:
+            # TPU decode fast path: one Pallas pass streams ONLY the pages
+            # each sequence's block table names (probe-gated; every other
+            # platform took the gather fallback above).
+            from seldon_core_tpu.ops.paged_attention import paged_attention
+
+            out = paged_attention(q, new_cache, bt, positions)
+        elif cache is None and cfg.attention_impl == "ring":
             from seldon_core_tpu.ops.ring_attention import ring_attention
 
             # ring is GQA-aware: unrepeated KV rides the ring
@@ -363,10 +497,12 @@ class TransformerBlock(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, cache=None, cache_index=None):
+    def __call__(self, x, positions, cache=None, cache_index=None,
+                 block_tables=None):
         cfg = self.cfg
         h, new_cache = Attention(cfg, name="attention")(
-            RMSNorm(cfg.dim, cfg.norm_eps, name="attention_norm")(x), positions, cache, cache_index
+            RMSNorm(cfg.dim, cfg.norm_eps, name="attention_norm")(x), positions, cache, cache_index,
+            block_tables,
         )
         ffn_norm = RMSNorm(cfg.dim, cfg.norm_eps, name="ffn_norm")
         if cfg.fused_norm:
@@ -391,8 +527,11 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, caches=None, cache_index=None):
-        """tokens: [b, s] int32. Returns (logits [b, s, vocab], new_caches)."""
+    def __call__(self, tokens, positions=None, caches=None, cache_index=None,
+                 block_tables=None):
+        """tokens: [b, s] int32. Returns (logits [b, s, vocab], new_caches).
+        ``block_tables`` ([b, n_pages] int32, shared by every layer) switches
+        the caches to the paged-pool layout — see Attention."""
         cfg = self.cfg
         b, s = tokens.shape
         if positions is None:
@@ -406,7 +545,8 @@ class Transformer(nn.Module):
         new_caches = []
         for i in range(cfg.n_layers):
             layer_cache = caches[i] if caches is not None else None
-            x, nc = TransformerBlock(cfg, name=f"layer_{i}")(x, positions, layer_cache, cache_index)
+            x, nc = TransformerBlock(cfg, name=f"layer_{i}")(
+                x, positions, layer_cache, cache_index, block_tables)
             new_caches.append(nc)
         x = RMSNorm(cfg.dim, cfg.norm_eps, name="norm")(x)
         if cfg.tie_embeddings:
@@ -447,6 +587,44 @@ def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int,
             jnp.zeros(shape, dtype=cfg.dtype),
             jnp.zeros(shape, dtype=cfg.dtype),
             jnp.full((batch, max_len), PAD_POS, dtype=jnp.int32),
+        )
+        for _ in range(cfg.n_layers)
+    ]
+
+
+def init_paged_kv_caches(cfg: TransformerConfig, num_pages: int,
+                         page_size: int, kv_cache_dtype: Optional[str] = None):
+    """Paged KV pools: one (k, v, pos) triple per layer with leading dims
+    [num_pages, page_size] instead of [batch, max_len] — pages are shared by
+    every sequence through per-sequence block tables. Pages 0 and 1 are
+    reserved (NULL_PAGE / TRASH_PAGE; see module constants), so a pool of
+    ``num_pages`` serves ``num_pages - RESERVED_PAGES`` tokens' worth of
+    allocatable KV. Position rows initialise to PAD_POS (never attended);
+    int8 pools carry f32 [num_pages, page_size, kvh] scale planes
+    initialised to 1 (empty slots dequantize to exact zeros)."""
+    if num_pages <= RESERVED_PAGES:
+        raise ValueError(
+            f"paged KV pool needs > {RESERVED_PAGES} pages "
+            f"(got {num_pages}; pages 0/1 are reserved)")
+    kvd = normalize_kv_cache_dtype(kv_cache_dtype or cfg.kv_cache_dtype)
+    shape = (num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    if kvd == "int8":
+        scale_shape = (num_pages, page_size, cfg.n_kv_heads)
+        return [
+            (
+                jnp.zeros(shape, dtype=jnp.int8),
+                jnp.ones(scale_shape, dtype=jnp.float32),
+                jnp.zeros(shape, dtype=jnp.int8),
+                jnp.ones(scale_shape, dtype=jnp.float32),
+                jnp.full((num_pages, page_size), PAD_POS, dtype=jnp.int32),
+            )
+            for _ in range(cfg.n_layers)
+        ]
+    return [
+        (
+            jnp.zeros(shape, dtype=cfg.dtype),
+            jnp.zeros(shape, dtype=cfg.dtype),
+            jnp.full((num_pages, page_size), PAD_POS, dtype=jnp.int32),
         )
         for _ in range(cfg.n_layers)
     ]

@@ -39,24 +39,183 @@ the lag can only cost wasted compute, never wrong output
 When the admit queue is empty, ``decode_fuse_steps`` K>1 fuses K steps into
 one device-side ``lax.scan`` between syncs (one dispatch + one host read
 per K tokens).
+
+Paged KV cache (PR 7): with ``kv_cache_layout="paged"`` (the default) the
+dense ``[S, max_len, ...]`` slot pool is replaced by a GLOBAL pool of
+fixed-size KV pages plus a device-resident per-slot block table — the
+vLLM/PagedAttention design (Kwon et al., SOSP 2023). HBM is billed for
+pages actually written, so a deliberately undersized pool
+(``kv_pool_pages``) oversubscribes: more concurrent slots per HBM byte,
+with page-exhaustion shedding (503 + Retry-After, runtime/resilience.py
+ShedError) as the relief valve — the decode loop never raises. Admission
+prefill runs in fixed-size chunks (``prefill_chunk``) interleaved with
+decode dispatches (Sarathi-Serve; Agrawal et al., OSDI 2024), so a
+2k-token prompt never stalls in-flight decodes for its whole compile
+bucket. Page bookkeeping is host-side (PageAllocator, lock-guarded);
+block-table updates are jitted device ops that serialize behind in-flight
+steps in device program order, exactly like the dense ``insert``.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 from typing import Any, List, Optional, Sequence
 
 import numpy as np
 
+from seldon_core_tpu.models.transformer import (
+    NULL_PAGE,
+    PAD_POS,
+    RESERVED_PAGES,
+    TRASH_PAGE,
+    normalize_kv_cache_layout,
+)
 from seldon_core_tpu.servers.llmserver import LLMServer, _bucket
 
 logger = logging.getLogger(__name__)
 
+DEFAULT_PAGE_SIZE = 64
+DEFAULT_PREFILL_CHUNK = 256
+
+
+def _page_table_ops():
+    """Jitted block-table / page ops, shared by every batcher instance
+    (jax.jit caches per input shape, so two batchers with equal shapes
+    share compiled code — a per-batcher closure would recompile these on
+    every instance, and the page-growth path runs them MID-DECODE where a
+    compile is a stall). Built on first use; the double-build race is
+    benign (both results are equivalent, last write wins)."""
+    ops = _page_table_ops.__dict__.get("ops")
+    if ops is not None:
+        return ops
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def set_block_row(bt, slot, row):
+        return bt.at[slot].set(row)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def set_block_entry(bt, slot, idx, page):
+        return bt.at[slot, idx].set(page)
+
+    # Reset the POSITION rows of newly-allocated pages to PAD_POS: a page
+    # off the free list still holds its previous owner's positions, and a
+    # stale real position would make another sequence's mask attend
+    # garbage. page_ids is padded to a fixed length with TRASH_PAGE
+    # (re-masking trash is harmless), so one compile serves every
+    # allocation size.
+    @partial(jax.jit, donate_argnums=(0,))
+    def reset_pages(caches, page_ids):
+        return [
+            layer[:-1] + (layer[-1].at[page_ids].set(PAD_POS),)
+            for layer in caches
+        ]
+
+    # Per-slot admission update for the device-resident decode state (both
+    # layouts; slot index is traced, so one compile serves every slot). The
+    # position and key arrays are donated — the host never reads them;
+    # last_tok is NOT donated because its buffer may alias a stacked token
+    # output the host still has to read (see LLMServer._get_decode_step).
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def set_slot(last_tok, next_pos, keys, slot, tok, pos, key):
+        return (last_tok.at[slot].set(tok), next_pos.at[slot].set(pos),
+                keys.at[slot].set(key))
+
+    ops = (set_block_row, set_block_entry, reset_pages, set_slot)
+    _page_table_ops.ops = ops
+    return ops
+
+
+class PageAllocator:
+    """Host-side free-list allocator over the global KV page pool.
+
+    Pages 0/1 are reserved (NULL/TRASH — models/transformer.py); the rest
+    are handed out lowest-id-first, all-or-nothing. Every state transition
+    happens under ``self._lock``: alloc/free run on the batcher loop's
+    worker threads while /metrics scrapes read the gauges from transport
+    threads, and an unlocked free-list pop is exactly the double-allocation
+    the deterministic-interleaving suite (tests/test_schedules.py) guards
+    against. Double frees raise — a page returned twice would be handed to
+    two slots and silently cross-corrupt their KV."""
+
+    def __init__(self, total_pages: int, page_size: int):
+        if total_pages <= RESERVED_PAGES:
+            raise ValueError(
+                f"page pool needs > {RESERVED_PAGES} pages (got {total_pages})")
+        self.total = int(total_pages)
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        # pop() from the tail hands out the lowest free id: deterministic
+        # placement makes schedule replays and parity tests reproducible
+        self._free = list(range(self.total - 1, RESERVED_PAGES - 1, -1))
+        self._free_set = set(self._free)
+        self.shed_total = 0
+
+    @property
+    def capacity(self) -> int:
+        return self.total - RESERVED_PAGES
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages, all-or-nothing; None when the pool can't cover it."""
+        with self._lock:
+            if n > len(self._free):
+                return None
+            pages = [self._free.pop() for _ in range(n)]
+            self._free_set.difference_update(pages)
+            return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p in self._free_set or not (RESERVED_PAGES <= p < self.total):
+                    raise ValueError(f"double/invalid free of page {p}")
+                self._free.append(p)
+                self._free_set.add(p)
+
+    def count_shed(self) -> None:
+        """One page-exhaustion shed (counted under the same lock as the
+        free list it describes)."""
+        with self._lock:
+            self.shed_total += 1
+
+    def stats(self):
+        """(total, in_use, shed_total) — one consistent snapshot."""
+        with self._lock:
+            return self.total, self.capacity - len(self._free), self.shed_total
+
+
+class _PrefillJob:
+    """One chunked admission in progress: the slot it targets, the (already
+    truncated) prompt, the next write offset, and the device block-table
+    row its chunks write through. Only one job runs at a time; decode
+    dispatches interleave between its chunks."""
+
+    __slots__ = ("slot", "ids", "L", "next", "chunk", "max_new", "fut",
+                 "on_token", "info", "seed", "bt_row", "pages")
+
+    def __init__(self, slot, ids, start, chunk, max_new, fut, on_token,
+                 info, seed, bt_row, pages):
+        self.slot = slot
+        self.ids = ids
+        self.L = len(ids)
+        self.next = start            # first position the next chunk writes
+        self.chunk = chunk
+        self.max_new = max_new
+        self.fut = fut
+        self.on_token = on_token
+        self.info = info
+        self.seed = seed
+        self.bt_row = bt_row         # device [1, n_pages] int32
+        self.pages = pages           # host mirror of the allocated pages
+
 
 class _Slot:
     __slots__ = ("future", "tokens", "true_len", "n_new", "max_new", "active",
-                 "on_token", "gen", "disp_new")
+                 "on_token", "gen", "disp_new", "pages", "prefilling",
+                 "admit_seq")
 
     def __init__(self):
         self.active = False
@@ -74,6 +233,13 @@ class _Slot:
         # clamp the fused-K block so it never overruns max_new/max_len
         self.gen = 0
         self.disp_new = 0
+        # paged layout: the slot's allocated page ids (host mirror of its
+        # block-table row), whether a chunked prefill is mid-flight for it,
+        # and its admission sequence number (shed-victim ordering: newest
+        # admitted sheds first on page exhaustion)
+        self.pages: List[int] = []
+        self.prefilling = False
+        self.admit_seq = 0
 
     # cache positions are derived, never mirrored: after the prompt's L
     # tokens the n-th generated token sits at position true_len + n - 1
@@ -208,6 +374,10 @@ class ContinuousBatcher:
         len_buckets: Optional[Sequence[int]] = None,
         pipeline_depth: Optional[int] = None,
         fuse_steps: Optional[int] = None,
+        layout: Optional[str] = None,
+        page_size: Optional[int] = None,
+        pool_pages: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
     ):
         server.load()
         self.server = server
@@ -251,6 +421,38 @@ class ContinuousBatcher:
         fuse = fuse_steps if fuse_steps is not None else getattr(
             server, "decode_fuse_steps", 0)
         self.fuse_steps = max(int(fuse), 0)
+        # KV layout: paged (global page pool + per-slot block tables) or the
+        # historical dense slot pool. max_len keeps its requested value —
+        # truncation/budget semantics are layout-independent — and the
+        # block-table view simply spans ceil(max_len/page_size) pages (the
+        # past-max_len tail of the last page is never written and its
+        # PAD_POS rows are never attended).
+        if layout is None:
+            layout = getattr(server, "kv_cache_layout", "dense")
+        self.paged = normalize_kv_cache_layout(layout) == "paged"
+        if self.paged:
+            ps = int(page_size if page_size is not None else
+                     getattr(server, "kv_page_size", 0) or 0) or DEFAULT_PAGE_SIZE
+            if ps <= 0:
+                raise ValueError(f"kv_page_size={ps} must be positive")
+            self.page_size = ps
+            self.n_pages = -(-self.max_len // ps)   # pages per slot
+            pool = int(pool_pages if pool_pages is not None else
+                       getattr(server, "kv_pool_pages", 0) or 0)
+            # 0 = fully provisioned (every slot can reach max_len at once —
+            # never sheds on pages); smaller pools oversubscribe
+            self.pool_pages = pool or (self.S * self.n_pages + RESERVED_PAGES)
+            if self.pool_pages - RESERVED_PAGES < self.n_pages:
+                raise ValueError(
+                    f"kv_pool_pages={self.pool_pages} cannot hold even one "
+                    f"max_len sequence ({self.n_pages} pages of {ps} tokens "
+                    f"+ {RESERVED_PAGES} reserved)")
+            chunk = int(prefill_chunk if prefill_chunk is not None else
+                        getattr(server, "prefill_chunk", 0) or 0)
+            self.prefill_chunk = chunk or DEFAULT_PREFILL_CHUNK
+            self._allocator = PageAllocator(self.pool_pages, ps)
+        self._prefill: Optional[_PrefillJob] = None
+        self._admit_seq = 0
         self._inflight: Any = deque()
         self._inflight_hwm = 0       # max steps in flight ever reached
         self._last_admit_inflight = 0  # steps in flight at the last admit
@@ -270,39 +472,58 @@ class ContinuousBatcher:
         # slot caches inherit the server's KV storage format (int8 halves
         # the per-step attention read traffic — the dominant b8 term in
         # benchmarks/DECODE_NOTES.md)
-        self._caches = jax.jit(
-            lambda: init_kv_caches(cfg, self.S, self.max_len, server.kv_cache_dtype)
-        )()
+        if self.paged:
+            from seldon_core_tpu.models.transformer import (
+                PAD_POS, init_paged_kv_caches)
+
+            self._caches = jax.jit(
+                lambda: init_paged_kv_caches(
+                    cfg, self.pool_pages, self.page_size, server.kv_cache_dtype)
+            )()
+        else:
+            self._caches = jax.jit(
+                lambda: init_kv_caches(cfg, self.S, self.max_len, server.kv_cache_dtype)
+            )()
         self._cache_nbytes = sum(
             int(getattr(leaf, "nbytes", 0)) for leaf in jax.tree.leaves(self._caches)
         )
 
-        # donate the big slot cache through both mutating jits (insert and
-        # the decode step): self._caches is reassigned from the output each
-        # time, so XLA aliases the buffers and updates in place instead of
-        # copying S x max_len of KV per call. These donations are verified
-        # at the COMPILED level (input_output_alias) by the batcher.insert /
-        # batcher.set_slot / llm.decode_step_s4 contracts in tools/hlolint —
-        # a cache-structure change that silently breaks the aliasing fails
-        # CI, not a 7B perf round. (small is NOT donated: its 1-slot buffers
-        # can alias no output, XLA would just drop it.)
-        @partial(jax.jit, donate_argnums=(0,))
-        def insert(big, small, slot):
-            return jax.tree.map(lambda b, s: b.at[slot].set(s[0]), big, small)
+        if self.paged:
+            # Paged pool: no insert — chunked prefill writes straight into
+            # the pool through the slot's block-table row. The device block
+            # table (one row per slot) starts all-TRASH so inactive slots'
+            # ride-along decode writes land in the trash page; rows switch
+            # to real pages at activation and back to trash at release.
+            # Every table/pos mutation is a donated jit, so program order on
+            # the device stream serializes it behind in-flight steps exactly
+            # like the dense insert (see module docstring).
+            self._block_tables = jnp.full(
+                (self.S, self.n_pages), TRASH_PAGE, jnp.int32)
+            self._trash_row = jnp.full((self.n_pages,), TRASH_PAGE, jnp.int32)
+        else:
+            # donate the big slot cache through both mutating jits (insert
+            # and the decode step): self._caches is reassigned from the
+            # output each time, so XLA aliases the buffers and updates in
+            # place instead of copying S x max_len of KV per call. These
+            # donations are verified at the COMPILED level
+            # (input_output_alias) by the batcher.insert / batcher.set_slot
+            # / llm.decode_step_s4 contracts in tools/hlolint — a
+            # cache-structure change that silently breaks the aliasing fails
+            # CI, not a 7B perf round. (small is NOT donated: its 1-slot
+            # buffers can alias no output, XLA would just drop it.)
+            @partial(jax.jit, donate_argnums=(0,))
+            def insert(big, small, slot):
+                return jax.tree.map(lambda b, s: b.at[slot].set(s[0]), big, small)
 
-        self._insert = insert
+            self._insert = insert
 
-        # Per-slot admission update for the device-resident decode state
-        # (slot index is traced, so one compile serves every slot). The
-        # position and key arrays are donated — the host never reads them;
-        # last_tok is NOT donated because its buffer may alias a stacked
-        # token output the host still has to read (see _get_decode_step).
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def set_slot(last_tok, next_pos, keys, slot, tok, pos, key):
-            return (last_tok.at[slot].set(tok), next_pos.at[slot].set(pos),
-                    keys.at[slot].set(key))
-
-        self._set_slot = set_slot
+        # jitted table/slot-state ops are process-shared singletons
+        # (_page_table_ops): a fresh batcher reuses the compiled code of
+        # any prior batcher with the same shapes instead of recompiling
+        # its own closures — page growth runs these mid-decode, where a
+        # compile is a serving stall
+        (self._set_block_row, self._set_block_entry, self._reset_pages,
+         self._set_slot) = _page_table_ops()
 
         # device-resident per-slot decode state, threaded output->input
         # through every dispatched step (the decode jit updates them; the
@@ -402,20 +623,12 @@ class ContinuousBatcher:
             await self._task
 
     # ------------------------------------------------------------------
-    def _admit(self, ids: List[int], max_new: int, fut: asyncio.Future,
-               on_token: Optional[Any] = None,
-               info: Optional[dict] = None,
-               seed: Optional[int] = None) -> bool:
-        import jax
-        import jax.numpy as jnp
-
-        from seldon_core_tpu.models.transformer import PAD_POS
-
-        free = next((i for i, s in enumerate(self._slots) if not s.active), None)
-        if free is None:
-            return False
-        # same truncation rule as LLMServer.generate: never beyond the model's
-        # trained context, and leave room for at least one generated token
+    def _truncate_prompt(self, ids: List[int], max_new: int,
+                         info: Optional[dict]):
+        """Shared admission clipping: same truncation rule as
+        LLMServer.generate — never beyond the model's trained context, and
+        leave room for at least one generated token. Returns
+        (clipped ids, plen bucket)."""
         plen = min(
             _bucket(len(ids), self.len_buckets),
             self.server._cfg.max_seq_len,
@@ -443,22 +656,18 @@ class ContinuousBatcher:
                 "batcher will stop at %d new tokens (requested %d): slot "
                 "cache max_len=%d minus prompt %d",
                 self.max_len - plen, max_new, self.max_len, plen)
-        ids = ids[-plen:]
-        L = len(ids)
-        tokens = np.zeros((1, plen), np.int32)
-        positions = np.full((1, plen), PAD_POS, np.int32)
-        tokens[0, :L] = ids
-        positions[0, :L] = np.arange(L)
+        return ids[-plen:], plen
 
-        prefill = self.server._get_prefill(1, plen, self.max_len)
-        logits, cache1 = prefill(self.server._params, jnp.asarray(tokens), jnp.asarray(positions))
-        self._caches = self._insert(self._caches, cache1, free)
-        # graftlint: allow-host-sync-in-hot-path(admission-time sync, once per request not per token: the first sampled token must reach the host to seed slot bookkeeping before the slot joins the pipelined batch)
-        first_logits = np.asarray(logits[0, L - 1]).astype(np.float32)
+    def _sample_first(self, first_logits: np.ndarray, seed: Optional[int]):
+        """Host-side first-token draw from the prefill logits, on exactly
+        generate()'s rng chain (PRNGKey -> split for the first token ->
+        split per decode step). Returns (token, per-slot device key)."""
+        import jax
+        import jax.numpy as jnp
+
         # Per-request rng: an explicit seed reproduces generate(seed=...)'s
-        # exact chain (PRNGKey -> split for the first token -> split per
-        # decode step); otherwise derive an independent key from the
-        # batcher rng so concurrent requests don't share a stream.
+        # exact chain; otherwise derive an independent key from the batcher
+        # rng so concurrent requests don't share a stream.
         if seed is not None:
             key = jax.random.PRNGKey(int(seed))
         else:
@@ -473,9 +682,21 @@ class ContinuousBatcher:
             draw = int(np.asarray(jax.random.categorical(
                 sub, jnp.asarray(first_logits[topi]) / max(float(self._temp), 1e-6))))
             first = int(topi[draw])
+        return first, key
 
-        slot = self._slots[free]
+    def _commit_slot(self, i: int, first: int, key, L: int, max_new: int,
+                     fut: asyncio.Future, on_token: Optional[Any]):
+        """Slot bookkeeping shared by dense admission and paged activation:
+        thread the new occupant's state into the device arrays and surface
+        the first token. Program order on the device stream puts the
+        set_slot after every already-dispatched step, so in-flight steps
+        still see (and waste compute on) the old state while step N+1 picks
+        up the new occupant."""
+        import jax.numpy as jnp
+
+        slot = self._slots[i]
         slot.active = True
+        slot.prefilling = False
         slot.future = fut
         slot.true_len = L
         slot.max_new = max_new
@@ -484,20 +705,371 @@ class ContinuousBatcher:
         slot.on_token = on_token
         slot.gen += 1          # invalidates in-flight tokens for the old occupant
         slot.disp_new = 1      # the prefill-sampled first token counts
-        # thread the new slot's state into the device arrays; program order
-        # on the device stream puts this after every already-dispatched
-        # step, so in-flight steps still see (and waste compute on) the old
-        # state while step N+1 picks up the new occupant
+        self._admit_seq += 1
+        slot.admit_seq = self._admit_seq
         self._last_tok, self._next_pos, self._keys = self._set_slot(
             self._last_tok, self._next_pos, self._keys,
-            jnp.asarray(free, jnp.int32), jnp.asarray(first, jnp.int32),
+            jnp.asarray(i, jnp.int32), jnp.asarray(first, jnp.int32),
             jnp.asarray(L, jnp.int32), key)
         self._last_admit_inflight = len(self._inflight)
         if on_token is not None and first != self.eos_id:
             on_token(first)
         if first == self.eos_id or max_new <= 1:
-            self._finish(free)
+            self._finish(i)
+
+    def _admit(self, ids: List[int], max_new: int, fut: asyncio.Future,
+               on_token: Optional[Any] = None,
+               info: Optional[dict] = None,
+               seed: Optional[int] = None) -> bool:
+        """Dense-layout admission: one-shot prefill into a 1-sequence cache,
+        jitted insert into the free slot."""
+        import jax.numpy as jnp
+
+        free = next((i for i, s in enumerate(self._slots) if not s.active), None)
+        if free is None:
+            return False
+        ids, plen = self._truncate_prompt(ids, max_new, info)
+        L = len(ids)
+        tokens = np.zeros((1, plen), np.int32)
+        positions = np.full((1, plen), PAD_POS, np.int32)
+        tokens[0, :L] = ids
+        positions[0, :L] = np.arange(L)
+
+        prefill = self.server._get_prefill(1, plen, self.max_len)
+        logits, cache1 = prefill(self.server._params, jnp.asarray(tokens), jnp.asarray(positions))
+        self._caches = self._insert(self._caches, cache1, free)
+        # graftlint: allow-host-sync-in-hot-path(admission-time sync, once per request not per token: the first sampled token must reach the host to seed slot bookkeeping before the slot joins the pipelined batch)
+        first_logits = np.asarray(logits[0, L - 1]).astype(np.float32)
+        first, key = self._sample_first(first_logits, seed)
+        self._commit_slot(free, first, key, L, max_new, fut, on_token)
         return True
+
+    # ------------------------------------------------------------------
+    # Paged admission: page allocation + chunked prefill + activation
+    # ------------------------------------------------------------------
+    def _get_prefix_import(self, entry_len: int):
+        """Jitted dense->paged prefix import: copy whole pages of a stored
+        dense prefix-cache entry ([1, entry_len, ...] per layer) into the
+        slot's allocated pool pages. ``n_valid`` (traced) masks the copy to
+        the pages the prefix actually covers — pages past it target
+        TRASH_PAGE, so one compile serves every prefix length under this
+        entry size. The dense entry is NOT donated: it stays live in the
+        prefix cache."""
+        cache = getattr(self, "_import_cache", None)
+        if cache is None:
+            cache = self._import_cache = {}
+        fn = cache.get(entry_len)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from functools import partial
+
+        n_pages, ps = self.n_pages, self.page_size
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def import_prefix(pools, dense, block_row, n_valid):
+            idx = jnp.clip(
+                jnp.arange(n_pages * ps).reshape(n_pages, ps), 0, entry_len - 1)
+            target = jnp.where(
+                (jnp.arange(n_pages) < n_valid) & (block_row != NULL_PAGE),
+                block_row, TRASH_PAGE)
+            return [
+                tuple(pool.at[target].set(d[0][idx])
+                      for pool, d in zip(pool_layer, dense_layer))
+                for pool_layer, dense_layer in zip(pools, dense)
+            ]
+
+        cache[entry_len] = import_prefix
+        return import_prefix
+
+    def _admit_begin(self, ids: List[int], max_new: int, fut: asyncio.Future,
+                     on_token: Optional[Any] = None,
+                     info: Optional[dict] = None,
+                     seed: Optional[int] = None) -> bool:
+        """Paged admission, phase 1 (host-side, cheap): allocate prompt
+        pages, reset their stale positions, import any prefix-cache hit,
+        and stage a chunked-prefill job. Returns True when the request was
+        CONSUMED (job staged, activated outright on a full prefix hit, or
+        shed with 503) — False leaves it pending for a later loop turn."""
+        import jax.numpy as jnp
+
+        free = next((i for i, s in enumerate(self._slots)
+                     if not s.active and not s.prefilling), None)
+        if free is None:
+            return False
+        ids, plen = self._truncate_prompt(ids, max_new, info)
+        L = len(ids)
+        n0 = -(-L // self.page_size)
+        pages = self._allocator.alloc(n0)
+        if pages is None:
+            # Liveness rests entirely on this busy check: _truncate_prompt
+            # caps prompts at max_len-1 so n0 <= n_pages, and the
+            # constructor rejects pools with capacity < n_pages — an
+            # admission can always fit an empty pool. So if nothing is in
+            # flight to ever free a page, shed now instead of queueing
+            # forever; otherwise wait for in-flight completions.
+            if not any(s.active or s.prefilling for s in self._slots):
+                self._shed_request(
+                    fut, on_token,
+                    f"admission needs {n0} KV pages "
+                    f"(pool capacity {self._allocator.capacity}, "
+                    f"{self._allocator.stats()[1]} in use)")
+                return True
+            return False  # wait: in-flight completions will free pages
+        slot = self._slots[free]
+        slot.pages = pages
+        slot.prefilling = True
+        slot.future = fut
+        slot.on_token = on_token
+        # neutralize the pages' previous-owner positions BEFORE any write
+        # lands through them (stale real positions would make this slot's
+        # mask attend another sequence's leftover KV)
+        ids_np = np.full((self.n_pages,), TRASH_PAGE, np.int32)
+        ids_np[:n0] = pages
+        self._caches = self._reset_pages(self._caches, jnp.asarray(ids_np))
+        row = np.full((self.n_pages,), NULL_PAGE, np.int32)
+        row[:n0] = pages
+        bt_row = jnp.asarray(row[None, :])
+        # prefix-cache hit lands directly in the paged slot: whole pages of
+        # the stored dense entry are copied into the allocated pages, and
+        # only the suffix chunk-prefills
+        p0 = 0
+        first_logits = None
+        if self.server.prefix_cache_size > 0:
+            # page_size filters out entries too short for the whole-page
+            # import inside the scan, so every returned hit serves
+            hit = self.server._prefix_lookup(ids, page_size=self.page_size)
+            if hit is not None:
+                k0, entry_len, dcaches, dlogits = hit
+                n_im = -(-k0 // self.page_size)
+                imp = self._get_prefix_import(entry_len)
+                self._caches = imp(self._caches, dcaches, bt_row[0],
+                                   jnp.asarray(n_im, jnp.int32))
+                p0 = k0
+                if k0 == L:
+                    first_logits = np.asarray(dlogits)[0].astype(np.float32)
+        job = _PrefillJob(free, ids, p0, min(self.prefill_chunk, plen),
+                          max_new, fut, on_token, info, seed, bt_row, pages)
+        self._prefill = job
+        if first_logits is not None:
+            # full-prompt prefix hit: nothing to prefill, activate now from
+            # the stored next-token logits
+            self._activate(job, first_logits)
+        return True
+
+    def _prefill_step(self):
+        """One chunked-prefill dispatch (worker thread): write the next
+        ``chunk`` prompt tokens into the pool through the job's block-table
+        row. Only the LAST chunk syncs (the first-token logits must reach
+        the host) — intermediate chunks are enqueue-only, so decode steps
+        interleave between them and in-flight requests keep streaming."""
+        import jax.numpy as jnp
+
+        job = self._prefill
+        if job is None:
+            return
+        C = job.chunk
+        start = job.next
+        part = job.ids[start:start + C]
+        n = len(part)
+        toks = np.zeros((1, C), np.int32)
+        pos = np.full((1, C), PAD_POS, np.int32)
+        toks[0, :n] = part
+        pos[0, :n] = np.arange(start, start + n)
+        fn = self.server._get_prefill_chunk(C, self.n_pages)
+        logits, self._caches = fn(self.server._params, self._caches,
+                                  job.bt_row, jnp.asarray(toks),
+                                  jnp.asarray(pos))
+        job.next = start + n
+        if job.next >= job.L:
+            # graftlint: allow-host-sync-in-hot-path(admission-time sync, once per request not per chunk: the LAST chunk's logits seed the first sampled token; earlier chunks were enqueue-only)
+            first_logits = np.asarray(logits[0, n - 1]).astype(np.float32)
+            self._activate(job, first_logits)
+
+    def _activate(self, job: _PrefillJob, first_logits: np.ndarray):
+        """Paged admission, final phase: sample the first token on
+        generate()'s rng chain, point the slot's DEVICE block-table row at
+        the real pages (decode writes route through it from the next
+        dispatch; in-flight steps still see the trash row in program
+        order), and commit the slot into the decode batch."""
+        import jax.numpy as jnp
+
+        first, key = self._sample_first(first_logits, job.seed)
+        self._block_tables = self._set_block_row(
+            self._block_tables, jnp.asarray(job.slot, jnp.int32),
+            job.bt_row[0])
+        self._prefill = None
+        self._commit_slot(job.slot, first, key, job.L, job.max_new, job.fut,
+                          job.on_token)
+
+    # ------------------------------------------------------------------
+    # Page accounting: growth, exhaustion shedding, release
+    # ------------------------------------------------------------------
+    def _ensure_slot_pages(self, i: int, last_write_pos: int) -> bool:
+        """Grow slot ``i``'s page list to cover decode writes up to
+        ``last_write_pos`` BEFORE the step that writes them is dispatched
+        (a write through an unallocated table entry is redirected to trash
+        device-side — safe, but the token's KV would be lost). On pool
+        exhaustion the newest other request sheds (503 + Retry-After) to
+        free pages; if this slot is the only tenant left, its generation
+        ends early with the tokens it has — the decode loop itself NEVER
+        raises. Returns False when the slot was finished/released."""
+        import jax.numpy as jnp
+
+        slot = self._slots[i]
+        if not slot.active:
+            # released slots own no pages (release freed them) — growing
+            # one would allocate pool pages that nothing ever frees
+            return False
+        need = min(last_write_pos, self.max_len - 1) // self.page_size + 1
+        while len(slot.pages) < need:
+            got = self._allocator.alloc(1)
+            if got is None:
+                victim = self._pick_page_victim()
+                if victim is None:
+                    # sole tenant outgrew the pool: stop generating with the
+                    # tokens it has — the same cache-edge truncation posture
+                    # as the dense layout's max_len stop, never an error
+                    logger.warning(
+                        "kv page pool exhausted with no shed candidate: "
+                        "slot %d ends at %d generated tokens", i, slot.n_new)
+                    self._finish(i)
+                    return False
+                if victim == "job":
+                    self._shed_prefill_job("page pool exhausted by decode")
+                    continue
+                if victim == i:
+                    # the growing slot is itself the newest tenant: LIFO
+                    # says it yields to the older requests
+                    self._shed_slot(i, "page pool exhausted")
+                    return False
+                self._shed_slot(victim, "page pool exhausted")
+                continue
+            page = got[0]
+            ids_np = np.full((self.n_pages,), TRASH_PAGE, np.int32)
+            ids_np[0] = page
+            self._caches = self._reset_pages(self._caches, jnp.asarray(ids_np))
+            self._block_tables = self._set_block_entry(
+                self._block_tables, jnp.asarray(i, jnp.int32),
+                jnp.asarray(len(slot.pages), jnp.int32),
+                jnp.asarray(page, jnp.int32))
+            slot.pages.append(page)
+        return True
+
+    def _pick_page_victim(self):
+        """LIFO shed order on page exhaustion: the globally NEWEST tenant
+        yields — the staged prefill job first (it has produced nothing
+        yet), then the most recently admitted active slot, which may be the
+        growing slot itself. None when there is at most one tenant (shed
+        nothing — the sole request just stops growing)."""
+        if self._prefill is not None:
+            return "job"
+        active = [j for j, s in enumerate(self._slots) if s.active and s.pages]
+        if len(active) < 2:
+            return None
+        return max(active, key=lambda j: self._slots[j].admit_seq)
+
+    def _shed_error(self, why: str):
+        from seldon_core_tpu.runtime.resilience import (
+            DEFAULT_RETRY_AFTER_S, ShedError)
+
+        retry = getattr(self.server, "shed_retry_after_s", DEFAULT_RETRY_AFTER_S)
+        return ShedError(f"kv page pool exhausted: {why}",
+                         retry_after_s=retry)
+
+    def _shed_request(self, fut: asyncio.Future, on_token: Optional[Any],
+                      why: str):
+        """Shed a not-yet-admitted request (503 + Retry-After)."""
+        self._allocator.count_shed()
+        logger.warning("shedding admission: %s", why)
+        if on_token is not None:
+            try:
+                on_token(None)
+            except Exception:
+                pass
+        self._resolve(fut, exc=self._shed_error(why))
+
+    def _shed_slot(self, i: int, why: str):
+        """Shed an ACTIVE slot mid-decode to relieve page exhaustion: its
+        tokens are discarded and the client gets 503 + Retry-After (the
+        dense layout can never hit this — its slots pre-reserve max_len)."""
+        slot = self._slots[i]
+        self._allocator.count_shed()
+        logger.warning(
+            "shedding slot %d after %d generated tokens: %s", i, slot.n_new, why)
+        if slot.on_token is not None:
+            try:
+                slot.on_token(None)
+            except Exception:
+                pass
+        if slot.future is not None:
+            self._resolve(slot.future, exc=self._shed_error(why))
+        self._release_slot(i)
+
+    def _shed_prefill_job(self, why: str):
+        job = self._prefill
+        if job is None:
+            return
+        self._prefill = None
+        self._allocator.count_shed()
+        logger.warning("shedding staged prefill (slot %d): %s", job.slot, why)
+        if job.on_token is not None:
+            try:
+                job.on_token(None)
+            except Exception:
+                pass
+        self._resolve(job.fut, exc=self._shed_error(why))
+        self._release_slot(job.slot)
+
+    def _release_slot(self, i: int):
+        """Common slot teardown: return pages to the allocator and point
+        the device block-table row back at trash (in device program order,
+        so in-flight steps finish their reads first — reused pages are
+        reset/rewritten strictly AFTER)."""
+        slot = self._slots[i]
+        slot.active = False
+        slot.prefilling = False
+        slot.future = None
+        slot.on_token = None
+        if self.paged:
+            if slot.pages:
+                self._allocator.free(slot.pages)
+                slot.pages = []
+            import jax.numpy as jnp
+
+            self._block_tables = self._set_block_row(
+                self._block_tables, jnp.asarray(i, jnp.int32), self._trash_row)
+
+    def page_stats(self) -> dict:
+        """Pool gauges for llm_stats/metrics: in-use/total pages plus
+        internal fragmentation (1 - tokens written / page tokens held) —
+        the slack the page-size knob trades against table overhead.
+        All-zero under the dense layout (no pool exists)."""
+        if not self.paged:
+            return {"kv_pages_total": 0, "kv_pages_in_use": 0,
+                    "kv_page_size": 0, "kv_page_fragmentation": 0.0,
+                    "kv_page_sheds": 0}
+        total, in_use, sheds = self._allocator.stats()
+        used_tokens = 0
+        for s in self._slots:
+            if s.active:
+                used_tokens += min(s.true_len + s.disp_new,
+                                   len(s.pages) * self.page_size)
+        job = self._prefill
+        if job is not None:
+            used_tokens += min(job.next, len(job.pages) * self.page_size)
+        frag = 0.0
+        if in_use > 0:
+            frag = 1.0 - used_tokens / float(in_use * self.page_size)
+        return {
+            "kv_pages_total": total,
+            "kv_pages_in_use": in_use,
+            "kv_page_size": self.page_size,
+            "kv_page_fragmentation": max(0.0, min(1.0, frag)),
+            "kv_page_sheds": sheds,
+        }
 
     def _finish(self, i: int):
         slot = self._slots[i]
@@ -508,9 +1080,7 @@ class ContinuousBatcher:
             slot.on_token(None)  # stream end sentinel
         if slot.future is not None:
             self._resolve(slot.future, result=toks)
-        slot.active = False
-        slot.future = None
-        slot.on_token = None
+        self._release_slot(i)
 
     # ------------------------------------------------------------------
     # Pipelined decode: dispatch (producer) / drain (consumer)
@@ -533,7 +1103,7 @@ class ContinuousBatcher:
         never overruns max_new or writes past the cache). Falling back to 1
         instead of an arbitrary clamp keeps the compile count at two
         programs (K=1 and K=fuse_steps)."""
-        if self.fuse_steps <= 1 or self._pending:
+        if self.fuse_steps <= 1 or self._pending or self._prefill is not None:
             return 1
         eligible = self._dispatch_eligible()
         if not eligible:
@@ -551,11 +1121,31 @@ class ContinuousBatcher:
         import time
 
         k = self._pick_k()
-        fn = self.server._get_decode_step(self.S, self.max_len, k)
-        t0 = time.perf_counter()
-        (self._caches, self._last_tok, self._next_pos, self._keys,
-         toks) = fn(self.server._params, self._caches, self._last_tok,
-                    self._next_pos, self._keys, self._temp)
+        if self.paged:
+            # grow every eligible slot's pages to cover this dispatch's k
+            # writes FIRST — positions dispatched_pos()..dispatched_pos()+k-1
+            # (the device's next_pos equals dispatched_pos()). An exhaustion
+            # shed inside the loop can deactivate a LATER slot of this
+            # snapshot, so re-check activity before touching each one:
+            # growing a released slot would allocate pages nothing owns.
+            for i in self._dispatch_eligible():
+                if self._slots[i].active:
+                    self._ensure_slot_pages(
+                        i, self._slots[i].dispatched_pos() + k - 1)
+            if not self._dispatch_eligible():
+                return
+            fn = self.server._get_decode_step_paged(self.S, self.n_pages, k)
+            t0 = time.perf_counter()
+            (self._caches, self._last_tok, self._next_pos, self._keys,
+             toks) = fn(self.server._params, self._caches, self._last_tok,
+                        self._next_pos, self._keys, self._temp,
+                        self._block_tables)
+        else:
+            fn = self.server._get_decode_step(self.S, self.max_len, k)
+            t0 = time.perf_counter()
+            (self._caches, self._last_tok, self._next_pos, self._keys,
+             toks) = fn(self.server._params, self._caches, self._last_tok,
+                        self._next_pos, self._keys, self._temp)
         self.server._decode_dispatch_times.append(time.perf_counter() - t0)
         snapshot = [(i, s.gen) for i, s in enumerate(self._slots) if s.active]
         for i, _ in snapshot:
@@ -620,23 +1210,47 @@ class ContinuousBatcher:
                 # Admission happens while earlier steps are STILL IN FLIGHT
                 # — the insert/set_slot queue behind them in device program
                 # order, and the gen counter masks their stale tokens.
-                while self._pending:
+                while self._pending and self._prefill is None:
                     ids, max_new, fut, on_token, info, seed = self._pending[0]
-                    if not await asyncio.to_thread(self._admit, ids, max_new,
-                                                   fut, on_token, info, seed):
-                        break  # no free slot — decode until one frees up
+                    if self.paged:
+                        admitted = await asyncio.to_thread(
+                            self._admit_begin, ids, max_new, fut, on_token,
+                            info, seed)
+                    else:
+                        admitted = await asyncio.to_thread(
+                            self._admit, ids, max_new, fut, on_token, info,
+                            seed)
+                    if not admitted:
+                        break  # no free slot/pages — decode frees them
                     self._pending.popleft()
                 # producer: keep the device pipeline_depth steps ahead of
                 # the host — dispatch is enqueue-only, no sync
                 while (len(self._inflight) < self.pipeline_depth
                        and self._dispatch_eligible()):
                     await asyncio.to_thread(self._dispatch)
+                # chunked prefill interleaves: ONE chunk per loop turn, so a
+                # long admission prefill shares the device with the decode
+                # dispatches above instead of stalling them for its whole
+                # compile bucket (only the last chunk syncs)
+                if self._prefill is not None:
+                    await asyncio.to_thread(self._prefill_step)
+                    if self._inflight:
+                        await asyncio.to_thread(self._drain_one)
+                    # never fall through to the idle wait on a prefill turn:
+                    # the chunk either advanced the job or ACTIVATED the
+                    # slot (now dispatch-eligible) — loop back to dispatch
+                    continue
                 # consumer: drain the oldest step one (or more) behind
                 if self._inflight:
                     await asyncio.to_thread(self._drain_one)
                     continue
                 if self._closed:
                     return
+                if self._dispatch_eligible():
+                    # a slot became runnable without a wakeup signal (e.g.
+                    # activation landed on the final loop turn) — sleeping
+                    # 0.5s here would stall its whole decode
+                    continue
                 self._wakeup.clear()
                 try:
                     await asyncio.wait_for(self._wakeup.wait(), timeout=0.5)
@@ -648,8 +1262,9 @@ class ContinuousBatcher:
             # instead of leaving their futures hanging
             logger.exception("batcher loop died: %s", e)
             self._inflight.clear()
+            self._prefill = None
             for slot in self._slots:
-                if slot.active:
+                if slot.active or slot.prefilling:
                     if slot.on_token is not None:
                         try:
                             slot.on_token(None)  # unblock streaming consumers
@@ -659,6 +1274,7 @@ class ContinuousBatcher:
                     if slot.future is not None:
                         self._resolve(slot.future, exc=e)
                     slot.active = False
+                    slot.prefilling = False
                     slot.future = None
             while self._pending:
                 _, _, fut, on_token, _, _ = self._pending.popleft()

@@ -142,6 +142,10 @@ class LLMServer(SeldonComponent):
         quantize: str = "",
         param_dtype: str = "",
         kv_cache_dtype: str = "",
+        kv_cache_layout: str = "",
+        kv_page_size: int = 0,
+        kv_pool_pages: int = 0,
+        prefill_chunk: int = 0,
         continuous_batching: int = 0,
         continuous_batching_max_len: int = 0,
         decode_pipeline_depth: int = 2,
@@ -184,6 +188,27 @@ class LLMServer(SeldonComponent):
         # read traffic that dominates the b8 decode step —
         # benchmarks/DECODE_NOTES.md). Normalized + validated at load().
         self.kv_cache_dtype = kv_cache_dtype
+        # KV-cache layout for the continuous batcher's slot pool: "paged"
+        # (default — global pool of fixed-size KV pages addressed through
+        # per-slot block tables, so HBM is billed for pages actually written
+        # and admission prefill can run in chunks interleaved with decode)
+        # or "dense" (the historical [S, max_len, ...] allocation, kept for
+        # A/B and parity testing). Normalized + validated at load().
+        # generate()'s per-request caches stay dense either way.
+        self.kv_cache_layout = kv_cache_layout
+        # Tokens per KV page (paged layout; 0 = default 64). The batcher
+        # rounds its cache length up to a page multiple.
+        self.kv_page_size = int(kv_page_size)
+        # Total pages in the global pool (0 = fully provisioned: every slot
+        # can reach max_len simultaneously — no oversubscription, never
+        # sheds on pages). Smaller pools oversubscribe: more slots per HBM
+        # byte, with page-exhaustion shed (503 + Retry-After) as the relief
+        # valve — docs/performance.md "Paged KV".
+        self.kv_pool_pages = int(kv_pool_pages)
+        # Admission prefill chunk size (paged layout; 0 = default 256).
+        # A long prompt prefills chunk-by-chunk between decode steps so
+        # admission never stalls serving for a whole compile bucket.
+        self.prefill_chunk = int(prefill_chunk)
         # >0: serving transports route single-prompt /v1/generate (REST) and
         # jsonData {"prompt": ...} predicts (gRPC) through a shared
         # ContinuousBatcher with this many slots (runtime/batcher.py), so
@@ -247,8 +272,24 @@ class LLMServer(SeldonComponent):
         # Validate dtype knobs HERE, with a clear ValueError, instead of
         # letting an unknown string explode later inside a jitted cast or
         # cache init (where the traceback names nothing actionable).
+        from seldon_core_tpu.models.transformer import normalize_kv_cache_layout
+
         # racelint: allow-unguarded-shared-state(load()-time config normalization: runs once, before any serving thread or batcher loop exists — nothing can interleave with it)
         self.kv_cache_dtype = normalize_kv_cache_dtype(self.kv_cache_dtype)
+        # racelint: allow-unguarded-shared-state(load()-time config normalization: runs once, before any serving thread or batcher loop exists — nothing can interleave with it)
+        self.kv_cache_layout = normalize_kv_cache_layout(self.kv_cache_layout)
+        if self.kv_page_size < 0:
+            raise ValueError(
+                f"kv_page_size={self.kv_page_size} must be >= 0 "
+                f"(0 = default page size)")
+        if self.kv_pool_pages < 0:
+            raise ValueError(
+                f"kv_pool_pages={self.kv_pool_pages} must be >= 0 "
+                f"(0 = fully provisioned pool)")
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk={self.prefill_chunk} must be >= 0 "
+                f"(0 = default chunk size)")
         if self.param_dtype and self.param_dtype != "auto":
             try:
                 jnp.dtype(self.param_dtype)
@@ -531,21 +572,36 @@ class LLMServer(SeldonComponent):
             self._prefix_cache.clear()
             self._prefix_bytes = 0
 
-    def _prefix_lookup(self, tokens: List[int], max_len: int):
-        """Longest cached prefix of ``tokens`` with a compatible cache size
-        AND kv_cache_dtype; returns (prefix_len, caches, last_logits) or
-        None. Exact full-prompt hits return the stored logits so prefill is
-        skipped entirely. The dtype check matters: a bf16 3-tuple cache fed
-        to an int8-configured decode (or vice versa) would be structurally
+    def _prefix_lookup(self, tokens: List[int], max_len: Optional[int] = None,
+                       page_size: Optional[int] = None):
+        """Longest cached prefix of ``tokens`` with a compatible
+        kv_cache_dtype; returns (prefix_len, entry_max_len, caches,
+        last_logits) or None. With ``max_len`` set, only entries of exactly
+        that cache length serve — generate()'s dense path reuses the whole
+        cache object, so its geometry must match. ``max_len=None`` accepts
+        any length: the paged batcher imports only the entry's first
+        ``prefix_len`` positions into pool pages, so any dense entry long
+        enough to hold the prefix serves — with ``page_size`` set, entries
+        too short for that whole-page import are skipped DURING the scan
+        (a shorter importable prefix can still win, and the hit counter /
+        LRU promotion only ever record hits that actually serve). Exact
+        full-prompt hits return the stored logits so prefill is skipped
+        entirely. The dtype check matters: a bf16 3-tuple cache fed to an
+        int8-configured decode (or vice versa) would be structurally
         wrong, so a dtype flip must read as a miss, never a crash."""
         with self._prefix_lock:
             best = None
             for key, (entry_max_len, entry_kvd, caches, last_logits, _nb) in self._prefix_cache.items():
                 k = len(key)
-                if entry_max_len != max_len or entry_kvd != self.kv_cache_dtype or k > len(tokens):
+                if entry_kvd != self.kv_cache_dtype or k > len(tokens):
                     continue
+                if max_len is not None and entry_max_len != max_len:
+                    continue
+                if page_size is not None and \
+                        -(-k // page_size) * page_size > entry_max_len:
+                    continue  # entry ends mid-page: whole-page import can't
                 if list(key) == tokens[:k] and (best is None or k > best[0]):
-                    best = (k, caches, last_logits)
+                    best = (k, entry_max_len, caches, last_logits)
             if best is not None:
                 self._prefix_cache.move_to_end(tuple(tokens[: best[0]]))
                 # hit accounting lives under the same lock as the cache it
@@ -759,6 +815,96 @@ class LLMServer(SeldonComponent):
         self._decode_cache[key] = decode_step
         return decode_step
 
+    def _get_prefill_chunk(self, chunk: int, n_pages: int):
+        """Compiled chunked-prefill step for the PAGED continuous batcher:
+        write ``chunk`` prompt tokens (one sequence, PAD_POS padding) into
+        the global page pool through the slot's block-table row, reading the
+        earlier chunks' KV back from the pool — so a long admission prefill
+        runs piecewise between decode steps instead of stalling serving for
+        its whole compile bucket (Sarathi-Serve-style chunked prefill;
+        Agrawal et al., OSDI 2024). The pool pytree is donated: the scatter
+        updates in place, and the batcher threads the returned pool into
+        the next dispatch. Returns (logits [1, chunk, vocab], pools)."""
+        key = ("pchunk", chunk, n_pages)
+        fn = self._prefill_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+
+        module = self._module
+        deq = self._dequant
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_chunk(params, pools, block_row, tokens, positions):
+            logits, pools = module.apply(
+                deq(params), tokens, positions=positions, caches=pools,
+                block_tables=block_row,
+            )
+            return logits, pools
+
+        self._prefill_cache[key] = prefill_chunk
+        return prefill_chunk
+
+    def _get_decode_step_paged(self, slots: int, n_pages: int, k: int = 1):
+        """Compiled pipelined decode step over the PAGED pool: identical
+        sampling state machine to ``_get_decode_step`` (per-slot rng keys,
+        device-resident token/position state, k-step ``lax.scan``), with the
+        KV read/write routed through per-slot block tables instead of a
+        dense [S, max_len] slot cache. The block tables are an extra input,
+        NOT donated and NOT modified by the step — the host updates them
+        through the batcher's jitted table ops between dispatches, and
+        device program order serializes those against in-flight steps.
+
+        Returns ``(pools, last_tok, next_pos, keys, tokens[slots, k])`` with
+        the same donation shape as the dense step (pools, next_pos, keys
+        donated; last_tok not, for the same stacked-output aliasing reason).
+        Token parity with the dense step is bit-exact on the gather
+        fallback (tests/test_paged_kv.py); the compiled-form contract is
+        pinned as llm.paged_decode_step_s4 in tools/hlolint."""
+        key = ("pagedstep", slots, n_pages, k)
+        fn = self._decode_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        module = self._module
+        top_k = self.top_k
+        deq = self._dequant
+
+        @partial(jax.jit, donate_argnums=(1, 3, 4))
+        def decode_step(params, pools, last_tok, next_pos, keys, temperature,
+                        block_tables):
+            def sample(keys, lg):
+                greedy = jnp.argmax(lg, axis=-1)
+                kk = min(top_k, lg.shape[-1])
+                topv, topi = jax.lax.top_k(lg, kk)
+
+                def one(key, tv):
+                    key, sub = jax.random.split(key)
+                    return key, jax.random.categorical(
+                        sub, tv / jnp.maximum(temperature, 1e-6))
+
+                keys, draw = jax.vmap(one)(keys, topv)
+                sampled = jnp.take_along_axis(topi, draw[:, None], axis=-1)[:, 0]
+                return keys, jnp.where(temperature <= 0.0, greedy, sampled)
+
+            def step(carry, _):
+                pools, tok, pos, keys = carry
+                logits, pools = module.apply(
+                    deq(params), tok[:, None], positions=pos[:, None],
+                    caches=pools, block_tables=block_tables,
+                )
+                keys, nxt = sample(keys, logits[:, -1].astype(jnp.float32))
+                return (pools, nxt, pos + 1, keys), nxt
+
+            (pools, tok, pos, keys), toks = jax.lax.scan(
+                step, (pools, last_tok, next_pos, keys), None, length=k)
+            return pools, tok, pos, keys, toks.T  # tokens [slots, k]
+
+        self._decode_cache[key] = decode_step
+        return decode_step
+
     # ------------------------------------------------------------------
     def generate(
         self,
@@ -852,9 +998,9 @@ class LLMServer(SeldonComponent):
         decode = self._get_decode(nb, max_len, donate=not use_prefix)
         hit = self._prefix_lookup(token_lists[0], max_len) if use_prefix else None
         if hit is not None and hit[0] == len(token_lists[0]):
-            _, caches, first_logits = hit
+            _, _, caches, first_logits = hit
         elif hit is not None:
-            p0, caches, _ = hit
+            p0, _, caches, _ = hit
             suffix = token_lists[0][p0:]
             L = len(suffix)
             slen = min(_bucket(L, self.len_buckets), max_len - p0)
@@ -993,6 +1139,9 @@ class LLMServer(SeldonComponent):
         inflight_hwm = 0
         depth = self.decode_pipeline_depth
         fuse = self.decode_fuse_steps
+        page_stats = {"kv_pages_total": 0, "kv_pages_in_use": 0,
+                      "kv_page_size": 0, "kv_page_fragmentation": 0.0,
+                      "kv_page_sheds": 0}
         svc = getattr(self, "_batcher_service", None)
         if svc is not None:
             batcher = svc.batcher
@@ -1002,10 +1151,17 @@ class LLMServer(SeldonComponent):
             inflight_hwm = batcher._inflight_hwm
             depth = batcher.pipeline_depth
             fuse = batcher.fuse_steps
+            if getattr(batcher, "paged", False):
+                page_stats = batcher.page_stats()
         with self._prefix_lock:
             prefix_bytes = self._prefix_bytes
         return {
             "kv_cache_dtype": self.kv_cache_dtype,
+            "kv_cache_layout": self.kv_cache_layout,
+            # paged-pool accounting (zeros under the dense layout):
+            # in-use/total page gauge pair plus internal fragmentation —
+            # the slack between tokens written and pages held
+            **page_stats,
             "kv_cache_bytes": slot_bytes + prefix_bytes,
             "kv_occupancy": occupancy,
             "kv_bytes_per_step": self._last_decode_kv_bytes,

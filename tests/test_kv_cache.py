@@ -224,7 +224,7 @@ def test_prefix_store_lookup_roundtrip(kvd):
     max_len = next(iter(s._prefix_cache.values()))[0]
     hit = s._prefix_lookup(prompt, max_len)
     assert hit is not None and hit[0] == len(prompt)
-    layer0 = hit[1][0]
+    layer0 = hit[2][0]
     assert len(layer0) == (5 if kvd == "int8" else 3)
     # longest-prefix continuation also hits
     hit2 = s._prefix_lookup(prompt + [1, 2], max_len)

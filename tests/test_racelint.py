@@ -107,6 +107,60 @@ def test_all_call_sites_locked_is_clean(tmp_path):
     assert rules_of(reported) == []
 
 
+PAGE_ALLOCATOR = """
+    import threading
+
+    class PageAllocator:
+        # the PR 7 host-side KV page allocator shape: every free-list
+        # transition under the lock — except the mutated path below
+        def __init__(self, total):
+            self._lock = threading.Lock()
+            self._free = list(range(total))
+            self.shed_total = 0
+
+        def alloc(self, n):
+            with self._lock:
+                if n > len(self._free):
+                    return None
+                return [self._free.pop() for _ in range(n)]
+
+        def free(self, pages):
+            for p in pages:              # pre-fix: no lock on the return path
+                self._free.append(p)
+
+        def count_shed(self):
+            with self._lock:
+                self.shed_total += 1
+"""
+
+
+def test_page_allocator_unlocked_free_fires(tmp_path):
+    """The PR 7 allocator discipline: alloc/count_shed establish the
+    guarded-writes pattern on the free list; an unlocked free() path is
+    exactly the double-allocation corruption the lock exists to prevent
+    (the dynamic proof lives in tests/test_schedules.py)."""
+    root = write_tree(tmp_path / "pkg", {"runtime/pages.py": PAGE_ALLOCATOR})
+    reported, _, _ = lint(root)
+    us = [f for f in reported if f.rule == "unguarded-shared-state"]
+    assert us, "the unlocked free-list mutation must fire"
+    assert any("_free" in f.message for f in us)
+
+
+def test_page_allocator_locked_free_is_clean(tmp_path):
+    fixed = PAGE_ALLOCATOR.replace(
+        "        def free(self, pages):\n"
+        "            for p in pages:              # pre-fix: no lock on the return path\n"
+        "                self._free.append(p)",
+        "        def free(self, pages):\n"
+        "            with self._lock:\n"
+        "                for p in pages:\n"
+        "                    self._free.append(p)")
+    assert fixed != PAGE_ALLOCATOR
+    root = write_tree(tmp_path / "pkg", {"runtime/pages.py": fixed})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
 def test_unguarded_read_against_guarded_writes_fires(tmp_path):
     """The CircuitBreaker.state_code class: guarded writes establish the
     discipline, an unguarded public read violates it."""

@@ -30,6 +30,14 @@ SLOTS = 4          # continuous-batcher slots
 N_STEPS = 7        # decode scan length (max_new_tokens - 1)
 KV_HEADS = 2       # llama-tiny n_kv_heads
 HEAD_DIM = 16      # llama-tiny head_dim
+# paged layout (PR 7): 8-token pages, 3 pages/slot view, and an
+# OVERSUBSCRIBED pool (10 pages = 8 usable + 2 reserved, vs the 12 a fully
+# provisioned 4-slot pool would need) — the contract compiles the pool
+# shape serving actually runs, so the cost budget records the paged step's
+# bytes against a pool smaller than the dense slot cache
+PAGE_SIZE = 8
+PAGES_PER_SLOT = 3  # ceil(MAX_LEN / PAGE_SIZE)
+POOL_PAGES = 10
 
 
 def ensure_platform() -> None:
@@ -97,9 +105,24 @@ def _batcher():
         if "batcher" not in _STATE:
             from seldon_core_tpu.runtime.batcher import ContinuousBatcher
 
+            # layout pinned: these contracts cover the DENSE slot pool
+            # (insert/set_slot); the paged pool has its own contracts below
             _STATE["batcher"] = ContinuousBatcher(
-                _base_server(), max_slots=SLOTS, max_len=MAX_LEN)
+                _base_server(), max_slots=SLOTS, max_len=MAX_LEN,
+                layout="dense")
         return _STATE["batcher"]
+
+
+def _paged_batcher():
+    with _STATE_LOCK:  # nests into _base_server's hold: RLock
+        if "paged_batcher" not in _STATE:
+            from seldon_core_tpu.runtime.batcher import ContinuousBatcher
+
+            _STATE["paged_batcher"] = ContinuousBatcher(
+                _base_server(), max_slots=SLOTS, max_len=MAX_LEN,
+                layout="paged", page_size=PAGE_SIZE, pool_pages=POOL_PAGES,
+                prefill_chunk=PAGE_SIZE)
+        return _STATE["paged_batcher"]
 
 
 def _cache_specs(batch: int):
@@ -112,6 +135,19 @@ def _cache_specs(batch: int):
     s = _base_server()
     return jax.eval_shape(
         lambda: init_kv_caches(s._cfg, batch, MAX_LEN, s.kv_cache_dtype))
+
+
+def _paged_cache_specs():
+    """ShapeDtypeStruct pytree of the int8 paged pool (10 pages x 8
+    tokens) — shapes/dtypes only, nothing materialized."""
+    import jax
+
+    from seldon_core_tpu.models.transformer import init_paged_kv_caches
+
+    s = _base_server()
+    return jax.eval_shape(
+        lambda: init_paged_kv_caches(
+            s._cfg, POOL_PAGES, PAGE_SIZE, s.kv_cache_dtype))
 
 
 def _sds(shape, dtype):
@@ -135,6 +171,12 @@ F32_CACHE_WHY = (
     "cache was dequantized/upcast wholesale (2-4x the HBM traffic the "
     "int8 layout bought back)"
 )
+
+
+# same regression class for the paged pool: a whole-pool f32 tensor means
+# the int8 pages were dequantized/upcast wholesale
+def _f32_pool_sig() -> str:
+    return rf"tensor<{POOL_PAGES}x{PAGE_SIZE}x{KV_HEADS}x{HEAD_DIM}xf32>"
 
 
 # ----------------------------------------------------------------------
@@ -200,6 +242,35 @@ def _build_batcher_set_slot():
     return b._set_slot, (b._last_tok, b._next_pos, b._keys,
                          _sds((), "int32"), _sds((), "int32"),
                          _sds((), "int32"), _sds((2,), "uint32"))
+
+
+def _build_paged_decode_step():
+    s = _base_server()
+    fn = s._get_decode_step_paged(SLOTS, PAGES_PER_SLOT, 1)
+    return fn, (s._params, _paged_cache_specs(), _sds((SLOTS,), "int32"),
+                _sds((SLOTS,), "int32"), _sds((SLOTS, 2), "uint32"),
+                _sds((), "float32"),
+                _sds((SLOTS, PAGES_PER_SLOT), "int32"))
+
+
+def _build_prefill_chunk():
+    s = _base_server()
+    fn = s._get_prefill_chunk(PAGE_SIZE, PAGES_PER_SLOT)
+    return fn, (s._params, _paged_cache_specs(),
+                _sds((1, PAGES_PER_SLOT), "int32"),
+                _sds((1, PAGE_SIZE), "int32"), _sds((1, PAGE_SIZE), "int32"))
+
+
+def _build_set_block_row():
+    b = _paged_batcher()
+    return b._set_block_row, (b._block_tables, _sds((), "int32"),
+                              _sds((PAGES_PER_SLOT,), "int32"))
+
+
+def _build_reset_pages():
+    b = _paged_batcher()
+    return b._reset_pages, (_paged_cache_specs(),
+                            _sds((PAGES_PER_SLOT,), "int32"))
 
 
 def _build_jaxserver_predict():
@@ -317,6 +388,46 @@ def all_contracts() -> List[Contract]:
                     "plus two [1,2] partial-result rows per step — bytes, "
                     "not the KV cache (first enforcing run, 2026-08)",
             },
+        ),
+        Contract(
+            name="llm.paged_decode_step_s4",
+            description="ContinuousBatcher PAGED pipelined decode step "
+                        "(S=4, k=1, 8-token pages, oversubscribed 10-page "
+                        "pool): the hot function of paged served decode",
+            build=_build_paged_decode_step,
+            donated=(1, 3, 4),
+            forbid_dtypes=((_f32_pool_sig(), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="llm.prefill_chunk_c8",
+            description="chunked admission prefill (chunk=8 tokens into "
+                        "the paged pool through a block-table row): the "
+                        "scatter must update the pool in place",
+            build=_build_prefill_chunk,
+            donated=(1,),
+            forbid_dtypes=((_f32_pool_sig(), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="batcher.set_block_row",
+            description="ContinuousBatcher block-table row update "
+                        "(admission activate / slot release): donated so "
+                        "the table never copies behind in-flight steps",
+            build=_build_set_block_row,
+            donated=(0,),
+            collectives={},
+        ),
+        Contract(
+            name="batcher.reset_pages",
+            description="newly-allocated page position reset (PAD_POS "
+                        "scatter across layers): the pool must be donated "
+                        "through it, never copied per allocation",
+            build=_build_reset_pages,
+            donated=(0,),
+            collectives={},
         ),
         Contract(
             name="batcher.insert",
