@@ -29,6 +29,12 @@ across phases):
      cached suffix-only extend, device-isolated (jitted-call medians
      minus a measured dispatch floor — the round-5 methodology) so the
      cache is measured where it actually matters.
+  S. speculative decoding arm (ISSUE 8): SPEC_MODE=off|ngram|draft picks
+     the proposer, SPEC_K the max draft depth; sweeps K over the
+     repetitive-text scenario (the n-gram drafter's home turf) plus a
+     random un-draftable control, reporting tok/s, draft acceptance and
+     accepted tokens per verify forward — the >1-token-per-KV-read
+     multiplier — vs K.
 
 Writes benchmarks/report_llm_7b_serving.json and appends the attribution
 to DECODE_NOTES.md (by hand, from the printed table).
@@ -65,7 +71,7 @@ def log(key, value):
 def main() -> None:
     import jax
 
-    phases = "".join(sys.argv[1:]).upper() or "ABCDEP"
+    phases = "".join(sys.argv[1:]).upper() or "ABCDEPS"
     on_tpu = jax.devices()[0].platform == "tpu"
     report = {}
     if os.path.exists(REPORT):
@@ -169,6 +175,10 @@ def main() -> None:
     # ---- P. paged KV arm: capacity at fixed HBM + prefill adversary ----
     if "P" in phases:
         _paged_arm(server, report, rng, vocab, plen, max_new, on_tpu)
+
+    # ---- S. speculative decoding arm: acceptance + tok/s vs K ----------
+    if "S" in phases:
+        _spec_arm(server, report, rng, vocab, plen, max_new, on_tpu)
 
     # ---- D. b8 vs b1 decode-step attribution ---------------------------
     if on_tpu and "D" in phases:
@@ -308,6 +318,134 @@ def _paged_arm(server, report, rng, vocab, plen, max_new, on_tpu) -> None:
     }
     report["paged_prefill_adversary"] = adversary
     log("paged_prefill_adversary", adversary)
+    _write(report)
+
+
+def _spec_arm(server, report, rng, vocab, plen, max_new, on_tpu) -> None:
+    """Phase S (ISSUE 8): speculative decoding through the serving path.
+
+    SPEC_MODE=off|ngram|draft picks the proposer (default ngram — the
+    zero-extra-weights prompt-lookup self-draft; draft needs a draft
+    model: auto half-width rehearsal model on CPU, DRAFT_MODEL_URI on
+    TPU), SPEC_K the max draft depth per verify step (default 4). The
+    arm runs an off baseline plus a K sweep over the REPETITIVE-text
+    scenario — short cyclic prompts, where greedy decode falls into the
+    cycle and the proposer predicts it, so acceptance approaches 1 —
+    and a random-prompt un-draftable control at the top K, where the
+    per-slot controller must step the offered depth down to the 1-probe
+    floor. tokens_per_forward is the claim: accepted tokens per target
+    forward = tokens per KV-cache read (ROADMAP item 2's multiplier).
+    """
+    import asyncio
+
+    from seldon_core_tpu.runtime.batcher import ContinuousBatcher
+    from seldon_core_tpu.runtime.spec import normalize_spec_mode
+
+    mode = normalize_spec_mode(os.environ.get("SPEC_MODE", "ngram"))
+    if mode == "off":
+        report["speculation"] = {
+            "mode": "off", "note": "SPEC_MODE=off: arm skipped"}
+        _write(report)
+        return
+    k_top = int(os.environ.get("SPEC_K", "0")) or 4
+    clients = 8
+    if not on_tpu:
+        # the rehearsal's global max_new (8) cannot exercise an orbit:
+        # greedy decode needs ~10 tokens to settle into the repeating
+        # cycle the prompt-lookup proposer predicts, so the speculation
+        # arm decodes longer than the other phases
+        max_new = max(max_new, 64)
+
+    spec_server = server
+    if mode == "draft" and getattr(server, "_draft_module", None) is None:
+        if on_tpu:
+            # a second 7B-scale load belongs to its own invocation; tell
+            # the operator what to set instead of silently downgrading
+            report["speculation"] = {
+                "mode": "draft",
+                "skipped": "target server has no draft model loaded — "
+                           "run phase S with DRAFT_MODEL_URI (or a "
+                           "draft-configured server)"}
+            _write(report)
+            return
+        from seldon_core_tpu.servers.llmserver import LLMServer
+
+        tkw = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                   n_kv_heads=2, ffn_dim=128, max_seq_len=1024)
+        dkw = dict(tkw)
+        dkw["dim"], dkw["ffn_dim"] = 32, 64  # half-width rehearsal draft
+        spec_server = LLMServer(
+            model="transformer", model_kwargs=tkw, init_random=True,
+            seed=0, max_new_tokens=max_new, len_buckets=server.len_buckets,
+            batch_buckets=(1, clients), temperature=0.0, eos_id=-1,
+            continuous_batching=clients,
+            draft_model="transformer", draft_model_kwargs=dkw)
+        spec_server.load()
+
+    # repetitive scenario: per-client 3-token cycles tiled to plen
+    cycles = [rng.integers(1, vocab, size=3).tolist() for _ in range(clients)]
+    rep_prompts = [(c * ((plen + 2) // 3))[:plen] for c in cycles]
+    rand_prompts = [rng.integers(1, vocab, size=plen).tolist()
+                    for _ in range(clients)]
+
+    def run_arm(prompts, spec_mode, k):
+        async def go():
+            b = ContinuousBatcher(spec_server, max_slots=clients,
+                                  spec_mode=spec_mode, spec_k=k or None)
+            # warm: the spec/decode programs compile per static shape —
+            # a compile inside the timed window is not the claim
+            await asyncio.gather(*[
+                b.submit(p, max_new_tokens=2) for p in prompts[:1]])
+            t0 = time.perf_counter()
+            outs = await asyncio.gather(*[
+                b.submit(p, max_new_tokens=max_new) for p in prompts])
+            wall = time.perf_counter() - t0
+            stats = b.spec_stats()
+            await b.close()
+            toks = sum(len(o) for o in outs)
+            return toks, wall, stats
+
+        return asyncio.run(go())
+
+    arms = {}
+    toks, wall, _ = run_arm(rep_prompts, "off", 0)
+    arms["off"] = {"tok_per_s": round(toks / wall, 1),
+                   "wall_s": round(wall, 3)}
+    log("spec_off", arms["off"])
+    for k in sorted({1, 2, k_top}):
+        toks, wall, st = run_arm(rep_prompts, mode, k)
+        arms[f"k{k}"] = {
+            "tok_per_s": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "accept_rate": round(st["spec_accept_rate"], 3),
+            "tokens_per_forward": round(st["spec_tokens_per_forward"], 3),
+            "draft_overhead_fraction": round(
+                st["spec_draft_overhead_fraction"], 3),
+            "slot_verify_steps": st["spec_slot_steps_total"],
+        }
+        log(f"spec_k{k}", arms[f"k{k}"])
+    toks, wall, st = run_arm(rand_prompts, mode, k_top)
+    control = {
+        "tok_per_s": round(toks / wall, 1),
+        "accept_rate": round(st["spec_accept_rate"], 3),
+        "tokens_per_forward": round(st["spec_tokens_per_forward"], 3),
+        "draft_overhead_fraction": round(
+            st["spec_draft_overhead_fraction"], 3),
+    }
+    log("spec_random_control", control)
+
+    report["speculation"] = {
+        "mode": mode, "spec_k": k_top, "clients": clients,
+        "scenario": "repetitive (3-token cycles tiled to prompt length)",
+        "arms": arms,
+        "random_control": control,
+        "note": "tokens_per_forward = accepted tokens per target verify "
+                "forward = tokens per KV-cache read; CPU-rehearsal tok/s "
+                "is dispatch-bound (each verify forward is K+1 columns "
+                "wide but the rehearsal model is compute-trivial) — the "
+                "bandwidth win needs the chip, the acceptance numbers "
+                "do not",
+    }
     _write(report)
 
 

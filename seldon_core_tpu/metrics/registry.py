@@ -218,6 +218,58 @@ class MetricsRegistry:
             buckets=(0, 1, 2, 3, 4, 6, 8, 16, 32),
             registry=self.registry,
         )
+        # Speculative decoding (runtime/batcher.py + runtime/spec.py): the
+        # accept rate and tokens-per-forward pair is the whole story —
+        # tokens/forward > 1 is the >1-accepted-token-per-KV-read
+        # multiplier speculation exists to buy, and the accept rate is why
+        # it moves (benchmarks/DECODE_NOTES.md "PR 8"). The per-slot gauge
+        # mirrors the draft-length controller's steering EMA; the overhead
+        # fraction is the verify-forward compute share wasted on drafts
+        # that lost verification (what speculation COSTS when text is
+        # un-draftable).
+        self._spec_accept_rate = Gauge(
+            "seldon_llm_spec_accept_rate",
+            "Aggregate draft-token acceptance rate (accepted drafts / "
+            "offered drafts, 0-1)",
+            base,
+            registry=self.registry,
+        )
+        self._spec_accept_rate_slot = Gauge(
+            "seldon_llm_spec_accept_rate_per_slot",
+            "Per-slot draft acceptance-rate EMA (the draft-length "
+            "controller's steering signal)",
+            base + ["slot"],
+            registry=self.registry,
+        )
+        self._spec_tokens_per_forward = Gauge(
+            "seldon_llm_spec_tokens_per_forward",
+            "Accepted tokens per verify forward (>1 = more than one token "
+            "per KV-cache read)",
+            base,
+            registry=self.registry,
+        )
+        self._spec_accepted_per_step = Histogram(
+            "seldon_llm_spec_accepted_tokens_per_step",
+            "Tokens emitted by each drained verify step (1..K+1)",
+            base,
+            buckets=(1, 2, 3, 4, 5, 6, 8, 12, 16),
+            registry=self.registry,
+        )
+        self._spec_draft_overhead = Gauge(
+            "seldon_llm_spec_draft_overhead_fraction",
+            "Fraction of verify-forward token columns wasted on drafts "
+            "that lost verification (0-1)",
+            base,
+            registry=self.registry,
+        )
+        self._spec_slot_steps = Counter(
+            "seldon_llm_spec_slot_verify_steps_total",
+            "Per-slot verify steps drained: each verify forward "
+            "contributes one per active slot (divide by the active-slot "
+            "count for the forward/program count)",
+            base,
+            registry=self.registry,
+        )
         # breakers publish transitions through on_transition; remember which
         # are wired so scrape-time syncs are idempotent
         self._bound_breakers: set = set()
@@ -333,6 +385,30 @@ class MetricsRegistry:
         self._decode_steps_in_flight.labels(**self._base()).set(
             stats.get("decode_steps_in_flight", 0)
         )
+        # speculative decoding: gauges refresh from the controller's
+        # lifetime aggregates; the accepted-tokens histogram drains the
+        # per-step observations accumulated since the last scrape, and the
+        # slot-step counter catches up from the controller tally (same
+        # idiom as the page-shed counter above)
+        self._spec_accept_rate.labels(**self._base()).set(
+            stats.get("spec_accept_rate", 0.0)
+        )
+        self._spec_tokens_per_forward.labels(**self._base()).set(
+            stats.get("spec_tokens_per_forward", 0.0)
+        )
+        self._spec_draft_overhead.labels(**self._base()).set(
+            stats.get("spec_draft_overhead_fraction", 0.0)
+        )
+        for slot, rate in enumerate(stats.get("spec_accept_rate_per_slot", ())):
+            self._spec_accept_rate_slot.labels(
+                **self._base(), slot=str(slot)).set(rate)
+        acc_hist = self._spec_accepted_per_step.labels(**self._base())
+        for tokens in stats.get("spec_accepted_per_step", ()):
+            acc_hist.observe(tokens)
+        steps = self._spec_slot_steps.labels(**self._base())
+        delta = stats.get("spec_slot_steps_total", 0) - steps._value.get()
+        if delta > 0:
+            steps.inc(delta)
 
     # ------------------------------------------------------------------
     def register_custom(self, response: SeldonMessage) -> None:

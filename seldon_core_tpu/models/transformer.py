@@ -271,10 +271,13 @@ class Attention(nn.Module):
         (k_q, k_scale, v_q, v_scale, pos_cache) with int8 values and
         f32 [b, max_len, kvh] scales — runs incremental decode and
         returns (out, new_cache). cache_index is the write offset: a scalar
-        (same slot for the whole batch — prefill) or a [b] vector (per-sequence
-        slots — continuous batching decode, s must be 1). pos_cache holds each
-        slot's absolute position (PAD_POS when empty), so causal masking is
-        exact under right-padding: empty/pad slots are never attended.
+        (same slot for the whole batch — prefill) or a [b] vector
+        (per-sequence slots — continuous batching decode; s == 1 writes at
+        the vector index, while s > 1 — the speculative K-token verify —
+        writes every token at its own ``positions`` entry, dropping PAD_POS
+        columns). pos_cache holds each slot's absolute position (PAD_POS
+        when empty), so causal masking is exact under right-padding:
+        empty/pad slots are never attended.
 
         With ``block_tables`` ([b, n_pages] int32) the cache tuple is a PAGED
         pool — [pages, page_size, kvh, hd] buffers (same bf16 3-tuple / int8
@@ -368,7 +371,7 @@ class Attention(nn.Module):
                 pos_cache = jax.lax.dynamic_update_slice(
                     pos_cache, positions.astype(pos_cache.dtype), (0, idx)
                 )
-            else:
+            elif s == 1:
                 # per-sequence write offsets (continuous batching): s == 1
                 bidx = jnp.arange(b)
                 kq_cache = kq_cache.at[bidx, idx].set(kq[:, 0])
@@ -376,6 +379,21 @@ class Attention(nn.Module):
                 vq_cache = vq_cache.at[bidx, idx].set(vq[:, 0])
                 vs_cache = vs_cache.at[bidx, idx].set(vs[:, 0])
                 pos_cache = pos_cache.at[bidx, idx].set(positions[:, 0].astype(pos_cache.dtype))
+            else:
+                # per-sequence K-token writes (speculative verify): every
+                # token scatters at its own absolute position. Padded draft
+                # columns carry PAD_POS positions — far past max_len — and
+                # mode="drop" discards those writes, so a short draft never
+                # touches the cache (the dense analog of the paged layout's
+                # TRASH_PAGE redirect).
+                bidx2 = jnp.arange(b)[:, None]
+                wp = positions.astype(jnp.int32)
+                kq_cache = kq_cache.at[bidx2, wp].set(kq, mode="drop")
+                ks_cache = ks_cache.at[bidx2, wp].set(ks, mode="drop")
+                vq_cache = vq_cache.at[bidx2, wp].set(vq, mode="drop")
+                vs_cache = vs_cache.at[bidx2, wp].set(vs, mode="drop")
+                pos_cache = pos_cache.at[bidx2, wp].set(
+                    positions.astype(pos_cache.dtype), mode="drop")
             # the int8 buffers are what streams from HBM; XLA fuses this
             # convert+multiply into the attention einsums (VMEM dequant)
             k_all = dequantize_kv(kq_cache, ks_cache, dt)
@@ -391,12 +409,24 @@ class Attention(nn.Module):
                 pos_cache = jax.lax.dynamic_update_slice(
                     pos_cache, positions.astype(pos_cache.dtype), (0, idx)
                 )
-            else:
+            elif s == 1:
                 # per-sequence write offsets (continuous batching): s == 1
                 bidx = jnp.arange(b)
                 k_cache = k_cache.at[bidx, idx].set(k[:, 0].astype(k_cache.dtype))
                 v_cache = v_cache.at[bidx, idx].set(v[:, 0].astype(v_cache.dtype))
                 pos_cache = pos_cache.at[bidx, idx].set(positions[:, 0].astype(pos_cache.dtype))
+            else:
+                # per-sequence K-token writes (speculative verify): see the
+                # int8 branch above — positions address the cache directly,
+                # PAD_POS columns drop.
+                bidx2 = jnp.arange(b)[:, None]
+                wp = positions.astype(jnp.int32)
+                k_cache = k_cache.at[bidx2, wp].set(
+                    k.astype(k_cache.dtype), mode="drop")
+                v_cache = v_cache.at[bidx2, wp].set(
+                    v.astype(v_cache.dtype), mode="drop")
+                pos_cache = pos_cache.at[bidx2, wp].set(
+                    positions.astype(pos_cache.dtype), mode="drop")
             k_all, v_all = k_cache, v_cache
             # pos_cache marks empty slots with PAD_POS, so one predicate covers
             # causality, the unfilled suffix, and right-padding garbage.

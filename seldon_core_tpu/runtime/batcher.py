@@ -29,16 +29,40 @@ token array and does all bookkeeping there — EOS detection, ``n_new``
 accounting, ``on_token`` streaming, ``_finish``, admissions.
 
 EOS semantics under the lag: the device may run up to ``pipeline_depth``
-speculative steps past a sequence's EOS before the host sees it. Those
+run-ahead steps past a sequence's EOS before the host sees it. Those
 trailing tokens are masked by a per-slot generation counter (a slot freed
 and re-admitted between dispatch and drain fails the ``gen`` check), and the
 trailing KV writes land in a slot that the next insert overwrites whole —
 the lag can only cost wasted compute, never wrong output
 (tests/test_batcher_pipeline.py holds token parity against ``generate()``).
+The masking is advance-agnostic: a dispatched step may land 1 token
+(plain decode), a fixed K (fused scan) or a data-dependent 1..K+1
+(speculative verify, below) — in every case the drain credits tokens to a
+slot only while ``(slot, gen)`` still matches the dispatch-time snapshot
+and the slot still has budget, so trailing tokens of ANY width for a
+finished or replaced occupant are dropped, never surfaced.
 
 When the admit queue is empty, ``decode_fuse_steps`` K>1 fuses K steps into
 one device-side ``lax.scan`` between syncs (one dispatch + one host read
 per K tokens).
+
+Speculative decoding (PR 8): with ``spec_mode`` "ngram" or "draft" each
+dispatched step is a fused draft+verify program
+(``LLMServer._get_spec_step``): up to K tokens are proposed per slot — by
+a zero-weight device-side prompt-lookup match over the slot's
+prompt+generated history, or by a small draft model with its own KV pool —
+and verified in ONE K+1-token target forward that accepts the longest
+prefix agreeing with the slot's exact sampling chain. Each step therefore
+advances a slot by a VARIABLE 1..K+1 tokens (``n_acc``), known only at
+drain time: the dispatch side books the pessimistic maximum into
+``disp_new`` (page provisioning and cache-edge caps must cover the
+all-accepted case) and the drain corrects it back to
+``n_new + pending-in-flight maxima`` once actual advances land. Rejected
+drafts' KV rows are position-reset to PAD_POS inside the verify program
+itself, so the cache never holds tokens that lost verification.
+``decode_fuse_steps`` > 1 is rejected in combination with speculation: a
+fused fixed-K scan and variable accept lengths are incompatible until a
+follow-up (the scan would need per-slot variable stride).
 
 Paged KV cache (PR 7): with ``kv_cache_layout="paged"`` (the default) the
 dense ``[S, max_len, ...]`` slot pool is replaced by a GLOBAL pool of
@@ -124,7 +148,16 @@ def _page_table_ops():
         return (last_tok.at[slot].set(tok), next_pos.at[slot].set(pos),
                 keys.at[slot].set(key))
 
-    ops = (set_block_row, set_block_entry, reset_pages, set_slot)
+    # Admission write of a slot's token-history row (speculative decoding:
+    # the n-gram proposer and the verify step's accepted-token appends read
+    # and extend this device-resident history). Donated like the other
+    # per-slot state — the host keeps no mirror.
+    @partial(jax.jit, donate_argnums=(0,))
+    def set_hist_row(hist, slot, row):
+        return hist.at[slot].set(row)
+
+    ops = (set_block_row, set_block_entry, reset_pages, set_slot,
+           set_hist_row)
     _page_table_ops.ops = ops
     return ops
 
@@ -253,15 +286,23 @@ class _Slot:
 class _InFlight:
     """One dispatched (possibly K-fused) decode step the host has not yet
     drained: the device token array, the per-slot (index, gen) snapshot
-    taken at dispatch, and the dispatch timestamp."""
+    taken at dispatch, and the dispatch timestamp.
 
-    __slots__ = ("tokens", "k", "snapshot", "t_dispatch")
+    Speculative verify steps additionally carry ``acc`` (the device [S]
+    accepted-token counts — how far each slot ACTUALLY advanced, 1..K+1)
+    and ``booked`` (slot -> the pessimistic K+1 maximum the dispatch
+    side credited to ``disp_new``; the drain reconciles the difference)."""
 
-    def __init__(self, tokens, k, snapshot, t_dispatch):
+    __slots__ = ("tokens", "k", "snapshot", "t_dispatch", "acc", "booked")
+
+    def __init__(self, tokens, k, snapshot, t_dispatch, acc=None,
+                 booked=None):
         self.tokens = tokens
         self.k = k
         self.snapshot = snapshot
         self.t_dispatch = t_dispatch
+        self.acc = acc
+        self.booked = booked
 
 
 class BatcherService:
@@ -378,6 +419,8 @@ class ContinuousBatcher:
         page_size: Optional[int] = None,
         pool_pages: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
+        spec_mode: Optional[str] = None,
+        spec_k: Optional[int] = None,
     ):
         server.load()
         self.server = server
@@ -421,6 +464,38 @@ class ContinuousBatcher:
         fuse = fuse_steps if fuse_steps is not None else getattr(
             server, "decode_fuse_steps", 0)
         self.fuse_steps = max(int(fuse), 0)
+        # Speculative decoding (module docstring): draft mode + depth K,
+        # resolved from the server unless overridden. The per-slot
+        # acceptance-rate controller adapts the offered draft length to
+        # what each slot's text actually accepts.
+        from seldon_core_tpu.runtime.spec import (
+            DEFAULT_SPEC_K, SpecController, normalize_spec_mode)
+
+        mode = spec_mode if spec_mode is not None else getattr(
+            server, "spec_mode", "off")
+        self.spec_mode = normalize_spec_mode(mode)
+        k = spec_k if spec_k is not None else getattr(server, "spec_k", 0)
+        self.spec_k = int(k or 0) or DEFAULT_SPEC_K
+        if self.spec_mode != "off":
+            if self.fuse_steps > 1:
+                raise ValueError(
+                    f"decode_fuse_steps={self.fuse_steps} cannot combine "
+                    f"with spec_mode={self.spec_mode!r}: the fused scan "
+                    f"runs a FIXED K steps per dispatch while a verify "
+                    f"step advances each slot by a data-dependent 1.."
+                    f"{self.spec_k + 1} tokens — a fused variable-stride "
+                    f"scan is a follow-up; run speculation with "
+                    f"decode_fuse_steps=0 (pipelining composes fine)")
+            if self.spec_k < 1:
+                raise ValueError(
+                    f"spec_k={self.spec_k} must be >= 1 when speculation "
+                    f"is on")
+            if self.spec_mode == "draft" and getattr(
+                    server, "_draft_module", None) is None:
+                raise ValueError(
+                    "spec_mode='draft' needs the server loaded with a "
+                    "draft model (draft_model= / draft_model_uri=)")
+            self._spec = SpecController(self.S, self.spec_k)
         # KV layout: paged (global page pool + per-slot block tables) or the
         # historical dense slot pool. max_len keeps its requested value —
         # truncation/budget semantics are layout-independent — and the
@@ -523,7 +598,30 @@ class ContinuousBatcher:
         # its own closures — page growth runs these mid-decode, where a
         # compile is a serving stall
         (self._set_block_row, self._set_block_entry, self._reset_pages,
-         self._set_slot) = _page_table_ops()
+         self._set_slot, self._set_hist_row) = _page_table_ops()
+
+        if self.spec_mode != "off":
+            # Per-slot prompt+generated token history, device-resident: the
+            # n-gram proposer matches against it and the verify step appends
+            # accepted tokens to it inside the compiled program. One entry
+            # per cache position, so every token a slot can ever hold fits.
+            self.hist_len = self.max_len
+            self._hist = jnp.zeros((self.S, self.hist_len), jnp.int32)
+            if self.spec_mode == "draft":
+                # The draft model's KV is always DENSE [S, max_len]: the
+                # draft is small by construction, so paging it would buy
+                # nothing and cost a second allocator. Prompt prefill lands
+                # through the same insert idiom as the dense target path.
+                dcfg = server._draft_cfg
+                self._draft_caches = jax.jit(
+                    lambda: init_kv_caches(dcfg, self.S, self.max_len))()
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def draft_insert(big, small, slot):
+                    return jax.tree.map(
+                        lambda b, s: b.at[slot].set(s[0]), big, small)
+
+                self._draft_insert = draft_insert
 
         # device-resident per-slot decode state, threaded output->input
         # through every dispatched step (the decode jit updates them; the
@@ -685,13 +783,16 @@ class ContinuousBatcher:
         return first, key
 
     def _commit_slot(self, i: int, first: int, key, L: int, max_new: int,
-                     fut: asyncio.Future, on_token: Optional[Any]):
+                     fut: asyncio.Future, on_token: Optional[Any],
+                     ids: Optional[List[int]] = None):
         """Slot bookkeeping shared by dense admission and paged activation:
         thread the new occupant's state into the device arrays and surface
         the first token. Program order on the device stream puts the
         set_slot after every already-dispatched step, so in-flight steps
         still see (and waste compute on) the old state while step N+1 picks
-        up the new occupant."""
+        up the new occupant. ``ids`` (the truncated prompt) seeds the
+        speculative token history and the draft-model cache when
+        speculation is on."""
         import jax.numpy as jnp
 
         slot = self._slots[i]
@@ -711,11 +812,47 @@ class ContinuousBatcher:
             self._last_tok, self._next_pos, self._keys,
             jnp.asarray(i, jnp.int32), jnp.asarray(first, jnp.int32),
             jnp.asarray(L, jnp.int32), key)
+        if self.spec_mode != "off" and ids is not None:
+            # Seed the slot's device-resident token history: prompt at
+            # positions 0..L-1, the prefill-sampled first token at L
+            # (L <= max_len - 1 — _truncate_prompt leaves decode room).
+            # Overwriting the WHOLE row retires the previous occupant's
+            # tokens, exactly like the dense cache insert.
+            row = np.zeros((self.hist_len,), np.int32)
+            row[:L] = ids
+            row[L] = first
+            self._hist = self._set_hist_row(
+                self._hist, jnp.asarray(i, jnp.int32), jnp.asarray(row))
+            self._spec.reset(i)
+            if self.spec_mode == "draft":
+                self._draft_prefill_slot(i, ids)
         self._last_admit_inflight = len(self._inflight)
         if on_token is not None and first != self.eos_id:
             on_token(first)
         if first == self.eos_id or max_new <= 1:
             self._finish(i)
+
+    def _draft_prefill_slot(self, i: int, ids: List[int]):
+        """spec_mode='draft': prefill the slot's DENSE draft-model cache
+        over the (already truncated) prompt and insert it whole — the
+        fresh cache covers all max_len positions, so the previous
+        occupant's rows are retired exactly like the dense target insert.
+        The draft's logits are discarded: drafting always restarts from
+        the last accepted TARGET token inside the verify step."""
+        import jax.numpy as jnp
+
+        L = len(ids)
+        plen = min(_bucket(L, self.len_buckets),
+                   self.server._cfg.max_seq_len, self.max_len - 1)
+        toks = np.zeros((1, plen), np.int32)
+        pos = np.full((1, plen), PAD_POS, np.int32)
+        toks[0, :L] = ids
+        pos[0, :L] = np.arange(L)
+        fn = self.server._get_draft_prefill(1, plen, self.max_len)
+        _, dcache = fn(self.server._draft_params, jnp.asarray(toks),
+                       jnp.asarray(pos))
+        self._draft_caches = self._draft_insert(
+            self._draft_caches, dcache, jnp.asarray(i, jnp.int32))
 
     def _admit(self, ids: List[int], max_new: int, fut: asyncio.Future,
                on_token: Optional[Any] = None,
@@ -741,7 +878,8 @@ class ContinuousBatcher:
         # graftlint: allow-host-sync-in-hot-path(admission-time sync, once per request not per token: the first sampled token must reach the host to seed slot bookkeeping before the slot joins the pipelined batch)
         first_logits = np.asarray(logits[0, L - 1]).astype(np.float32)
         first, key = self._sample_first(first_logits, seed)
-        self._commit_slot(free, first, key, L, max_new, fut, on_token)
+        self._commit_slot(free, first, key, L, max_new, fut, on_token,
+                          ids=ids)
         return True
 
     # ------------------------------------------------------------------
@@ -902,7 +1040,7 @@ class ContinuousBatcher:
             job.bt_row[0])
         self._prefill = None
         self._commit_slot(job.slot, first, key, job.L, job.max_new, job.fut,
-                          job.on_token)
+                          job.on_token, ids=job.ids)
 
     # ------------------------------------------------------------------
     # Page accounting: growth, exhaustion shedding, release
@@ -1071,6 +1209,31 @@ class ContinuousBatcher:
             "kv_page_sheds": sheds,
         }
 
+    def spec_stats(self) -> dict:
+        """Speculation counters for llm_stats/metrics: aggregate draft
+        acceptance rate, accepted tokens per target forward (the
+        >1-per-cache-read multiplier), the per-slot acceptance EMAs the
+        draft-length controller steers by, and the draft-overhead
+        fraction (verify-forward token columns wasted on rejected
+        drafts). All-off zeros when speculation is disabled."""
+        if self.spec_mode == "off":
+            return {"spec_mode": "off", "spec_k": 0,
+                    "spec_accept_rate": 0.0, "spec_tokens_per_forward": 0.0,
+                    "spec_slot_steps_total": 0,
+                    "spec_accept_rate_per_slot": [],
+                    "spec_draft_overhead_fraction": 0.0}
+        snap = self._spec.snapshot()
+        return {
+            "spec_mode": self.spec_mode,
+            "spec_k": self.spec_k,
+            "spec_accept_rate": snap["spec_accept_rate"],
+            "spec_tokens_per_forward": snap["spec_tokens_per_forward"],
+            "spec_slot_steps_total": snap["spec_slot_steps_total"],
+            "spec_accept_rate_per_slot": self._spec.rates(),
+            "spec_draft_overhead_fraction":
+                snap["spec_draft_overhead_fraction"],
+        }
+
     def _finish(self, i: int):
         slot = self._slots[i]
         toks = slot.tokens
@@ -1120,6 +1283,8 @@ class ContinuousBatcher:
         previous step's outputs, so the device runs ahead of the host."""
         import time
 
+        if self.spec_mode != "off":
+            return self._dispatch_spec()
         k = self._pick_k()
         if self.paged:
             # grow every eligible slot's pages to cover this dispatch's k
@@ -1154,6 +1319,85 @@ class ContinuousBatcher:
         if len(self._inflight) > self._inflight_hwm:
             self._inflight_hwm = len(self._inflight)
 
+    def _dispatch_spec(self):
+        """Enqueue one fused draft+verify step (``LLMServer._get_spec_step``)
+        WITHOUT waiting for its tokens. Each slot advances a data-dependent
+        1..cap+1 tokens known only at drain time, so the dispatch side books
+        the PESSIMISTIC maximum (cap+1) into ``disp_new`` — page
+        provisioning and the cache-edge/budget caps must cover the
+        all-accepted case — and the drain reconciles it back to the actual
+        advance. The per-slot cap clamps the drafts offered: the
+        acceptance-rate controller's depth, the remaining token budget
+        (emits <= cap+1), and the cache edge (writes reach next_pos+cap)."""
+        import time
+
+        import jax.numpy as jnp
+
+        K = self.spec_k
+        caps = np.zeros((self.S,), np.int32)
+        for i in self._dispatch_eligible():
+            s = self._slots[i]
+            cap = min(self._spec.cap(i), K,
+                      s.max_new - s.disp_new - 1,
+                      (self.max_len - 1) - s.dispatched_pos())
+            caps[i] = max(int(cap), 0)
+        if self.paged:
+            # provision pages to the step's FURTHEST possible write
+            # (next_pos + cap); an exhaustion shed inside the loop can
+            # deactivate a later slot of this snapshot — re-check activity
+            # (same discipline as the plain dispatch)
+            for i in self._dispatch_eligible():
+                if self._slots[i].active:
+                    self._ensure_slot_pages(
+                        i, self._slots[i].dispatched_pos() + int(caps[i]))
+            if not self._dispatch_eligible():
+                return
+            fn = self.server._get_spec_step(
+                self.S, K, self.hist_len, mode=self.spec_mode,
+                layout="paged", n_pages=self.n_pages)
+        else:
+            fn = self.server._get_spec_step(
+                self.S, K, self.hist_len, mode=self.spec_mode,
+                layout="dense")
+        cap_dev = jnp.asarray(caps)
+        draft = self.spec_mode == "draft"
+        t0 = time.perf_counter()
+        if self.paged and draft:
+            (self._caches, self._last_tok, self._next_pos, self._keys,
+             self._hist, toks, acc, self._draft_caches) = fn(
+                self.server._params, self._caches, self._last_tok,
+                self._next_pos, self._keys, self._temp, self._block_tables,
+                self._hist, cap_dev, self.server._draft_params,
+                self._draft_caches)
+        elif self.paged:
+            (self._caches, self._last_tok, self._next_pos, self._keys,
+             self._hist, toks, acc) = fn(
+                self.server._params, self._caches, self._last_tok,
+                self._next_pos, self._keys, self._temp, self._block_tables,
+                self._hist, cap_dev)
+        elif draft:
+            (self._caches, self._last_tok, self._next_pos, self._keys,
+             self._hist, toks, acc, self._draft_caches) = fn(
+                self.server._params, self._caches, self._last_tok,
+                self._next_pos, self._keys, self._temp, self._hist,
+                cap_dev, self.server._draft_params, self._draft_caches)
+        else:
+            (self._caches, self._last_tok, self._next_pos, self._keys,
+             self._hist, toks, acc) = fn(
+                self.server._params, self._caches, self._last_tok,
+                self._next_pos, self._keys, self._temp, self._hist,
+                cap_dev)
+        self.server._decode_dispatch_times.append(time.perf_counter() - t0)
+        snapshot = [(i, s.gen) for i, s in enumerate(self._slots) if s.active]
+        booked = {}
+        for i, _ in snapshot:
+            booked[i] = int(caps[i]) + 1
+            self._slots[i].disp_new += booked[i]
+        self._inflight.append(_InFlight(toks, 1, snapshot, t0, acc=acc,
+                                        booked=booked))
+        if len(self._inflight) > self._inflight_hwm:
+            self._inflight_hwm = len(self._inflight)
+
     def _drain_one(self):
         """Consume the OLDEST in-flight step: block until its tokens land,
         then run all host bookkeeping (EOS, budgets, streaming callbacks,
@@ -1168,6 +1412,9 @@ class ContinuousBatcher:
         t0 = time.perf_counter()
         # graftlint: allow-host-sync-in-hot-path(the consumer's deliberate drain sync: the host reads tokens one pipeline_depth BEHIND the device, so this blocks on the oldest step only while newer steps keep the chip busy — docs/performance.md)
         arr = np.asarray(rec.tokens)  # [S, k] — the only per-step host sync
+        if rec.acc is not None:
+            # graftlint: allow-host-sync-in-hot-path(part of the same drain sync: the verify step's per-slot accepted counts land with its tokens — the program already finished for the token read above)
+            accs = np.asarray(rec.acc)  # [S] accepted counts, 1..K+1
         now = time.perf_counter()
         self.server._decode_sync_times.append(now - t0)
         self.server._decode_host_lag.append(lag)
@@ -1182,11 +1429,14 @@ class ContinuousBatcher:
             self.server._decode_step_times.append(per_step)
         self._last_drain_t = now
         self.server._last_decode_kv_bytes = self._cache_nbytes
+        if rec.acc is not None:
+            self._credit_spec(rec, arr, accs)
+            return
         for j in range(rec.k):
             for i, gen in rec.snapshot:
                 slot = self._slots[i]
                 if not slot.active or slot.gen != gen:
-                    # trailing speculative token for a finished (or already
+                    # trailing run-ahead token for a finished (or already
                     # replaced) occupant — masked, never surfaced
                     continue
                 if slot.n_new >= slot.max_new:
@@ -1199,6 +1449,45 @@ class ContinuousBatcher:
                 if (tok == self.eos_id or slot.n_new >= slot.max_new
                         or slot.host_pos() >= self.max_len):
                     self._finish(i)
+
+    def _credit_spec(self, rec: _InFlight, arr: np.ndarray,
+                     accs: np.ndarray):
+        """Drain-side bookkeeping for one verify step: reconcile the
+        pessimistic dispatch booking to the device's ACTUAL advance, feed
+        the acceptance-rate controller, and credit each slot its accepted
+        tokens with the same (slot, gen) masking and EOS/budget/cache-edge
+        stops as the plain drain. An EOS landing INSIDE an accepted draft
+        block cuts the credit loop there — the device ran ahead past it,
+        exactly like a trailing run-ahead step, and the leftover tokens
+        are dropped, never surfaced."""
+        for i, gen in rec.snapshot:
+            slot = self._slots[i]
+            if not slot.active or slot.gen != gen:
+                # the occupant this step decoded for is gone; the new
+                # occupant's disp_new/controller state were reset at
+                # admission, so there is nothing to reconcile either
+                continue
+            adv = int(accs[i])
+            booked = rec.booked.get(i, 1)
+            # dispatch booked the all-accepted maximum (cap+1); the device
+            # actually advanced next_pos by adv — restore the invariant
+            # dispatched_pos() == device next_pos + later in-flight maxima
+            slot.disp_new -= booked - adv
+            offered = booked - 1
+            self._spec.observe(i, max(adv - 1, 0), offered, adv)
+            self.server._spec_accepted.append(adv)
+            if slot.n_new >= slot.max_new:
+                continue  # budget-exhausted slot riding along
+            for j in range(adv):
+                tok = int(arr[i, j])
+                slot.tokens.append(tok)
+                slot.n_new += 1
+                if slot.on_token is not None and tok != self.eos_id:
+                    slot.on_token(tok)
+                if (tok == self.eos_id or slot.n_new >= slot.max_new
+                        or slot.host_pos() >= self.max_len):
+                    self._finish(i)
+                    break
 
     async def _run(self):
         try:

@@ -105,6 +105,37 @@ def _cast_params(params, param_dtype: str, module_dtype) -> Any:
     return jax.tree.map(cast, params)
 
 
+def _slot_sampler(top_k: int):
+    """The per-slot sampling chain shared by every compiled batcher step
+    (`_get_decode_step`, `_get_decode_step_paged`, `_get_spec_step`): one
+    key split + top-k categorical per emitted token per slot, greedy under
+    temperature <= 0. The speculative verify step is bit-exact vs plain
+    decode ONLY while all three sample through this single definition —
+    any fork of this code re-opens the parity hazard the CI suites
+    (tests/test_batcher_pipeline.py, tests/test_speculative.py) exist to
+    catch. generate()'s batch decode keeps its own variant: it draws one
+    categorical for the whole batch from a single pre-split key, a
+    different (batch-level) chain by design."""
+    import jax
+    import jax.numpy as jnp
+
+    def sample(keys, lg, temperature):
+        greedy = jnp.argmax(lg, axis=-1)
+        kk = min(top_k, lg.shape[-1])
+        topv, topi = jax.lax.top_k(lg, kk)
+
+        def one(key, tv):
+            key, sub = jax.random.split(key)
+            return key, jax.random.categorical(
+                sub, tv / jnp.maximum(temperature, 1e-6))
+
+        keys, draw = jax.vmap(one)(keys, topv)
+        sampled = jnp.take_along_axis(topi, draw[:, None], axis=-1)[:, 0]
+        return keys, jnp.where(temperature <= 0.0, greedy, sampled)
+
+    return sample
+
+
 from seldon_core_tpu.utils import bucket as _bucket  # single bucketing policy
 
 
@@ -150,6 +181,12 @@ class LLMServer(SeldonComponent):
         continuous_batching_max_len: int = 0,
         decode_pipeline_depth: int = 2,
         decode_fuse_steps: int = 0,
+        spec_mode: str = "",
+        spec_k: int = 0,
+        spec_ngram: int = 0,
+        draft_model: Optional[str] = None,
+        draft_model_kwargs: Optional[Dict[str, Any]] = None,
+        draft_model_uri: str = "",
         prefix_cache_size: int = 0,
         prefix_cache_bytes: int = 0,
         seed: int = 0,
@@ -225,6 +262,29 @@ class LLMServer(SeldonComponent):
         # host syncs when the admit queue is empty (0/1 = off).
         self.decode_pipeline_depth = int(decode_pipeline_depth)
         self.decode_fuse_steps = int(decode_fuse_steps)
+        # Speculative decoding (runtime/batcher.py + _get_spec_step): "off"
+        # (default), "ngram" — a zero-weight device-side prompt-lookup
+        # proposer over each slot's prompt+generated history — or "draft"
+        # — a small draft model (draft_model / draft_model_uri) runs K+1
+        # greedy forwards per turn. Either way each batcher turn verifies
+        # the K proposed tokens in ONE K+1-token target forward and accepts
+        # the longest prefix agreeing with the per-slot sampling chain, so
+        # greedy and seeded-sampled outputs stay bit-exact vs generate()
+        # while accepted tokens per KV-cache read can exceed 1
+        # (docs/performance.md "Speculative decoding"). Normalized +
+        # validated at load().
+        self.spec_mode = spec_mode
+        # draft tokens per verify step (0 = default 4); the verify forward
+        # is spec_k + 1 tokens wide
+        self.spec_k = int(spec_k)
+        # longest n-gram the self-draft proposer matches (0 = default 3)
+        self.spec_ngram = int(spec_ngram)
+        # optional draft model: registry name + kwargs (random init on the
+        # server's seed) or a jaxserver-style checkpoint dir. Must share
+        # the target's vocab — draft proposals index the target's tokens.
+        self.draft_model = str(draft_model or "")
+        self.draft_model_kwargs = dict(draft_model_kwargs or {})
+        self.draft_model_uri = str(draft_model_uri or "")
         # Prefix caching (opt-in): single-prompt requests reuse the KV cache
         # of the longest previously-prefilled token prefix (shared system
         # prompts prefill once); entries are LRU-evicted past this size.
@@ -258,6 +318,10 @@ class LLMServer(SeldonComponent):
         self._decode_dispatch_times: Any = deque(maxlen=4096)
         self._decode_sync_times: Any = deque(maxlen=4096)
         self._decode_host_lag: Any = deque(maxlen=4096)
+        # speculative decode observability: tokens accepted by each drained
+        # verify step (drained into the accepted-tokens-per-step histogram
+        # at /metrics scrape time, like the step-time deques above)
+        self._spec_accepted: Any = deque(maxlen=4096)
 
     # ------------------------------------------------------------------
     def load(self) -> None:
@@ -308,6 +372,23 @@ class LLMServer(SeldonComponent):
                 f"decode_fuse_steps={self.decode_fuse_steps} must be >= 0 "
                 f"(0/1 = no fusing)"
             )
+        from seldon_core_tpu.runtime.spec import normalize_spec_mode
+
+        # racelint: allow-unguarded-shared-state(load()-time config normalization: runs once, before any serving thread or batcher loop exists — nothing can interleave with it)
+        self.spec_mode = normalize_spec_mode(self.spec_mode)
+        if self.spec_k < 0:
+            raise ValueError(
+                f"spec_k={self.spec_k} must be >= 0 (0 = default draft "
+                f"depth when speculation is on)")
+        if self.spec_ngram < 0:
+            raise ValueError(
+                f"spec_ngram={self.spec_ngram} must be >= 0 (0 = default "
+                f"3-gram prompt lookup)")
+        if self.spec_mode == "draft" and not (
+                self.draft_model or self.draft_model_uri):
+            raise ValueError(
+                "spec_mode='draft' needs a draft model: pass draft_model="
+                "<registry name> (+ draft_model_kwargs) or draft_model_uri")
 
         cfg_kwargs = dict(self.model_kwargs)
         name = self.model_name
@@ -383,6 +464,41 @@ class LLMServer(SeldonComponent):
             logical = logical_axis_tree(self._module, jax.ShapeDtypeStruct((1, 8), jnp.int32))
             params = shard_params(params, self.mesh, logical)
         self._params = params
+
+        # Draft model for spec_mode="draft": loaded alongside the target,
+        # replicated (it is small by construction — sharding it would cost
+        # more in collectives than its forwards). Random init reuses the
+        # server seed, so a draft configured identically to the target is
+        # a bit-identical copy (the perfect-drafter fixture in
+        # tests/test_speculative.py).
+        self._draft_module = None
+        self._draft_params = None
+        self._draft_dequant = lambda p: p
+        if self.draft_model or self.draft_model_uri:
+            dname = self.draft_model or None
+            dkw = dict(self.draft_model_kwargs)
+            dparams = None
+            if self.draft_model_uri:
+                from seldon_core_tpu import storage
+
+                dpath = storage.download(self.draft_model_uri)
+                with open(os.path.join(dpath, "config.json")) as f:
+                    dfile = json.load(f)
+                dname = dname or dfile["model"]
+                dkw = {**dfile.get("kwargs", {}), **dkw}
+                dparams = self._load_params(dpath, dname, dkw)
+            self._draft_module = get_model(dname, **dkw)
+            self._draft_cfg = self._draft_module.cfg
+            if self._draft_cfg.vocab_size != self._cfg.vocab_size:
+                raise ValueError(
+                    f"draft model vocab {self._draft_cfg.vocab_size} != "
+                    f"target vocab {self._cfg.vocab_size}: draft proposals "
+                    f"index the target's token space")
+            if dparams is None:
+                dparams = jax.jit(self._draft_module.init)(
+                    jax.random.PRNGKey(self.seed), jnp.zeros((1, 8), jnp.int32))
+            self._draft_params = _cast_params(
+                dparams, self.param_dtype, self._draft_cfg.dtype)
 
         if self.tokenizer_name == "bytes":
             self._tokenizer = ByteTokenizer()
@@ -785,19 +901,7 @@ class LLMServer(SeldonComponent):
 
         @partial(jax.jit, donate_argnums=(1, 3, 4))
         def decode_step(params, caches, last_tok, next_pos, keys, temperature):
-            def sample(keys, lg):
-                greedy = jnp.argmax(lg, axis=-1)
-                kk = min(top_k, lg.shape[-1])
-                topv, topi = jax.lax.top_k(lg, kk)
-
-                def one(key, tv):
-                    key, sub = jax.random.split(key)
-                    return key, jax.random.categorical(
-                        sub, tv / jnp.maximum(temperature, 1e-6))
-
-                keys, draw = jax.vmap(one)(keys, topv)
-                sampled = jnp.take_along_axis(topi, draw[:, None], axis=-1)[:, 0]
-                return keys, jnp.where(temperature <= 0.0, greedy, sampled)
+            sample = _slot_sampler(top_k)
 
             def step(carry, _):
                 caches, tok, pos, keys = carry
@@ -805,7 +909,8 @@ class LLMServer(SeldonComponent):
                     deq(params), tok[:, None], positions=pos[:, None],
                     caches=caches, cache_index=pos,
                 )
-                keys, nxt = sample(keys, logits[:, -1].astype(jnp.float32))
+                keys, nxt = sample(keys, logits[:, -1].astype(jnp.float32),
+                                   temperature)
                 return (caches, nxt, pos + 1, keys), nxt
 
             (caches, tok, pos, keys), toks = jax.lax.scan(
@@ -875,19 +980,7 @@ class LLMServer(SeldonComponent):
         @partial(jax.jit, donate_argnums=(1, 3, 4))
         def decode_step(params, pools, last_tok, next_pos, keys, temperature,
                         block_tables):
-            def sample(keys, lg):
-                greedy = jnp.argmax(lg, axis=-1)
-                kk = min(top_k, lg.shape[-1])
-                topv, topi = jax.lax.top_k(lg, kk)
-
-                def one(key, tv):
-                    key, sub = jax.random.split(key)
-                    return key, jax.random.categorical(
-                        sub, tv / jnp.maximum(temperature, 1e-6))
-
-                keys, draw = jax.vmap(one)(keys, topv)
-                sampled = jnp.take_along_axis(topi, draw[:, None], axis=-1)[:, 0]
-                return keys, jnp.where(temperature <= 0.0, greedy, sampled)
+            sample = _slot_sampler(top_k)
 
             def step(carry, _):
                 pools, tok, pos, keys = carry
@@ -895,7 +988,8 @@ class LLMServer(SeldonComponent):
                     deq(params), tok[:, None], positions=pos[:, None],
                     caches=pools, block_tables=block_tables,
                 )
-                keys, nxt = sample(keys, logits[:, -1].astype(jnp.float32))
+                keys, nxt = sample(keys, logits[:, -1].astype(jnp.float32),
+                                   temperature)
                 return (pools, nxt, pos + 1, keys), nxt
 
             (pools, tok, pos, keys), toks = jax.lax.scan(
@@ -904,6 +998,269 @@ class LLMServer(SeldonComponent):
 
         self._decode_cache[key] = decode_step
         return decode_step
+
+    def _get_draft_prefill(self, b: int, plen: int, max_len: int):
+        """DRAFT-model prompt prefill into a fresh dense cache (the dense
+        batcher's draft admission): same shape contract as ``_get_prefill``
+        but over the draft module; the logits are discarded — only the
+        written KV matters, drafting always restarts from the last accepted
+        target token."""
+        key = ("draft_prefill", b, plen, max_len)
+        fn = self._prefill_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+
+        from seldon_core_tpu.models.transformer import init_kv_caches
+
+        module, cfg = self._draft_module, self._draft_cfg
+        deq = self._draft_dequant
+
+        def prefill(params, tokens, positions):
+            caches = init_kv_caches(cfg, tokens.shape[0], max_len)
+            logits, caches = module.apply(
+                deq(params), tokens, positions=positions, caches=caches,
+                cache_index=0)
+            return logits, caches
+
+        fn = jax.jit(prefill)
+        self._prefill_cache[key] = fn
+        return fn
+
+    def _get_spec_step(self, slots: int, spec_k: int, hist_len: int, *,
+                       mode: str = "ngram", layout: str = "paged",
+                       n_pages: int = 0):
+        """Compiled speculative decode step for the ContinuousBatcher: ONE
+        dispatch drafts up to K tokens per slot, verifies them in a single
+        K+1-token target forward, and accepts the longest prefix that
+        agrees with the slot's exact sampling chain.
+
+        Drafting. ``mode="ngram"`` runs a zero-weight prompt-lookup
+        proposer (Saxena's prompt-lookup decoding; the self-draft family of
+        Leviathan et al. 2023) over the slot's device-resident
+        prompt+generated token history ``hist [S, hist_len]``: the longest
+        (up to spec_ngram) trailing n-gram is matched against every earlier
+        position — most recent longest match wins — and the K tokens that
+        followed it are proposed. ``mode="draft"`` runs K+1 sequential
+        greedy forwards of the small draft model over its own cache
+        (drafting consumes NO slot rng — the chain belongs to the target).
+        The draft cache is always DENSE [S, max_len] regardless of the
+        target layout: the draft is small by construction, so paging it
+        would buy nothing and cost a second allocator. Either way the
+        per-slot ``draft_cap`` input clamps the offer (the batcher's
+        acceptance-rate controller + cache-edge headroom).
+
+        Verification. The target forward feeds [last_tok, d_1..d_K] at
+        positions next_pos..next_pos+K (columns past the cap carry PAD_POS:
+        masked from attention, writes dropped/trash-redirected). Token j+1
+        is then SAMPLED from the target logits at column j on generate()'s
+        exact per-slot rng chain — split once per ACCEPTED token, never per
+        forward — and the draft is accepted only while the sample equals
+        it. This is the chain-exact form of the rejection-sampling
+        correction: the emitted tokens are precisely the ones sequential
+        decode would have emitted (greedy bit-exact, seeded sampling on the
+        identical key sequence), speculation only changes how many arrive
+        per forward (1..K+1, output ``n_acc``).
+
+        Cache repair. Rows written for drafts that lost verification
+        (positions next_pos+a..next_pos+K, and the draft model's own rows
+        in draft mode) have their position entries reset to PAD_POS inside
+        this same program — the reset_pages idiom — so the cache never
+        holds tokens that lost verification: they are unattendable
+        immediately and their rows are overwritten when the true tokens
+        reach those positions.
+
+        Returns ``(caches, last_tok, next_pos, keys, hist,
+        tokens[S, K+1], n_acc[S])`` (+ draft caches in draft mode) with the
+        decode-step donation discipline: caches, next_pos, keys, hist (and
+        draft caches) donated; last_tok NOT (its buffer may alias the
+        stacked token output the host still reads). The compiled form is
+        pinned by the llm.verify_step_k4 / llm.draft_verify_step_k4
+        contracts in tools/hlolint (zero host transfers, intact aliasing,
+        cost bands)."""
+        key = ("specstep", slots, spec_k, hist_len, mode, layout, n_pages)
+        fn = self._decode_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.transformer import (
+            PAD_POS, paged_write_targets)
+
+        module = self._module
+        top_k_cfg = self.top_k
+        deq = self._dequant
+        K = int(spec_k)
+        S = int(slots)
+        H = int(hist_len)
+        NGRAM = max(int(self.spec_ngram) or 3, 1)
+        draft_mode = mode == "draft"
+        paged = layout == "paged"
+        if draft_mode:
+            dmodule = self._draft_module
+            ddeq = self._draft_dequant
+
+        def core(params, caches, last_tok, next_pos, keys, temperature,
+                 hist, draft_cap, bt, dparams, dcaches):
+            # verification samples through the SAME chain every compiled
+            # decode step uses — the bit-exactness contract lives in
+            # _slot_sampler, not in a local copy
+            _sample = _slot_sampler(top_k_cfg)
+
+            def sample(keys_, lg):
+                return _sample(keys_, lg, temperature)
+
+            cap = jnp.clip(draft_cap, 0, K)
+
+            if draft_mode:
+                # K+1 sequential greedy draft forwards: feeds t_0,d_1..d_K
+                # so the draft cache covers every position the target may
+                # accept (incl. the all-accepted bonus case)
+                def dstep(carry, _):
+                    dc, tok, pos = carry
+                    dlg, dc = dmodule.apply(
+                        ddeq(dparams), tok[:, None],
+                        positions=pos[:, None], caches=dc,
+                        cache_index=pos)
+                    nxt = jnp.argmax(
+                        dlg[:, -1].astype(jnp.float32), axis=-1
+                    ).astype(tok.dtype)
+                    return (dc, nxt, pos + 1), nxt
+
+                (dcaches, _, _), dtoks = jax.lax.scan(
+                    dstep, (dcaches, last_tok, next_pos), None, length=K + 1)
+                drafts = dtoks.T[:, :K]
+                dlen = cap
+            else:
+                # prompt-lookup proposer: matched-length score per earlier
+                # position (prefix-AND over the trailing NGRAM tokens),
+                # longest match wins, most recent breaks ties
+                idx = jnp.arange(H)
+                ok = jnp.ones((S, H), bool)
+                length = jnp.zeros((S, H), jnp.int32)
+                for j in range(NGRAM):
+                    hj = hist[:, jnp.clip(idx - j, 0, H - 1)]
+                    cj = jnp.take_along_axis(
+                        hist, jnp.clip(next_pos - j, 0, H - 1)[:, None],
+                        axis=1)
+                    ok = ok & (hj == cj) & ((idx - j) >= 0)[None, :] \
+                        & ((next_pos - j) >= 0)[:, None]
+                    length = length + ok.astype(jnp.int32)
+                cand = idx[None, :] < next_pos[:, None]
+                score = jnp.where(cand & (length > 0),
+                                  length * H + idx[None, :], -1)
+                best = jnp.argmax(score, axis=1)
+                has = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0] >= 0
+                offs = jnp.arange(1, K + 1)
+                src = best[:, None] + offs[None, :]
+                drafts = jnp.take_along_axis(
+                    hist, jnp.clip(src, 0, H - 1), axis=1)
+                dlen = jnp.where(
+                    has,
+                    jnp.sum((src <= next_pos[:, None]).astype(jnp.int32),
+                            axis=1),
+                    0)
+                dlen = jnp.minimum(dlen, cap)
+
+            cols = jnp.arange(K + 1)
+            tokens_in = jnp.concatenate([last_tok[:, None], drafts], axis=1)
+            positions = jnp.where(cols[None, :] <= dlen[:, None],
+                                  next_pos[:, None] + cols[None, :], PAD_POS)
+            if bt is None:
+                logits, caches = module.apply(
+                    deq(params), tokens_in, positions=positions,
+                    caches=caches, cache_index=next_pos)
+            else:
+                logits, caches = module.apply(
+                    deq(params), tokens_in, positions=positions,
+                    caches=caches, block_tables=bt)
+            lg32 = logits.astype(jnp.float32)
+
+            # chain-exact accept loop: sample column j -> token j+1; rng
+            # advances ONLY while accepting, so the key state after this
+            # step equals sequential decode's after the same tokens
+            a = jnp.zeros((S,), jnp.int32)
+            valid = jnp.ones((S,), bool)
+            out_cols = []
+            cur_keys = keys
+            for j in range(K + 1):
+                keys2, sj = sample(cur_keys, lg32[:, j])
+                cur_keys = jnp.where(valid[:, None], keys2, cur_keys)
+                a = a + valid.astype(jnp.int32)
+                out_cols.append(jnp.where(valid, sj, 0))
+                if j < K:
+                    valid = valid & (sj == tokens_in[:, j + 1]) \
+                        & (j + 1 <= dlen)
+            toks = jnp.stack(out_cols, axis=1)  # [S, K+1]
+            new_last = jnp.take_along_axis(toks, (a - 1)[:, None], axis=1)[:, 0]
+
+            # history append: fed t_0 plus the a accepted samples (columns
+            # past a land at index H -> dropped)
+            wcols = jnp.arange(K + 2)
+            wtok = jnp.concatenate([last_tok[:, None], toks], axis=1)
+            wpos = jnp.where(wcols[None, :] <= a[:, None],
+                             next_pos[:, None] + wcols[None, :], H)
+            rows = jnp.arange(S)[:, None]
+            hist = hist.at[rows, wpos].set(wtok, mode="drop")
+
+            # reject repair: columns a..K lost verification — reset their
+            # position rows to PAD_POS (unattendable now, overwritten when
+            # the true tokens reach those positions). Surviving columns map
+            # to PAD_POS write targets (dense: dropped; paged: trash).
+            rcols = jnp.arange(1, K + 1)
+            rej = rcols[None, :] >= a[:, None]
+            rpos = jnp.where(rej, next_pos[:, None] + rcols[None, :], PAD_POS)
+
+            def repair(cs, tables):
+                if tables is None:
+                    return [layer[:-1] + (
+                        layer[-1].at[rows, rpos].set(PAD_POS, mode="drop"),)
+                        for layer in cs]
+                ps = cs[0][0].shape[1]
+                entry, off = paged_write_targets(tables, rpos, ps)
+                return [layer[:-1] + (layer[-1].at[entry, off].set(PAD_POS),)
+                        for layer in cs]
+
+            caches = repair(caches, bt)
+            if draft_mode:
+                dcaches = repair(dcaches, None)  # draft cache is dense
+                return (caches, new_last, next_pos + a, cur_keys, hist,
+                        toks, a, dcaches)
+            return (caches, new_last, next_pos + a, cur_keys, hist, toks, a)
+
+        if paged and draft_mode:
+            @partial(jax.jit, donate_argnums=(1, 3, 4, 7, 10))
+            def spec_step(params, pools, last_tok, next_pos, keys,
+                          temperature, block_tables, hist, draft_cap,
+                          draft_params, draft_caches):
+                return core(params, pools, last_tok, next_pos, keys,
+                            temperature, hist, draft_cap, block_tables,
+                            draft_params, draft_caches)
+        elif paged:
+            @partial(jax.jit, donate_argnums=(1, 3, 4, 7))
+            def spec_step(params, pools, last_tok, next_pos, keys,
+                          temperature, block_tables, hist, draft_cap):
+                return core(params, pools, last_tok, next_pos, keys,
+                            temperature, hist, draft_cap, block_tables,
+                            None, None)
+        elif draft_mode:
+            @partial(jax.jit, donate_argnums=(1, 3, 4, 6, 9))
+            def spec_step(params, caches, last_tok, next_pos, keys,
+                          temperature, hist, draft_cap, draft_params,
+                          draft_caches):
+                return core(params, caches, last_tok, next_pos, keys,
+                            temperature, hist, draft_cap, None,
+                            draft_params, draft_caches)
+        else:
+            @partial(jax.jit, donate_argnums=(1, 3, 4, 6))
+            def spec_step(params, caches, last_tok, next_pos, keys,
+                          temperature, hist, draft_cap):
+                return core(params, caches, last_tok, next_pos, keys,
+                            temperature, hist, draft_cap, None, None, None)
+
+        self._decode_cache[key] = spec_step
+        return spec_step
 
     # ------------------------------------------------------------------
     def generate(
@@ -1142,6 +1499,12 @@ class LLMServer(SeldonComponent):
         page_stats = {"kv_pages_total": 0, "kv_pages_in_use": 0,
                       "kv_page_size": 0, "kv_page_fragmentation": 0.0,
                       "kv_page_sheds": 0}
+        spec_stats = {"spec_mode": self.spec_mode, "spec_k": self.spec_k,
+                      "spec_accept_rate": 0.0,
+                      "spec_tokens_per_forward": 0.0,
+                      "spec_slot_steps_total": 0,
+                      "spec_accept_rate_per_slot": [],
+                      "spec_draft_overhead_fraction": 0.0}
         svc = getattr(self, "_batcher_service", None)
         if svc is not None:
             batcher = svc.batcher
@@ -1153,6 +1516,8 @@ class LLMServer(SeldonComponent):
             fuse = batcher.fuse_steps
             if getattr(batcher, "paged", False):
                 page_stats = batcher.page_stats()
+            if getattr(batcher, "spec_mode", "off") != "off":
+                spec_stats.update(batcher.spec_stats())
         with self._prefix_lock:
             prefix_bytes = self._prefix_bytes
         return {
@@ -1176,4 +1541,10 @@ class LLMServer(SeldonComponent):
             "decode_inflight_hwm": inflight_hwm,
             "decode_pipeline_depth": depth,
             "decode_fuse_steps": fuse,
+            # speculative decoding: aggregate + per-slot acceptance, the
+            # accepted-tokens-per-verify-step observations accumulated
+            # since the last scrape, and the draft compute-overhead
+            # fraction (metrics/registry.py seldon_llm_spec_*)
+            **spec_stats,
+            "spec_accepted_per_step": drain(self._spec_accepted),
         }

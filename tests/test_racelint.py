@@ -161,6 +161,59 @@ def test_page_allocator_locked_free_is_clean(tmp_path):
     assert rules_of(reported) == []
 
 
+SPEC_CONTROLLER = """
+    import threading
+
+    class SpecController:
+        # the PR 8 draft-length controller shape: observe() runs on the
+        # batcher drain thread, cap() on its dispatch thread, rates() on
+        # transport threads at /metrics scrape time
+        def __init__(self, slots, k):
+            self._lock = threading.Lock()
+            self._rate = [1.0] * slots
+            self._accepted_total = 0
+
+        def observe(self, slot, accepted, offered):
+            self._accepted_total += accepted     # pre-fix: unlocked RMW
+            self._rate[slot] += 0.3 * (accepted / offered - self._rate[slot])
+
+        def cap(self, slot):
+            with self._lock:
+                return 4 if self._rate[slot] >= 0.5 else 1
+
+        def rates(self):
+            with self._lock:
+                return list(self._rate)
+"""
+
+
+def test_spec_controller_unlocked_observe_fires(tmp_path):
+    """The PR 8 acceptance-rate controller discipline: cap/rates establish
+    the guarded pattern on the EMA list; an unlocked observe() is the
+    lost-observation race tests/test_schedules.py explores dynamically."""
+    root = write_tree(tmp_path / "pkg", {"runtime/spec.py": SPEC_CONTROLLER})
+    reported, _, _ = lint(root)
+    us = [f for f in reported if f.rule == "unguarded-shared-state"]
+    assert us, "the unlocked EMA read-modify-write must fire"
+    assert any("_rate" in f.message or "_accepted_total" in f.message
+               for f in us)
+
+
+def test_spec_controller_locked_observe_is_clean(tmp_path):
+    fixed = SPEC_CONTROLLER.replace(
+        "        def observe(self, slot, accepted, offered):\n"
+        "            self._accepted_total += accepted     # pre-fix: unlocked RMW\n"
+        "            self._rate[slot] += 0.3 * (accepted / offered - self._rate[slot])",
+        "        def observe(self, slot, accepted, offered):\n"
+        "            with self._lock:\n"
+        "                self._accepted_total += accepted\n"
+        "                self._rate[slot] += 0.3 * (accepted / offered - self._rate[slot])")
+    assert fixed != SPEC_CONTROLLER
+    root = write_tree(tmp_path / "pkg", {"runtime/spec.py": fixed})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
 def test_unguarded_read_against_guarded_writes_fires(tmp_path):
     """The CircuitBreaker.state_code class: guarded writes establish the
     discipline, an unguarded public read violates it."""

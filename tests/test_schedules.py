@@ -470,3 +470,179 @@ def test_breaker_single_probe_under_exploration():
 
     assert find_race(scenario, ok, granularity="line",
                      max_schedules=80, stall_s=STALL) is None
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (PR 8): the acceptance-rate controller and the
+# variable-advance slot bookkeeping
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_spec_controller_unlocked_observe_races():
+    """Reconstruction of the bug SpecController._lock exists to prevent:
+    observe() runs on the batcher's drain worker thread while a /metrics
+    scrape snapshots on a transport thread and dispatch reads cap() — an
+    unlocked EMA/total update is a read-modify-write that loses
+    observations under some interleaving. Found by opcode exploration,
+    replayed deterministically; the REAL (locked) controller survives the
+    identical scenario below."""
+    from seldon_core_tpu.runtime.spec import SpecController
+
+    class Unlocked(SpecController):
+        def observe(self, slot, accepted_drafts, offered, tokens):
+            self._forwards_total += 1
+            self._tokens_total += int(tokens)
+            self._accepted_total += int(accepted_drafts)
+            self._drafted_total += int(offered)
+            self._steps[slot] += 1
+            if offered > 0:
+                r = accepted_drafts / float(offered)
+                self._rate[slot] += self.ALPHA * (r - self._rate[slot])
+
+    def scenario(sched):
+        c = Unlocked(slots=2, k=4)
+        sched.spawn(lambda: c.observe(0, 3, 4, 4), name="drain0")
+        sched.spawn(lambda: c.observe(0, 1, 4, 2), name="drain1")
+        return c
+
+    def ok(c):
+        return (c._accepted_total == 4 and c._drafted_total == 8
+                and c._forwards_total == 2 and c._tokens_total == 6)
+
+    bad = find_race(scenario, ok, granularity="opcode",
+                    max_schedules=200, stall_s=STALL)
+    assert bad is not None, "unlocked observe must lose an update"
+    c, _, _ = run_schedule(scenario, schedule=bad.to_list(),
+                           granularity="opcode", stall_s=STALL)
+    assert not ok(c)  # the lost observation, replayed
+
+
+def test_spec_controller_totals_exact_under_exploration():
+    """The REAL SpecController (runtime/spec.py) under the threads that
+    actually share it: two drain observations racing a dispatch cap()
+    read and a /metrics snapshot — lifetime totals must come out exact
+    and the cap must be a legal depth whatever the interleaving."""
+    from seldon_core_tpu.runtime.spec import SpecController
+
+    def scenario(sched):
+        c = SpecController(slots=2, k=4)
+        caps = []
+        c._caps = caps
+        sched.spawn(lambda: c.observe(0, 3, 4, 4), name="drain0")
+        sched.spawn(lambda: c.observe(0, 1, 4, 2), name="drain1")
+        sched.spawn(lambda: caps.append(c.cap(0)), name="dispatch")
+        sched.spawn(c.snapshot, name="scrape")
+        return c
+
+    def ok(c):
+        s = c.snapshot()
+        return (s["spec_accepted_drafts_total"] == 4
+                and s["spec_drafted_total"] == 8
+                and s["spec_slot_steps_total"] == 2
+                and s["spec_tokens_total"] == 6
+                and all(x in (1, 2, 4) for x in c._caps))
+
+    assert find_race(scenario, ok, granularity="opcode",
+                     max_schedules=200, stall_s=STALL) is None
+
+
+def test_spec_controller_concurrent_reset_never_corrupts():
+    """Admission racing drain: reset(slot) (new occupant) interleaving
+    with observe() for the OLD occupant's final verify step must leave
+    the per-slot EMA in a sane state — either the fresh 1.0 or a single
+    EMA step from it — and never corrupt the lifetime totals."""
+    from seldon_core_tpu.runtime.spec import SpecController
+
+    def scenario(sched):
+        c = SpecController(slots=1, k=4)
+        sched.spawn(lambda: c.observe(0, 0, 4, 1), name="drain")
+        sched.spawn(lambda: c.reset(0), name="admit")
+        return c
+
+    def ok(c):
+        s = c.snapshot()
+        # the observation is never lost from the totals, and the EMA is
+        # one of the two orderings' legal values (reset-last -> 1.0;
+        # observe-last -> one EMA step down from 1.0)
+        return (s["spec_slot_steps_total"] == 1
+                and s["spec_drafted_total"] == 4
+                and c._rate[0] in (1.0, 1.0 - c.ALPHA))
+
+    assert find_race(scenario, ok, granularity="opcode",
+                     max_schedules=200, stall_s=STALL) is None
+
+
+class _SpecSlotBook:
+    """The batcher's variable-advance slot bookkeeping shape (PR 8):
+    _dispatch_spec books the PESSIMISTIC cap+1 into disp_new with a
+    (slot, gen) snapshot, _credit_spec reconciles to the device's actual
+    advance and credits tokens under the gen mask, and admission
+    releases + reoccupies the slot bumping gen. The event loop
+    serializes these on one thread in production — the lock models that
+    serialization — so the defense PROVEN here is the gen mask itself:
+    a drain whose dispatch snapshot predates a re-admission must never
+    touch the new occupant's counters (masked=False reconstructs the
+    corruption a maskless drain would cause)."""
+
+    def __init__(self, masked: bool = True):
+        self._lock = threading.Lock()   # stands in for the event loop
+        self.masked = masked
+        self.gen = 0
+        self.active = True
+        self.n_new = 0
+        self.disp_new = 0
+
+    def dispatch(self, cap):
+        with self._lock:
+            booked = cap + 1
+            self.disp_new += booked
+            return (self.gen, booked)
+
+    def drain(self, snap, adv):
+        gen, booked = snap
+        with self._lock:
+            if self.masked and (not self.active or self.gen != gen):
+                return  # stale step for a replaced occupant: masked
+            self.disp_new -= booked - adv
+            self.n_new += adv
+
+    def readmit(self):
+        with self._lock:
+            self.active = False       # release the old occupant...
+            self.gen += 1             # ...and admit a new one
+            self.n_new = 0
+            self.disp_new = 0
+            self.active = True
+
+
+def test_spec_variable_advance_gen_mask_protects_counters():
+    """ISSUE 8: concurrent admit + variable-advance bookkeeping cannot
+    corrupt per-slot generation counters. A verify step is in flight
+    (booked cap+1=5) when its slot is re-admitted; whatever order the
+    drain (actual advance 3) and the re-admission land in, the NEW
+    occupant's counters must be exactly zero. Without the gen mask,
+    exploration finds the order where the stale drain credits the new
+    occupant — replayed deterministically."""
+
+    def scenario_of(masked):
+        def scenario(sched):
+            s = _SpecSlotBook(masked=masked)
+            snap = s.dispatch(4)        # one verify step in flight
+            sched.spawn(lambda: s.drain(snap, 3), name="drain")
+            sched.spawn(s.readmit, name="admit")
+            return s
+
+        return scenario
+
+    def ok(s):
+        return s.n_new == 0 and s.disp_new == 0
+
+    bad = find_race(scenario_of(False), ok, granularity="line",
+                    max_schedules=60, stall_s=STALL)
+    assert bad is not None, "maskless drain must corrupt under some order"
+    s, _, _ = run_schedule(scenario_of(False), schedule=bad.to_list(),
+                           granularity="line", stall_s=STALL)
+    assert s.n_new != 0 or s.disp_new != 0  # the corruption, replayed
+
+    assert find_race(scenario_of(True), ok, granularity="line",
+                     max_schedules=60, stall_s=STALL) is None

@@ -38,6 +38,10 @@ HEAD_DIM = 16      # llama-tiny head_dim
 PAGE_SIZE = 8
 PAGES_PER_SLOT = 3  # ceil(MAX_LEN / PAGE_SIZE)
 POOL_PAGES = 10
+# speculative decoding (PR 8): draft depth of the verify-step contracts —
+# the serving default, so the cost budget records the K+1=5-token-wide
+# verify forward serving actually dispatches
+SPEC_K = 4
 
 
 def ensure_platform() -> None:
@@ -98,6 +102,29 @@ def _tp_server():
             s.load()
             _STATE["tp_server"] = s
         return _STATE["tp_server"]
+
+
+def _draft_server():
+    """base-server layout plus a draft model (spec_mode='draft'): the
+    draft shares the target's config — what matters to the contract is
+    the compiled SHAPE of the fused draft+verify program, not drafting
+    quality."""
+    with _STATE_LOCK:
+        if "draft_server" not in _STATE:
+            ensure_platform()
+            from seldon_core_tpu.servers.llmserver import LLMServer
+
+            s = LLMServer(
+                model="llama-tiny", model_kwargs={"dtype": "bfloat16"},
+                init_random=True, max_new_tokens=N_STEPS + 1,
+                len_buckets=(PLEN,), batch_buckets=(1, SLOTS), seed=7,
+                kv_cache_dtype="int8", spec_mode="draft",
+                draft_model="llama-tiny",
+                draft_model_kwargs={"dtype": "bfloat16"},
+            )
+            s.load()
+            _STATE["draft_server"] = s
+        return _STATE["draft_server"]
 
 
 def _batcher():
@@ -273,6 +300,58 @@ def _build_reset_pages():
                             _sds((PAGES_PER_SLOT,), "int32"))
 
 
+def _build_verify_step_k4():
+    """ngram spec step over the PAGED pool: the serving-default
+    speculative hot function (self-draft, zero extra weights)."""
+    s = _base_server()
+    fn = s._get_spec_step(SLOTS, SPEC_K, MAX_LEN, mode="ngram",
+                          layout="paged", n_pages=PAGES_PER_SLOT)
+    return fn, (s._params, _paged_cache_specs(), _sds((SLOTS,), "int32"),
+                _sds((SLOTS,), "int32"), _sds((SLOTS, 2), "uint32"),
+                _sds((), "float32"),
+                _sds((SLOTS, PAGES_PER_SLOT), "int32"),
+                _sds((SLOTS, MAX_LEN), "int32"), _sds((SLOTS,), "int32"))
+
+
+def _build_verify_step_dense_k4():
+    """ngram spec step over the DENSE slot cache (the A/B reference
+    layout): same program shape, per-position scatter instead of the
+    block-table redirect."""
+    s = _base_server()
+    fn = s._get_spec_step(SLOTS, SPEC_K, MAX_LEN, mode="ngram",
+                          layout="dense")
+    return fn, (s._params, _cache_specs(SLOTS), _sds((SLOTS,), "int32"),
+                _sds((SLOTS,), "int32"), _sds((SLOTS, 2), "uint32"),
+                _sds((), "float32"),
+                _sds((SLOTS, MAX_LEN), "int32"), _sds((SLOTS,), "int32"))
+
+
+def _build_draft_verify_step_k4():
+    """draft-model spec step (dense layout): K+1 sequential draft
+    forwards fused with the single K+1-token target verify, the draft's
+    own dense cache donated through the program alongside the target's."""
+    import jax
+
+    from seldon_core_tpu.models.transformer import init_kv_caches
+
+    s = _draft_server()
+    fn = s._get_spec_step(SLOTS, SPEC_K, MAX_LEN, mode="draft",
+                          layout="dense")
+    dcaches = jax.eval_shape(
+        lambda: init_kv_caches(s._draft_cfg, SLOTS, MAX_LEN))
+    return fn, (s._params, _cache_specs(SLOTS), _sds((SLOTS,), "int32"),
+                _sds((SLOTS,), "int32"), _sds((SLOTS, 2), "uint32"),
+                _sds((), "float32"),
+                _sds((SLOTS, MAX_LEN), "int32"), _sds((SLOTS,), "int32"),
+                s._draft_params, dcaches)
+
+
+def _build_set_hist_row():
+    b = _batcher()
+    return b._set_hist_row, (_sds((SLOTS, MAX_LEN), "int32"),
+                             _sds((), "int32"), _sds((MAX_LEN,), "int32"))
+
+
 def _build_jaxserver_predict():
     ensure_platform()
     import jax.numpy as jnp
@@ -410,6 +489,54 @@ def all_contracts() -> List[Contract]:
             forbid_dtypes=((_f32_pool_sig(), F32_CACHE_WHY),),
             collectives={},
             cost=True,
+        ),
+        Contract(
+            name="llm.verify_step_k4",
+            description="speculative ngram draft+verify step over the "
+                        "paged pool (S=4, K=4): ONE K+1-token target "
+                        "forward per dispatched turn — the PR 8 hot "
+                        "function. Zero host transfers; caches / next_pos "
+                        "/ keys / hist donated (last_tok is not: its "
+                        "buffer may alias the stacked token output the "
+                        "host reads)",
+            build=_build_verify_step_k4,
+            donated=(1, 3, 4, 7),
+            forbid_dtypes=((_f32_pool_sig(), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="llm.verify_step_dense_k4",
+            description="speculative ngram draft+verify step over the "
+                        "dense slot cache (the A/B reference layout): "
+                        "PAD_POS columns drop their writes instead of "
+                        "redirecting to the trash page",
+            build=_build_verify_step_dense_k4,
+            donated=(1, 3, 4, 6),
+            forbid_dtypes=((_f32_cache_sig(SLOTS), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="llm.draft_verify_step_k4",
+            description="draft-model spec step (S=4, K=4, dense): K+1 "
+                        "sequential greedy draft forwards fused with the "
+                        "single K+1-token target verify; BOTH caches "
+                        "(target + draft) must donate through the program",
+            build=_build_draft_verify_step_k4,
+            donated=(1, 3, 4, 6, 9),
+            forbid_dtypes=((_f32_cache_sig(SLOTS), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="batcher.set_hist_row",
+            description="speculative token-history row write at admission: "
+                        "donated like the other per-slot state (the host "
+                        "keeps no mirror of the history)",
+            build=_build_set_hist_row,
+            donated=(0,),
+            collectives={},
         ),
         Contract(
             name="batcher.set_block_row",
