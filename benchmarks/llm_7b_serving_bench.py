@@ -35,6 +35,14 @@ across phases):
      random un-draftable control, reporting tok/s, draft acceptance and
      accepted tokens per verify forward — the >1-token-per-KV-read
      multiplier — vs K.
+  D (DISAGG set). disaggregated prefill/decode arm (ISSUE 9): DISAGG=
+     remote_prefill splits the mesh (PREFILL_DEVICES / DECODE_DEVICES /
+     PREFILL_WORKERS envs) and reruns phase P's long-prefill adversary
+     with admission prefill on the prefill slice — the decode-slice
+     victim's worst inter-token gap vs the PR 7 chunked-interleaved
+     number — plus TTFT / inter-token-gap histogram summaries and the
+     handoff counters. Needs >= 2 visible devices (CPU rehearsal:
+     XLA_FLAGS=--xla_force_host_platform_device_count=8).
 
 Writes benchmarks/report_llm_7b_serving.json and appends the attribution
 to DECODE_NOTES.md (by hand, from the printed table).
@@ -179,6 +187,10 @@ def main() -> None:
     # ---- S. speculative decoding arm: acceptance + tok/s vs K ----------
     if "S" in phases:
         _spec_arm(server, report, rng, vocab, plen, max_new, on_tpu)
+
+    # ---- D (DISAGG env). disaggregated prefill/decode arm (ISSUE 9) ----
+    if "D" in phases and os.environ.get("DISAGG", ""):
+        _disagg_arm(server, report, rng, vocab, plen, max_new, on_tpu)
 
     # ---- D. b8 vs b1 decode-step attribution ---------------------------
     if on_tpu and "D" in phases:
@@ -724,6 +736,146 @@ def _prefix_long_system(server, report, rng, vocab, on_tpu) -> None:
                 "subtracted (round-5 device-isolated methodology)",
     }
     log("prefix_long_system", report["prefix_long_system"])
+    _write(report)
+
+
+def _disagg_arm(server, report, rng, vocab, plen, max_new, on_tpu) -> None:
+    """Phase D with DISAGG set (ISSUE 9): disaggregation's headline claim,
+    measured — the decode slice's worst victim inter-token gap under the
+    SAME long-prefill adversary phase P times, with admission prefill
+    moved off-slice entirely (local chunked prefill interleaves the burst;
+    remote prefill removes it), plus the adversary's TTFT, the TTFT /
+    inter-token-gap histogram summaries (the new
+    seldon_llm_ttft_seconds / seldon_llm_inter_token_seconds series), and
+    the handoff counters (count, device-to-device bytes, per-handoff
+    wall)."""
+    import asyncio
+
+    import jax
+
+    from seldon_core_tpu.runtime.batcher import ContinuousBatcher
+    from seldon_core_tpu.runtime.disagg import normalize_disaggregation
+
+    mode = normalize_disaggregation(os.environ.get("DISAGG", ""))
+    if mode == "off" or len(jax.devices()) < 2:
+        note = (f"DISAGG={mode}, devices={len(jax.devices())}: arm needs "
+                "remote_prefill + >= 2 devices (CPU rehearsal: XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8)")
+        report["disagg"] = {"note": note}
+        log("disagg", report["disagg"])
+        return
+    pre_n = int(os.environ.get("PREFILL_DEVICES", "0")) or 1
+    dec_n = int(os.environ.get("DECODE_DEVICES", "0"))
+    workers = int(os.environ.get("PREFILL_WORKERS", "0"))
+    page_size = int(os.environ.get("KV_PAGE_SIZE", "0")) or (
+        64 if on_tpu else 8)
+    chunk = int(os.environ.get("PREFILL_CHUNK", "0")) or (
+        256 if on_tpu else 8)
+    long_len = server.len_buckets[-1]
+
+    from seldon_core_tpu.parallel.mesh import disaggregated_mesh
+
+    mesh = disaggregated_mesh(pre_n, dec_n)
+
+    def adversary_run(disagg):
+        async def go():
+            kw = dict(max_slots=2, max_len=long_len + max_new,
+                      layout="paged", page_size=page_size,
+                      prefill_chunk=chunk, disaggregation=disagg)
+            if disagg != "off":
+                kw["disagg_mesh"] = mesh
+                if workers:
+                    kw["prefill_workers"] = workers
+            b = ContinuousBatcher(server, **kw)
+            gaps, last = [], [None]
+
+            def on_tok(t):
+                now = time.perf_counter()
+                if t is not None and last[0] is not None:
+                    gaps.append(now - last[0])
+                last[0] = now
+
+            victim_p = rng.integers(1, vocab, size=plen // 2).tolist()
+            steady = asyncio.ensure_future(
+                b.submit(victim_p, max_new_tokens=4 * max_new,
+                         on_token=on_tok))
+            while not any(s.active for s in b._slots):
+                await asyncio.sleep(0.002)
+            warm_gaps = len(gaps)
+            adv_p = rng.integers(1, vocab, size=long_len).tolist()
+            t0 = time.perf_counter()
+            ttft = [None]
+
+            def first_tok(t):
+                if t is not None and ttft[0] is None:
+                    ttft[0] = time.perf_counter() - t0
+            await asyncio.sleep(0)
+            adv = asyncio.ensure_future(
+                b.submit(adv_p, max_new_tokens=4, on_token=first_tok))
+            await asyncio.gather(steady, adv)
+            handoff = b.handoff_stats()
+            await b.close()
+            during = gaps[warm_gaps:] or [0.0]
+            base = [g for g in gaps[:warm_gaps] if g > 1e-6] or [0.0]
+            return (float(np.median(base)), float(np.max(during)),
+                    ttft[0], handoff)
+
+        return asyncio.run(go())
+
+    # warm passes: the chunk/decode/import programs (and the workers'
+    # committed param copies) compile outside the timed window
+    adversary_run("off")
+    adversary_run(mode)
+    # drain latency deques so the histograms below cover timed runs only
+    server.llm_stats()
+    base_g, worst_local, ttft_local, _ = adversary_run("off")
+    _, worst_disagg, ttft_disagg, handoff = adversary_run(mode)
+    st = server.llm_stats()
+
+    def _hist(samples_s):
+        if not samples_s:
+            return None
+        ms = np.asarray(samples_s) * 1e3
+        return {"n": int(ms.size),
+                "p50_ms": round(float(np.percentile(ms, 50)), 2),
+                "p90_ms": round(float(np.percentile(ms, 90)), 2),
+                "p99_ms": round(float(np.percentile(ms, 99)), 2),
+                "max_ms": round(float(np.max(ms)), 2)}
+
+    disagg = {
+        "mode": mode,
+        "prefill_devices": len(mesh.prefill_devices),
+        "decode_devices": len(mesh.decode_devices),
+        "prefill_workers": workers or len(mesh.prefill_devices),
+        "adversary_prompt_tokens": long_len, "prefill_chunk": chunk,
+        "victim_median_gap_ms": round(1e3 * base_g, 2),
+        # local_chunked is PR 7's number on today's build; disagg is the
+        # PR 9 claim — the burst leaves the decode slice entirely
+        "victim_worst_gap_ms": {
+            "local_chunked": round(1e3 * worst_local, 2),
+            "disagg": round(1e3 * worst_disagg, 2),
+        },
+        "adversary_ttft_ms": {
+            "local_chunked": round(1e3 * (ttft_local or 0), 2),
+            "disagg": round(1e3 * (ttft_disagg or 0), 2),
+        },
+        "gap_inflation_x": {
+            "local_chunked": round(worst_local / base_g, 2) if base_g
+            else None,
+            "disagg": round(worst_disagg / base_g, 2) if base_g else None,
+        },
+        "handoffs_total": handoff["handoffs_total"],
+        "handoff_transfer_mb": round(
+            handoff["handoff_transfer_bytes_total"] / 1e6, 3),
+        # the new latency series, summarized the way the Prometheus
+        # histograms bucket them (llm_stats -> seldon_llm_ttft_seconds /
+        # seldon_llm_inter_token_seconds / seldon_llm_handoff_seconds)
+        "ttft_hist": _hist(st.get("ttft_s", [])),
+        "inter_token_hist": _hist(st.get("inter_token_s", [])),
+        "handoff_hist": _hist(st.get("handoff_times_s", [])),
+    }
+    report["disagg"] = disagg
+    log("disagg", disagg)
     _write(report)
 
 

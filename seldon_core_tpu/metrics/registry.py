@@ -182,6 +182,55 @@ class MetricsRegistry:
             buckets=LATENCY_BUCKETS,
             registry=self.registry,
         )
+        # Streaming latency (runtime/batcher.py on_token path): TTFT is
+        # the admission-side headline (what chunked prefill and
+        # disaggregation move for the ARRIVING request), the inter-token
+        # gap is the decode-side one (what they move for the VICTIMS —
+        # every already-streaming request sharing the slice). Multi-token
+        # drains (fused/speculative steps) surface a block in one burst,
+        # so a block's trailing tokens observe ~0 gaps by construction.
+        self._ttft = Histogram(
+            "seldon_llm_ttft_seconds",
+            "Time from request submission to its first generated token "
+            "(batcher path)",
+            base,
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self._inter_token = Histogram(
+            "seldon_llm_inter_token_seconds",
+            "Gap before each surfaced token (batcher on_token path; "
+            "fused/speculative blocks surface as bursts)",
+            base,
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        # Disaggregated prefill/decode (runtime/disagg.py): per-handoff
+        # wall (prefill-slice compute + device-to-device transfer +
+        # decode-side import), handoffs delivered, and the staged+ready
+        # backlog — the prefill-side congestion signal replica routing
+        # steers by (docs/performance.md "Disaggregated serving")
+        self._handoff = Histogram(
+            "seldon_llm_handoff_seconds",
+            "Per-admission prefill handoff wall: prefill-slice compute + "
+            "D2D transfer + decode-side page import",
+            base,
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self._handoffs_total = Counter(
+            "seldon_llm_handoffs_total",
+            "Prefill->decode KV handoffs delivered (disaggregated serving)",
+            base,
+            registry=self.registry,
+        )
+        self._handoff_queue_depth = Gauge(
+            "seldon_llm_handoff_queue_depth",
+            "Admissions staged on the prefill slice or awaiting import "
+            "(sampled at scrape)",
+            base,
+            registry=self.registry,
+        )
         # Pipelined decode (runtime/batcher.py): the per-step wall above
         # splits into dispatch (enqueue the compiled step, no sync) vs sync
         # (host blocked on the oldest in-flight step's tokens); the gauge +
@@ -373,6 +422,25 @@ class MetricsRegistry:
         hist = self._decode_step.labels(**self._base())
         for seconds in stats.get("decode_step_times_s", ()):
             hist.observe(seconds)
+        ttft = self._ttft.labels(**self._base())
+        for seconds in stats.get("ttft_s", ()):
+            ttft.observe(seconds)
+        gap = self._inter_token.labels(**self._base())
+        for seconds in stats.get("inter_token_s", ()):
+            gap.observe(seconds)
+        handoff = self._handoff.labels(**self._base())
+        for seconds in stats.get("handoff_times_s", ()):
+            handoff.observe(seconds)
+        # counter catch-up from the transfer queue's own tally (handoffs
+        # land on the batcher loop, counted locally — same idiom as the
+        # page-shed counter above)
+        handoffs = self._handoffs_total.labels(**self._base())
+        delta = stats.get("handoffs_total", 0) - handoffs._value.get()
+        if delta > 0:
+            handoffs.inc(delta)
+        self._handoff_queue_depth.labels(**self._base()).set(
+            stats.get("handoff_queue_depth", 0)
+        )
         disp = self._decode_dispatch.labels(**self._base())
         for seconds in stats.get("decode_dispatch_times_s", ()):
             disp.observe(seconds)

@@ -47,3 +47,82 @@ def make_mesh(
 def serving_mesh(model_parallel: int = 1, devices: Optional[Sequence] = None):
     """Standard serving mesh: ('data', 'model') with tp innermost for ICI."""
     return make_mesh({"data": -1, "model": model_parallel}, devices)
+
+
+class DisaggregatedMesh:
+    """A serving mesh split into a PREFILL slice and a DECODE slice
+    (DistServe/Splitwise): the compute-bound admission burst runs on one
+    set of chips, the bandwidth-bound decode batch on a disjoint set, and
+    the prefilled KV moves between them device-to-device
+    (runtime/disagg.py). Each role carries its own sub-mesh so
+    tensor/sequence parallelism can still shard WITHIN a slice."""
+
+    def __init__(self, prefill_devices: Sequence, decode_devices: Sequence):
+        self.prefill_devices = list(prefill_devices)
+        self.decode_devices = list(decode_devices)
+        if not self.prefill_devices or not self.decode_devices:
+            raise ValueError(
+                f"disaggregated mesh needs >=1 device per role, got "
+                f"{len(self.prefill_devices)} prefill / "
+                f"{len(self.decode_devices)} decode")
+        overlap = set(map(id, self.prefill_devices)) & set(
+            map(id, self.decode_devices))
+        if overlap:
+            raise ValueError(
+                "prefill and decode slices overlap: a shared device would "
+                "re-couple the prefill burst to decode latency — the exact "
+                "interference disaggregation exists to remove")
+        self.prefill = serving_mesh(devices=self.prefill_devices)
+        self.decode = serving_mesh(devices=self.decode_devices)
+
+    def __repr__(self) -> str:
+        return (f"DisaggregatedMesh(prefill={len(self.prefill_devices)}, "
+                f"decode={len(self.decode_devices)})")
+
+
+def disaggregated_mesh(
+    prefill_devices=1,
+    decode_devices=0,
+    devices: Optional[Sequence] = None,
+) -> DisaggregatedMesh:
+    """Split the device world into a prefill slice and a decode slice.
+
+    ``prefill_devices`` / ``decode_devices`` are either explicit device
+    sequences or counts. With counts, the prefill slice takes devices from
+    the END of the enumeration and decode from the front (0 = all the
+    rest): on multi-slice platforms device enumeration is slice-major, so
+    the roles land on distinct physical slices and the handoff crosses
+    ICI/DCN exactly once (parallel/multihost.py
+    ``partition_for_disaggregation`` refines the split along physical
+    slice boundaries when the platform exposes them)."""
+    import jax
+
+    if not isinstance(prefill_devices, int) and not isinstance(
+            decode_devices, int):
+        return DisaggregatedMesh(prefill_devices, decode_devices)
+
+    from seldon_core_tpu.parallel.multihost import (
+        partition_for_disaggregation)
+
+    devices = list(devices if devices is not None else jax.devices())
+    if not isinstance(prefill_devices, int):
+        pre = list(prefill_devices)
+        taken = set(map(id, pre))
+        rest = [d for d in devices if id(d) not in taken]
+        n_dec = int(decode_devices) or len(rest)
+        return DisaggregatedMesh(pre, rest[:n_dec])
+    if not isinstance(decode_devices, int):
+        dec = list(decode_devices)
+        taken = set(map(id, dec))
+        rest = [d for d in devices if id(d) not in taken]
+        n_pre = int(prefill_devices) or len(rest)
+        return DisaggregatedMesh(rest[-n_pre:], dec)
+    n_pre = int(prefill_devices) or 1
+    if n_pre >= len(devices):
+        raise ValueError(
+            f"prefill_devices={n_pre} leaves no decode devices out of "
+            f"{len(devices)}")
+    pre, dec = partition_for_disaggregation(devices, n_pre)
+    if decode_devices:
+        dec = dec[: int(decode_devices)]
+    return DisaggregatedMesh(pre, dec)

@@ -76,6 +76,44 @@ def initialize(env: Optional[Dict[str, str]] = None) -> bool:
     return True
 
 
+def partition_for_disaggregation(devices: Sequence, prefill_count: int):
+    """Split ``devices`` into (prefill, decode) slices for disaggregated
+    serving (parallel/mesh.py ``disaggregated_mesh``), preferring PHYSICAL
+    slice boundaries: the KV handoff then crosses between slices exactly
+    once (ICI within a slice, DCN across), instead of cutting a slice in
+    half and paying intra-slice collectives on both sides of the split.
+
+    The prefill slice takes whole physical slices from the END of the
+    enumeration when the per-slice device count divides ``prefill_count``;
+    otherwise (CPU test mesh, single-slice platforms, ragged counts) the
+    split is a plain contiguous tail — device enumeration is slice-major
+    on real pods, so the tail is still the "farthest" granule."""
+    devices = list(devices)
+    n = int(prefill_count)
+    if not (0 < n < len(devices)):
+        raise ValueError(
+            f"prefill_count={n} must leave >=1 decode device out of "
+            f"{len(devices)}")
+    if all(hasattr(d, "slice_index") for d in devices):
+        by_slice: Dict[int, list] = {}
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        sizes = {len(v) for v in by_slice.values()}
+        if len(by_slice) > 1 and len(sizes) == 1:
+            per_slice = sizes.pop()
+            if n % per_slice == 0 and n // per_slice < len(by_slice):
+                order = sorted(by_slice)
+                pre_slices = order[-(n // per_slice):]
+                pre = [d for s in pre_slices for d in by_slice[s]]
+                dec = [d for s in order[: len(order) - len(pre_slices)]
+                       for d in by_slice[s]]
+                return pre, dec
+        logger.debug(
+            "prefill_count %d does not align with physical slices; "
+            "falling back to a contiguous tail split", n)
+    return devices[-n:], devices[:-n]
+
+
 def hybrid_mesh(
     ici_axes: Dict[str, int],
     dcn_axes: Optional[Dict[str, int]] = None,

@@ -64,6 +64,29 @@ itself, so the cache never holds tokens that lost verification.
 fused fixed-K scan and variable accept lengths are incompatible until a
 follow-up (the scan would need per-slot variable stride).
 
+Disaggregated prefill/decode (PR 9): with ``disaggregation="remote_prefill"``
+admission prefill leaves this batcher's device entirely — the device world
+splits into a prefill slice and a decode slice (parallel/mesh.py
+``disaggregated_mesh``; the decode slice anchors the process default
+device, where the slot pool lives), prefill-slice workers
+(runtime/disagg.py) run the server's own compiled prefill programs on
+their devices and ``jax.device_put`` the written KV straight onto the
+decode device, and the admission path here stages remote jobs and
+consumes finished handoffs instead of prefilling locally: one donated
+jitted scatter imports the staged pages into the slot's pool pages
+(``_get_handoff_import``; dense handoffs reuse ``insert``), then the slot
+commits exactly as a local admission would. Because the prefill programs
+and the sampling chain are shared with the local path, remote-prefill
+serving is bit-exact against single-slice serving (tests/test_disagg.py);
+what changes is WHO pays for the burst — the decode slice's worst victim
+inter-token gap under a long-prefill adversary drops from "a chunk's
+forward" to "one jitted page import" (docs/performance.md
+"Disaggregated serving"). Unlike the single local chunked-prefill job,
+MULTIPLE remote jobs may be staged at once (that concurrency is the
+point); sheds cancel a staged job through the TransferQueue's
+exactly-once protocol, so a handoff racing a shed can never double-free
+its decode-side pages (tests/test_schedules.py).
+
 Paged KV cache (PR 7): with ``kv_cache_layout="paged"`` (the default) the
 dense ``[S, max_len, ...]`` slot pool is replaced by a GLOBAL pool of
 fixed-size KV pages plus a device-resident per-slot block table — the
@@ -227,10 +250,10 @@ class _PrefillJob:
     dispatches interleave between its chunks."""
 
     __slots__ = ("slot", "ids", "L", "next", "chunk", "max_new", "fut",
-                 "on_token", "info", "seed", "bt_row", "pages")
+                 "on_token", "info", "seed", "bt_row", "pages", "t_arrival")
 
     def __init__(self, slot, ids, start, chunk, max_new, fut, on_token,
-                 info, seed, bt_row, pages):
+                 info, seed, bt_row, pages, t_arrival=None):
         self.slot = slot
         self.ids = ids
         self.L = len(ids)
@@ -243,15 +266,48 @@ class _PrefillJob:
         self.seed = seed
         self.bt_row = bt_row         # device [1, n_pages] int32
         self.pages = pages           # host mirror of the allocated pages
+        self.t_arrival = t_arrival   # submit() wall clock, for TTFT
+
+
+class _RemoteJob:
+    """One admission staged on the prefill slice (disaggregated serving):
+    the slot reserved for it, the (already truncated) prompt, the
+    decode-side pages allocated for the import (paged layout; ``row`` is
+    the NULL-padded host block row those pages form), and the request
+    bookkeeping the consume path needs to commit the slot. The handoff
+    itself travels through the TransferQueue; this record is the decode
+    side's half of the rendezvous, keyed by ``job_id``."""
+
+    __slots__ = ("job_id", "slot", "ids", "L", "plen", "max_new", "fut",
+                 "on_token", "info", "seed", "pages", "row", "t_arrival")
+
+    def __init__(self, job_id, slot, ids, plen, max_new, fut, on_token,
+                 info, seed, pages, row, t_arrival):
+        self.job_id = job_id
+        self.slot = slot
+        self.ids = ids
+        self.L = len(ids)
+        self.plen = plen
+        self.max_new = max_new
+        self.fut = fut
+        self.on_token = on_token
+        self.info = info
+        self.seed = seed
+        self.pages = pages           # decode-side pages (host mirror)
+        self.row = row               # host [n_pages] int32 block row, or None
+        self.t_arrival = t_arrival
 
 
 class _Slot:
     __slots__ = ("future", "tokens", "true_len", "n_new", "max_new", "active",
                  "on_token", "gen", "disp_new", "pages", "prefilling",
-                 "admit_seq")
+                 "admit_seq", "t_last")
 
     def __init__(self):
         self.active = False
+        # wall clock of the last token surfaced for this occupant (TTFT /
+        # inter-token-gap observability; reset at every commit)
+        self.t_last = None
         self.future: Optional[asyncio.Future] = None
         self.tokens: List[int] = []
         self.true_len = 0
@@ -356,6 +412,22 @@ class BatcherService:
             self._loop)
         return await asyncio.wrap_future(cfut)
 
+    def submit_stream(self, prompt: Any,
+                      max_new_tokens: Optional[int] = None,
+                      on_token: Optional[Any] = None,
+                      info: Optional[dict] = None,
+                      seed: Optional[int] = None):
+        """Streaming submit from a SYNC thread (the gRPC server-streaming
+        servicer): returns the concurrent.futures.Future of the final token
+        list while ``on_token`` fires per token from the batcher's worker
+        thread — the caller pumps its own response stream from them."""
+        with self._stats_lock:
+            self.submitted += 1
+        return asyncio.run_coroutine_threadsafe(
+            self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
+                                info=info, seed=seed),
+            self._loop)
+
     def close(self) -> None:
         asyncio.run_coroutine_threadsafe(self.batcher.close(), self._loop).result(30)
         self._loop.call_soon_threadsafe(self._loop.stop)
@@ -421,6 +493,9 @@ class ContinuousBatcher:
         prefill_chunk: Optional[int] = None,
         spec_mode: Optional[str] = None,
         spec_k: Optional[int] = None,
+        disaggregation: Optional[str] = None,
+        disagg_mesh: Optional[Any] = None,
+        prefill_workers: Optional[int] = None,
     ):
         server.load()
         self.server = server
@@ -532,7 +607,22 @@ class ContinuousBatcher:
         self._inflight_hwm = 0       # max steps in flight ever reached
         self._last_admit_inflight = 0  # steps in flight at the last admit
         self._last_drain_t: Optional[float] = None
+        # Disaggregated prefill/decode (module docstring): remote-prefill
+        # admission stages jobs on prefill-slice workers and consumes
+        # finished handoffs from the TransferQueue instead of prefilling
+        # locally. Resolved from the server unless overridden.
+        from seldon_core_tpu.runtime.disagg import normalize_disaggregation
+
+        disagg = disaggregation if disaggregation is not None else getattr(
+            server, "disaggregation", "off")
+        self.disaggregation = normalize_disaggregation(disagg)
+        self._remote = None
+        self._transfer = None
+        self._remote_jobs: "dict[int, _RemoteJob]" = {}
+        self._job_seq = 0
         self._build()
+        if self.disaggregation != "off":
+            self._build_remote(disagg_mesh, prefill_workers)
 
     # ------------------------------------------------------------------
     def _build(self):
@@ -634,6 +724,81 @@ class ContinuousBatcher:
         self._temp = jnp.asarray(server.temperature, jnp.float32)
 
     # ------------------------------------------------------------------
+    # Disaggregated prefill: slice setup, handoff import, stats
+    # ------------------------------------------------------------------
+    def _build_remote(self, disagg_mesh, prefill_workers):
+        """Split the device world and start the prefill-worker pool. The
+        decode slice must contain the process DEFAULT device: the slot
+        pool and every decode-side jit live uncommitted there, so
+        anchoring the decode role on it means no serving-path array ever
+        needs explicit placement — only the prefill workers commit copies
+        to their own devices."""
+        import jax
+
+        from seldon_core_tpu.parallel.mesh import disaggregated_mesh
+        from seldon_core_tpu.runtime.disagg import PrefillWorkerPool
+
+        server = self.server
+        mesh = disagg_mesh or getattr(server, "disagg_mesh", None)
+        if mesh is None:
+            mesh = disaggregated_mesh(
+                getattr(server, "prefill_devices", 0) or 1,
+                getattr(server, "decode_devices", 0) or 0)
+        default = jax.devices()[0]
+        if default not in mesh.decode_devices:
+            raise ValueError(
+                "the decode slice must contain the process default device "
+                f"({default}): the batcher's slot pool lives there — put "
+                "the PREFILL slice on the non-default devices")
+        self.disagg_mesh = mesh
+        n_workers = (prefill_workers
+                     if prefill_workers is not None else
+                     getattr(server, "prefill_workers", 0)) or len(
+                         mesh.prefill_devices)
+        devices = [mesh.prefill_devices[i % len(mesh.prefill_devices)]
+                   for i in range(int(n_workers))]
+        self._remote = PrefillWorkerPool(
+            server, devices, default,
+            layout="paged" if self.paged else "dense",
+            max_len=self.max_len,
+            page_size=self.page_size if self.paged else 0,
+            n_pages=self.n_pages if self.paged else 0,
+            prefill_chunk=self.prefill_chunk if self.paged else 0)
+        self._transfer = self._remote.queue
+
+    def _get_handoff_import(self, staged_pages: Optional[int] = None):
+        """Jitted staged-pool -> slot-pool page import (the decode-side
+        half of the KV handoff). ``staged_pages`` is the page count of the
+        transferred buffer beyond the reserved rows (workers ship a
+        power-of-two bucket, not the whole staging pool). Compiled and
+        cached ON THE SERVER (servers/llmserver.py ``_get_handoff_import``,
+        like the prefill programs) so rebuilt batchers and bench arms
+        share one compile per bucket. Compiled-form contract:
+        ``disagg.import_pages`` in tools/hlolint (zero host transfers,
+        donation intact, bytes within budget)."""
+        return self.server._get_handoff_import(self.n_pages, staged_pages)
+
+    def handoff_stats(self) -> dict:
+        """Transfer-queue counters for llm_stats/metrics: handoffs
+        delivered, bytes moved device-to-device, and the jobs currently
+        staged or ready (the prefill-slice backlog signal replica routing
+        steers by). All-off zeros when disaggregation is off."""
+        if self._remote is None:
+            return {"disaggregation": "off", "handoffs_total": 0,
+                    "handoff_transfer_bytes_total": 0,
+                    "handoff_queue_depth": 0}
+        total, nbytes, depth = self._transfer.stats()
+        return {
+            "disaggregation": self.disaggregation,
+            "handoffs_total": total,
+            "handoff_transfer_bytes_total": nbytes,
+            # staged + ready jobs (a registered job stays counted while it
+            # waits in a worker backlog, runs, and sits ready — exactly
+            # the prefill-side congestion a replica router cares about)
+            "handoff_queue_depth": depth,
+        }
+
+    # ------------------------------------------------------------------
     async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None,
                      on_token: Optional[Any] = None,
                      info: Optional[dict] = None,
@@ -658,6 +823,8 @@ class ContinuousBatcher:
         carries its own per-request key device-side)."""
         if self._closed:
             raise RuntimeError("batcher closed")
+        import time
+
         if isinstance(prompt, str):
             ids = self.server._tokenizer.encode(prompt)
         else:
@@ -665,10 +832,16 @@ class ContinuousBatcher:
         if not ids:
             raise ValueError("empty prompt")
         self._loop = asyncio.get_running_loop()
+        if self._transfer is not None and self._transfer.on_ready is None:
+            # a finished handoff must wake the loop like a submit does —
+            # otherwise activation waits out the 0.5 s idle timeout
+            loop = self._loop
+            self._transfer.on_ready = lambda: loop.call_soon_threadsafe(
+                self._wakeup.set)
         fut: asyncio.Future = self._loop.create_future()
         self._pending.append(
             (ids, int(max_new_tokens or self.server.max_new_tokens), fut,
-             on_token, info, seed))
+             on_token, info, seed, time.perf_counter()))
         self._ensure_running()
         self._wakeup.set()
         return await fut
@@ -719,6 +892,9 @@ class ContinuousBatcher:
         self._wakeup.set()
         if self._task is not None:
             await self._task
+        if self._remote is not None:
+            # bounded worker joins (runtime/disagg.py close uses timeouts)
+            await asyncio.to_thread(self._remote.close)
 
     # ------------------------------------------------------------------
     def _truncate_prompt(self, ids: List[int], max_new: int,
@@ -784,7 +960,8 @@ class ContinuousBatcher:
 
     def _commit_slot(self, i: int, first: int, key, L: int, max_new: int,
                      fut: asyncio.Future, on_token: Optional[Any],
-                     ids: Optional[List[int]] = None):
+                     ids: Optional[List[int]] = None,
+                     t_arrival: Optional[float] = None):
         """Slot bookkeeping shared by dense admission and paged activation:
         thread the new occupant's state into the device arrays and surface
         the first token. Program order on the device stream puts the
@@ -793,6 +970,8 @@ class ContinuousBatcher:
         up the new occupant. ``ids`` (the truncated prompt) seeds the
         speculative token history and the draft-model cache when
         speculation is on."""
+        import time
+
         import jax.numpy as jnp
 
         slot = self._slots[i]
@@ -804,6 +983,12 @@ class ContinuousBatcher:
         slot.n_new = 1
         slot.tokens = [first]
         slot.on_token = on_token
+        # first token surfaced NOW: time-to-first-token from submit(), and
+        # the baseline the next token's gap measures from
+        now = time.perf_counter()
+        if t_arrival is not None:
+            self.server._ttft_times.append(now - t_arrival)
+        slot.t_last = now
         slot.gen += 1          # invalidates in-flight tokens for the old occupant
         slot.disp_new = 1      # the prefill-sampled first token counts
         self._admit_seq += 1
@@ -857,7 +1042,8 @@ class ContinuousBatcher:
     def _admit(self, ids: List[int], max_new: int, fut: asyncio.Future,
                on_token: Optional[Any] = None,
                info: Optional[dict] = None,
-               seed: Optional[int] = None) -> bool:
+               seed: Optional[int] = None,
+               t_arrival: Optional[float] = None) -> bool:
         """Dense-layout admission: one-shot prefill into a 1-sequence cache,
         jitted insert into the free slot."""
         import jax.numpy as jnp
@@ -879,8 +1065,155 @@ class ContinuousBatcher:
         first_logits = np.asarray(logits[0, L - 1]).astype(np.float32)
         first, key = self._sample_first(first_logits, seed)
         self._commit_slot(free, first, key, L, max_new, fut, on_token,
-                          ids=ids)
+                          ids=ids, t_arrival=t_arrival)
         return True
+
+    # ------------------------------------------------------------------
+    # Disaggregated admission: stage remote jobs, consume handoffs
+    # ------------------------------------------------------------------
+    def _admit_remote(self, ids: List[int], max_new: int, fut: asyncio.Future,
+                      on_token: Optional[Any] = None,
+                      info: Optional[dict] = None,
+                      seed: Optional[int] = None,
+                      t_arrival: Optional[float] = None) -> bool:
+        """Remote-prefill admission, decode-side half: reserve a slot,
+        allocate the pages the import will land in (paged layout), and
+        stage the job on the prefill slice. Returns True when the request
+        was CONSUMED (staged or shed) — False leaves it pending. No
+        prefill compute happens here: that is the point. The prefix cache
+        is not consulted (the prefill compute being skipped lives on the
+        OTHER slice; cross-slice prefix reuse is a follow-up)."""
+        free = next((i for i, s in enumerate(self._slots)
+                     if not s.active and not s.prefilling), None)
+        if free is None:
+            return False
+        ids, plen = self._truncate_prompt(ids, max_new, info)
+        L = len(ids)
+        pages: List[int] = []
+        row = None
+        n0 = 0
+        if self.paged:
+            n0 = -(-L // self.page_size)
+            got = self._allocator.alloc(n0)
+            if got is None:
+                # same liveness posture as _admit_begin: with no tenant in
+                # flight anywhere (active, local prefill, or staged remote
+                # — remote slots hold prefilling=True), nothing will ever
+                # free a page, so shed now instead of queueing forever
+                if not any(s.active or s.prefilling for s in self._slots):
+                    self._shed_request(
+                        fut, on_token,
+                        f"admission needs {n0} KV pages "
+                        f"(pool capacity {self._allocator.capacity}, "
+                        f"{self._allocator.stats()[1]} in use)")
+                    return True
+                return False
+            pages = got
+            row = np.full((self.n_pages,), NULL_PAGE, np.int32)
+            row[:n0] = pages
+        from seldon_core_tpu.runtime.disagg import PrefillRequest
+
+        slot = self._slots[free]
+        slot.pages = list(pages)
+        slot.prefilling = True
+        slot.future = fut
+        slot.on_token = on_token
+        self._job_seq += 1
+        job = _RemoteJob(self._job_seq, free, ids, plen, max_new, fut,
+                         on_token, info, seed, pages, row, t_arrival)
+        self._remote_jobs[job.job_id] = job
+        self._remote.submit(PrefillRequest(job.job_id, ids, plen, n0))
+        return True
+
+    def _consume_handoffs(self):
+        """Drain every READY handoff: import the staged KV into the slot
+        pool (one donated jitted scatter through the slot's block row;
+        dense handoffs reuse the insert), then commit the slot exactly as
+        a local admission would — same first-token sampling chain, so
+        tokens are bit-identical to single-slice serving."""
+        import time
+
+        import jax.numpy as jnp
+
+        while True:
+            h = self._transfer.pop()
+            if h is None:
+                return
+            job = self._remote_jobs.pop(h.job_id, None)
+            if job is None:
+                continue  # defensive: cancel removes READY records itself
+            if h.error is not None:
+                # worker-side failure: fail THIS request, release its slot
+                # and pages — the batch keeps serving
+                if job.on_token is not None:
+                    try:
+                        job.on_token(None)
+                    except Exception:
+                        pass
+                self._resolve(job.fut, exc=h.error)
+                self._release_slot(job.slot)
+                continue
+            t0 = time.perf_counter()
+            if self.paged:
+                import jax
+
+                n0 = -(-job.L // self.page_size)
+                # the worker shipped a power-of-two page bucket; the
+                # buffer's own shape names the compile to import it with
+                staged_pages = (jax.tree.leaves(h.staged)[0].shape[0]
+                                - RESERVED_PAGES)
+                imp = self._get_handoff_import(staged_pages)
+                self._caches = imp(self._caches, h.staged,
+                                   jnp.asarray(job.row),
+                                   jnp.asarray(n0, jnp.int32))
+                self._block_tables = self._set_block_row(
+                    self._block_tables, jnp.asarray(job.slot, jnp.int32),
+                    jnp.asarray(job.row))
+            else:
+                self._caches = self._insert(self._caches, h.staged, job.slot)
+            self.server._handoff_times.append(
+                h.prefill_s + (time.perf_counter() - t0))
+            first, key = self._sample_first(h.first_logits, job.seed)
+            self._commit_slot(job.slot, first, key, job.L, job.max_new,
+                              job.fut, job.on_token, ids=job.ids,
+                              t_arrival=job.t_arrival)
+
+    def _shed_remote_job(self, job_id: int, why: str):
+        """Shed a staged remote admission (page pressure / shutdown): the
+        TransferQueue's cancel makes the outcome exactly-once — either we
+        take the READY handoff out of the queue (its payload drops with
+        it) or the worker's later put is refused; in BOTH cases this
+        path, and only this path, frees the decode-side pages (via the
+        slot release)."""
+        job = self._remote_jobs.pop(job_id, None)
+        if job is None:
+            return
+        self._transfer.cancel(job_id)
+        if self.paged:
+            self._allocator.count_shed()
+        logger.warning("shedding staged remote prefill (slot %d): %s",
+                       job.slot, why)
+        if job.on_token is not None:
+            try:
+                job.on_token(None)
+            except Exception:
+                pass
+        self._resolve(job.fut, exc=self._shed_error(why))
+        self._release_slot(job.slot)
+
+    def _fail_remote_jobs(self, exc: BaseException):
+        """Shutdown/crash path: no staged request may leave its future
+        hanging."""
+        for job_id in list(self._remote_jobs):
+            job = self._remote_jobs.pop(job_id)
+            self._transfer.cancel(job_id)
+            if job.on_token is not None:
+                try:
+                    job.on_token(None)
+                except Exception:
+                    pass
+            self._resolve(job.fut, exc=exc)
+            self._release_slot(job.slot)
 
     # ------------------------------------------------------------------
     # Paged admission: page allocation + chunked prefill + activation
@@ -925,7 +1258,8 @@ class ContinuousBatcher:
     def _admit_begin(self, ids: List[int], max_new: int, fut: asyncio.Future,
                      on_token: Optional[Any] = None,
                      info: Optional[dict] = None,
-                     seed: Optional[int] = None) -> bool:
+                     seed: Optional[int] = None,
+                     t_arrival: Optional[float] = None) -> bool:
         """Paged admission, phase 1 (host-side, cheap): allocate prompt
         pages, reset their stale positions, import any prefix-cache hit,
         and stage a chunked-prefill job. Returns True when the request was
@@ -989,7 +1323,8 @@ class ContinuousBatcher:
                 if k0 == L:
                     first_logits = np.asarray(dlogits)[0].astype(np.float32)
         job = _PrefillJob(free, ids, p0, min(self.prefill_chunk, plen),
-                          max_new, fut, on_token, info, seed, bt_row, pages)
+                          max_new, fut, on_token, info, seed, bt_row, pages,
+                          t_arrival=t_arrival)
         self._prefill = job
         if first_logits is not None:
             # full-prompt prefix hit: nothing to prefill, activate now from
@@ -1040,7 +1375,7 @@ class ContinuousBatcher:
             job.bt_row[0])
         self._prefill = None
         self._commit_slot(job.slot, first, key, job.L, job.max_new, job.fut,
-                          job.on_token, ids=job.ids)
+                          job.on_token, ids=job.ids, t_arrival=job.t_arrival)
 
     # ------------------------------------------------------------------
     # Page accounting: growth, exhaustion shedding, release
@@ -1078,6 +1413,10 @@ class ContinuousBatcher:
                 if victim == "job":
                     self._shed_prefill_job("page pool exhausted by decode")
                     continue
+                if isinstance(victim, tuple):  # ("remote", job_id)
+                    self._shed_remote_job(victim[1],
+                                          "page pool exhausted by decode")
+                    continue
                 if victim == i:
                     # the growing slot is itself the newest tenant: LIFO
                     # says it yields to the older requests
@@ -1099,11 +1438,16 @@ class ContinuousBatcher:
     def _pick_page_victim(self):
         """LIFO shed order on page exhaustion: the globally NEWEST tenant
         yields — the staged prefill job first (it has produced nothing
-        yet), then the most recently admitted active slot, which may be the
-        growing slot itself. None when there is at most one tenant (shed
-        nothing — the sole request just stops growing)."""
+        yet), then the newest staged REMOTE job (same reasoning: its
+        prefill compute is sunk on the other slice, but no client has a
+        token yet), then the most recently admitted active slot, which may
+        be the growing slot itself. None when there is at most one tenant
+        (shed nothing — the sole request just stops growing)."""
         if self._prefill is not None:
             return "job"
+        if self._remote_jobs:
+            # dict preserves insertion order: the last key is the newest
+            return ("remote", next(reversed(self._remote_jobs)))
         active = [j for j, s in enumerate(self._slots) if s.active and s.pages]
         if len(active) < 2:
             return None
@@ -1444,6 +1788,11 @@ class ContinuousBatcher:
                 tok = int(arr[i, j])
                 slot.tokens.append(tok)
                 slot.n_new += 1
+                # inter-token gap at this drain (a fused block surfaces
+                # its k tokens in one burst: trailing tokens record ~0)
+                if slot.t_last is not None:
+                    self.server._inter_token_times.append(now - slot.t_last)
+                slot.t_last = now
                 if slot.on_token is not None and tok != self.eos_id:
                     slot.on_token(tok)
                 if (tok == self.eos_id or slot.n_new >= slot.max_new
@@ -1460,6 +1809,9 @@ class ContinuousBatcher:
         block cuts the credit loop there — the device ran ahead past it,
         exactly like a trailing run-ahead step, and the leftover tokens
         are dropped, never surfaced."""
+        import time
+
+        now = time.perf_counter()
         for i, gen in rec.snapshot:
             slot = self._slots[i]
             if not slot.active or slot.gen != gen:
@@ -1482,6 +1834,12 @@ class ContinuousBatcher:
                 tok = int(arr[i, j])
                 slot.tokens.append(tok)
                 slot.n_new += 1
+                # inter-token gap (an accepted block surfaces as a burst:
+                # its trailing tokens record ~0 gaps — the block's real
+                # cadence is the first token's gap)
+                if slot.t_last is not None:
+                    self.server._inter_token_times.append(now - slot.t_last)
+                slot.t_last = now
                 if slot.on_token is not None and tok != self.eos_id:
                     slot.on_token(tok)
                 if (tok == self.eos_id or slot.n_new >= slot.max_new
@@ -1500,18 +1858,31 @@ class ContinuousBatcher:
                 # — the insert/set_slot queue behind them in device program
                 # order, and the gen counter masks their stale tokens.
                 while self._pending and self._prefill is None:
-                    ids, max_new, fut, on_token, info, seed = self._pending[0]
-                    if self.paged:
+                    (ids, max_new, fut, on_token, info, seed,
+                     t_arr) = self._pending[0]
+                    if self._remote is not None:
+                        # disaggregated: stage the job on the prefill
+                        # slice — host-side only, so MULTIPLE admissions
+                        # can be in flight while decode keeps dispatching
+                        admitted = await asyncio.to_thread(
+                            self._admit_remote, ids, max_new, fut,
+                            on_token, info, seed, t_arr)
+                    elif self.paged:
                         admitted = await asyncio.to_thread(
                             self._admit_begin, ids, max_new, fut, on_token,
-                            info, seed)
+                            info, seed, t_arr)
                     else:
                         admitted = await asyncio.to_thread(
                             self._admit, ids, max_new, fut, on_token, info,
-                            seed)
+                            seed, t_arr)
                     if not admitted:
                         break  # no free slot/pages — decode frees them
                     self._pending.popleft()
+                # disaggregated: activate every finished handoff (import +
+                # commit — one jitted scatter each, no prefill compute on
+                # this slice)
+                if self._transfer is not None and self._transfer.ready_depth():
+                    await asyncio.to_thread(self._consume_handoffs)
                 # producer: keep the device pipeline_depth steps ahead of
                 # the host — dispatch is enqueue-only, no sync
                 while (len(self._inflight) < self.pipeline_depth
@@ -1534,6 +1905,14 @@ class ContinuousBatcher:
                     await asyncio.to_thread(self._drain_one)
                     continue
                 if self._closed:
+                    # staged remote jobs would leave futures hanging past
+                    # the loop's death — fail them before returning
+                    # (to_thread like every other _release_slot caller:
+                    # page/block-table writers stay single-context)
+                    if self._remote_jobs:
+                        await asyncio.to_thread(
+                            self._fail_remote_jobs,
+                            RuntimeError("batcher closed"))
                     return
                 if self._dispatch_eligible():
                     # a slot became runnable without a wakeup signal (e.g.
@@ -1552,6 +1931,12 @@ class ContinuousBatcher:
             logger.exception("batcher loop died: %s", e)
             self._inflight.clear()
             self._prefill = None
+            if self._remote_jobs:
+                # cancel staged handoffs first: their slots then read as
+                # released, so the slot sweep below cannot double-resolve
+                # (to_thread keeps every _release_slot caller in the same
+                # offload context the page/block-table state is guarded by)
+                await asyncio.to_thread(self._fail_remote_jobs, e)
             for slot in self._slots:
                 if slot.active or slot.prefilling:
                     if slot.on_token is not None:
@@ -1566,7 +1951,7 @@ class ContinuousBatcher:
                     slot.prefilling = False
                     slot.future = None
             while self._pending:
-                _, _, fut, on_token, _, _ = self._pending.popleft()
+                _, _, fut, on_token, _, _, _ = self._pending.popleft()
                 if on_token is not None:
                     try:
                         on_token(None)

@@ -1,0 +1,445 @@
+"""Disaggregated prefill/decode serving: the host-side coordination layer.
+
+The compute story (docs/performance.md "Disaggregated serving"): prefill is
+a compute-bound burst, decode is a bandwidth-bound trickle, and running both
+on one mesh slice makes every admission a latency spike for every in-flight
+stream — PR 7's chunked prefill only *interleaves* the burst. Splitting the
+serving mesh (parallel/mesh.py ``disaggregated_mesh``) runs admission
+prefill on a **prefill slice** and the pipelined decode batch on a
+**decode slice**, with the prefilled KV moved device-to-device (DistServe,
+Zhong et al. OSDI 2024; Splitwise, Patel et al. ISCA 2024).
+
+This module is the host half of that split:
+
+- ``TransferQueue`` — the lock-guarded handoff channel between prefill
+  workers and the decode batcher. A handoff is registered at admission,
+  becomes READY when the worker finishes, and is consumed by the batcher
+  loop — or cancelled by a shed. Every transition is atomic under one
+  lock, so a handoff is delivered exactly once and its decode-side pages
+  are freed exactly once even when a shed races the worker's put (the
+  interleavings tests/test_schedules.py explores).
+- ``PrefillWorker`` — one worker thread per prefill-slice device: it keeps
+  a committed copy of the params and (paged layout) a single-sequence
+  staging page pool on its device, runs the server's own compiled prefill
+  programs there (``_get_prefill`` dense, ``_get_prefill_chunk`` paged —
+  the SAME programs local admission compiles, so the written KV is
+  bit-identical), then moves the result onto the decode device with
+  ``jax.device_put`` — a direct device-to-device copy, no host round trip
+  for the KV — and publishes the handoff.
+- ``PrefillWorkerPool`` — M workers behind least-backlog dispatch.
+
+The decode side (runtime/batcher.py ``disaggregation="remote_prefill"``)
+imports a ready handoff into its slot pool with one donated jitted scatter
+(``ContinuousBatcher._get_handoff_import``; dense handoffs reuse the
+existing ``insert``), pinned by the ``disagg.import_pages`` hlolint
+contract: zero infeed/outfeed, donation aliasing intact, bytes within the
+committed budget.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+DISAGGREGATION_MODES = ("off", "remote_prefill")
+
+# TransferQueue record states (values are only compared for identity)
+_STAGED = "staged"        # registered; the worker has not finished yet
+_READY = "ready"          # handoff published, waiting for the batcher
+_CANCELLED = "cancelled"  # shed before the worker finished
+
+
+def normalize_disaggregation(value) -> str:
+    """Canonical disaggregation mode ("off" or "remote_prefill"); raises
+    ValueError on anything else so misconfiguration fails at load() time,
+    not inside the batcher's admission path."""
+    v = str(value or "off").strip().lower()
+    if v in ("off", "none", "no", "0", ""):
+        return "off"
+    if v in ("remote_prefill", "remote-prefill", "prefill", "disagg",
+             "disaggregated"):
+        return "remote_prefill"
+    raise ValueError(
+        f"unknown disaggregation {value!r}: expected one of "
+        f"{DISAGGREGATION_MODES}")
+
+
+class PrefillRequest:
+    """What a worker needs to prefill one admission: the (already
+    truncated) prompt, its dense prefill bucket, and the page count the
+    decode side allocated for it (paged layout)."""
+
+    __slots__ = ("job_id", "ids", "plen", "n_pages")
+
+    def __init__(self, job_id: int, ids: List[int], plen: int,
+                 n_pages: int = 0):
+        self.job_id = job_id
+        self.ids = list(ids)
+        self.plen = int(plen)
+        self.n_pages = int(n_pages)
+
+
+class Handoff:
+    """One finished prefill, published by a worker: the staged KV already
+    resident on the DECODE device (``jax.device_put`` moved it
+    device-to-device; the host never materialized it), the last-position
+    logits the first sampled token draws from (a small [vocab] host array
+    — admission-time, once per request), and timing/bytes for the
+    handoff metrics. ``error`` carries a worker-side failure instead of
+    a payload — the batcher resolves the request with it."""
+
+    __slots__ = ("job_id", "staged", "first_logits", "error", "prefill_s",
+                 "transfer_bytes")
+
+    def __init__(self, job_id: int, staged: Any = None,
+                 first_logits: Optional[np.ndarray] = None,
+                 error: Optional[BaseException] = None,
+                 prefill_s: float = 0.0, transfer_bytes: int = 0):
+        self.job_id = job_id
+        self.staged = staged
+        self.first_logits = first_logits
+        self.error = error
+        self.prefill_s = prefill_s
+        self.transfer_bytes = transfer_bytes
+
+
+class TransferQueue:
+    """Lock-guarded handoff channel between prefill workers and the decode
+    batcher, with exactly-once delivery/cancellation semantics.
+
+    Protocol (all transitions atomic under ``self._lock``):
+
+    - ``register(job_id)`` (batcher, at admission): the job exists, STAGED.
+    - ``put(handoff)`` (worker thread): STAGED -> READY, or returns False
+      when the job was cancelled meanwhile — the worker just drops the
+      payload (the decode-side pages were freed by the canceller).
+    - ``pop()`` (batcher loop): oldest READY handoff, removed — the
+      batcher now owns the import and the slot owns the pages.
+    - ``cancel(job_id)`` (batcher shed paths): READY -> returns the
+      handoff (the CALLER frees the pages, exactly once); STAGED ->
+      marked cancelled and returns None (the caller frees the pages NOW;
+      the worker's later put is refused). Unknown/already-popped ->
+      None and the caller must NOT free (the slot owns them).
+
+    An unlocked reconstruction of this state machine double-delivers a
+    handoff (pop vs pop) or frees pages twice (pop vs cancel) under
+    interleavings the deterministic-schedule suite finds
+    (tests/test_schedules.py); the real class survives the same
+    exploration."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state: Dict[int, str] = {}
+        self._ready: deque = deque()  # Handoff records, arrival order
+        self.handoffs_total = 0
+        self.transfer_bytes_total = 0
+        # optional ready-notification hook (the batcher points this at a
+        # loop-threadsafe wakeup); read under the lock, invoked outside it
+        # so the callback can never deadlock against queue users
+        self.on_ready: Optional[Any] = None
+
+    def register(self, job_id: int) -> None:
+        with self._lock:
+            self._state[job_id] = _STAGED
+
+    def put(self, handoff: Handoff) -> bool:
+        """Publish a finished prefill. False = the job was cancelled while
+        the worker ran; the payload is dropped (nothing to free here —
+        the canceller already freed the decode-side pages)."""
+        with self._lock:
+            st = self._state.get(handoff.job_id)
+            if st is _CANCELLED:
+                del self._state[handoff.job_id]
+                return False
+            self._state[handoff.job_id] = _READY
+            self._ready.append(handoff)
+            self.handoffs_total += 1
+            self.transfer_bytes_total += int(handoff.transfer_bytes)
+            cb = self.on_ready
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # a wakeup hook must never kill a worker
+                logger.exception("transfer-queue on_ready hook failed")
+        return True
+
+    def pop(self) -> Optional[Handoff]:
+        """Oldest READY handoff, or None. The caller owns the import; the
+        job's pages now belong to its slot."""
+        with self._lock:
+            if not self._ready:
+                return None
+            h = self._ready.popleft()
+            self._state.pop(h.job_id, None)
+            return h
+
+    def cancel(self, job_id: int) -> Optional[Handoff]:
+        """Shed a job. Returns the handoff if it was READY (caller frees
+        its decode-side pages); None if it was still STAGED (caller frees
+        the pages now — the worker's put will be refused) or already
+        popped (caller must NOT free: the slot owns them)."""
+        with self._lock:
+            st = self._state.get(job_id)
+            if st is _READY:
+                found = None
+                for i, h in enumerate(self._ready):
+                    if h.job_id == job_id:
+                        found = h
+                        del self._ready[i]
+                        break
+                del self._state[job_id]
+                return found
+            if st is _STAGED:
+                self._state[job_id] = _CANCELLED
+            return None
+
+    def ready_depth(self) -> int:
+        with self._lock:
+            return len(self._ready)
+
+    def depth(self) -> int:
+        """Jobs registered and not yet consumed (staged + ready)."""
+        with self._lock:
+            return len(self._state)
+
+    def stats(self):
+        """(handoffs_total, transfer_bytes_total, staged+ready depth) —
+        one consistent snapshot for the /metrics scrape."""
+        with self._lock:
+            return (self.handoffs_total, self.transfer_bytes_total,
+                    len(self._state))
+
+
+class PrefillWorker:
+    """One prefill-slice worker: a dedicated thread that runs the server's
+    compiled prefill programs on its own device and hands the written KV
+    to the decode device.
+
+    The worker keeps a committed copy of the params on its device
+    (``LLMServer._params_on``) and, under the paged layout, a
+    single-sequence staging page pool (``RESERVED_PAGES + n_pages`` pages
+    — pages 2.. back the sequence; the batcher's block-row width is
+    reused so the chunk program has the batcher's exact shape contract).
+    Prefill itself is the SAME compiled program local admission runs
+    (``_get_prefill`` / ``_get_prefill_chunk``), just dispatched on the
+    prefill device — which is what makes remote-prefill serving
+    bit-exact against single-slice serving (tests/test_disagg.py).
+
+    All cross-thread state (the backlog, the closing flag) lives under
+    ``self._cond``; the staging pool and params copy are touched only by
+    the worker thread after ``__init__``."""
+
+    def __init__(self, server: Any, queue: TransferQueue, device: Any,
+                 decode_device: Any, *, layout: str, max_len: int,
+                 page_size: int = 0, n_pages: int = 0,
+                 prefill_chunk: int = 0, name: str = "prefill-worker"):
+        self.server = server
+        self.queue = queue
+        self.device = device
+        self.decode_device = decode_device
+        self.layout = layout
+        self.max_len = int(max_len)
+        self.page_size = int(page_size)
+        self.n_pages = int(n_pages)
+        self.prefill_chunk = int(prefill_chunk)
+        self.name = name
+        self._cond = threading.Condition()
+        self._backlog: deque = deque()
+        self._closing = False
+        self._params = None    # committed copy, built on first job
+        self._staging = None   # paged staging pool, built on first job
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- caller side ---------------------------------------------------
+    def submit(self, req: PrefillRequest) -> None:
+        with self._cond:
+            if self._closing:
+                raise RuntimeError(f"{self.name} is closed")
+            self._backlog.append(req)
+            self._cond.notify()
+
+    def backlog_depth(self) -> int:
+        with self._cond:
+            return len(self._backlog)
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+        # bounded: a wedged device dispatch must not hang server shutdown
+        self._thread.join(timeout=timeout_s)
+
+    # -- worker side ---------------------------------------------------
+    def _next_job(self) -> Optional[PrefillRequest]:
+        with self._cond:
+            while not self._backlog and not self._closing:
+                self._cond.wait(timeout=0.5)
+            if self._backlog:
+                return self._backlog.popleft()
+            return None  # closing and drained
+
+    def _run(self) -> None:
+        while True:
+            req = self._next_job()
+            if req is None:
+                return
+            try:
+                handoff = self._prefill_one(req)
+            except BaseException as e:  # noqa: BLE001 — worker must not die
+                logger.exception("prefill worker %s failed job %d",
+                                 self.name, req.job_id)
+                handoff = Handoff(req.job_id, error=e)
+            self.queue.put(handoff)
+
+    def _ensure_state(self):
+        import jax
+
+        if self._params is None:
+            self._params = self.server._params_on(self.device)
+        if self.layout == "paged" and self._staging is None:
+            from seldon_core_tpu.models.transformer import RESERVED_PAGES
+
+            # server-cached compile: M workers share one staging-init
+            # program; each executes it once onto its own device
+            pool = self.server._get_staging_pool_init(
+                RESERVED_PAGES + self.n_pages, self.page_size)()
+            self._staging = jax.device_put(pool, self.device)
+
+    def _prefill_one(self, req: PrefillRequest) -> Handoff:
+        import time
+
+        t0 = time.perf_counter()
+        self._ensure_state()
+        if self.layout == "paged":
+            staged, first_logits = self._prefill_paged(req)
+        else:
+            staged, first_logits = self._prefill_dense(req)
+        import jax
+
+        # THE handoff: a direct device-to-device copy onto the decode
+        # slice — the KV never rounds through host memory (the jitted
+        # decode-side import is hlolint-checked for zero infeed/outfeed)
+        moved = jax.device_put(staged, self.decode_device)
+        nbytes = sum(int(getattr(leaf, "nbytes", 0))
+                     for leaf in jax.tree.leaves(moved))
+        return Handoff(req.job_id, staged=moved, first_logits=first_logits,
+                       prefill_s=time.perf_counter() - t0,
+                       transfer_bytes=nbytes)
+
+    def _prefill_dense(self, req: PrefillRequest):
+        """One-shot dense prefill at the request's bucket — the same
+        compiled program (and therefore the same KV bits) as the local
+        dense admission path (``ContinuousBatcher._admit``)."""
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.transformer import PAD_POS
+
+        L = len(req.ids)
+        toks = np.zeros((1, req.plen), np.int32)
+        pos = np.full((1, req.plen), PAD_POS, np.int32)
+        toks[0, :L] = req.ids
+        pos[0, :L] = np.arange(L)
+        fn = self.server._get_prefill(1, req.plen, self.max_len)
+        logits, cache1 = fn(self._params, jnp.asarray(toks),
+                            jnp.asarray(pos))
+        # graftlint: allow-host-sync-in-hot-path(admission-time sync on the PREFILL worker thread, once per request: the first sampled token's logits must reach the host; the decode slice never blocks on it)
+        first_logits = np.asarray(logits[0, L - 1]).astype(np.float32)
+        return cache1, first_logits
+
+    def _prefill_paged(self, req: PrefillRequest):
+        """Chunked prefill into the staging pool through a staging block
+        row — the same compiled chunk program type as local paged
+        admission (``_prefill_step``), on the prefill device. The staging
+        pool is reused across jobs: its pages are position-reset before
+        each prompt so no previous occupant's positions survive."""
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.transformer import (
+            NULL_PAGE, PAD_POS, RESERVED_PAGES, TRASH_PAGE)
+        from seldon_core_tpu.runtime.batcher import _page_table_ops
+
+        (_, _, reset_pages, _, _) = _page_table_ops()
+        n0 = req.n_pages or -(-len(req.ids) // self.page_size)
+        ids_np = np.full((self.n_pages,), TRASH_PAGE, np.int32)
+        ids_np[:n0] = np.arange(RESERVED_PAGES, RESERVED_PAGES + n0)
+        self._staging = reset_pages(self._staging, jnp.asarray(ids_np))
+        row = np.full((self.n_pages,), NULL_PAGE, np.int32)
+        row[:n0] = np.arange(RESERVED_PAGES, RESERVED_PAGES + n0)
+        bt_row = jnp.asarray(row[None, :])
+
+        C = min(self.prefill_chunk, req.plen) or req.plen
+        fn = self.server._get_prefill_chunk(C, self.n_pages)
+        L = len(req.ids)
+        logits = None
+        n = 0
+        start = 0
+        while start < L:
+            part = req.ids[start:start + C]
+            n = len(part)
+            toks = np.zeros((1, C), np.int32)
+            pos = np.full((1, C), PAD_POS, np.int32)
+            toks[0, :n] = part
+            pos[0, :n] = np.arange(start, start + n)
+            logits, self._staging = fn(self._params, self._staging, bt_row,
+                                       jnp.asarray(toks), jnp.asarray(pos))
+            start += n
+        # graftlint: allow-host-sync-in-hot-path(admission-time sync on the PREFILL worker thread, once per request: the LAST chunk's logits seed the first sampled token; the decode slice never blocks on it)
+        first_logits = np.asarray(logits[0, n - 1]).astype(np.float32)
+        # Ship only a power-of-two page bucket covering the written pages,
+        # not the whole max_len staging pool: interconnect bytes track
+        # prompt length (DECODE_NOTES.md "interconnect math") and the
+        # decode-side import stays at O(log n_pages) compiles. The slice
+        # runs on the prefill device; the import masks rows >= n0 to
+        # TRASH_PAGE so the bucket's padding never lands in a live page.
+        import jax
+
+        b = 1
+        while b < n0:
+            b <<= 1
+        b = min(b, self.n_pages)
+        staged = jax.tree.map(lambda p: p[:RESERVED_PAGES + b],
+                              self._staging)
+        return staged, first_logits
+
+
+class PrefillWorkerPool:
+    """M prefill workers behind least-backlog dispatch, publishing into
+    one shared TransferQueue. One worker per prefill-slice device is the
+    natural shape (each worker's programs are committed to its device);
+    more devices than workers just leaves slices idle."""
+
+    def __init__(self, server: Any, devices: Sequence, decode_device: Any,
+                 *, layout: str, max_len: int, page_size: int = 0,
+                 n_pages: int = 0, prefill_chunk: int = 0):
+        self.queue = TransferQueue()
+        self.workers = [
+            PrefillWorker(server, self.queue, dev, decode_device,
+                          layout=layout, max_len=max_len,
+                          page_size=page_size, n_pages=n_pages,
+                          prefill_chunk=prefill_chunk,
+                          name=f"prefill-worker-{i}")
+            for i, dev in enumerate(devices)
+        ]
+
+    def submit(self, req: PrefillRequest) -> None:
+        self.queue.register(req.job_id)
+        # least-backlog, lowest index breaks ties: deterministic placement
+        # keeps parity tests and schedule replays reproducible
+        _, w = min(enumerate(self.workers),
+                   key=lambda iw: (iw[1].backlog_depth(), iw[0]))
+        w.submit(req)
+
+    def backlog_depth(self) -> int:
+        return sum(w.backlog_depth() for w in self.workers)
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        for w in self.workers:
+            w.close(timeout_s=timeout_s)

@@ -120,6 +120,111 @@ def make_puid() -> str:
     return secrets.token_hex(16)
 
 
+def replica_load(component: Any) -> Tuple[float, float]:
+    """Load score for least-loaded replica dispatch, from the signals the
+    serving stack already exports (no new instrumentation): primary = the
+    work queued ahead of a new request (admission backlog + occupied
+    batcher slots — staged prefill handoffs count HERE, through the
+    prefilling slot each remote admission holds until commit/shed, so
+    they are not tallied twice off the TransferQueue), secondary = KV
+    page-pool pressure (in-use fraction — the shed-proximity signal).
+    Components without a batcher score (0, 0): an idle plain component
+    is as good a target as an idle LLM replica."""
+    svc = getattr(component, "_batcher_service", None)
+    if svc is None:
+        return (0.0, 0.0)
+    b = svc.batcher
+    queued = len(b._pending) + sum(
+        1 for s in b._slots if s.active or s.prefilling)
+    pages = 0.0
+    if getattr(b, "paged", False):
+        from seldon_core_tpu.models.transformer import RESERVED_PAGES
+
+        total, in_use, _ = b._allocator.stats()
+        usable = max(total - RESERVED_PAGES, 1)
+        pages = in_use / usable
+    return (float(queued), pages)
+
+
+class ReplicaSet(SeldonComponent):
+    """N identical component replicas behind least-loaded dispatch — the
+    in-process analog of the reference's HPA-scaled Deployment fronted by
+    the engine's service (PAPER.md layer map). A predictor unit whose
+    registered component is a LIST resolves to one of these: each
+    predict/generate picks the replica with the least queued work
+    (``replica_load`` — admission queue depth, slot occupancy, staged
+    prefill handoffs, page-pool pressure), lowest index breaking ties so
+    dispatch is deterministic under equal load. With
+    ``disaggregation="remote_prefill"`` replicas, this is the "N decode
+    replicas + M prefill workers behind one predictor" topology
+    (docs/performance.md "Disaggregated serving")."""
+
+    def __init__(self, replicas: List[SeldonComponent]):
+        if not replicas:
+            raise SeldonError("ReplicaSet needs >= 1 replica", status_code=500)
+        self.replicas = list(replicas)
+
+    def load(self) -> None:
+        for r in self.replicas:
+            if hasattr(r, "load"):
+                r.load()
+
+    def pick(self) -> SeldonComponent:
+        """The least-loaded replica right now (scores re-read per call —
+        the signals mutate under their own locks on the serving path)."""
+        best, best_score = self.replicas[0], replica_load(self.replicas[0])
+        for r in self.replicas[1:]:
+            score = replica_load(r)
+            if score < best_score:
+                best, best_score = r, score
+        return best
+
+    def loads(self) -> List[Tuple[float, float]]:
+        return [replica_load(r) for r in self.replicas]
+
+    # the component surface delegates to the chosen replica; generate is
+    # included so LLM graph nodes (and their transports) route too
+    def predict(self, X, names, meta=None):
+        return self.pick().predict(X, names, meta)
+
+    def generate(self, *a, **kw):
+        return self.pick().generate(*a, **kw)
+
+    def tags(self) -> Dict[str, Any]:
+        from seldon_core_tpu.components.component import client_custom_tags
+
+        out: Dict[str, Any] = {"replicas": len(self.replicas)}
+        for i, r in enumerate(self.replicas):
+            for k, v in client_custom_tags(r).items():
+                out[f"replica_{i}_{k}"] = v
+        return out
+
+    def llm_stats(self) -> Dict[str, Any]:
+        """Aggregated snapshot for /metrics: numeric gauges/counters sum,
+        drained lists concatenate (each replica's deques drain exactly
+        once, same as solo), strings/configs come from replica 0."""
+        stats_list = [r.llm_stats() for r in self.replicas
+                      if hasattr(r, "llm_stats")]
+        if not stats_list:
+            return {}
+        fractions = ("kv_occupancy", "kv_page_fragmentation",
+                     "spec_accept_rate", "spec_tokens_per_forward",
+                     "spec_draft_overhead_fraction")
+        merged = dict(stats_list[0])
+        for stats in stats_list[1:]:
+            for k, v in stats.items():
+                cur = merged.get(k)
+                if isinstance(v, list) and isinstance(cur, list):
+                    merged[k] = cur + v
+                elif isinstance(v, (int, float)) and isinstance(
+                        cur, (int, float)) and not isinstance(v, bool):
+                    merged[k] = cur + v
+        for k in fractions:  # fractions average; sums would exceed 1.0
+            if isinstance(merged.get(k), (int, float)):
+                merged[k] = merged[k] / len(stats_list)
+        return merged
+
+
 @dataclass
 class UnitState:
     """Built (static) state for one graph node: resolved component + children.
@@ -248,6 +353,13 @@ class GraphEngine:
     def _resolve(self, unit: PredictiveUnit) -> Optional[SeldonComponent]:
         if unit.name in self._components:
             comp = self._components[unit.name]
+            if isinstance(comp, (list, tuple)):
+                # a list of components registers N replicas behind
+                # least-loaded dispatch; cache the wrapper so repeated
+                # builds (and the metrics scrape walking _components)
+                # see ONE ReplicaSet, not one per resolve
+                comp = ReplicaSet(list(comp))
+                self._components[unit.name] = comp
         elif unit.implementation is not None and unit.implementation not in (
             UnitImplementation.UNKNOWN_IMPLEMENTATION,
         ):

@@ -184,6 +184,11 @@ class LLMServer(SeldonComponent):
         spec_mode: str = "",
         spec_k: int = 0,
         spec_ngram: int = 0,
+        disaggregation: str = "",
+        prefill_devices: int = 0,
+        decode_devices: int = 0,
+        prefill_workers: int = 0,
+        disagg_mesh: Optional[Any] = None,
         draft_model: Optional[str] = None,
         draft_model_kwargs: Optional[Dict[str, Any]] = None,
         draft_model_uri: str = "",
@@ -279,6 +284,24 @@ class LLMServer(SeldonComponent):
         self.spec_k = int(spec_k)
         # longest n-gram the self-draft proposer matches (0 = default 3)
         self.spec_ngram = int(spec_ngram)
+        # Disaggregated prefill/decode (runtime/disagg.py,
+        # docs/performance.md "Disaggregated serving"): "remote_prefill"
+        # splits the device world into a prefill slice and a decode slice
+        # (parallel/mesh.py disaggregated_mesh) — admission prefill runs on
+        # prefill-slice workers and the written KV moves device-to-device
+        # into the decode slice's pool, so the compute burst never touches
+        # the latency-critical decode batch. Bit-exact vs single-slice
+        # serving (tests/test_disagg.py). Normalized + validated at load().
+        self.disaggregation = disaggregation
+        # slice sizing (counts; the prefill slice takes devices from the
+        # END of the enumeration, decode from the front; 0 decode = all
+        # the rest) — or pass a prebuilt DisaggregatedMesh programmatically
+        self.prefill_devices = int(prefill_devices)
+        self.decode_devices = int(decode_devices)
+        # prefill workers (one thread+device each; 0 = one per
+        # prefill-slice device)
+        self.prefill_workers = int(prefill_workers)
+        self.disagg_mesh = disagg_mesh
         # optional draft model: registry name + kwargs (random init on the
         # server's seed) or a jaxserver-style checkpoint dir. Must share
         # the target's vocab — draft proposals index the target's tokens.
@@ -322,6 +345,19 @@ class LLMServer(SeldonComponent):
         # verify step (drained into the accepted-tokens-per-step histogram
         # at /metrics scrape time, like the step-time deques above)
         self._spec_accepted: Any = deque(maxlen=4096)
+        # streaming-latency observability (batcher on_token path): time to
+        # first token per request and the gap before each surfaced token —
+        # the headline pair disaggregation/chunked-prefill move
+        # (seldon_llm_ttft_seconds / seldon_llm_inter_token_seconds)
+        self._ttft_times: Any = deque(maxlen=4096)
+        self._inter_token_times: Any = deque(maxlen=8192)
+        # disaggregated serving: per-handoff wall (prefill-slice compute +
+        # device-to-device transfer + decode-side import)
+        self._handoff_times: Any = deque(maxlen=4096)
+        # per-device committed param copies for prefill-slice workers
+        # (runtime/disagg.py); built on first use under its own lock
+        self._device_params: Dict[Any, Any] = {}
+        self._device_params_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def load(self) -> None:
@@ -389,6 +425,29 @@ class LLMServer(SeldonComponent):
             raise ValueError(
                 "spec_mode='draft' needs a draft model: pass draft_model="
                 "<registry name> (+ draft_model_kwargs) or draft_model_uri")
+        from seldon_core_tpu.runtime.disagg import normalize_disaggregation
+
+        # racelint: allow-unguarded-shared-state(load()-time config normalization: runs once, before any serving thread or batcher loop exists — nothing can interleave with it)
+        self.disaggregation = normalize_disaggregation(self.disaggregation)
+        if self.prefill_devices < 0 or self.decode_devices < 0 or \
+                self.prefill_workers < 0:
+            raise ValueError(
+                f"prefill_devices={self.prefill_devices} / decode_devices="
+                f"{self.decode_devices} / prefill_workers="
+                f"{self.prefill_workers} must be >= 0")
+        if self.disaggregation != "off":
+            if self.tensor_parallel > 1 or self.sequence_parallel > 1 \
+                    or self.mesh is not None:
+                raise ValueError(
+                    "disaggregation='remote_prefill' does not yet compose "
+                    "with tensor/sequence parallelism or an explicit mesh: "
+                    "the batcher's slot pool is single-device per slice — "
+                    "shard WITHIN a slice is a follow-up")
+            if self.disagg_mesh is None and len(jax.devices()) < 2:
+                raise ValueError(
+                    "disaggregation='remote_prefill' needs >= 2 devices "
+                    "(one per slice); this process sees "
+                    f"{len(jax.devices())}")
 
         cfg_kwargs = dict(self.model_kwargs)
         name = self.model_name
@@ -507,6 +566,23 @@ class LLMServer(SeldonComponent):
         self.eos_id = self._eos_override if self._eos_override is not None else self._tokenizer.eos_id
         self.ready = True
         logger.info("LLMServer loaded %s (vocab=%d)", name, self._cfg.vocab_size)
+
+    def _params_on(self, device):
+        """Committed copy of the serving params on ``device`` (cached —
+        one copy per prefill-slice device, built on a worker's first job).
+        Disaggregation pays this duplication deliberately: on a real pod
+        each slice owns its HBM anyway, and replicating the weights is
+        what lets the prefill burst run without touching the decode
+        slice. The cache is lock-guarded: two workers' first jobs race
+        the build, and losing a copy would device_put the tree twice."""
+        import jax
+
+        with self._device_params_lock:
+            params = self._device_params.get(device)
+            if params is None:
+                params = jax.device_put(self._params, device)
+                self._device_params[device] = params
+            return params
 
     def _init_shapes(self):
         import jax
@@ -949,6 +1025,71 @@ class LLMServer(SeldonComponent):
 
         self._prefill_cache[key] = prefill_chunk
         return prefill_chunk
+
+    def _get_handoff_import(self, n_pages: int,
+                            staged_pages: Optional[int] = None):
+        """Compiled decode-side KV-handoff import for DISAGGREGATED serving
+        (runtime/disagg.py): copy a prefill worker's staged pages (staging
+        pool rows RESERVED_PAGES..) into the decode pool pages the
+        admission allocated, whole pages at a time. ``staged_pages`` is
+        the STATIC page count of the transferred buffer — workers ship
+        only a power-of-two bucket covering the prompt's written pages,
+        not the whole staging pool, so interconnect bytes track prompt
+        length (DECODE_NOTES.md "interconnect math") at a bounded
+        O(log n_pages) compile count. ``n_valid`` (traced) masks the copy
+        to the prompt's exact pages — rows past it (and NULL block-row
+        entries) target TRASH_PAGE, so one compile serves every prompt
+        length inside a bucket. The slot pool is donated (the scatter
+        updates in place behind in-flight steps in device program order);
+        the staged buffer is NOT — it is a transient dropped after the
+        call. Cached on the server (like the prefill programs) so every
+        batcher built on it shares one compile per bucket. Compiled-form
+        contract: ``disagg.import_pages`` in tools/hlolint (zero host
+        transfers, donation intact, bytes within the committed budget)."""
+        m = n_pages if staged_pages is None else min(staged_pages, n_pages)
+        key = ("handoff_import", n_pages, m)
+        fn = self._prefill_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.transformer import (NULL_PAGE,
+                                                        RESERVED_PAGES,
+                                                        TRASH_PAGE)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def import_pages(pools, staged, block_row, n_valid):
+            src = jnp.arange(m) + RESERVED_PAGES
+            tgt = jnp.where(
+                (jnp.arange(m) < n_valid) & (block_row[:m] != NULL_PAGE),
+                block_row[:m], TRASH_PAGE)
+            return [
+                tuple(pool.at[tgt].set(st[src])
+                      for pool, st in zip(pool_layer, staged_layer))
+                for pool_layer, staged_layer in zip(pools, staged)
+            ]
+
+        self._prefill_cache[key] = import_pages
+        return import_pages
+
+    def _get_staging_pool_init(self, pool_pages: int, page_size: int):
+        """Compiled zero-init of a prefill worker's staging page pool
+        (runtime/disagg.py): cached on the server so M workers (and every
+        rebuilt batcher) share one compile — each worker still executes it
+        once and commits the result to its own device."""
+        key = ("staging_init", pool_pages, page_size)
+        fn = self._prefill_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+
+        from seldon_core_tpu.models.transformer import init_paged_kv_caches
+
+        fn = jax.jit(lambda: init_paged_kv_caches(
+            self._cfg, pool_pages, page_size, self.kv_cache_dtype))
+        self._prefill_cache[key] = fn
+        return fn
 
     def _get_decode_step_paged(self, slots: int, n_pages: int, k: int = 1):
         """Compiled pipelined decode step over the PAGED pool: identical
@@ -1505,6 +1646,10 @@ class LLMServer(SeldonComponent):
                       "spec_slot_steps_total": 0,
                       "spec_accept_rate_per_slot": [],
                       "spec_draft_overhead_fraction": 0.0}
+        handoff_stats = {"disaggregation": self.disaggregation or "off",
+                         "handoffs_total": 0,
+                         "handoff_transfer_bytes_total": 0,
+                         "handoff_queue_depth": 0}
         svc = getattr(self, "_batcher_service", None)
         if svc is not None:
             batcher = svc.batcher
@@ -1518,6 +1663,8 @@ class LLMServer(SeldonComponent):
                 page_stats = batcher.page_stats()
             if getattr(batcher, "spec_mode", "off") != "off":
                 spec_stats.update(batcher.spec_stats())
+            if getattr(batcher, "_remote", None) is not None:
+                handoff_stats.update(batcher.handoff_stats())
         with self._prefix_lock:
             prefix_bytes = self._prefix_bytes
         return {
@@ -1547,4 +1694,16 @@ class LLMServer(SeldonComponent):
             # fraction (metrics/registry.py seldon_llm_spec_*)
             **spec_stats,
             "spec_accepted_per_step": drain(self._spec_accepted),
+            # streaming latency (batcher on_token path): TTFT per request
+            # and the gap observed before each surfaced token — the
+            # headline pair disaggregation moves (seldon_llm_ttft_seconds /
+            # seldon_llm_inter_token_seconds). Multi-token drains (fused /
+            # speculative steps) surface their block in one burst, so a
+            # block's trailing tokens record ~0 gaps by construction.
+            "ttft_s": drain(self._ttft_times),
+            "inter_token_s": drain(self._inter_token_times),
+            # disaggregated serving: per-handoff wall (prefill + D2D
+            # transfer + import) and the transfer-queue counters
+            **handoff_stats,
+            "handoff_times_s": drain(self._handoff_times),
         }

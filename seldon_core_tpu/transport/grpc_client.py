@@ -112,6 +112,29 @@ def call_sync(
     return pc.message_from_proto(out)
 
 
+def call_stream(
+    target: str,
+    method: str,
+    msg: Any,
+    service: str = "Model",
+    timeout_s: float = 120.0,
+    options: Optional[list] = None,
+    credentials: Optional[grpc.ChannelCredentials] = None,
+    metadata: Optional[list] = None,
+):
+    """Server-streaming call (e.g. Model/GenerateStream): yields
+    SeldonMessages as the server emits them — the gRPC mirror of the REST
+    SSE event stream."""
+    channel = get_channel(target, options, credentials)
+    rpc = channel.unary_stream(
+        f"/seldon.protos.{service}/{method}",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=pb.SeldonMessage.FromString,
+    )
+    for out in rpc(_to_proto(msg), timeout=timeout_s, metadata=metadata):
+        yield pc.message_from_proto(out)
+
+
 async def unary_call(
     target: str, method: str, msg: Any, service: Optional[str] = None, timeout_s: float = 5.0
 ) -> SeldonMessage:

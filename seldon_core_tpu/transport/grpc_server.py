@@ -124,9 +124,11 @@ def _component_methods(
     route = wrap(dispatch.route, pc.message_from_proto, "route")
     aggregate = wrap(dispatch.aggregate, pc.list_from_proto, "aggregate")
     feedback = wrap(fb, pc.feedback_from_proto, "send_feedback")
+    gen_stream = _make_generate_stream(component)
 
     return {
-        "Model": {"Predict": (predict, pb.SeldonMessage), "SendFeedback": (feedback, pb.Feedback)},
+        "Model": {"Predict": (predict, pb.SeldonMessage), "SendFeedback": (feedback, pb.Feedback),
+                  "GenerateStream": (gen_stream, pb.SeldonMessage, "unary_stream")},
         "Generic": {
             "TransformInput": (tin, pb.SeldonMessage),
             "TransformOutput": (tout, pb.SeldonMessage),
@@ -141,12 +143,128 @@ def _component_methods(
     }
 
 
+def _make_generate_stream(component: Any):
+    """Server-streaming LLM generation: the gRPC mirror of the REST SSE
+    contract (transport/rest.py ``/v1/generate`` with ``"stream": true``).
+
+    Request: SeldonMessage jsonData ``{"prompt": str|[ids],
+    "max_new_tokens": N, "seed": S}``. Responses: one jsonData
+    ``{"token": t, "text": piece}`` per generated token as the shared
+    batch decodes, then one jsonData done event with the SAME payload
+    shape as the SSE done event (``{"done": true, "tokens": [...],
+    "text": ...}`` + ``truncated_prompt`` when admission clipped).
+    Rejections mirror SSE too: per-request temperature and a seeded
+    prompt that exceeds the batcher slot cache abort INVALID_ARGUMENT
+    before the stream starts (the REST path 400s before the SSE
+    response starts) — parity-tested event-for-event in
+    tests/test_batcher_serving.py."""
+    import queue as _queue
+
+    from seldon_core_tpu.contracts.payload import SeldonMessage
+
+    def generate_stream(request, context):
+        if not hasattr(component, "generate"):
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "component has no generate() surface")
+            return
+        try:
+            msg = pc.message_from_proto(request)
+            body = msg.json_data if msg.which == "jsonData" else None
+            if not isinstance(body, dict) or body.get("prompt") is None:
+                raise SeldonError("jsonData needs 'prompt'", status_code=400)
+            if "temperature" in body:
+                raise SeldonError(
+                    "streaming with per-request temperature is not "
+                    "supported; set it on the server", status_code=400)
+            prompt = body["prompt"]
+            if isinstance(prompt, list):
+                prompt = [int(t) for t in prompt]
+            max_new = body.get("max_new_tokens")
+            if max_new is not None:
+                max_new = int(max_new)
+            from seldon_core_tpu.runtime.batcher import ensure_stream_service
+
+            svc = ensure_stream_service(component)
+            if "seed" in body and not svc.batcher.accommodates(
+                    prompt, max_new):
+                # same contract as the SSE path: no generate() fallback
+                # exists for a stream, so a seeded prompt the slot cache
+                # would clip cannot reproduce generate(seed=...)
+                raise SeldonError(
+                    "seeded streaming prompt exceeds the batcher slot "
+                    "cache and would not reproduce generate(seed=...); "
+                    "raise continuous_batching_max_len or drop streaming",
+                    status_code=400)
+        except Exception as e:  # noqa: BLE001 — pre-stream rejection
+            _abort(context, e)
+            return
+
+        decode = getattr(component, "_tokenizer", None)
+        text_mode = isinstance(body["prompt"], str)
+
+        def tok_event(tok):
+            piece = decode.decode([tok]) if (decode is not None
+                                             and text_mode) else None
+            return pc.message_to_proto(SeldonMessage.from_json_data(
+                {"token": tok, "text": piece}))
+
+        q: _queue.Queue = _queue.Queue()
+        _DONE = object()
+        info: dict = {}
+        cfut = svc.submit_stream(prompt, max_new, on_token=q.put,
+                                 info=info, seed=body.get("seed"))
+        # a submit that fails before any token never sends the None
+        # sentinel; the done-callback marker keeps the pump from hanging
+        cfut.add_done_callback(lambda f: q.put(_DONE))
+        try:
+            while True:
+                tok = q.get()
+                if tok is None:
+                    break
+                if tok is _DONE:
+                    # future resolved with no sentinel yet: drain the
+                    # queue fully (the SSE drain contract) — a token
+                    # enqueued around completion is never dropped
+                    while True:
+                        try:
+                            tok = q.get_nowait()
+                        except _queue.Empty:
+                            break
+                        if tok is None or tok is _DONE:
+                            break
+                        yield tok_event(tok)
+                    break
+                yield tok_event(tok)
+            toks = cfut.result(timeout=600.0)
+            text = decode.decode(toks) if (decode is not None
+                                           and text_mode) else None
+            done_evt = {"done": True, "tokens": toks, "text": text}
+            if info.get("truncated_prompt"):
+                done_evt["truncated_prompt"] = info["truncated_prompt"]
+            yield pc.message_to_proto(SeldonMessage.from_json_data(done_evt))
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+        finally:
+            # a client disconnect unwinds the generator with GeneratorExit
+            # (a BaseException the except above never sees): cancel here so
+            # an abandoned stream's submit stops, matching the SSE path's
+            # disconnect handling — on a completed future this is a no-op
+            cfut.cancel()
+
+    return generate_stream
+
+
 def _generic_handlers(method_table: Dict[str, Dict[str, tuple]]):
     handlers = []
     for service, methods in method_table.items():
         rpc_handlers = {}
-        for rpc_name, (fn, req_cls) in methods.items():
-            rpc_handlers[rpc_name] = grpc.unary_unary_rpc_method_handler(
+        for rpc_name, entry in methods.items():
+            fn, req_cls = entry[0], entry[1]
+            kind = entry[2] if len(entry) > 2 else "unary_unary"
+            make = (grpc.unary_stream_rpc_method_handler
+                    if kind == "unary_stream"
+                    else grpc.unary_unary_rpc_method_handler)
+            rpc_handlers[rpc_name] = make(
                 fn,
                 request_deserializer=req_cls.FromString,
                 response_serializer=lambda m: m.SerializeToString(),

@@ -332,21 +332,46 @@ def _add_generate_routes(app: web.Application, component: Any,
                 # any token (closed batcher, bad prompt) never sends the None
                 # sentinel, and waiting only on the queue would hang the
                 # connection forever.
+                async def write_tok(tok):
+                    piece = (decode.decode([tok]) if decode is not None
+                             and isinstance(prompt, str) else None)
+                    await resp.write(
+                        f"data: {json.dumps({'token': tok, 'text': piece})}\n\n".encode())
+
                 while True:
                     getter = asyncio.ensure_future(q.get())
                     done, _ = await asyncio.wait(
                         {getter, fut}, return_when=asyncio.FIRST_COMPLETED)
                     if getter in done:
                         tok = getter.result()
-                    else:
-                        getter.cancel()
-                        tok = q.get_nowait() if not q.empty() else None
-                    if tok is None:
-                        break
-                    piece = (decode.decode([tok]) if decode is not None
-                             and isinstance(prompt, str) else None)
-                    await resp.write(
-                        f"data: {json.dumps({'token': tok, 'text': piece})}\n\n".encode())
+                        if tok is None:
+                            break
+                        await write_tok(tok)
+                        continue
+                    # fut resolved first. The old code took AT MOST ONE
+                    # leftover token here, so tokens enqueued between the
+                    # future resolving and the next loop turn were silently
+                    # dropped from the stream (they only reappeared in the
+                    # done event's full token list) — and cancelling the
+                    # getter could swallow a token it had already claimed.
+                    # Recover the getter's claim, then drain the queue FULLY
+                    # (the None sentinel, if queued, still terminates).
+                    getter.cancel()
+                    try:
+                        tok = await getter
+                    except asyncio.CancelledError:
+                        tok = False  # cancelled clean: claimed nothing
+                    leftovers = [] if tok is False else [tok]
+                    while True:
+                        try:
+                            leftovers.append(q.get_nowait())
+                        except asyncio.QueueEmpty:
+                            break
+                    for tok in leftovers:
+                        if tok is None:
+                            break
+                        await write_tok(tok)
+                    break
                 toks = await fut
                 text = decode.decode(toks) if (decode is not None
                                                and isinstance(prompt, str)) else None

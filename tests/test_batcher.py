@@ -149,6 +149,7 @@ def test_batcher_rejects_after_close(server):
     asyncio.run(go())
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_continuous_batcher_int8_matches_generate():
     """int8 serving: the batcher's decode_step must dequant inside the jit
     like the server's own prefill/decode paths (round-5 fix: it applied

@@ -75,6 +75,7 @@ def test_pipelined_seeded_sampled_parity_with_generate(sampled_server):
     assert outs == expected
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_dispatch_ahead_depth_reached_before_first_sync():
     """Instrumentation guard against silent re-serialization: with depth 3
     and a long decode through the REAL service path, the in-flight
@@ -161,6 +162,7 @@ def test_fused_steps_parity(server):
     assert max(server._decode_host_lag) > 4
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_fused_steps_respect_eos_and_budget(server):
     """A fused block may overshoot a sequence's EOS device-side; the host
     must still cut at the first EOS, and max_new that is not a multiple of

@@ -100,6 +100,7 @@ def test_transformer_fused_norm_flag_matches_unfused():
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_llmserver_generate_with_fused_norm():
     """End-to-end: a fused-norm server produces the same greedy tokens as
     the unfused twin (flag changes cost, never tokens)."""

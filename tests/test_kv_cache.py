@@ -110,6 +110,7 @@ def test_int8_kv_greedy_matches_bf16_for_32_steps(bf16_server, int8_server):
 
 
 @pytest.mark.pallas
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_int8_kv_ragged_batch_matches_solo(int8_server):
     """PAD_POS masking stays exact under quantization: right-padded ragged
     rows reproduce their solo int8 decode."""
@@ -215,7 +216,11 @@ def test_prefix_cache_entry_survives_decode(bf16_server):
 
 
 # ------------------------------------------- prefix cache under KV dtypes
-@pytest.mark.parametrize("kvd", ["bf16", "int8"])
+@pytest.mark.parametrize("kvd", [
+    "bf16",
+    # tier-1 870s budget keeps bf16; int8 rides CI's unfiltered steps
+    pytest.param("int8", marks=pytest.mark.slow),
+])
 def test_prefix_store_lookup_roundtrip(kvd):
     s = make_server(prefix_cache_size=4, kv_cache_dtype=kvd)
     prompt = [5, 9, 17, 33, 2, 7, 40, 3]
@@ -231,7 +236,11 @@ def test_prefix_store_lookup_roundtrip(kvd):
     assert hit2 is not None and hit2[0] == len(prompt)
 
 
-@pytest.mark.parametrize("kvd", ["bf16", "int8"])
+@pytest.mark.parametrize("kvd", [
+    "bf16",
+    # tier-1 870s budget keeps bf16; int8 rides CI's unfiltered steps
+    pytest.param("int8", marks=pytest.mark.slow),
+])
 def test_prefix_eviction_accounting(kvd):
     """_prefix_bytes must track the sum of _entry_nbytes over live entries
     across stores and evictions, for either cache layout."""
@@ -270,6 +279,7 @@ def test_prefix_entry_not_served_across_kv_dtypes():
     assert q._prefix_lookup(prompt, max_len) is None
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_prefix_cache_int8_multi_turn_matches_plain():
     """Turn-2 extends turn-1 under int8 KV: the cache must hit and the
     output must match a cache-less int8 twin."""
@@ -287,6 +297,7 @@ def test_prefix_cache_int8_multi_turn_matches_plain():
 
 
 # ------------------------------------------------- sharded int8 caches
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_seq_sharded_int8_cache_layout(eight_devices):
     """int8 cache sharding: values split max_len over 'seq' and kv_heads
     over 'model' like bf16, with the f32 scale planes sharded alongside."""
@@ -312,6 +323,7 @@ def test_seq_sharded_int8_cache_layout(eight_devices):
     assert pos.sharding.shard_shape(pos.shape)[1] == 9
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_seq_sharded_int8_decode_matches_unsharded(eight_devices):
     """Greedy int8-KV decode over a seq/model-sharded mesh reproduces the
     unsharded int8 decode exactly."""

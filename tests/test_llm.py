@@ -182,6 +182,7 @@ def test_batch_larger_than_biggest_bucket(server):
         assert o == server.generate([p], max_new_tokens=3)["tokens"][0]
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_growing_max_new_tokens_recompiles_prefill(server):
     """Regression: prefill cache keyed without max_len reused undersized KV
     caches, silently truncating attention for longer generations."""
@@ -297,6 +298,7 @@ def test_prefix_cache_exact_hit_matches_uncached():
     assert cached.tags()["prefix_cache_hits"] == 1
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_prefix_cache_shared_system_prompt():
     """Two prompts sharing a system prefix: the second reuses the prefix KV
     and still decodes exactly like an uncached server."""
@@ -318,6 +320,7 @@ def test_prefix_cache_shared_system_prompt():
     assert cached._prefix_hits >= 2  # both continuations hit the prefix
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_prefix_cache_lru_eviction():
     _, cached = make_servers()
     cached.prefix_cache_size = 2
@@ -346,6 +349,7 @@ def test_prefix_cache_overlong_prompt():
     assert again == out
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_streamed_quantized_init(monkeypatch):
     """Big-config path: when the f32 init tree would exceed the streaming
     threshold and int8 serving is requested, params are initialized
@@ -402,6 +406,7 @@ def test_clear_prefix_cache_resets_byte_accounting():
     assert s._prefix_hits >= 1
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_multi_turn_prefix_cache_e2e():
     """Conversation-shaped e2e (VERDICT r4 #8): turn-2's prompt extends
     turn-1's, the prefix cache must HIT, and the cached generation must be

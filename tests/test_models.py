@@ -23,6 +23,7 @@ def test_mlp_forward():
     np.testing.assert_allclose(np.asarray(out).sum(axis=-1), 1.0, rtol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_resnet_forward_small():
     model = get_model("resnet18", num_classes=10, dtype="float32")
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))

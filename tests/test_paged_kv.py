@@ -97,7 +97,13 @@ def test_paged_greedy_parity_with_generate(fixt, request):
     assert stats["pages"]["kv_page_sheds"] == 0
 
 
-@pytest.mark.parametrize("fixt", ["sampled_server", "sampled_int8_server"])
+@pytest.mark.parametrize("fixt", [
+    # tier-1 870s budget keeps the int8 seeded pair (the densest coverage:
+    # same cache path + rng chain + dequant); bf16 seeded rides CI's
+    # unfiltered unit step, bf16 greedy parity stays tier-1 above
+    pytest.param("sampled_server", marks=pytest.mark.slow),
+    "sampled_int8_server",
+])
 def test_paged_seeded_sampled_parity_with_generate(fixt, request):
     """A seeded request through the PAGED batcher decodes the IDENTICAL
     token sequence generate() produces for the same seed — the per-slot

@@ -57,6 +57,7 @@ def test_pipeline_matches_sequential(eight_devices, n_stages, n_micro):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_pipeline_gradients_match_sequential(eight_devices):
     """The backward pass falls out of autodiff: grads through the pipeline
     schedule (including the transposed ppermute hops) equal the grads of the

@@ -127,6 +127,7 @@ def test_bfloat16_checkpoint_quantizes():
     assert err.max() < 0.05
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_llmserver_int8_generates():
     """Quantized LLM decode: int8 weights through prefill + scan decode;
     greedy output stays close to the fp32 server (same seed/params)."""
@@ -205,6 +206,7 @@ def test_shard_params_quantized_leaves(eight_devices):
                                    np.asarray(want["params"][k]), rtol=0, atol=0)
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_llmserver_int8_with_mesh_generates(eight_devices):
     """int8 LLM decode under a ('data','seq','model') mesh: loads, shards
     quantized leaves, and generates greedily with bounded drift vs the

@@ -517,6 +517,7 @@ def test_prefix_spec_controller_unlocked_observe_races():
     assert not ok(c)  # the lost observation, replayed
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_spec_controller_totals_exact_under_exploration():
     """The REAL SpecController (runtime/spec.py) under the threads that
     actually share it: two drain observations racing a dispatch cap()
@@ -646,3 +647,214 @@ def test_spec_variable_advance_gen_mask_protects_counters():
 
     assert find_race(scenario_of(True), ok, granularity="line",
                      max_schedules=60, stall_s=STALL) is None
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill handoff (PR 9): the TransferQueue's exactly-once
+# delivery/cancellation protocol (runtime/disagg.py)
+# ---------------------------------------------------------------------------
+
+
+class UnlockedTransferQueue:
+    """Reconstruction of the bug TransferQueue._lock exists to prevent: the
+    SAME state machine with every check-then-act transition unlocked. The
+    contenders are real: a prefill-worker thread publishes (put) while the
+    batcher loop consumes (pop) or sheds (cancel). Without the lock, pop
+    racing cancel hands the SAME handoff to both sides (the consumer's slot
+    owns the pages AND the canceller frees them — a double free), and two
+    workers' puts can lose a publication outright."""
+
+    def __init__(self):
+        self._state = {}
+        self._ready = []
+
+    def register(self, job_id):
+        self._state[job_id] = "staged"
+
+    def put(self, h):
+        st = self._state.get(h.job_id)        # check...
+        if st == "cancelled":
+            del self._state[h.job_id]
+            return False
+        self._state[h.job_id] = "ready"       # ...then act
+        self._ready = self._ready + [h]       # read-copy-write, not atomic
+        return True
+
+    def pop(self):
+        if not self._ready:                   # check...
+            return None
+        h = self._ready[0]                    # ...read...
+        self._ready = self._ready[1:]         # ...then act
+        self._state.pop(h.job_id, None)
+        return h
+
+    def cancel(self, job_id):
+        st = self._state.get(job_id)          # check...
+        if st == "ready":
+            found = None
+            for i, h in enumerate(self._ready):
+                if h.job_id == job_id:
+                    found = h
+                    self._ready = self._ready[:i] + self._ready[i + 1:]
+                    break
+            self._state.pop(job_id, None)     # ...then act
+            return found
+        if st == "staged":
+            self._state[job_id] = "cancelled"
+        return None
+
+
+def _handoff(job_id):
+    from seldon_core_tpu.runtime.disagg import Handoff
+
+    return Handoff(job_id, staged=f"kv{job_id}")
+
+
+def test_prefix_transfer_queue_pop_cancel_double_delivers():
+    """The double-free shape: one READY handoff, the batcher loop pops it
+    while a shed cancels it. Unlocked, some interleaving hands the handoff
+    to BOTH (slot owns the pages AND the canceller frees them) — found by
+    exploration and replayed; the real class never can (below)."""
+
+    def scenario(sched):
+        q = UnlockedTransferQueue()
+        q.register(1)
+        q.put(_handoff(1))
+        got = []
+        q._got = got
+        sched.spawn(lambda: got.append(q.pop()), name="loop")
+        sched.spawn(lambda: got.append(q.cancel(1)), name="shed")
+        return q
+
+    def ok(q):
+        return sum(1 for h in q._got if h is not None) == 1
+
+    bad = find_race(scenario, ok, granularity="line",
+                    max_schedules=150, stall_s=STALL)
+    assert bad is not None, "unlocked pop/cancel must double-deliver"
+    q, _, sched = run_schedule(scenario, schedule=bad.to_list(),
+                               granularity="line", stall_s=STALL)
+    # the corruption, replayed — either shape is the missing lock's fault:
+    # both sides got the SAME handoff (double free), or pop crashed on the
+    # list cancel emptied between its check and its read
+    winners = [h for h in q._got if h is not None]
+    if sched.errors():
+        assert isinstance(sched.errors()["loop"], IndexError)
+    else:
+        assert len(winners) == 2 and winners[0] is winners[1]
+
+
+def test_prefix_transfer_queue_concurrent_puts_lose_a_handoff():
+    """Two prefill workers publish concurrently: the unlocked read-copy-
+    write of the ready list loses one handoff under some interleaving — a
+    request whose prefill finished but whose future never resolves."""
+
+    def scenario(sched):
+        q = UnlockedTransferQueue()
+        q.register(1)
+        q.register(2)
+        sched.spawn(lambda: q.put(_handoff(1)), name="worker0")
+        sched.spawn(lambda: q.put(_handoff(2)), name="worker1")
+        return q
+
+    def ok(q):
+        return len(q._ready) == 2
+
+    # the read-copy-write lives on one line: line-level preemption cannot
+    # interleave inside it, opcode-level must (the _two_bumps idiom)
+    bad = find_race(scenario, ok, granularity="opcode",
+                    max_schedules=200, stall_s=STALL)
+    assert bad is not None, "unlocked put must lose a handoff"
+    q, _, _ = run_schedule(scenario, schedule=bad.to_list(),
+                           granularity="opcode", stall_s=STALL)
+    assert len(q._ready) == 1         # the lost handoff, replayed
+
+
+def test_transfer_queue_pop_cancel_exactly_once_under_exploration():
+    """The REAL TransferQueue (runtime/disagg.py) under the double-free
+    scenario: whatever the interleaving, exactly ONE of pop/cancel gets the
+    handoff, so the pages have exactly one owner-who-frees."""
+    from seldon_core_tpu.runtime.disagg import TransferQueue
+
+    def scenario(sched):
+        q = TransferQueue()
+        q.register(1)
+        q.put(_handoff(1))
+        got = []
+        q._got = got
+        sched.spawn(lambda: got.append(q.pop()), name="loop")
+        sched.spawn(lambda: got.append(q.cancel(1)), name="shed")
+        return q
+
+    def ok(q):
+        return (sum(1 for h in q._got if h is not None) == 1
+                and q.depth() == 0 and q.ready_depth() == 0)
+
+    assert find_race(scenario, ok, granularity="line",
+                     max_schedules=100, stall_s=STALL) is None
+
+
+def test_transfer_queue_put_cancel_shed_frees_exactly_once():
+    """A shed racing the worker's publish (the tests/test_disagg.py
+    protocol, explored): whichever order lands, the SHED path frees the
+    decode-side pages exactly once — either it takes the READY handoff out
+    of the queue, or the worker's later put is refused — and nothing stays
+    deliverable afterward."""
+    from seldon_core_tpu.runtime.disagg import TransferQueue
+
+    def scenario(sched):
+        q = TransferQueue()
+        q.register(1)
+        frees = []
+        q._frees = frees
+
+        def worker():
+            q.put(_handoff(1))
+
+        def shed():
+            # the batcher's _shed_remote_job contract: BOTH cancel outcomes
+            # free here (READY -> the returned handoff's pages; STAGED ->
+            # the pages now, the late put is refused)
+            q.cancel(1)
+            frees.append(1)
+
+        sched.spawn(worker, name="worker")
+        sched.spawn(shed, name="shed")
+        return q
+
+    def ok(q):
+        return (len(q._frees) == 1 and q.pop() is None
+                and q.depth() == 0 and q.ready_depth() == 0)
+
+    assert find_race(scenario, ok, granularity="line",
+                     max_schedules=100, stall_s=STALL) is None
+
+
+def test_transfer_queue_two_workers_publish_both_under_exploration():
+    """Two real workers publishing while the loop pops: both handoffs are
+    delivered exactly once each, in some order, and the counters are
+    exact — no lost publication, no double pop."""
+    from seldon_core_tpu.runtime.disagg import TransferQueue
+
+    def scenario(sched):
+        q = TransferQueue()
+        q.register(1)
+        q.register(2)
+        got = []
+        q._got = got
+        sched.spawn(lambda: q.put(_handoff(1)), name="worker0")
+        sched.spawn(lambda: q.put(_handoff(2)), name="worker1")
+        sched.spawn(lambda: got.extend([q.pop(), q.pop()]), name="loop")
+        return q
+
+    def ok(q):
+        delivered = [h.job_id for h in q._got if h is not None]
+        while True:  # the loop may have raced ahead of the puts
+            h = q.pop()
+            if h is None:
+                break
+            delivered.append(h.job_id)
+        return sorted(delivered) == [1, 2] and q.handoffs_total == 2
+
+    assert find_race(scenario, ok, granularity="line",
+                     max_schedules=100, stall_s=STALL) is None

@@ -5,12 +5,14 @@ import pytest
 import numpy as np
 
 
+@pytest.mark.slow  # tier-1 870s budget: CI pins this via its dedicated Multi-chip dryrun step
 def test_dryrun_multichip_8(eight_devices):
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_train_step_loss_decreases(eight_devices):
     import jax.numpy as jnp
     import optax
@@ -41,6 +43,7 @@ def test_train_step_loss_decreases(eight_devices):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_entry_compiles_cpu():
     import jax
 
@@ -62,6 +65,7 @@ def test_factor_axes():
         assert prod == n
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_train_state_checkpoint_roundtrip(eight_devices, tmp_path):
     """Save a sharded TrainState mid-training, restore into a fresh mesh
     placement, and continue: step/params/optimizer state all round-trip and

@@ -300,6 +300,29 @@ def _build_reset_pages():
                             _sds((PAGES_PER_SLOT,), "int32"))
 
 
+def _build_handoff_import():
+    """Disaggregated KV handoff, decode-side import (PR 9): the staged
+    pool a prefill worker moved device-to-device
+    (runtime/disagg.py ``PrefillWorker``) scattered whole-pages into the
+    slot pool through the admission's block row
+    (runtime/batcher.py ``_get_handoff_import``). The staged pool has the
+    worker's single-sequence shape: RESERVED_PAGES + pages-per-slot."""
+    import jax
+
+    from seldon_core_tpu.models.transformer import (RESERVED_PAGES,
+                                                    init_paged_kv_caches)
+
+    b = _paged_batcher()
+    fn = b._get_handoff_import()
+    s = _base_server()
+    staged = jax.eval_shape(
+        lambda: init_paged_kv_caches(
+            s._cfg, RESERVED_PAGES + PAGES_PER_SLOT, PAGE_SIZE,
+            s.kv_cache_dtype))
+    return fn, (_paged_cache_specs(), staged,
+                _sds((PAGES_PER_SLOT,), "int32"), _sds((), "int32"))
+
+
 def _build_verify_step_k4():
     """ngram spec step over the PAGED pool: the serving-default
     speculative hot function (self-draft, zero extra weights)."""
@@ -555,6 +578,23 @@ def all_contracts() -> List[Contract]:
             build=_build_reset_pages,
             donated=(0,),
             collectives={},
+        ),
+        Contract(
+            name="disagg.import_pages",
+            description="disaggregated prefill handoff, decode-side "
+                        "import (PR 9): the worker's staged pages scatter "
+                        "whole-pages into the slot pool through the "
+                        "admission's block row — ZERO host transfers (the "
+                        "KV moved device-to-device and must stay on "
+                        "device), slot pool donated (the import updates in "
+                        "place behind in-flight steps; the staged pool is "
+                        "a dropped transient, NOT donated), bytes within "
+                        "the committed budget",
+            build=_build_handoff_import,
+            donated=(0,),
+            forbid_dtypes=((_f32_pool_sig(), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
         ),
         Contract(
             name="batcher.insert",
