@@ -59,6 +59,13 @@ def main() -> None:
                          "drafter's home turf (the acceptance-rate "
                          "headline scenario); random = un-draftable "
                          "worst case")
+    ap.add_argument("--tracing", action="store_true",
+                    help="tracing-overhead guard arm: rerun the concurrent "
+                         "phase with the flight recorder enabled and "
+                         "assert throughput stays within "
+                         "TRACING_MAX_OVERHEAD_PCT (default 2%%) of "
+                         "disabled — the recorder's no-new-syncs claim, "
+                         "enforced (docs/observability.md)")
     args = ap.parse_args()
 
     import jax
@@ -151,22 +158,37 @@ def main() -> None:
     direct_s = time.perf_counter() - t0
     direct_tokens = sum(len(t) for t in out["tokens"])
 
-    # (b) concurrent: all clients at once through the shared batch
+    # (b) concurrent: all clients at once through the shared batch. ONE
+    # harness serves both the headline phase and the --tracing A/B arm —
+    # the overhead arm must difference the exact workload the headline
+    # measures, not a hand-kept copy that can drift.
     import threading
 
-    results = [0] * args.clients
-    def work(i):
-        results[i] = len(svc.submit_sync(prompts[i], max_new))
+    def concurrent_phase(s, reps=1):
+        """(total tokens, wall) for ``reps`` back-to-back waves of all
+        clients; gc runs OUTSIDE the window so one arm's garbage cannot
+        bill the next."""
+        import gc
 
-    t0 = time.perf_counter()
-    threads = [threading.Thread(target=work, args=(i,))
-               for i in range(args.clients)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    conc_s = time.perf_counter() - t0
-    conc_tokens = sum(results)
+        gc.collect()
+        total = 0
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            results = [0] * args.clients
+
+            def work(i):
+                results[i] = len(s.submit_sync(prompts[i], max_new))
+
+            threads = [threading.Thread(target=work, args=(i,))
+                       for i in range(args.clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            total += sum(results)
+        return total, time.perf_counter() - t0
+
+    conc_tokens, conc_s = concurrent_phase(svc)
     # pipeline instrumentation BEFORE close(): dispatch-ahead depth actually
     # reached, and the dispatch/sync split the tentpole is about
     from benchmarks._pipeline_stats import pipeline_report
@@ -174,7 +196,130 @@ def main() -> None:
     server._batcher_service = svc  # llm_stats reads the hwm through it
     pipeline = pipeline_report(server)
     spec = svc.batcher.spec_stats()
+    # close BEFORE the tracing arm builds its own service: two live
+    # services means two device-resident slot-cache/KV pools at once — a
+    # config whose single pool fits the chip would OOM inside the arm
     svc.close()
+
+    # --tracing: the overhead guard arm. Two halves:
+    #
+    # 1. REPORTED: an interleaved on/off throughput A/B on ONE
+    #    recorder-armed service (same event loop, same slot caches, same
+    #    compiled programs — the recorder toggled while idle). On real
+    #    chips this differenced pair is the headline; on the CPU rehearsal
+    #    it is BIMODAL (a measurement window that eats one batcher
+    #    0.5s idle-wait edge swings the arm +-50%), so it is reported,
+    #    never gated on.
+    # 2. ENFORCED: the deterministic decomposition of the same quantity —
+    #    the recorder's measured host work per token (per-event append +
+    #    per-request materialization, microbenched on the real class with
+    #    realistic segments) over the measured serving wall per token at
+    #    this batch. The numerator is syscall-free pure Python (stable to
+    #    a few percent); the denominator's noise only scales a number an
+    #    order of magnitude under the limit. The recorder claims
+    #    "appends, never synchronization" on the decode path; this is
+    #    where that claim is a number instead of a comment.
+    tracing_entry = None
+    if args.tracing:
+        from seldon_core_tpu.tracing import Tracer, set_tracer
+
+        def run_concurrent(s):
+            # reps=2 lengthens the timed window so thread-spawn and
+            # scheduler noise amortize; same harness as the headline phase
+            tokens, wall = concurrent_phase(s, reps=2)
+            return tokens / wall
+
+        set_tracer(Tracer(enabled=True))
+        svc_ab = BatcherService(server, max_slots=args.slots)
+        recorder = svc_ab.batcher._flight
+        assert recorder is not None, "recorder never armed"
+        svc_ab.submit_sync(prompts[0], max_new)  # warm (compiles shared)
+        # paired rounds, MEDIAN of per-round on/off ratios: adjacent
+        # off/on runs see the same machine state, so slow drift cancels,
+        # and the median shrugs off one scheduler hiccup that a best-of
+        # or a single pair would bake into the verdict
+        import statistics
+
+        rounds = 6
+        ratios = []
+        offs, ons = [], []
+        run_concurrent(svc_ab)  # shake out thread-pool cold start
+        for r in range(rounds):
+            # alternate which arm runs first: any within-pair drift
+            # (allocator growth, cache churn) biases both directions
+            # equally instead of always billing the second arm.
+            # toggled only while the batcher is idle (all submits joined)
+            order = ("off", "on") if r % 2 == 0 else ("on", "off")
+            vals = {}
+            for arm in order:
+                svc_ab.batcher._flight = recorder if arm == "on" else None
+                vals[arm] = run_concurrent(svc_ab)
+            svc_ab.batcher._flight = recorder
+            offs.append(vals["off"])
+            ons.append(vals["on"])
+            ratios.append(vals["on"] / vals["off"])
+        svc_ab.close()
+        set_tracer(Tracer(enabled=False))
+        ab_overhead_pct = (1.0 - statistics.median(ratios)) * 100.0
+
+        # the enforced half: microbench the recorder's two cost centers on
+        # the real class — the per-event append (what every drained step
+        # pays per active slot) and the per-request begin+materialize
+        # (ring -> timeline dict + span tree + tracer buffer append)
+        from seldon_core_tpu.runtime.flight import (
+            EV_FIRST_TOKEN, EV_STEP, FlightRecorder)
+        from seldon_core_tpu.tracing import Tracer as _Tracer
+
+        bench_fr = FlightRecorder(1)
+        bench_tr = _Tracer(enabled=True, max_buffer=1 << 30)
+        bench_fr.begin(0, None, time.perf_counter(), plen)
+        n_rec = 50_000
+        t0 = time.perf_counter()
+        for _ in range(n_rec):
+            bench_fr.record(0, EV_STEP, tokens=1, t_dispatch=0.0)
+        per_record_s = (time.perf_counter() - t0) / n_rec
+        n_req = 500
+        t0 = time.perf_counter()
+        for _ in range(n_req):
+            bench_fr.begin(0, None, time.perf_counter(), plen)
+            bench_fr.record(0, EV_FIRST_TOKEN, tokens=1)
+            for _ in range(max_new - 1):
+                bench_fr.record(0, EV_STEP, tokens=1, t_dispatch=0.0)
+            bench_fr.complete(0, "done", max_new, bench_tr)
+        per_request_s = (time.perf_counter() - t0) / n_req
+        bench_tr.drain()
+
+        # per_request_s covers one whole lifecycle (admission + an event
+        # per token + materialization), so the recorder's cost per SERVED
+        # token is simply per_request_s / tokens-per-request. Denominator:
+        # the DISABLED arm's per-token wall — dividing by the enabled arm
+        # would put the recorder's own cost in the denominator and make
+        # the limit self-lenient as that cost grows.
+        baseline_tok_per_s = statistics.median(offs)
+        recorder_s_per_token = per_request_s / max(max_new, 1)
+        serving_s_per_token = 1.0 / baseline_tok_per_s
+        overhead_pct = 100.0 * recorder_s_per_token / serving_s_per_token
+        limit = float(os.environ.get("TRACING_MAX_OVERHEAD_PCT", "2.0"))
+        # TRACING_ENFORCE_AB=1 (on-chip runs, where decode steps are long
+        # enough for the differenced pair to mean something) additionally
+        # gates the raw A/B delta, making the literal "throughput within
+        # limit of disabled" claim enforceable where it is measurable
+        enforce_ab = os.environ.get("TRACING_ENFORCE_AB", "") == "1"
+        if enforce_ab and ab_overhead_pct > limit:
+            overhead_pct = max(overhead_pct, ab_overhead_pct)
+        tracing_entry = {
+            "disabled_tok_per_s": round(baseline_tok_per_s, 1),
+            "enabled_tok_per_s": round(statistics.median(ons), 1),
+            "ab_overhead_pct": round(ab_overhead_pct, 2),
+            "ab_enforced": enforce_ab,
+            "recorder_us_per_event": round(per_record_s * 1e6, 3),
+            "recorder_us_per_request": round(per_request_s * 1e6, 1),
+            "overhead_pct": round(overhead_pct, 2),
+            "limit_pct": limit,
+        }
+        # the violation verdict is ENFORCED at the very end, AFTER the
+        # report JSON is written — a failing CI run must leave the
+        # numbers it failed on in the artifact, not just a stdout line
 
     platform = jax.devices()[0].platform
     # per-token KV bytes alongside tok/s so BENCH rounds can attribute
@@ -214,6 +359,10 @@ def main() -> None:
                         for k, v in spec.items()
                         if k != "spec_accept_rate_per_slot"},
     }
+    if tracing_entry is not None:
+        # the --tracing guard arm: enabled-vs-disabled flight-recorder
+        # throughput at this batch (CI enforces the limit via exit code)
+        entry["tracing"] = tracing_entry
     if platform == "tpu":
         entry["note"] = (
             "this harness reaches the chip over a ~75ms-RTT tunnel; the "
@@ -243,6 +392,11 @@ def main() -> None:
                "served_vs_direct": entry["served_vs_direct"],
                "inflight_hwm": pipeline["inflight_hwm"],
                "speedup": entry["speedup"], "platform": platform}
+    if tracing_entry is not None:
+        summary["tracing_overhead_pct"] = tracing_entry["overhead_pct"]
+        if tracing_entry["overhead_pct"] > tracing_entry["limit_pct"]:
+            print(json.dumps({"tracing_overhead_violation": tracing_entry}))
+            sys.exit(1)
     if spec.get("spec_mode", "off") != "off":
         summary["spec_mode"] = spec["spec_mode"]
         summary["spec_k"] = spec["spec_k"]

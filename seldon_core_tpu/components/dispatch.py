@@ -103,6 +103,14 @@ def _maybe_continuous_batch(component: Any, request: SeldonMessage):
     if svc is None:
         return None
 
+    # join the inbound trace: the transport's server span is active here
+    # (rest.py / grpc_server.py opened it from the traceparent), so the
+    # request's flight-recorder timeline roots under it instead of a
+    # fresh 'internal' trace the caller's id can never find; the ingress
+    # label inherits the span's name (predict / grpc:predict / ...)
+    from seldon_core_tpu.tracing import current_trace_context, get_tracer
+
+    trace = current_trace_context() if get_tracer().enabled else None
     info: dict = {}
 
     def to_msg(toks):
@@ -125,13 +133,13 @@ def _maybe_continuous_batch(component: Any, request: SeldonMessage):
     except RuntimeError:
         # sync transport (gRPC worker thread): block this thread only
         return to_msg(svc.submit_sync(body["prompt"], body.get("max_new_tokens"),
-                                      info=info))
+                                      info=info, trace=trace))
 
     async def run():
         # async transport (graph engine, REST app, ring handler): never block
         # the event loop while the shared batch decodes
         toks = await svc.submit(body["prompt"], body.get("max_new_tokens"),
-                                info=info)
+                                info=info, trace=trace)
         return to_msg(toks)
 
     return run()

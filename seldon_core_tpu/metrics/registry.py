@@ -319,6 +319,36 @@ class MetricsRegistry:
             base,
             registry=self.registry,
         )
+        # Tracing/flight-recorder observability (tracing/__init__.py +
+        # runtime/flight.py): spans lost to export failures (a batch is
+        # re-enqueued once; the second failure drops it — without this
+        # counter a dead collector silently eats every trace), per-flush
+        # OTLP export latency, and request traces retained by sampling
+        # mode ('head' = the inbound traceparent flag said keep, 'tail' =
+        # retained past an unsampled flag because TTFT / worst inter-token
+        # gap crossed the tail thresholds) — docs/observability.md
+        self._trace_spans_dropped = Counter(
+            "seldon_trace_spans_dropped_total",
+            "Trace spans dropped after a failed OTLP export's single "
+            "bounded re-enqueue",
+            base,
+            registry=self.registry,
+        )
+        self._trace_export = Histogram(
+            "seldon_trace_export_seconds",
+            "OTLP trace export latency per flush (success or failure)",
+            base,
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self._traces_retained = Counter(
+            "seldon_llm_traces_retained_total",
+            "Request traces materialized and exported, by sampling mode "
+            "(head = inbound sampled flag; tail = latency-threshold "
+            "retention of an unsampled request)",
+            base + ["mode"],
+            registry=self.registry,
+        )
         # breakers publish transitions through on_transition; remember which
         # are wired so scrape-time syncs are idempotent
         self._bound_breakers: set = set()
@@ -385,6 +415,32 @@ class MetricsRegistry:
             delta = admission.shed_total - shed._value.get()
             if delta > 0:
                 shed.inc(delta)
+
+    # ------------------------------------------------------------------
+    # Tracing observability (tracing/__init__.py Tracer.export_stats)
+    # ------------------------------------------------------------------
+    def sync_tracing(self, tracer: Any = None) -> None:
+        """Refresh the trace export/retention series from the (global)
+        tracer at /metrics scrape time — same drain/catch-up idiom as
+        sync_llm: latencies are drained (observed exactly once), counters
+        catch up from the tracer's own lifetime tallies."""
+        if tracer is None:
+            from seldon_core_tpu.tracing import get_tracer
+
+            tracer = get_tracer()
+        stats = tracer.export_stats()
+        hist = self._trace_export.labels(**self._base())
+        for seconds in stats.get("export_times_s", ()):
+            hist.observe(seconds)
+        dropped = self._trace_spans_dropped.labels(**self._base())
+        delta = stats.get("spans_dropped_total", 0) - dropped._value.get()
+        if delta > 0:
+            dropped.inc(delta)
+        for mode, total in (stats.get("retained_total") or {}).items():
+            retained = self._traces_retained.labels(**self._base(), mode=mode)
+            delta = total - retained._value.get()
+            if delta > 0:
+                retained.inc(delta)
 
     # ------------------------------------------------------------------
     # LLM decode observability (servers/llmserver.py)

@@ -19,6 +19,12 @@ REQUESTS_TOTAL = "seldon_api_executor_server_requests_total"
 REQUESTS_SECONDS = "seldon_api_executor_server_requests_seconds"
 FEEDBACK_TOTAL = "seldon_api_model_feedback_total"
 FEEDBACK_REWARD = "seldon_api_model_feedback_reward_total"
+# request-timeline layer (tracing + flight recorder, PR 10)
+TTFT_SECONDS = "seldon_llm_ttft_seconds"
+INTER_TOKEN_SECONDS = "seldon_llm_inter_token_seconds"
+TRACES_RETAINED = "seldon_llm_traces_retained_total"
+TRACE_SPANS_DROPPED = "seldon_trace_spans_dropped_total"
+TRACE_EXPORT_SECONDS = "seldon_trace_export_seconds"
 
 
 def prometheus_scrape_config() -> Dict[str, Any]:
@@ -168,6 +174,31 @@ def predictions_dashboard() -> Dict[str, Any]:
             {"expr": f"sum by (deployment_name) ({FEEDBACK_REWARD}{sel})",
              "legend": "{{deployment_name}}"},
         ], y=16, x=12),
+        # Request timeline (PR 10): the aggregate view of what the
+        # flight-recorder timelines show per request — TTFT vs worst-gap
+        # percentiles are the pair tail sampling keys on, and the
+        # retained/dropped counters say whether the trace pipeline itself
+        # is healthy (an exporter outage shows up HERE, not as silence)
+        _panel(7, "Serving timeline: TTFT / inter-token gap", [
+            {"expr": "histogram_quantile(0.5, sum by (le) "
+                     f"(rate({TTFT_SECONDS}_bucket{sel}[5m])))",
+             "legend": "TTFT p50"},
+            {"expr": "histogram_quantile(0.99, sum by (le) "
+                     f"(rate({TTFT_SECONDS}_bucket{sel}[5m])))",
+             "legend": "TTFT p99"},
+            {"expr": "histogram_quantile(0.99, sum by (le) "
+                     f"(rate({INTER_TOKEN_SECONDS}_bucket{sel}[5m])))",
+             "legend": "inter-token p99"},
+        ], y=24, unit="s"),
+        _panel(8, "Traces retained / spans dropped", [
+            {"expr": f"sum by (deployment_name, mode) (rate({TRACES_RETAINED}{sel}[5m]))",
+             "legend": "retained {{mode}}"},
+            {"expr": f"sum by (deployment_name) (rate({TRACE_SPANS_DROPPED}{sel}[5m]))",
+             "legend": "spans dropped"},
+            {"expr": "histogram_quantile(0.95, sum by (le) "
+                     f"(rate({TRACE_EXPORT_SECONDS}_bucket{sel}[5m])))",
+             "legend": "export p95 (s)"},
+        ], y=24, x=12),
     ]
     return {
         "title": "Seldon TPU — Predictions Analytics",

@@ -1,0 +1,99 @@
+"""/debug/timeline: recent per-request flight-recorder timelines plus the
+aggregated scaling-signal snapshot.
+
+The REST route (transport/rest.py, on both the component and engine apps)
+and its gRPC mirror (``Model/DebugTimeline``, transport/grpc_server.py)
+both render through :func:`timeline_report`, so the two transports can
+never drift. Schema: docs/observability.md "The /debug/timeline schema".
+
+The scaling block is the per-request-derived half of what ROADMAP item 4
+(elastic control plane) consumes: queue depth, slot occupancy, page
+pressure and shed totals say how loaded the replica IS; the flight
+recorder's TTFT / queue-wait / worst-gap quantiles say what that load is
+DOING to requests — the pair a scale controller steers by.
+
+Deliberately read-only and drain-free: unlike ``llm_stats`` (which drains
+its observation deques into the /metrics histograms), everything here is a
+snapshot — hitting /debug/timeline in a loop never starves the Prometheus
+scrape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def parse_n(raw: Any, default: int = 32) -> int:
+    """The shared ``?n=`` / jsonData ``n`` parse for every timeline
+    surface (REST component app, REST engine app, gRPC DebugTimeline):
+    one clamp, one default — three hand-kept copies would drift."""
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+def _batcher(component: Any):
+    svc = getattr(component, "_batcher_service", None)
+    return None if svc is None else svc.batcher
+
+
+def _recorder(component: Any, batcher: Any):
+    fn = getattr(component, "flight_recorder", None)
+    if fn is not None:
+        return fn()
+    return getattr(batcher, "_flight", None) if batcher is not None else None
+
+
+def timeline_report(component: Any, n: int = 32) -> dict:
+    """The /debug/timeline payload for one component. Components without a
+    batcher (or with tracing disabled) report ``tracing: false`` with an
+    empty timeline list — the endpoint never 500s on configuration."""
+    from seldon_core_tpu.tracing import get_tracer
+
+    batcher = _batcher(component)
+    recorder = _recorder(component, batcher)
+    out: dict = {
+        "tracing": recorder is not None,
+        "tracer_enabled": get_tracer().enabled,
+        "timelines": [],
+        "scaling": scaling_snapshot(component, batcher, recorder),
+    }
+    if recorder is not None:
+        out["timelines"] = recorder.timelines(n)
+    return out
+
+
+def scaling_snapshot(component: Any, batcher: Any = None,
+                     recorder: Optional[Any] = None) -> dict:
+    """The aggregated scaling-signal snapshot (load state + request-latency
+    quantiles). Safe on a bare component: absent layers report zeros."""
+    if batcher is None:
+        batcher = _batcher(component)
+    if recorder is None:
+        recorder = _recorder(component, batcher)
+    snap: dict = {
+        "active_slots": 0,
+        "total_slots": 0,
+        "queue_depth": 0,
+        "steps_in_flight": 0,
+        "page_pressure": 0.0,
+        "page_sheds_total": 0,
+        "handoff_queue_depth": 0,
+    }
+    if batcher is not None:
+        snap["active_slots"] = sum(1 for s in batcher._slots if s.active)
+        snap["total_slots"] = batcher.S
+        snap["queue_depth"] = len(batcher._pending)
+        snap["steps_in_flight"] = len(batcher._inflight)
+        if getattr(batcher, "paged", False):
+            pages = batcher.page_stats()
+            total = max(pages["kv_pages_total"], 1)
+            snap["page_pressure"] = pages["kv_pages_in_use"] / total
+            snap["page_sheds_total"] = pages["kv_page_sheds"]
+        if getattr(batcher, "_remote", None) is not None:
+            snap["handoff_queue_depth"] = (
+                batcher.handoff_stats()["handoff_queue_depth"])
+    if recorder is not None:
+        snap["requests"] = recorder.snapshot()
+    return snap

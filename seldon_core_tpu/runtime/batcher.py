@@ -87,6 +87,17 @@ point); sheds cancel a staged job through the TransferQueue's
 exactly-once protocol, so a handoff racing a shed can never double-free
 its decode-side pages (tests/test_schedules.py).
 
+Request-scoped tracing (PR 10): when the tracer is enabled (TRACING=1)
+every request records a flight-recorder timeline (runtime/flight.py) —
+queue wait, each prefill chunk, handoff stages, every drained decode step
+with token/accept counts, page-grow stalls, sheds, EOS — written
+single-writer from this loop's serialized offload context at points that
+already touch host state (NO new lock acquisition or device sync on the
+decode path), and materialized into one span tree per request at
+completion, rooted at the transport ingress that carried the request's
+``traceparent``. Disabled tracing leaves ``_flight`` None and every hook
+is a None check; the compiled step programs are identical either way.
+
 Paged KV cache (PR 7): with ``kv_cache_layout="paged"`` (the default) the
 dense ``[S, max_len, ...]`` slot pool is replaced by a GLOBAL pool of
 fixed-size KV pages plus a device-resident per-slot block table — the
@@ -118,6 +129,17 @@ from seldon_core_tpu.models.transformer import (
     RESERVED_PAGES,
     TRASH_PAGE,
     normalize_kv_cache_layout,
+)
+from seldon_core_tpu.runtime.flight import (
+    EV_FIRST_TOKEN,
+    EV_HANDOFF_IMPORT,
+    EV_HANDOFF_STAGED,
+    EV_PAGE_GROW,
+    EV_PREFILL,
+    EV_PREFILL_CHUNK,
+    EV_PREFIX_HIT,
+    EV_SHED,
+    EV_STEP,
 )
 from seldon_core_tpu.servers.llmserver import LLMServer, _bucket
 
@@ -392,23 +414,26 @@ class BatcherService:
     def submit_sync(self, prompt: Any, max_new_tokens: Optional[int] = None,
                     timeout_s: float = 600.0,
                     info: Optional[dict] = None,
-                    seed: Optional[int] = None) -> List[int]:
+                    seed: Optional[int] = None,
+                    trace: Optional[Any] = None) -> List[int]:
         with self._stats_lock:
             self.submitted += 1
         return asyncio.run_coroutine_threadsafe(
-            self.batcher.submit(prompt, max_new_tokens, info=info, seed=seed),
+            self.batcher.submit(prompt, max_new_tokens, info=info, seed=seed,
+                                trace=trace),
             self._loop
         ).result(timeout_s)
 
     async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None,
                      on_token: Optional[Any] = None,
                      info: Optional[dict] = None,
-                     seed: Optional[int] = None) -> List[int]:
+                     seed: Optional[int] = None,
+                     trace: Optional[Any] = None) -> List[int]:
         with self._stats_lock:
             self.submitted += 1
         cfut = asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
-                                info=info, seed=seed),
+                                info=info, seed=seed, trace=trace),
             self._loop)
         return await asyncio.wrap_future(cfut)
 
@@ -416,7 +441,8 @@ class BatcherService:
                       max_new_tokens: Optional[int] = None,
                       on_token: Optional[Any] = None,
                       info: Optional[dict] = None,
-                      seed: Optional[int] = None):
+                      seed: Optional[int] = None,
+                      trace: Optional[Any] = None):
         """Streaming submit from a SYNC thread (the gRPC server-streaming
         servicer): returns the concurrent.futures.Future of the final token
         list while ``on_token`` fires per token from the batcher's worker
@@ -425,7 +451,7 @@ class BatcherService:
             self.submitted += 1
         return asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
-                                info=info, seed=seed),
+                                info=info, seed=seed, trace=trace),
             self._loop)
 
     def close(self) -> None:
@@ -496,6 +522,7 @@ class ContinuousBatcher:
         disaggregation: Optional[str] = None,
         disagg_mesh: Optional[Any] = None,
         prefill_workers: Optional[int] = None,
+        tracing: Optional[bool] = None,
     ):
         server.load()
         self.server = server
@@ -620,6 +647,22 @@ class ContinuousBatcher:
         self._transfer = None
         self._remote_jobs: "dict[int, _RemoteJob]" = {}
         self._job_seq = 0
+        # Flight recorder (module docstring, runtime/flight.py): built only
+        # when the tracer is enabled (``tracing`` overrides for tests and
+        # the bench's overhead arm) — disabled tracing leaves every hook a
+        # None check and the compiled step path untouched.
+        from seldon_core_tpu.tracing import get_tracer, tail_thresholds
+
+        self._tracer = get_tracer()
+        enabled = self._tracer.enabled if tracing is None else bool(tracing)
+        if enabled:
+            from seldon_core_tpu.runtime.flight import FlightRecorder
+
+            tail_ttft_s, tail_gap_s = tail_thresholds()
+            self._flight: Optional[Any] = FlightRecorder(
+                self.S, tail_ttft_s=tail_ttft_s, tail_gap_s=tail_gap_s)
+        else:
+            self._flight = None
         self._build()
         if self.disaggregation != "off":
             self._build_remote(disagg_mesh, prefill_workers)
@@ -802,8 +845,15 @@ class ContinuousBatcher:
     async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None,
                      on_token: Optional[Any] = None,
                      info: Optional[dict] = None,
-                     seed: Optional[int] = None) -> List[int]:
+                     seed: Optional[int] = None,
+                     trace: Optional[Any] = None) -> List[int]:
         """prompt: str or token sequence. Resolves to generated token ids.
+
+        ``trace`` (optional ``tracing.TraceContext``) carries the request's
+        trace identity from the transport ingress (W3C ``traceparent``) into
+        the flight recorder, which roots this request's span tree at it. A
+        None trace with the recorder running still records a timeline under
+        a fresh trace id; with the recorder off it is ignored entirely.
 
         ``on_token(tok)`` (optional) fires for every generated token as it is
         decoded and ``on_token(None)`` once at completion — from a worker
@@ -841,7 +891,7 @@ class ContinuousBatcher:
         fut: asyncio.Future = self._loop.create_future()
         self._pending.append(
             (ids, int(max_new_tokens or self.server.max_new_tokens), fut,
-             on_token, info, seed, time.perf_counter()))
+             on_token, info, seed, time.perf_counter(), trace))
         self._ensure_running()
         self._wakeup.set()
         return await fut
@@ -989,6 +1039,8 @@ class ContinuousBatcher:
         if t_arrival is not None:
             self.server._ttft_times.append(now - t_arrival)
         slot.t_last = now
+        if self._flight is not None:
+            self._flight.record(i, EV_FIRST_TOKEN, tokens=1)
         slot.gen += 1          # invalidates in-flight tokens for the old occupant
         slot.disp_new = 1      # the prefill-sampled first token counts
         self._admit_seq += 1
@@ -1043,9 +1095,12 @@ class ContinuousBatcher:
                on_token: Optional[Any] = None,
                info: Optional[dict] = None,
                seed: Optional[int] = None,
-               t_arrival: Optional[float] = None) -> bool:
+               t_arrival: Optional[float] = None,
+               trace: Optional[Any] = None) -> bool:
         """Dense-layout admission: one-shot prefill into a 1-sequence cache,
         jitted insert into the free slot."""
+        import time
+
         import jax.numpy as jnp
 
         free = next((i for i, s in enumerate(self._slots) if not s.active), None)
@@ -1053,16 +1108,22 @@ class ContinuousBatcher:
             return False
         ids, plen = self._truncate_prompt(ids, max_new, info)
         L = len(ids)
+        if self._flight is not None:
+            self._flight.begin(free, trace, t_arrival, L)
         tokens = np.zeros((1, plen), np.int32)
         positions = np.full((1, plen), PAD_POS, np.int32)
         tokens[0, :L] = ids
         positions[0, :L] = np.arange(L)
 
+        t0 = time.perf_counter()
         prefill = self.server._get_prefill(1, plen, self.max_len)
         logits, cache1 = prefill(self.server._params, jnp.asarray(tokens), jnp.asarray(positions))
         self._caches = self._insert(self._caches, cache1, free)
         # graftlint: allow-host-sync-in-hot-path(admission-time sync, once per request not per token: the first sampled token must reach the host to seed slot bookkeeping before the slot joins the pipelined batch)
         first_logits = np.asarray(logits[0, L - 1]).astype(np.float32)
+        if self._flight is not None:
+            self._flight.record(free, EV_PREFILL, tokens=L,
+                                dur_s=time.perf_counter() - t0)
         first, key = self._sample_first(first_logits, seed)
         self._commit_slot(free, first, key, L, max_new, fut, on_token,
                           ids=ids, t_arrival=t_arrival)
@@ -1075,7 +1136,8 @@ class ContinuousBatcher:
                       on_token: Optional[Any] = None,
                       info: Optional[dict] = None,
                       seed: Optional[int] = None,
-                      t_arrival: Optional[float] = None) -> bool:
+                      t_arrival: Optional[float] = None,
+                      trace: Optional[Any] = None) -> bool:
         """Remote-prefill admission, decode-side half: reserve a slot,
         allocate the pages the import will land in (paged layout), and
         stage the job on the prefill slice. Returns True when the request
@@ -1122,7 +1184,13 @@ class ContinuousBatcher:
         job = _RemoteJob(self._job_seq, free, ids, plen, max_new, fut,
                          on_token, info, seed, pages, row, t_arrival)
         self._remote_jobs[job.job_id] = job
-        self._remote.submit(PrefillRequest(job.job_id, ids, plen, n0))
+        if self._flight is not None:
+            self._flight.begin(free, trace, t_arrival, L)
+            self._flight.record(free, EV_HANDOFF_STAGED, job_id=job.job_id,
+                                pages=n0)
+        self._remote.submit(PrefillRequest(job.job_id, ids, plen, n0,
+                                           record_events=self._flight
+                                           is not None))
         return True
 
     def _consume_handoffs(self):
@@ -1151,8 +1219,15 @@ class ContinuousBatcher:
                     except Exception:
                         pass
                 self._resolve(job.fut, exc=h.error)
+                if self._flight is not None:
+                    self._flight.complete(job.slot, "error", 0, self._tracer)
                 self._release_slot(job.slot)
                 continue
+            if self._flight is not None and h.events:
+                # worker-stamped stages (compute, D2D transfer) recorded on
+                # the prefill thread BEFORE the handoff was published —
+                # ownership moved through the TransferQueue's lock
+                self._flight.extend(job.slot, h.events)
             t0 = time.perf_counter()
             if self.paged:
                 import jax
@@ -1173,6 +1248,10 @@ class ContinuousBatcher:
                 self._caches = self._insert(self._caches, h.staged, job.slot)
             self.server._handoff_times.append(
                 h.prefill_s + (time.perf_counter() - t0))
+            if self._flight is not None:
+                self._flight.record(job.slot, EV_HANDOFF_IMPORT,
+                                    bytes=h.transfer_bytes,
+                                    dur_s=time.perf_counter() - t0)
             first, key = self._sample_first(h.first_logits, job.seed)
             self._commit_slot(job.slot, first, key, job.L, job.max_new,
                               job.fut, job.on_token, ids=job.ids,
@@ -1199,6 +1278,9 @@ class ContinuousBatcher:
             except Exception:
                 pass
         self._resolve(job.fut, exc=self._shed_error(why))
+        if self._flight is not None:
+            self._flight.record(job.slot, EV_SHED, why=why)
+            self._flight.complete(job.slot, "shed", 0, self._tracer)
         self._release_slot(job.slot)
 
     def _fail_remote_jobs(self, exc: BaseException):
@@ -1213,6 +1295,8 @@ class ContinuousBatcher:
                 except Exception:
                     pass
             self._resolve(job.fut, exc=exc)
+            if self._flight is not None:
+                self._flight.complete(job.slot, "error", 0, self._tracer)
             self._release_slot(job.slot)
 
     # ------------------------------------------------------------------
@@ -1259,7 +1343,8 @@ class ContinuousBatcher:
                      on_token: Optional[Any] = None,
                      info: Optional[dict] = None,
                      seed: Optional[int] = None,
-                     t_arrival: Optional[float] = None) -> bool:
+                     t_arrival: Optional[float] = None,
+                     trace: Optional[Any] = None) -> bool:
         """Paged admission, phase 1 (host-side, cheap): allocate prompt
         pages, reset their stale positions, import any prefix-cache hit,
         and stage a chunked-prefill job. Returns True when the request was
@@ -1295,6 +1380,8 @@ class ContinuousBatcher:
         slot.prefilling = True
         slot.future = fut
         slot.on_token = on_token
+        if self._flight is not None:
+            self._flight.begin(free, trace, t_arrival, L)
         # neutralize the pages' previous-owner positions BEFORE any write
         # lands through them (stale real positions would make this slot's
         # mask attend another sequence's leftover KV)
@@ -1320,6 +1407,8 @@ class ContinuousBatcher:
                 self._caches = imp(self._caches, dcaches, bt_row[0],
                                    jnp.asarray(n_im, jnp.int32))
                 p0 = k0
+                if self._flight is not None:
+                    self._flight.record(free, EV_PREFIX_HIT, tokens=k0)
                 if k0 == L:
                     first_logits = np.asarray(dlogits)[0].astype(np.float32)
         job = _PrefillJob(free, ids, p0, min(self.prefill_chunk, plen),
@@ -1343,6 +1432,8 @@ class ContinuousBatcher:
         job = self._prefill
         if job is None:
             return
+        import time
+
         C = job.chunk
         start = job.next
         part = job.ids[start:start + C]
@@ -1351,11 +1442,17 @@ class ContinuousBatcher:
         pos = np.full((1, C), PAD_POS, np.int32)
         toks[0, :n] = part
         pos[0, :n] = np.arange(start, start + n)
+        t0 = time.perf_counter()
         fn = self.server._get_prefill_chunk(C, self.n_pages)
         logits, self._caches = fn(self.server._params, self._caches,
                                   job.bt_row, jnp.asarray(toks),
                                   jnp.asarray(pos))
         job.next = start + n
+        if self._flight is not None:
+            # dispatch wall (enqueue-only); the last chunk's logits sync
+            # below lands in the gap before the first_token event
+            self._flight.record(job.slot, EV_PREFILL_CHUNK, start=start,
+                                tokens=n, dur_s=time.perf_counter() - t0)
         if job.next >= job.L:
             # graftlint: allow-host-sync-in-hot-path(admission-time sync, once per request not per chunk: the LAST chunk's logits seed the first sampled token; earlier chunks were enqueue-only)
             first_logits = np.asarray(logits[0, n - 1]).astype(np.float32)
@@ -1391,12 +1488,16 @@ class ContinuousBatcher:
         raises. Returns False when the slot was finished/released."""
         import jax.numpy as jnp
 
+        import time
+
         slot = self._slots[i]
         if not slot.active:
             # released slots own no pages (release freed them) — growing
             # one would allocate pool pages that nothing ever frees
             return False
         need = min(last_write_pos, self.max_len - 1) // self.page_size + 1
+        n0_pages = len(slot.pages)
+        t0_grow = time.perf_counter() if n0_pages < need else 0.0
         while len(slot.pages) < need:
             got = self._allocator.alloc(1)
             if got is None:
@@ -1433,6 +1534,13 @@ class ContinuousBatcher:
                 jnp.asarray(len(slot.pages), jnp.int32),
                 jnp.asarray(page, jnp.int32))
             slot.pages.append(page)
+        if self._flight is not None and len(slot.pages) > n0_pages:
+            # mid-decode page growth is the paged layout's stall risk: the
+            # allocation (and any shed it forced) ran between this slot's
+            # dispatches — the timeline shows it where the gap opened
+            self._flight.record(i, EV_PAGE_GROW,
+                                pages=len(slot.pages) - n0_pages,
+                                dur_s=time.perf_counter() - t0_grow)
         return True
 
     def _pick_page_victim(self):
@@ -1488,6 +1596,9 @@ class ContinuousBatcher:
                 pass
         if slot.future is not None:
             self._resolve(slot.future, exc=self._shed_error(why))
+        if self._flight is not None:
+            self._flight.record(i, EV_SHED, why=why)
+            self._flight.complete(i, "shed", slot.n_new, self._tracer)
         self._release_slot(i)
 
     def _shed_prefill_job(self, why: str):
@@ -1503,6 +1614,9 @@ class ContinuousBatcher:
             except Exception:
                 pass
         self._resolve(job.fut, exc=self._shed_error(why))
+        if self._flight is not None:
+            self._flight.record(job.slot, EV_SHED, why=why)
+            self._flight.complete(job.slot, "shed", 0, self._tracer)
         self._release_slot(job.slot)
 
     def _release_slot(self, i: int):
@@ -1587,6 +1701,11 @@ class ContinuousBatcher:
             slot.on_token(None)  # stream end sentinel
         if slot.future is not None:
             self._resolve(slot.future, result=toks)
+        if self._flight is not None:
+            # ``tokens`` = tokens CREDITED to the slot (n_new): the sum the
+            # per-step events must reproduce; an EOS trim shortens the
+            # client's list but never the credited count
+            self._flight.complete(i, "done", slot.n_new, self._tracer)
         self._release_slot(i)
 
     # ------------------------------------------------------------------
@@ -1776,18 +1895,21 @@ class ContinuousBatcher:
         if rec.acc is not None:
             self._credit_spec(rec, arr, accs)
             return
-        for j in range(rec.k):
-            for i, gen in rec.snapshot:
-                slot = self._slots[i]
-                if not slot.active or slot.gen != gen:
-                    # trailing run-ahead token for a finished (or already
-                    # replaced) occupant — masked, never surfaced
-                    continue
-                if slot.n_new >= slot.max_new:
-                    continue  # budget-exhausted slot riding along
+        for i, gen in rec.snapshot:
+            slot = self._slots[i]
+            if not slot.active or slot.gen != gen:
+                # trailing run-ahead token for a finished (or already
+                # replaced) occupant — masked, never surfaced
+                continue
+            if slot.n_new >= slot.max_new:
+                continue  # budget-exhausted slot riding along
+            credited = 0
+            finish = False
+            for j in range(rec.k):
                 tok = int(arr[i, j])
                 slot.tokens.append(tok)
                 slot.n_new += 1
+                credited += 1
                 # inter-token gap at this drain (a fused block surfaces
                 # its k tokens in one burst: trailing tokens record ~0)
                 if slot.t_last is not None:
@@ -1797,7 +1919,16 @@ class ContinuousBatcher:
                     slot.on_token(tok)
                 if (tok == self.eos_id or slot.n_new >= slot.max_new
                         or slot.host_pos() >= self.max_len):
-                    self._finish(i)
+                    finish = True
+                    break
+            if self._flight is not None and credited:
+                # one step event per slot per drain, BEFORE any finish
+                # materializes the segment: tokens credited this drain plus
+                # the step's device dwell (dispatch -> drain)
+                self._flight.record(i, EV_STEP, tokens=credited,
+                                    t_dispatch=rec.t_dispatch)
+            if finish:
+                self._finish(i)
 
     def _credit_spec(self, rec: _InFlight, arr: np.ndarray,
                      accs: np.ndarray):
@@ -1830,10 +1961,13 @@ class ContinuousBatcher:
             self.server._spec_accepted.append(adv)
             if slot.n_new >= slot.max_new:
                 continue  # budget-exhausted slot riding along
+            credited = 0
+            finish = False
             for j in range(adv):
                 tok = int(arr[i, j])
                 slot.tokens.append(tok)
                 slot.n_new += 1
+                credited += 1
                 # inter-token gap (an accepted block surfaces as a burst:
                 # its trailing tokens record ~0 gaps — the block's real
                 # cadence is the first token's gap)
@@ -1844,8 +1978,17 @@ class ContinuousBatcher:
                     slot.on_token(tok)
                 if (tok == self.eos_id or slot.n_new >= slot.max_new
                         or slot.host_pos() >= self.max_len):
-                    self._finish(i)
+                    finish = True
                     break
+            if self._flight is not None and credited:
+                # per-verify-step event: tokens surfaced, drafts offered,
+                # device-accepted count — the speculative half of the
+                # timeline's token accounting (recorded before any finish)
+                self._flight.record(i, EV_STEP, tokens=credited,
+                                    offered=offered, accepted=adv,
+                                    t_dispatch=rec.t_dispatch)
+            if finish:
+                self._finish(i)
 
     async def _run(self):
         try:
@@ -1859,22 +2002,22 @@ class ContinuousBatcher:
                 # order, and the gen counter masks their stale tokens.
                 while self._pending and self._prefill is None:
                     (ids, max_new, fut, on_token, info, seed,
-                     t_arr) = self._pending[0]
+                     t_arr, trace) = self._pending[0]
                     if self._remote is not None:
                         # disaggregated: stage the job on the prefill
                         # slice — host-side only, so MULTIPLE admissions
                         # can be in flight while decode keeps dispatching
                         admitted = await asyncio.to_thread(
                             self._admit_remote, ids, max_new, fut,
-                            on_token, info, seed, t_arr)
+                            on_token, info, seed, t_arr, trace)
                     elif self.paged:
                         admitted = await asyncio.to_thread(
                             self._admit_begin, ids, max_new, fut, on_token,
-                            info, seed, t_arr)
+                            info, seed, t_arr, trace)
                     else:
                         admitted = await asyncio.to_thread(
                             self._admit, ids, max_new, fut, on_token, info,
-                            seed, t_arr)
+                            seed, t_arr, trace)
                     if not admitted:
                         break  # no free slot/pages — decode frees them
                     self._pending.popleft()
@@ -1951,7 +2094,7 @@ class ContinuousBatcher:
                     slot.prefilling = False
                     slot.future = None
             while self._pending:
-                _, _, fut, on_token, _, _, _ = self._pending.popleft()
+                _, _, fut, on_token, _, _, _, _ = self._pending.popleft()
                 if on_token is not None:
                     try:
                         on_token(None)

@@ -73,16 +73,19 @@ def normalize_disaggregation(value) -> str:
 class PrefillRequest:
     """What a worker needs to prefill one admission: the (already
     truncated) prompt, its dense prefill bucket, and the page count the
-    decode side allocated for it (paged layout)."""
+    decode side allocated for it (paged layout). ``record_events`` asks
+    the worker to stamp flight-recorder stage events into the Handoff
+    (set when the decode side's recorder is running)."""
 
-    __slots__ = ("job_id", "ids", "plen", "n_pages")
+    __slots__ = ("job_id", "ids", "plen", "n_pages", "record_events")
 
     def __init__(self, job_id: int, ids: List[int], plen: int,
-                 n_pages: int = 0):
+                 n_pages: int = 0, record_events: bool = False):
         self.job_id = job_id
         self.ids = list(ids)
         self.plen = int(plen)
         self.n_pages = int(n_pages)
+        self.record_events = bool(record_events)
 
 
 class Handoff:
@@ -92,21 +95,30 @@ class Handoff:
     logits the first sampled token draws from (a small [vocab] host array
     — admission-time, once per request), and timing/bytes for the
     handoff metrics. ``error`` carries a worker-side failure instead of
-    a payload — the batcher resolves the request with it."""
+    a payload — the batcher resolves the request with it.
+
+    ``events`` carries the worker's flight-recorder stage stamps
+    ((perf_counter t, kind, fields) tuples — runtime/flight.py): written by
+    the WORKER thread before ``put`` publishes the handoff, read by the
+    batcher after ``pop`` — ownership transfers through the TransferQueue's
+    lock, so the single-writer-per-slot ring discipline holds without the
+    worker ever touching a slot ring."""
 
     __slots__ = ("job_id", "staged", "first_logits", "error", "prefill_s",
-                 "transfer_bytes")
+                 "transfer_bytes", "events")
 
     def __init__(self, job_id: int, staged: Any = None,
                  first_logits: Optional[np.ndarray] = None,
                  error: Optional[BaseException] = None,
-                 prefill_s: float = 0.0, transfer_bytes: int = 0):
+                 prefill_s: float = 0.0, transfer_bytes: int = 0,
+                 events: Optional[list] = None):
         self.job_id = job_id
         self.staged = staged
         self.first_logits = first_logits
         self.error = error
         self.prefill_s = prefill_s
         self.transfer_bytes = transfer_bytes
+        self.events = events or []
 
 
 class TransferQueue:
@@ -324,15 +336,28 @@ class PrefillWorker:
             staged, first_logits = self._prefill_dense(req)
         import jax
 
+        t1 = time.perf_counter()
         # THE handoff: a direct device-to-device copy onto the decode
         # slice — the KV never rounds through host memory (the jitted
         # decode-side import is hlolint-checked for zero infeed/outfeed)
         moved = jax.device_put(staged, self.decode_device)
         nbytes = sum(int(getattr(leaf, "nbytes", 0))
                      for leaf in jax.tree.leaves(moved))
+        t2 = time.perf_counter()
+        events = []
+        if req.record_events:
+            from seldon_core_tpu.runtime.flight import (
+                EV_HANDOFF_COMPUTE, EV_HANDOFF_TRANSFER)
+
+            events = [
+                (t1, EV_HANDOFF_COMPUTE,
+                 {"worker": self.name, "dur_s": t1 - t0}),
+                (t2, EV_HANDOFF_TRANSFER,
+                 {"bytes": nbytes, "dur_s": t2 - t1}),
+            ]
         return Handoff(req.job_id, staged=moved, first_logits=first_logits,
-                       prefill_s=time.perf_counter() - t0,
-                       transfer_bytes=nbytes)
+                       prefill_s=t2 - t0,
+                       transfer_bytes=nbytes, events=events)
 
     def _prefill_dense(self, req: PrefillRequest):
         """One-shot dense prefill at the request's bucket — the same

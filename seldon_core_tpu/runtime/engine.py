@@ -62,6 +62,7 @@ from seldon_core_tpu.runtime.resilience import (
     deadline_scope,
     failure_counts_for_breaker,
 )
+from seldon_core_tpu.tracing import get_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -712,26 +713,33 @@ class GraphEngine:
         breaker = state.breaker
         if breaker is not None and not breaker.allow():
             raise BreakerOpen(state.name, breaker.retry_in_s())
-        try:
-            if getattr(comp, "is_async", False):
-                result = await fn(comp, message)
-            else:
-                result = fn(comp, message)
-                if inspect.isawaitable(result):
-                    result = await result
-        except BaseException as e:
-            # Every outcome must resolve a half-open probe, or the breaker
-            # wedges with its one probe slot held forever. Counting failures
-            # re-open; cancellation judges nothing (release the slot); any
-            # other error means the node RESPONDED (4xx and kin) — healthy.
-            if breaker is not None:
-                if failure_counts_for_breaker(e):
-                    breaker.record_failure()
-                elif isinstance(e, asyncio.CancelledError):
-                    breaker.release_probe()
+        # per-node child span (the reference's engine->graph-node topology,
+        # PAPER.md §5): parented to the transport's server span via the
+        # tracer's contextvar, so a remote node's outbound traceparent
+        # (runtime/remote.py) carries this node's span id downstream. A
+        # disabled tracer yields None immediately — no per-node cost.
+        with get_tracer().span(f"node:{state.name}",
+                               method=getattr(fn, "__name__", "")):
+            try:
+                if getattr(comp, "is_async", False):
+                    result = await fn(comp, message)
                 else:
-                    breaker.record_success()
-            raise
+                    result = fn(comp, message)
+                    if inspect.isawaitable(result):
+                        result = await result
+            except BaseException as e:
+                # Every outcome must resolve a half-open probe, or the breaker
+                # wedges with its one probe slot held forever. Counting failures
+                # re-open; cancellation judges nothing (release the slot); any
+                # other error means the node RESPONDED (4xx and kin) — healthy.
+                if breaker is not None:
+                    if failure_counts_for_breaker(e):
+                        breaker.record_failure()
+                    elif isinstance(e, asyncio.CancelledError):
+                        breaker.release_probe()
+                    else:
+                        breaker.record_success()
+                raise
         if breaker is not None:
             breaker.record_success()
         return result

@@ -23,6 +23,7 @@ from seldon_core_tpu.contracts.payload import (
     SeldonMessageList,
 )
 from seldon_core_tpu.runtime.resilience import DeadlineExceeded, current_deadline, effective_timeout
+from seldon_core_tpu.tracing import current_traceparent
 
 logger = logging.getLogger(__name__)
 
@@ -154,6 +155,11 @@ class RemoteComponent(SeldonComponent):
 
         session = self._get_session()
         url = f"http://{self.endpoint.service_host}:{self.endpoint.service_port}{path}"
+        # the active span's W3C traceparent rides every hop (and every
+        # retry), so the remote node's own spans join this request's trace
+        # — the reference's engine->node span chain (PAPER.md §5)
+        tp = current_traceparent()
+        headers = {"traceparent": tp} if tp else None
         last_err: Optional[Exception] = None
         for attempt in range(self.retries):
             # each attempt (not just the first) is clamped to the remaining
@@ -164,6 +170,7 @@ class RemoteComponent(SeldonComponent):
                 async with session.post(
                     url,
                     json=payload,
+                    headers=headers,
                     timeout=aiohttp.ClientTimeout(
                         total=hop_timeout, connect=self.connect_timeout_s
                     ),
@@ -194,11 +201,13 @@ class RemoteComponent(SeldonComponent):
     async def _grpc_call(self, method: str, request_msg: Any) -> SeldonMessage:
         from seldon_core_tpu.transport.grpc_client import unary_call
 
+        tp = current_traceparent()
         return await unary_call(
             f"{self.endpoint.service_host}:{self.endpoint.service_port}",
             method,
             request_msg,
             timeout_s=effective_timeout(self.grpc_timeout_s),
+            metadata=[("traceparent", tp)] if tp else None,
         )
 
     async def _call(self, rest_path: str, grpc_method: str, msg: Any) -> SeldonMessage:

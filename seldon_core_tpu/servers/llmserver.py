@@ -1616,6 +1616,16 @@ class LLMServer(SeldonComponent):
                 out["prefix_cache_entries"] = len(self._prefix_cache)
         return out
 
+    def flight_recorder(self):
+        """The active batcher's flight recorder (runtime/flight.py), or
+        None when tracing is off / no batcher service exists — the
+        /debug/timeline + gRPC DebugTimeline data source
+        (observability/timeline.py)."""
+        svc = getattr(self, "_batcher_service", None)
+        if svc is None:
+            return None
+        return getattr(svc.batcher, "_flight", None)
+
     def llm_stats(self) -> Dict[str, Any]:
         """Decode-bandwidth observability snapshot, consumed by
         MetricsRegistry.sync_llm at /metrics scrape time: resident KV bytes

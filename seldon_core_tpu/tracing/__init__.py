@@ -6,6 +6,23 @@ microservice.py:116-151`). The opentelemetry SDK is not installed in this
 image, so this module ships a small native tracer with the same span topology
 (server span -> per-node child spans) and W3C traceparent propagation;
 ``export`` hooks let deployments forward finished spans to a collector.
+
+Request-scoped serving timelines (the batcher flight recorder,
+runtime/flight.py) materialize into the same span model: one tree per
+request, rooted at the transport ingress, fed through this tracer's buffer
+to the OTLP exporter. Sampling is two-stage: the W3C ``sampled`` flag from
+the inbound ``traceparent`` is the head decision, and the flight recorder
+may still RETAIN an unsampled request whose TTFT or worst inter-token gap
+exceeds the tail thresholds (``TRACING_TAIL_TTFT_MS`` /
+``TRACING_TAIL_GAP_MS``) — the slow outliers are exactly the traces an
+operator needs, and head sampling is blind to latency by construction.
+
+Clock discipline: span timestamps come from :func:`now` — a monotonic clock
+anchored to the wall clock once at module import (re-anchor explicitly via
+:func:`anchor`, only while quiescent). ``time.time()`` at both ends of a span made
+durations wrong, possibly negative, whenever NTP stepped the wall clock
+mid-span; the anchored clock keeps durations exact under any wall step and
+only ever pays the anchor's one-time offset in absolute timestamps.
 """
 
 from __future__ import annotations
@@ -19,13 +36,40 @@ import secrets
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("seldon.tracing")
 
 _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "seldon_current_span", default=None
 )
+
+# ---------------------------------------------------------------------------
+# Anchored monotonic clock
+# ---------------------------------------------------------------------------
+
+_mono = time.monotonic
+_wall_anchor = time.time()
+_mono_anchor = time.monotonic()
+
+
+def anchor(wall=time.time, mono=time.monotonic) -> None:
+    """(Re-)anchor the span clock: absolute time = wall-at-anchor plus
+    monotonic elapsed since the anchor. Called once at module import; only
+    re-anchor while no spans are open (a shift mid-span would move that
+    span's duration by the drift). Tests inject fake ``wall``/``mono``
+    sources (e.g. a FaultClock) to step the clocks deterministically."""
+    global _mono, _wall_anchor, _mono_anchor
+    _mono = mono
+    _wall_anchor = wall()
+    _mono_anchor = mono()
+
+
+def now() -> float:
+    """Wall-anchored monotonic seconds — the span timestamp source. A wall
+    clock step between a span's start and finish cannot change its
+    duration (the delta is purely monotonic)."""
+    return _wall_anchor + (_mono() - _mono_anchor)
 
 
 @dataclass
@@ -34,12 +78,19 @@ class Span:
     trace_id: str
     span_id: str
     parent_id: Optional[str]
-    start: float = field(default_factory=time.time)
+    start: float = field(default_factory=now)
     end: Optional[float] = None
     tags: Dict[str, Any] = field(default_factory=dict)
+    # W3C sampled flag: unsampled spans propagate context but are never
+    # buffered/exported (unless flight-recorder tail sampling retains the
+    # whole request tree — runtime/flight.py)
+    sampled: bool = True
+    # set by Tracer.flush when an export failure re-enqueued this span once
+    # already; a second failure drops it (bounded retry, never a loop)
+    requeued: bool = False
 
     def finish(self) -> None:
-        self.end = time.time()
+        self.end = now()
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -48,12 +99,76 @@ class Span:
             "spanId": self.span_id,
             "parentId": self.parent_id,
             "startUs": int(self.start * 1e6),
-            "durationUs": int(((self.end or time.time()) - self.start) * 1e6),
+            "durationUs": int(((self.end or now()) - self.start) * 1e6),
             "tags": self.tags,
         }
 
     def traceparent(self) -> str:
-        return f"00-{self.trace_id}-{self.span_id}-01"
+        return f"00-{self.trace_id}-{self.span_id}-{'01' if self.sampled else '00'}"
+
+
+@dataclass
+class TraceContext:
+    """A request's trace identity, carried from the transport ingress into
+    the batcher (and onward to prefill workers): what the flight recorder
+    needs to root one span tree per request. ``parent_span_id`` is the
+    remote caller's span when the request arrived with a ``traceparent``
+    header; ``sampled`` is the head-sampling decision that tail sampling
+    may override."""
+
+    trace_id: str
+    parent_span_id: Optional[str] = None
+    sampled: bool = True
+    ingress: str = ""
+
+    @classmethod
+    def from_traceparent(cls, header: Optional[str],
+                         ingress: str = "") -> "TraceContext":
+        """Context from an inbound W3C traceparent header; absent or
+        malformed headers start a fresh (sampled) trace."""
+        parsed = _parse_traceparent(header) if header else None
+        if parsed is None:
+            return cls(trace_id=secrets.token_hex(16), parent_span_id=None,
+                       sampled=True, ingress=ingress)
+        trace_id, span_id, sampled = parsed
+        return cls(trace_id=trace_id, parent_span_id=span_id,
+                   sampled=sampled, ingress=ingress)
+
+
+def current_traceparent() -> Optional[str]:
+    """The active span's outbound traceparent header value (None outside
+    any span) — what remote hops attach so downstream services join this
+    trace."""
+    s = _current_span.get()
+    return s.traceparent() if s is not None else None
+
+
+def ingress_trace(tracer: "Tracer", header: Optional[str],
+                  ingress: str) -> Optional[TraceContext]:
+    """The transports' ONE trace-setup path (REST /v1/generate and gRPC
+    GenerateStream both call this): None when tracing is off, else a
+    context from the inbound W3C header rooted at this ingress. Shared so
+    the enablement gate and header handling cannot drift between the
+    mirrored transports."""
+    if not tracer.enabled:
+        return None
+    return TraceContext.from_traceparent(header, ingress=ingress)
+
+
+def current_trace_context(ingress: str = "") -> Optional[TraceContext]:
+    """A TraceContext hanging under the ACTIVE span (None outside any
+    span): how interior layers (engine dispatch) hand the transport's
+    server span down into the batcher's flight recorder, so the request's
+    timeline joins the same trace as the node spans instead of starting a
+    fresh 'internal' one. ``ingress`` defaults to the active span's NAME —
+    the same request can arrive as 'predict', 'grpc:predict' or
+    'predictions', and a hardcoded label would point the operator at a
+    transport hop that does not exist."""
+    s = _current_span.get()
+    if s is None:
+        return None
+    return TraceContext(trace_id=s.trace_id, parent_span_id=s.span_id,
+                        sampled=s.sampled, ingress=ingress or s.name)
 
 
 class Tracer:
@@ -64,6 +179,22 @@ class Tracer:
         self._lock = threading.Lock()
         self._max_buffer = max_buffer
         self.exporter = None  # callable(List[Span]) or None
+        # export observability (metrics/registry.py sync_tracing drains
+        # these at /metrics scrape time): spans dropped by export failures
+        # (a batch is re-enqueued ONCE; the second failure drops it),
+        # per-flush export latency, and flight-recorder retention counts
+        # by sampling mode
+        self.spans_dropped_total = 0
+        from collections import deque
+
+        self._export_times: Any = deque(maxlen=512)
+        self.retained_total: Dict[str, int] = {"head": 0, "tail": 0}
+        # NOTE: deliberately no anchor() here. The span clock anchors once
+        # at module import; re-anchoring from an instance constructor would
+        # shift the duration of every span OPEN across the construction by
+        # the accumulated wall-vs-monotonic drift — reintroducing the
+        # clock-step bug the anchored clock exists to fix. Deployments that
+        # fix NTP late call tracing.anchor() explicitly, while quiescent.
 
     @contextlib.contextmanager
     def span(self, name: str, traceparent: Optional[str] = None, **tags: Any):
@@ -71,13 +202,20 @@ class Tracer:
             yield None
             return
         parent = _current_span.get()
+        sampled = True
         if traceparent and parent is None:
-            trace_id, parent_id = _parse_traceparent(traceparent)
+            parsed = _parse_traceparent(traceparent)
+            if parsed is None:
+                trace_id, parent_id = secrets.token_hex(16), None
+            else:
+                trace_id, parent_id, sampled = parsed
         elif parent is not None:
             trace_id, parent_id = parent.trace_id, parent.span_id
+            sampled = parent.sampled
         else:
             trace_id, parent_id = secrets.token_hex(16), None
-        s = Span(name=name, trace_id=trace_id, span_id=secrets.token_hex(8), parent_id=parent_id, tags=dict(tags))
+        s = Span(name=name, trace_id=trace_id, span_id=secrets.token_hex(8),
+                 parent_id=parent_id, tags=dict(tags), sampled=sampled)
         token = _current_span.set(s)
         try:
             yield s
@@ -86,13 +224,57 @@ class Tracer:
             _current_span.reset(token)
             self._record(s)
 
-    def _record(self, s: Span) -> None:
+    def _append(self, spans: List[Span]) -> None:
+        """Shared buffering that NEVER does network I/O on the recording
+        thread: with an exporter installed, the background PeriodicFlusher
+        owns the (possibly blocking) HTTP flush — an inline flush would
+        park the batcher loop / a transport handler behind a 5s connect
+        timeout (the exact stall class the flight recorder exists to
+        diagnose), so this path only buffers, dropping-and-counting
+        whatever a full buffer cannot hold. Without an exporter, flush is
+        local (TRACING_LOG or discard) and stays inline so log mode keeps
+        emitting."""
         flush_now = False
         with self._lock:
-            self._buffer.append(s)
+            if self.exporter is not None:
+                # NEVER flush from here when an exporter is installed —
+                # not even when this very append crosses the threshold:
+                # the recording thread is the batcher loop / a transport
+                # handler, and exporter() blocks on the network. Buffer
+                # what fits, drop-and-count the rest; the PeriodicFlusher
+                # drains on its own thread.
+                space = self._max_buffer - len(self._buffer)
+                kept = spans[:space] if space > 0 else []
+                self._buffer.extend(kept)
+                self.spans_dropped_total += len(spans) - len(kept)
+                return
+            self._buffer.extend(spans)
             flush_now = len(self._buffer) >= self._max_buffer
         if flush_now:  # outside the lock: flush() re-acquires it
             self.flush()
+
+    def _record(self, s: Span) -> None:
+        if not s.sampled:
+            # head-sampling: unsampled spans propagate context only (the
+            # flight recorder's tail path records its trees via
+            # record_spans with sampled flipped on retention)
+            return
+        self._append([s])
+
+    def record_spans(self, spans: List[Span]) -> None:
+        """Batch-append finished spans (the flight recorder's materialized
+        request trees). The caller already decided retention — sampled
+        flags are taken as-is."""
+        if not self.enabled or not spans:
+            return
+        self._append(list(spans))
+
+    def count_retained(self, mode: str) -> None:
+        """One request trace retained, by sampling mode ('head' = the W3C
+        flag said keep; 'tail' = retained past an unsampled flag because
+        TTFT / worst-gap crossed the tail thresholds)."""
+        with self._lock:
+            self.retained_total[mode] = self.retained_total.get(mode, 0) + 1
 
     def flush(self) -> None:
         with self._lock:
@@ -100,13 +282,46 @@ class Tracer:
         if not spans:
             return
         if self.exporter is not None:
+            t0 = time.perf_counter()
             try:
                 self.exporter(spans)
             except Exception:
                 logger.exception("trace export failed")
+                # bounded re-enqueue: a transient collector blip must not
+                # lose a whole flush window, but a dead collector must not
+                # grow the buffer forever — each span gets ONE retry, and
+                # re-enqueueing never pushes the buffer past max_buffer
+                retry = [s for s in spans if not s.requeued]
+                dropped = len(spans) - len(retry)
+                for s in retry:
+                    s.requeued = True
+                with self._lock:
+                    space = max(self._max_buffer - len(self._buffer), 0)
+                    kept, overflow = retry[:space], retry[space:]
+                    # front of the buffer: re-enqueued spans keep arrival
+                    # order ahead of spans recorded since
+                    self._buffer[:0] = kept
+                    self.spans_dropped_total += dropped + len(overflow)
+            finally:
+                with self._lock:
+                    self._export_times.append(time.perf_counter() - t0)
         elif os.environ.get("TRACING_LOG", ""):
             for s in spans:
                 logger.info("span %s", json.dumps(s.to_dict()))
+
+    def export_stats(self) -> Dict[str, Any]:
+        """Drain-and-snapshot for MetricsRegistry.sync_tracing: per-flush
+        export latencies observed since the last scrape (drained — each is
+        recorded into the histogram exactly once) plus the lifetime
+        dropped/retained tallies (counter catch-up idiom)."""
+        with self._lock:
+            times = list(self._export_times)
+            self._export_times.clear()
+            return {
+                "export_times_s": times,
+                "spans_dropped_total": self.spans_dropped_total,
+                "retained_total": dict(self.retained_total),
+            }
 
     def drain(self) -> List[Span]:
         with self._lock:
@@ -114,12 +329,58 @@ class Tracer:
         return spans
 
 
-def _parse_traceparent(header: str):
-    try:
-        parts = header.split("-")
-        return parts[1], parts[2]
-    except (IndexError, AttributeError):
-        return secrets.token_hex(16), None
+def _parse_traceparent(header: str) -> Optional[Tuple[str, str, bool]]:
+    """Strict W3C traceparent parse: ``version-traceid-spanid-flags`` with
+    2/32/16/2 lowercase-hex fields, version != 'ff', ids not all-zero.
+    Returns (trace_id, span_id, sampled) or None — malformed headers start
+    a FRESH trace at the caller instead of silently adopting garbage ids
+    (which would stitch unrelated requests into one trace)."""
+    if not isinstance(header, str):
+        return None
+    parts = header.strip().split("-")
+    # future versions may append fields (the first four keep their
+    # meaning); version 00 is REQUIRED to have exactly four
+    if len(parts) < 4:
+        return None
+    if parts[0] == "00" and len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16 \
+            or len(flags) != 2:
+        return None
+    # charset check, not int(x, 16): int() tolerates '+'/'-' signs and
+    # whitespace, which would adopt (and re-emit downstream) ids that
+    # spec-compliant parsers reject — severing the trace at the next hop
+    hexdigits = set("0123456789abcdefABCDEF")
+    if not all(set(field) <= hexdigits
+               for field in (version, trace_id, span_id, flags)):
+        return None
+    flag_bits = int(flags, 16)
+    if version.lower() == "ff":
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id.lower(), span_id.lower(), bool(flag_bits & 0x01)
+
+
+# Tail-sampling thresholds (seconds; None = that signal never tail-retains).
+# Read once per recorder from the environment: requests whose TTFT or worst
+# inter-token gap exceeds a threshold are retained even when head sampling
+# (the inbound traceparent's flag) said drop — docs/observability.md.
+def tail_thresholds(env: Optional[dict] = None) -> Tuple[Optional[float], Optional[float]]:
+    env = env if env is not None else os.environ
+
+    def ms(key: str) -> Optional[float]:
+        raw = env.get(key, "")
+        if not raw:
+            return None
+        try:
+            v = float(raw)
+        except (TypeError, ValueError):
+            return None
+        return v / 1000.0 if v >= 0 else None
+
+    return ms("TRACING_TAIL_TTFT_MS"), ms("TRACING_TAIL_GAP_MS")
 
 
 _tracer: Optional[Tracer] = None

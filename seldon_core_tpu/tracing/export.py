@@ -8,6 +8,13 @@ not in this image, so the OTLP/HTTP JSON envelope is built by hand — Jaeger
 Wiring: ``TRACING=1`` + ``OTEL_EXPORTER_OTLP_ENDPOINT=http://host:4318``
 (the standard OTel env var; ``TRACING_OTLP_ENDPOINT`` also accepted) installs
 the exporter on the global tracer with a background flush loop.
+
+Failure accounting lives in ``Tracer.flush`` (tracing/__init__.py): a
+failed export re-enqueues the batch exactly once (a transient collector
+blip loses nothing), a second failure drops it into
+``seldon_trace_spans_dropped_total``, and every flush's latency lands in
+``seldon_trace_export_seconds`` — an exporter outage is a counter on the
+dashboard, never silence (docs/observability.md).
 """
 
 from __future__ import annotations

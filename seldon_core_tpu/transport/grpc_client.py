@@ -33,6 +33,7 @@ _METHODS = {
     "Route": ("Router", pb.SeldonMessage),
     "Aggregate": ("Combiner", pb.SeldonMessageList),
     "SendFeedback": ("Model", pb.Feedback),
+    "DebugTimeline": ("Model", pb.SeldonMessage),
 }
 
 
@@ -136,7 +137,10 @@ def call_stream(
 
 
 async def unary_call(
-    target: str, method: str, msg: Any, service: Optional[str] = None, timeout_s: float = 5.0
+    target: str, method: str, msg: Any, service: Optional[str] = None, timeout_s: float = 5.0,
+    metadata: Optional[list] = None,
 ) -> SeldonMessage:
-    """Async wrapper used by RemoteComponent (runs the blocking stub in a thread)."""
-    return await asyncio.to_thread(call_sync, target, method, msg, service, timeout_s)
+    """Async wrapper used by RemoteComponent (runs the blocking stub in a
+    thread); ``metadata`` carries cross-cutting keys like ``traceparent``."""
+    return await asyncio.to_thread(
+        call_sync, target, method, msg, service, timeout_s, None, None, metadata)

@@ -66,6 +66,15 @@ def _abort(context: grpc.ServicerContext, e: Exception):
     context.abort(grpc.StatusCode.INTERNAL, str(e))
 
 
+def _traceparent_from_context(context: grpc.ServicerContext) -> Optional[str]:
+    """The inbound W3C ``traceparent`` metadata key (the gRPC spelling of
+    the REST header), or None."""
+    for key, value in context.invocation_metadata() or ():
+        if key == "traceparent":
+            return value
+    return None
+
+
 def _deadline_from_context(context: grpc.ServicerContext) -> Deadline | None:
     """The client's gRPC deadline (context.time_remaining()), else the
     ``seldon-deadline-ms`` metadata key for clients that cannot set one."""
@@ -101,7 +110,9 @@ def _component_methods(
                 return
             try:
                 with deadline_scope(_deadline_from_context(context)):
-                    with tracer.span("grpc:" + method_name):
+                    with tracer.span("grpc:" + method_name,
+                                     traceparent=_traceparent_from_context(
+                                         context)):
                         result = fn(component, req_from(request))
                         if asyncio.iscoroutine(result):
                             result = asyncio.run(result)
@@ -125,10 +136,12 @@ def _component_methods(
     aggregate = wrap(dispatch.aggregate, pc.list_from_proto, "aggregate")
     feedback = wrap(fb, pc.feedback_from_proto, "send_feedback")
     gen_stream = _make_generate_stream(component)
+    timeline = _make_debug_timeline(component)
 
     return {
         "Model": {"Predict": (predict, pb.SeldonMessage), "SendFeedback": (feedback, pb.Feedback),
-                  "GenerateStream": (gen_stream, pb.SeldonMessage, "unary_stream")},
+                  "GenerateStream": (gen_stream, pb.SeldonMessage, "unary_stream"),
+                  "DebugTimeline": (timeline, pb.SeldonMessage)},
         "Generic": {
             "TransformInput": (tin, pb.SeldonMessage),
             "TransformOutput": (tout, pb.SeldonMessage),
@@ -141,6 +154,29 @@ def _component_methods(
         "OutputTransformer": {"TransformOutput": (tout, pb.SeldonMessage)},
         "Combiner": {"Aggregate": (aggregate, pb.SeldonMessageList)},
     }
+
+
+def _make_debug_timeline(component: Any):
+    """``Model/DebugTimeline``: the gRPC mirror of REST /debug/timeline —
+    identical payload (observability/timeline.py timeline_report renders
+    both), carried as SeldonMessage jsonData. Request jsonData may set
+    ``{"n": K}`` to bound the timeline count."""
+    from seldon_core_tpu.contracts.payload import SeldonMessage
+
+    def debug_timeline(request, context):
+        from seldon_core_tpu.observability.timeline import (
+            parse_n, timeline_report)
+
+        try:
+            msg = pc.message_from_proto(request)
+            body = msg.json_data if msg.which == "jsonData" else None
+            n = parse_n(body.get("n") if isinstance(body, dict) else None)
+            return pc.message_to_proto(
+                SeldonMessage.from_json_data(timeline_report(component, n=n)))
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    return debug_timeline
 
 
 def _make_generate_stream(component: Any):
@@ -199,6 +235,25 @@ def _make_generate_stream(component: Any):
             _abort(context, e)
             return
 
+        # request-scoped tracing: the traceparent metadata key (the gRPC
+        # spelling of the REST header) roots this stream's span tree at
+        # this ingress; the trace id rides the done event like SSE's
+        from seldon_core_tpu.tracing import ingress_trace
+
+        trace = ingress_trace(get_tracer(),
+                              _traceparent_from_context(context),
+                              "grpc:GenerateStream")
+        if trace is not None:
+            # INITIAL metadata, like SSE's X-Trace-Id header: the id must
+            # reach the client BEFORE the first token — a hung stream is
+            # exactly when the operator needs the /debug/timeline key, and
+            # trailing metadata never arrives on a cancelled RPC
+            try:
+                context.send_initial_metadata(
+                    (("x-trace-id", trace.trace_id),))
+            except Exception:  # transport already started the stream
+                pass
+
         decode = getattr(component, "_tokenizer", None)
         text_mode = isinstance(body["prompt"], str)
 
@@ -212,7 +267,8 @@ def _make_generate_stream(component: Any):
         _DONE = object()
         info: dict = {}
         cfut = svc.submit_stream(prompt, max_new, on_token=q.put,
-                                 info=info, seed=body.get("seed"))
+                                 info=info, seed=body.get("seed"),
+                                 trace=trace)
         # a submit that fails before any token never sends the None
         # sentinel; the done-callback marker keeps the pump from hanging
         cfut.add_done_callback(lambda f: q.put(_DONE))
@@ -239,6 +295,8 @@ def _make_generate_stream(component: Any):
             text = decode.decode(toks) if (decode is not None
                                            and text_mode) else None
             done_evt = {"done": True, "tokens": toks, "text": text}
+            if trace is not None:
+                done_evt["trace_id"] = trace.trace_id
             if info.get("truncated_prompt"):
                 done_evt["truncated_prompt"] = info["truncated_prompt"]
             yield pc.message_to_proto(SeldonMessage.from_json_data(done_evt))
@@ -335,11 +393,15 @@ def make_engine_server(
     def run_coro(coro):
         return asyncio.run_coroutine_threadsafe(coro, own_loop).result()
 
-    async def _predict_with_deadline(msg, deadline):
+    async def _predict_with_deadline(msg, deadline, traceparent=None):
         # scope INSIDE the engine-loop task: the deadline contextvar must be
-        # visible to the engine and its remote hops on that loop
+        # visible to the engine (and its per-node spans / remote hops) on
+        # that loop — same reason the server span opens here, not on the
+        # gRPC worker thread
         with deadline_scope(deadline):
-            return await engine.predict(msg)
+            with get_tracer().span("grpc:predictions",
+                                   traceparent=traceparent):
+                return await engine.predict(msg)
 
     def predict(request, context):
         import time
@@ -353,7 +415,8 @@ def make_engine_server(
         try:
             deadline = _deadline_from_context(context)
             msg = pc.message_from_proto(request)
-            out = run_coro(_predict_with_deadline(msg, deadline))
+            out = run_coro(_predict_with_deadline(
+                msg, deadline, _traceparent_from_context(context)))
             metrics.observe_prediction(engine, out, time.perf_counter() - t0)
             return pc.message_to_proto(out)
         except Exception as e:  # noqa: BLE001

@@ -156,7 +156,11 @@ def make_component_app(
                 deadline = deadline_from_headers(request)
                 payload = parser(await parse_request(request))
                 with deadline_scope(deadline):
-                    with tracer.span(method_name):
+                    # inbound W3C traceparent roots this request's server
+                    # span in the caller's trace (sampled flag honored)
+                    with tracer.span(method_name,
+                                     traceparent=request.headers.get(
+                                         "traceparent")):
                         result = fn(component, payload)
                         if asyncio.iscoroutine(result):
                             result = await result
@@ -203,7 +207,18 @@ def make_component_app(
     async def prom(request):
         metrics.sync_resilience(admission=admission, transport="rest")
         metrics.sync_llm(component)
+        metrics.sync_tracing()
         return web.Response(body=metrics.expose(), content_type="text/plain")
+
+    async def debug_timeline(request):
+        """Recent per-request flight-recorder timelines + the scaling
+        snapshot (docs/observability.md); mirrored by the gRPC
+        ``Model/DebugTimeline`` rpc."""
+        from seldon_core_tpu.observability.timeline import (
+            parse_n, timeline_report)
+
+        return web.json_response(
+            timeline_report(component, n=parse_n(request.query.get("n"))))
 
     app.router.add_get("/health/status", health)
     app.router.add_get("/ready", health)
@@ -211,6 +226,7 @@ def make_component_app(
     app.router.add_get("/seldon.json", openapi)
     app.router.add_get("/metrics", prom)
     app.router.add_get("/prometheus", prom)
+    app.router.add_get("/debug/timeline", debug_timeline)
 
     if hasattr(component, "generate"):
         _add_generate_routes(app, component, metrics)
@@ -232,6 +248,15 @@ def _add_generate_routes(app: web.Application, component: Any,
 
     async def generate(request: web.Request) -> web.Response:
         t0 = time.perf_counter()
+        # request-scoped tracing (runtime/flight.py): the inbound W3C
+        # traceparent (or a fresh trace) rides into the batcher, which
+        # roots the request's span tree at this ingress; the trace id is
+        # stamped on the response/stream so the client can correlate
+        from seldon_core_tpu.tracing import ingress_trace
+
+        trace = ingress_trace(get_tracer(),
+                              request.headers.get("traceparent"),
+                              "rest:/v1/generate")
         try:
             body = await request.json()
             if not isinstance(body, dict):
@@ -268,19 +293,27 @@ def _add_generate_routes(app: web.Application, component: Any,
             if not stream:
                 if svc is not None:
                     toks = await svc.submit(prompt, max_new, info=info,
-                                            seed=body.get("seed"))
+                                            seed=body.get("seed"),
+                                            trace=trace)
                 else:
                     out = await asyncio.to_thread(
                         component.generate, [prompt], max_new_tokens=max_new,
                         temperature=body.get("temperature"), seed=body.get("seed"))
                     metrics.observe_api_call("generate", "200",
                                              time.perf_counter() - t0)
-                    return web.json_response(
-                        {"tokens": out["tokens"][0], "text": out["texts"][0]})
+                    resp_body = {"tokens": out["tokens"][0],
+                                 "text": out["texts"][0]}
+                    if trace is not None:
+                        # private-generate fallback: no flight recorder ran,
+                        # but the client still gets a stable correlation id
+                        resp_body["trace_id"] = trace.trace_id
+                    return web.json_response(resp_body)
                 text = decode.decode(toks) if (decode is not None
                                                and isinstance(prompt, str)) else None
                 metrics.observe_api_call("generate", "200", time.perf_counter() - t0)
                 out = {"tokens": toks, "text": text}
+                if trace is not None:
+                    out["trace_id"] = trace.trace_id
                 if info.get("truncated_prompt"):
                     out["truncated_prompt"] = info["truncated_prompt"]
                 return web.json_response(out)
@@ -308,9 +341,15 @@ def _add_generate_routes(app: web.Application, component: Any,
                 svc = s_svc
 
             # SSE streaming: one event per token as the shared batch decodes
-            resp = web.StreamResponse(headers={
+            headers = {
                 "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache"})
+                "Cache-Control": "no-cache"}
+            if trace is not None:
+                # the stream's trace id, visible BEFORE the first token:
+                # a client filing "this stream stalled" hands the operator
+                # the exact /debug/timeline + Jaeger key
+                headers["X-Trace-Id"] = trace.trace_id
+            resp = web.StreamResponse(headers=headers)
             await resp.prepare(request)
             loop = asyncio.get_running_loop()
             q: asyncio.Queue = asyncio.Queue()
@@ -326,7 +365,8 @@ def _add_generate_routes(app: web.Application, component: Any,
             fut = asyncio.ensure_future(svc.submit(prompt, max_new,
                                                    on_token=on_token,
                                                    info=info,
-                                                   seed=body.get("seed")))
+                                                   seed=body.get("seed"),
+                                                   trace=trace))
             try:
                 # Wait on the queue AND the future: a submit that fails before
                 # any token (closed batcher, bad prompt) never sends the None
@@ -376,6 +416,8 @@ def _add_generate_routes(app: web.Application, component: Any,
                 text = decode.decode(toks) if (decode is not None
                                                and isinstance(prompt, str)) else None
                 done_evt = {"done": True, "tokens": toks, "text": text}
+                if trace is not None:
+                    done_evt["trace_id"] = trace.trace_id
                 if info.get("truncated_prompt"):
                     done_evt["truncated_prompt"] = info["truncated_prompt"]
                 await resp.write(
@@ -494,7 +536,9 @@ def make_engine_app(
             body = await parse_request(request)
             msg = SeldonMessage.from_dict(body)
             with deadline_scope(deadline):
-                with tracer.span("predictions"):
+                with tracer.span("predictions",
+                                 traceparent=request.headers.get(
+                                     "traceparent")):
                     out = await engine.predict(msg)
                 d = current_deadline()
                 if d is not None:
@@ -551,7 +595,20 @@ def make_engine_app(
         metrics.sync_resilience(engine=engine, admission=admission, transport="rest")
         for comp in getattr(engine, "_components", {}).values():
             metrics.sync_llm(comp)
+        metrics.sync_tracing()
         return web.Response(body=metrics.expose(), content_type="text/plain")
+
+    async def debug_timeline(request):
+        """Per-component flight-recorder timelines + scaling snapshots for
+        the whole graph (docs/observability.md)."""
+        from seldon_core_tpu.observability.timeline import (
+            parse_n, timeline_report)
+
+        n = parse_n(request.query.get("n"))
+        return web.json_response({
+            name: timeline_report(comp, n=n)
+            for name, comp in getattr(engine, "_components", {}).items()
+        })
 
     async def openapi(request):
         from seldon_core_tpu.transport.openapi import engine_spec
@@ -616,6 +673,7 @@ def make_engine_app(
     app.router.add_get("/metrics", prom)
     app.router.add_get("/prometheus", prom)
     app.router.add_get("/seldon.json", openapi)
+    app.router.add_get("/debug/timeline", debug_timeline)
     app.router.add_post("/profile", profile)
     return app
 

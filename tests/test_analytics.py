@@ -195,6 +195,7 @@ def test_outlier_graph_transformer():
     assert "outlier_score_max" in keys
 
 
+@pytest.mark.slow  # tier-1 870s budget: seq2seq scoring also exercised by test_outliers; CI unit step unfiltered
 def test_seq2seq_outlier_detector():
     """Seq2Seq reconstruction detector: a sine-wave series trains well; a
     noise burst reconstructs poorly and scores higher. Pickle round-trips

@@ -112,6 +112,7 @@ def test_concurrent_rest_generate_token_parity(rest_client, batched_component,
     assert svc.submitted - before == len(PROMPTS)
 
 
+@pytest.mark.slow  # tier-1 870s budget: seeded-join parity rides test_batcher_pipeline (direct) + CI's unfiltered unit step
 def test_rest_seeded_request_joins_batch():
     """A seed-only request no longer bypasses the shared batcher: per-slot
     device rng reproduces generate(seed=...)'s chain exactly (PR 3), so the
@@ -322,6 +323,7 @@ def test_engine_graph_jsondata_prompt_joins_batch(batched_component, solo_tokens
     assert batched_component._batcher_service.submitted - before == 4
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_batched_predict_shape_matches_unbatched(batched_component, solo_tokens):
     """The SAME jsonData prompt request must produce an identically-shaped
     response whether or not the component batches (meta included)."""
@@ -337,6 +339,7 @@ def test_batched_predict_shape_matches_unbatched(batched_component, solo_tokens)
     assert got.to_dict() == want.to_dict()
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_stream_service_does_not_capture_predict(solo_tokens):
     """A component with batching OFF that served one stream must keep the
     private generate() path for /predict (the 1-slot streaming service must
@@ -404,7 +407,7 @@ def test_sse_drain_delivers_tokens_flooded_at_completion():
         submitted = 0
 
         async def submit(self, prompt, max_new_tokens=None, on_token=None,
-                         info=None, seed=None):
+                         info=None, seed=None, trace=None):
             # let the SSE loop park in its queue/future wait first
             await asyncio.sleep(0.05)
             loop = asyncio.get_running_loop()
@@ -474,6 +477,7 @@ def test_grpc_stream_mirrors_sse_event_sequence(batched_component,
     assert grpc_events[-1]["tokens"] == solo_tokens[0]
 
 
+@pytest.mark.slow  # tier-1 870s budget: the SSE twin of this rejection stays tier-1; CI unit step unfiltered
 def test_grpc_stream_seeded_oversized_prompt_rejected():
     """The SSE rejection contract on the gRPC transport: a seeded stream
     whose prompt exceeds the batcher slot cache aborts INVALID_ARGUMENT

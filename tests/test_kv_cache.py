@@ -99,6 +99,7 @@ def test_normalize_kv_cache_dtype():
 
 # ------------------------------------------------------- decode parity
 @pytest.mark.pallas
+@pytest.mark.slow  # tier-1 870s budget: int8 parity also rides the pinned pallas + paged CI steps
 def test_int8_kv_greedy_matches_bf16_for_32_steps(bf16_server, int8_server):
     """The acceptance bar: int8-KV greedy token output matches the bf16-KV
     decode for >=32 steps on a small config."""
@@ -258,6 +259,7 @@ def test_prefix_eviction_accounting(kvd):
     assert s._prefix_bytes == 0 and len(s._prefix_cache) == 0
 
 
+@pytest.mark.slow  # tier-1 870s budget: dtype guard also asserted at entry-store time; runs in CI's unfiltered unit step
 def test_prefix_entry_not_served_across_kv_dtypes():
     """A bf16-stored entry must read as a MISS for an int8-configured
     decode (and vice versa) — serving it would hand the decode a cache of

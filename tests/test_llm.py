@@ -287,6 +287,7 @@ def make_servers(**extra):
     return base, cached
 
 
+@pytest.mark.slow  # tier-1 870s budget: prefix parity also covered by test_kv_cache/test_paged_kv prefix suites; CI unit step unfiltered
 def test_prefix_cache_exact_hit_matches_uncached():
     base, cached = make_servers()
     prompt = [5, 9, 17, 33, 2, 7, 40, 3]
@@ -330,6 +331,7 @@ def test_prefix_cache_lru_eviction():
     assert len(cached._prefix_cache) <= 2
 
 
+@pytest.mark.slow  # tier-1 870s budget: prefix edge cases also covered in test_kv_cache/test_paged_kv; CI unit step unfiltered
 def test_prefix_cache_off_for_batches():
     _, cached = make_servers()
     # batch requests bypass the cache (nb > 1 would need per-row prefixes)
@@ -338,6 +340,7 @@ def test_prefix_cache_off_for_batches():
     assert len(cached._prefix_cache) == 0
 
 
+@pytest.mark.slow  # tier-1 870s budget: prefix edge cases also covered in test_kv_cache/test_paged_kv; CI unit step unfiltered
 def test_prefix_cache_overlong_prompt():
     """A prompt past the top length bucket must still get a cache that fits
     it (regression: cached-mode max_len could undercut plen)."""

@@ -101,6 +101,7 @@ def test_llama2_7b_has_untied_head():
     assert "lm_head" in variables["params"], "untied lm_head required for Llama-2 checkpoints"
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_fold_batchnorm_matches_unfused():
     """fused=True + fold_batchnorm(vars) must reproduce the unfused
     inference forward exactly (with non-trivial running stats, so the fold
@@ -135,6 +136,7 @@ def test_fold_batchnorm_matches_unfused():
         fused.apply(fold_batchnorm(v), x, train=True)
 
 
+@pytest.mark.slow  # tier-1 870s budget: redundant coverage — runs in CI's unfiltered unit step
 def test_space_to_depth_stem_matches_folded():
     """stem_s2d=True + fold_space_to_depth must reproduce the folded-BN
     forward up to float summation order, both when the module packs the

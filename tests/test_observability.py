@@ -276,8 +276,11 @@ def test_committed_analytics_artifacts_current(tmp_path):
 
 
 def test_tracer_buffer_overflow_no_deadlock():
-    """Filling the span buffer past max_buffer must flush, not deadlock on
-    the tracer's own lock."""
+    """Filling the span buffer past max_buffer must neither deadlock on
+    the tracer's own lock nor run the exporter inline from the recording
+    thread (PR 10 contract: with an exporter installed, the background
+    PeriodicFlusher owns the — possibly blocking — network flush, so a
+    recording thread only buffers, dropping-and-counting overflow)."""
     from seldon_core_tpu.tracing import Tracer
 
     exported = []
@@ -286,4 +289,7 @@ def test_tracer_buffer_overflow_no_deadlock():
     for i in range(7):
         with tracer.span(f"s{i}"):
             pass
-    assert len(exported) >= 3  # at least one overflow flush fired
+    assert exported == []                  # no inline export while recording
+    assert tracer.spans_dropped_total == 4  # overflow counted, not hidden
+    tracer.flush()                          # the PeriodicFlusher's role
+    assert [s.name for s in exported] == ["s0", "s1", "s2"]

@@ -339,6 +339,7 @@ def test_prefix_cache_hit_lands_in_paged_slot(kvd):
 
 
 # ------------------------------------------------------------- metrics
+@pytest.mark.slow  # tier-1 870s budget: runs in CI's unfiltered paged step
 def test_page_gauges_reach_llm_stats_and_metrics(server):
     """kv_pages_in_use/total + fragmentation flow llm_stats -> sync_llm ->
     /metrics series."""

@@ -780,6 +780,7 @@ def test_cli_real_tree_is_the_gate():
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+@pytest.mark.slow  # tier-1 870s budget: runs in CI's unfiltered racelint proofs step
 def test_parallel_matches_serial(tmp_path):
     root = write_tree(tmp_path / "pkg", {
         "runtime/adm.py": PR6_SHED,

@@ -547,6 +547,7 @@ def test_spec_controller_totals_exact_under_exploration():
                      max_schedules=200, stall_s=STALL) is None
 
 
+@pytest.mark.slow  # tier-1 870s budget: runs in CI's unfiltered racelint proofs step
 def test_spec_controller_concurrent_reset_never_corrupts():
     """Admission racing drain: reset(slot) (new occupant) interleaving
     with observe() for the OLD occupant's final verify step must leave
@@ -858,3 +859,85 @@ def test_transfer_queue_two_workers_publish_both_under_exploration():
 
     assert find_race(scenario, ok, granularity="line",
                      max_schedules=100, stall_s=STALL) is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (PR 10): completion-ring discipline
+# ---------------------------------------------------------------------------
+# The recorder's per-slot rings are single-writer by contract (only the
+# batcher loop's serialized offload context touches them); the ONLY
+# cross-thread surface is the completed-timeline ring + aggregates, written
+# once per request under the lock. These tests prove both halves: the
+# unlocked reconstruction of the completion aggregates loses updates under
+# a found schedule, and the real class keeps exact totals under the same
+# exploration budget — including with a concurrent /debug/timeline reader.
+
+
+class _UnlockedCompletionAggregates:
+    """Reconstruction of FlightRecorder.complete's aggregate updates
+    WITHOUT self._lock: completed_total and the retained tally are plain
+    read-modify-writes, so two concurrent completions can lose one."""
+
+    def __init__(self):
+        self.completed_total = 0
+        self.retained = {"head": 0}
+
+    def complete(self):
+        self.retained["head"] = self.retained["head"] + 1
+        self.completed_total = self.completed_total + 1
+
+
+def _unlocked_completions(sched):
+    r = _UnlockedCompletionAggregates()
+    sched.spawn(r.complete, name="a")
+    sched.spawn(r.complete, name="b")
+    return r
+
+
+def test_unlocked_completion_aggregates_lose_updates():
+    bad = find_race(
+        _unlocked_completions,
+        lambda r: r.completed_total == 2 and r.retained["head"] == 2,
+        granularity="opcode", max_schedules=150, stall_s=STALL)
+    assert bad is not None, "unlocked completion RMW must lose an update"
+    r, _, sched = run_schedule(_unlocked_completions, schedule=bad.to_list(),
+                               granularity="opcode", stall_s=STALL)
+    assert not sched.errors()
+    assert r.completed_total == 1 or r.retained["head"] == 1  # replayed
+
+
+def _real_recorder_scenario(sched):
+    from seldon_core_tpu.runtime.flight import EV_STEP, FlightRecorder
+
+    fr = FlightRecorder(2, keep=8)
+    for slot in (0, 1):
+        fr.begin(slot, None, None, prompt_tokens=3)
+        fr.record(slot, EV_STEP, tokens=1)
+    reads = []
+    fr._reads = reads
+    sched.spawn(lambda: fr.complete(0, "done", 1), name="complete0")
+    sched.spawn(lambda: fr.complete(1, "done", 1), name="complete1")
+    # a /debug/timeline + scaling scrape racing both completions
+    sched.spawn(lambda: reads.append((fr.timelines(), fr.snapshot())),
+                name="reader")
+    return fr
+
+
+def test_flight_recorder_completions_exact_under_exploration():
+    def ok(fr):
+        snap = fr.snapshot()
+        if not (snap["completed_total"] == 2
+                and snap["retained"]["head"] == 2
+                and len(fr.timelines()) == 2):
+            return False
+        # the racing reader saw some consistent prefix, never corruption:
+        # timelines() ran before snapshot() (two lock acquisitions — a
+        # completion may land between them), so its count can only trail
+        # the later total, and every timeline it saw is fully formed
+        timelines, mid = fr._reads[0]
+        return (len(timelines) <= mid["completed_total"] <= 2
+                and all(t["status"] == "done" and t["tokens"] == 1
+                        for t in timelines))
+
+    assert find_race(_real_recorder_scenario, ok, granularity="line",
+                     max_schedules=120, stall_s=STALL) is None
