@@ -175,6 +175,54 @@ class MetricsRegistry:
             base,
             registry=self.registry,
         )
+        # Radix prefix cache (runtime/radix.py, docs/performance.md "Radix
+        # prefix cache"): hit blocks are block-table entries a request did
+        # NOT re-prefill (the FLOPs-saved signal), shared pages the live
+        # trie<->slot sharing right now, cow copies the one-page price of
+        # partial-block continuations, evictions the LRU churn, and
+        # bytes-saved the KV bytes neither copied nor recomputed on hits
+        self._prefix_hit_blocks = Counter(
+            "seldon_llm_prefix_hit_blocks",
+            "Cached KV blocks served by radix prefix-cache hits (block-"
+            "table entries written instead of prefilled)",
+            base,
+            registry=self.registry,
+        )
+        self._prefix_shared_pages = Gauge(
+            "seldon_llm_prefix_shared_pages",
+            "Cached pages currently referenced by at least one live slot "
+            "(refcount > 1; sampled at scrape)",
+            base,
+            registry=self.registry,
+        )
+        self._prefix_cached_blocks = Gauge(
+            "seldon_llm_prefix_cached_blocks",
+            "Token blocks resident in the radix prefix trie (sampled at "
+            "scrape)",
+            base,
+            registry=self.registry,
+        )
+        self._prefix_cow_copies = Counter(
+            "seldon_llm_prefix_cow_copies_total",
+            "Copy-on-write page copies (a slot continuing part-way into a "
+            "shared block pays one page copy)",
+            base,
+            registry=self.registry,
+        )
+        self._prefix_evicted_blocks = Counter(
+            "seldon_llm_prefix_evicted_blocks_total",
+            "Trie blocks evicted (LRU-by-leaf on pool pressure, plus "
+            "in-place upgrades/clears)",
+            base,
+            registry=self.registry,
+        )
+        self._prefix_bytes_saved = Counter(
+            "seldon_llm_prefix_bytes_saved",
+            "KV bytes radix hits served by sharing pages in place "
+            "(bytes neither recomputed by prefill nor copied)",
+            base,
+            registry=self.registry,
+        )
         self._decode_step = Histogram(
             "seldon_llm_decode_step_seconds",
             "LLM decode step latency",
@@ -475,6 +523,26 @@ class MetricsRegistry:
         delta = stats.get("kv_page_sheds", 0) - page_sheds._value.get()
         if delta > 0:
             page_sheds.inc(delta)
+        # radix prefix cache: gauges refresh from the snapshot, counters
+        # catch up from the trie's lifetime tallies (hits/copies/evictions
+        # happen on the admission path, counted locally — same idiom as
+        # the page-shed counter above)
+        self._prefix_shared_pages.labels(**self._base()).set(
+            stats.get("prefix_shared_pages", 0)
+        )
+        self._prefix_cached_blocks.labels(**self._base()).set(
+            stats.get("prefix_cached_blocks", 0)
+        )
+        for counter, key in (
+            (self._prefix_hit_blocks, "prefix_hit_blocks"),
+            (self._prefix_cow_copies, "prefix_cow_copies"),
+            (self._prefix_evicted_blocks, "prefix_evicted_blocks"),
+            (self._prefix_bytes_saved, "prefix_bytes_saved"),
+        ):
+            bound = counter.labels(**self._base())
+            delta = stats.get(key, 0) - bound._value.get()
+            if delta > 0:
+                bound.inc(delta)
         hist = self._decode_step.labels(**self._base())
         for seconds in stats.get("decode_step_times_s", ()):
             hist.observe(seconds)

@@ -119,7 +119,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -147,6 +147,19 @@ logger = logging.getLogger(__name__)
 
 DEFAULT_PAGE_SIZE = 64
 DEFAULT_PREFILL_CHUNK = 256
+
+
+def pow2_bucket(n: int, cap: int) -> int:
+    """Power-of-two page-bucket size covering ``n`` pages, capped at
+    ``cap``. THE one definition shared by every staged-transfer producer
+    (disagg handoffs, prefix exports — runtime/disagg.py) and consumer:
+    bucket shapes name compiled import programs on both sides, so a
+    divergent rounding rule would silently desynchronize exporter and
+    importer shapes (and the hlolint contract dims built on them)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, cap)
 
 
 def _page_table_ops():
@@ -201,23 +214,66 @@ def _page_table_ops():
     def set_hist_row(hist, slot, row):
         return hist.at[slot].set(row)
 
+    # Copy-on-write page copy (radix prefix cache, runtime/radix.py): a
+    # slot that must WRITE into a shared cached page gets a fresh page
+    # plus this one donated copy — values move whole-page, but the
+    # position row is masked to the source's VALID length (offsets past
+    # n_valid go PAD_POS: the source page may carry a previous occupant's
+    # run-ahead positions past its credited history, and copying those
+    # live would make the new slot attend another sequence's tail). The
+    # compiled form is pinned by the batcher.cow_page_copy hlolint
+    # contract (pool donated in place, zero host transfers, budgeted
+    # bytes — ONE page, not a prefix gather).
+    @partial(jax.jit, donate_argnums=(0,))
+    def cow_page_copy(caches, src, dst, n_valid):
+        import jax.numpy as jnp
+
+        out = []
+        for layer in caches:
+            vals = tuple(pool.at[dst].set(pool[src]) for pool in layer[:-1])
+            pos = layer[-1]
+            row = jnp.where(jnp.arange(pos.shape[1]) < n_valid,
+                            pos[src], PAD_POS)
+            out.append(vals + (pos.at[dst].set(row),))
+        return out
+
+    # Page export (disaggregated prefix reuse): gather the decode pool's
+    # cached-prefix pages into a staged handoff-shaped bucket, so a
+    # prefill worker can import them into its staging pool and compute
+    # ONLY the uncached suffix. NOT donated — the pool (and the trie's
+    # pages in it) stays live; the bucket is a transient the worker
+    # device_puts away. Pinned by the disagg.prefix_export hlolint
+    # contract (zero host transfers, bucket-not-pool bytes).
+    @jax.jit
+    def export_pages(caches, idx):
+        return [tuple(pool[idx] for pool in layer) for layer in caches]
+
     ops = (set_block_row, set_block_entry, reset_pages, set_slot,
-           set_hist_row)
+           set_hist_row, cow_page_copy, export_pages)
     _page_table_ops.ops = ops
     return ops
 
 
 class PageAllocator:
-    """Host-side free-list allocator over the global KV page pool.
+    """Host-side refcounted free-list allocator over the global KV page
+    pool.
 
     Pages 0/1 are reserved (NULL/TRASH — models/transformer.py); the rest
-    are handed out lowest-id-first, all-or-nothing. Every state transition
-    happens under ``self._lock``: alloc/free run on the batcher loop's
-    worker threads while /metrics scrapes read the gauges from transport
-    threads, and an unlocked free-list pop is exactly the double-allocation
-    the deterministic-interleaving suite (tests/test_schedules.py) guards
-    against. Double frees raise — a page returned twice would be handed to
-    two slots and silently cross-corrupt their KV."""
+    are handed out lowest-id-first, all-or-nothing, at refcount 1. The
+    radix prefix cache (runtime/radix.py) shares live pages between the
+    trie and slot block tables by growing the refcount (``retain``);
+    ``free`` is one uniform decrement-and-free-on-zero for every release
+    path, so a page returns to the free list exactly when its LAST owner
+    lets go — and a page's refcount is the shared-ownership truth the
+    trie's eviction policy reads (refcount 1 = trie-only, evictable;
+    >1 = a live slot references it, never evictable). Every state
+    transition happens under ``self._lock``: alloc/retain/free run on the
+    batcher loop's worker threads while /metrics scrapes read the gauges
+    from transport threads, and an unlocked refcount read-modify-write is
+    exactly the double-free/double-allocation the deterministic-
+    interleaving suite (tests/test_schedules.py) guards against.
+    Over-freeing raises — a page freed past zero would be handed to two
+    slots and silently cross-corrupt their KV."""
 
     def __init__(self, total_pages: int, page_size: int):
         if total_pages <= RESERVED_PAGES:
@@ -229,7 +285,7 @@ class PageAllocator:
         # pop() from the tail hands out the lowest free id: deterministic
         # placement makes schedule replays and parity tests reproducible
         self._free = list(range(self.total - 1, RESERVED_PAGES - 1, -1))
-        self._free_set = set(self._free)
+        self._refs: Dict[int, int] = {}   # page -> refcount (allocated only)
         self.shed_total = 0
 
     @property
@@ -237,21 +293,57 @@ class PageAllocator:
         return self.total - RESERVED_PAGES
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n pages, all-or-nothing; None when the pool can't cover it."""
+        """n pages at refcount 1, all-or-nothing; None when the pool
+        can't cover it."""
         with self._lock:
             if n > len(self._free):
                 return None
             pages = [self._free.pop() for _ in range(n)]
-            self._free_set.difference_update(pages)
+            for p in pages:
+                self._refs[p] = 1
             return pages
 
-    def free(self, pages: Sequence[int]) -> None:
+    def retain(self, pages: Sequence[int]) -> None:
+        """Add one reference to each (already-allocated) page — the trie
+        pinning matched pages into a slot's block table. Retaining a free
+        page raises: it would resurrect a page another alloc may own."""
         with self._lock:
             for p in pages:
-                if p in self._free_set or not (RESERVED_PAGES <= p < self.total):
+                if p not in self._refs:
+                    raise ValueError(f"retain of unallocated page {p}")
+            for p in pages:
+                self._refs[p] += 1
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; a page rejoins the free list when
+        its count reaches zero. Raises on a page not currently allocated
+        (double free / reserved id)."""
+        with self._lock:
+            for p in pages:
+                rc = self._refs.get(p)
+                if rc is None or not (RESERVED_PAGES <= p < self.total):
                     raise ValueError(f"double/invalid free of page {p}")
-                self._free.append(p)
-                self._free_set.add(p)
+                if rc > 1:
+                    self._refs[p] = rc - 1
+                else:
+                    del self._refs[p]
+                    self._free.append(p)
+
+    def refs_of(self, page: int) -> int:
+        """Current refcount (0 = free) — the trie's evictability probe."""
+        with self._lock:
+            return self._refs.get(page, 0)
+
+    def refs_map(self, pages: Sequence[int]) -> List[int]:
+        """Refcounts for many pages under ONE lock acquisition (the
+        trie's stats walk reads every node's count per /metrics scrape —
+        per-page locking would be O(nodes) lock round-trips)."""
+        with self._lock:
+            return [self._refs.get(p, 0) for p in pages]
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
 
     def count_shed(self) -> None:
         """One page-exhaustion shed (counted under the same lock as the
@@ -295,16 +387,19 @@ class _RemoteJob:
     """One admission staged on the prefill slice (disaggregated serving):
     the slot reserved for it, the (already truncated) prompt, the
     decode-side pages allocated for the import (paged layout; ``row`` is
-    the NULL-padded host block row those pages form), and the request
-    bookkeeping the consume path needs to commit the slot. The handoff
-    itself travels through the TransferQueue; this record is the decode
-    side's half of the rendezvous, keyed by ``job_id``."""
+    the NULL-padded host block row those pages form, led by
+    ``prefix_pages`` shared radix-trie pages the worker never recomputes),
+    and the request bookkeeping the consume path needs to commit the
+    slot. The handoff itself travels through the TransferQueue; this
+    record is the decode side's half of the rendezvous, keyed by
+    ``job_id``."""
 
     __slots__ = ("job_id", "slot", "ids", "L", "plen", "max_new", "fut",
-                 "on_token", "info", "seed", "pages", "row", "t_arrival")
+                 "on_token", "info", "seed", "pages", "row", "prefix_pages",
+                 "t_arrival")
 
     def __init__(self, job_id, slot, ids, plen, max_new, fut, on_token,
-                 info, seed, pages, row, t_arrival):
+                 info, seed, pages, row, t_arrival, prefix_pages=0):
         self.job_id = job_id
         self.slot = slot
         self.ids = ids
@@ -315,15 +410,16 @@ class _RemoteJob:
         self.on_token = on_token
         self.info = info
         self.seed = seed
-        self.pages = pages           # decode-side pages (host mirror)
+        self.pages = pages           # decode-side SUFFIX pages (host mirror)
         self.row = row               # host [n_pages] int32 block row, or None
+        self.prefix_pages = int(prefix_pages)  # shared trie pages leading row
         self.t_arrival = t_arrival
 
 
 class _Slot:
     __slots__ = ("future", "tokens", "true_len", "n_new", "max_new", "active",
-                 "on_token", "gen", "disp_new", "pages", "prefilling",
-                 "admit_seq", "t_last")
+                 "on_token", "gen", "disp_new", "pages", "shared", "ids",
+                 "prefilling", "admit_seq", "t_last")
 
     def __init__(self):
         self.active = False
@@ -344,13 +440,24 @@ class _Slot:
         # clamp the fused-K block so it never overruns max_new/max_len
         self.gen = 0
         self.disp_new = 0
-        # paged layout: the slot's allocated page ids (host mirror of its
-        # block-table row), whether a chunked prefill is mid-flight for it,
-        # and its admission sequence number (shed-victim ordering: newest
-        # admitted sheds first on page exhaustion)
+        # paged layout: the slot's OWNED page ids (host mirror of the
+        # owned tail of its block-table row — freed, or adopted by the
+        # radix trie, at release), the SHARED trie pages its row leads
+        # with (radix prefix hit: pinned at admission, unpinned at
+        # release, never written by this slot), whether a chunked prefill
+        # is mid-flight for it, and its admission sequence number
+        # (shed-victim ordering: newest admitted sheds first on page
+        # exhaustion). ``ids`` keeps the truncated prompt so completion
+        # can insert prompt+generated blocks back into the trie.
         self.pages: List[int] = []
+        self.shared: List[int] = []
+        self.ids: Optional[List[int]] = None
         self.prefilling = False
         self.admit_seq = 0
+
+    def covered_pages(self) -> int:
+        """Block-table entries pointing at real pages (shared + owned)."""
+        return len(self.shared) + len(self.pages)
 
     # cache positions are derived, never mirrored: after the prompt's L
     # tokens the n-th generated token sits at position true_len + n - 1
@@ -628,6 +735,23 @@ class ContinuousBatcher:
                         getattr(server, "prefill_chunk", 0) or 0)
             self.prefill_chunk = chunk or DEFAULT_PREFILL_CHUNK
             self._allocator = PageAllocator(self.pool_pages, ps)
+        # Radix prefix cache (runtime/radix.py, docs/performance.md "Radix
+        # prefix cache"): paged layout + prefix caching opted in. The trie
+        # shares pool pages between cached prefixes and live slots
+        # (refcounted, copy-on-write), so a hit costs block-table entries
+        # instead of a page gather/copy; completed slots insert their
+        # blocks back in place. The dense layout keeps no batcher-side
+        # prefix reuse (its slots pre-reserve whole caches).
+        self._radix = None
+        if self.paged and int(getattr(server, "prefix_cache_size", 0)) > 0:
+            from seldon_core_tpu.models.transformer import \
+                kv_cache_bytes_per_token
+            from seldon_core_tpu.runtime.radix import RadixPrefixCache
+
+            self._radix = RadixPrefixCache(
+                self._allocator, self.page_size,
+                bytes_per_block=self.page_size * kv_cache_bytes_per_token(
+                    cfg, server.kv_cache_dtype))
         self._prefill: Optional[_PrefillJob] = None
         self._admit_seq = 0
         self._inflight: Any = deque()
@@ -731,7 +855,8 @@ class ContinuousBatcher:
         # its own closures — page growth runs these mid-decode, where a
         # compile is a serving stall
         (self._set_block_row, self._set_block_entry, self._reset_pages,
-         self._set_slot, self._set_hist_row) = _page_table_ops()
+         self._set_slot, self._set_hist_row, self._cow_page_copy,
+         self._export_pages) = _page_table_ops()
 
         if self.spec_mode != "off":
             # Per-slot prompt+generated token history, device-resident: the
@@ -1033,6 +1158,9 @@ class ContinuousBatcher:
         slot.n_new = 1
         slot.tokens = [first]
         slot.on_token = on_token
+        # the truncated prompt feeds the radix trie's completion-time
+        # insertion (prompt + generated blocks re-enter the cache)
+        slot.ids = list(ids) if ids is not None else None
         # first token surfaced NOW: time-to-first-token from submit(), and
         # the baseline the next token's gap measures from
         now = time.perf_counter()
@@ -1139,12 +1267,16 @@ class ContinuousBatcher:
                       t_arrival: Optional[float] = None,
                       trace: Optional[Any] = None) -> bool:
         """Remote-prefill admission, decode-side half: reserve a slot,
-        allocate the pages the import will land in (paged layout), and
-        stage the job on the prefill slice. Returns True when the request
-        was CONSUMED (staged or shed) — False leaves it pending. No
-        prefill compute happens here: that is the point. The prefix cache
-        is not consulted (the prefill compute being skipped lives on the
-        OTHER slice; cross-slice prefix reuse is a follow-up)."""
+        consult the radix trie so the prefill slice only computes the
+        UNCACHED suffix (matched whole blocks stay decode-side, shared
+        into the slot's row; their KV ships forward to the worker as one
+        exported page bucket so its suffix chunks can attend over them),
+        allocate the suffix pages the import will land in, and stage the
+        job. Returns True when the request was CONSUMED (staged or shed)
+        — False leaves it pending. No prefill compute happens here: that
+        is the point."""
+        import jax.numpy as jnp
+
         free = next((i for i, s in enumerate(self._slots)
                      if not s.active and not s.prefilling), None)
         if free is None:
@@ -1152,12 +1284,24 @@ class ContinuousBatcher:
         ids, plen = self._truncate_prompt(ids, max_new, info)
         L = len(ids)
         pages: List[int] = []
+        shared: List[int] = []
         row = None
+        prefix_staged = None
+        k0 = 0
         n0 = 0
         if self.paged:
             n0 = -(-L // self.page_size)
-            got = self._allocator.alloc(n0)
+            if self._radix is not None:
+                # whole blocks only: the worker's suffix prefill starts at
+                # a page boundary and partial-block COW stays a local
+                # (decode-side) move — capped at L-1 so the worker always
+                # computes the first-token logits
+                k0, shared, _ = self._radix.match_and_pin(
+                    ids, limit=L - 1, full_blocks_only=True)
+            got = self._alloc_pages(n0 - len(shared))
             if got is None:
+                if shared:
+                    self._allocator.free(shared)  # drop pins: retry later
                 # same liveness posture as _admit_begin: with no tenant in
                 # flight anywhere (active, local prefill, or staged remote
                 # — remote slots hold prefilling=True), nothing will ever
@@ -1172,25 +1316,46 @@ class ContinuousBatcher:
                 return False
             pages = got
             row = np.full((self.n_pages,), NULL_PAGE, np.int32)
-            row[:n0] = pages
+            row[:n0] = shared + pages
+            if shared:
+                # export the matched blocks as a power-of-two page bucket
+                # (handoff-shaped: RESERVED leading rows, then pages) the
+                # worker imports into its staging pool — D2D forward
+                # shipment of already-computed KV, never a recompute
+                b = pow2_bucket(len(shared), self.n_pages)
+                idx = np.full((RESERVED_PAGES + b,), TRASH_PAGE, np.int32)
+                idx[RESERVED_PAGES:RESERVED_PAGES + len(shared)] = shared
+                prefix_staged = self._export_pages(self._caches,
+                                                   jnp.asarray(idx))
         from seldon_core_tpu.runtime.disagg import PrefillRequest
 
         slot = self._slots[free]
         slot.pages = list(pages)
+        slot.shared = list(shared)
         slot.prefilling = True
         slot.future = fut
         slot.on_token = on_token
         self._job_seq += 1
         job = _RemoteJob(self._job_seq, free, ids, plen, max_new, fut,
-                         on_token, info, seed, pages, row, t_arrival)
+                         on_token, info, seed, pages, row, t_arrival,
+                         prefix_pages=len(shared))
         self._remote_jobs[job.job_id] = job
+        if k0:
+            # once per funded admission, like the local path
+            self._radix.record_hit(k0, len(shared), False)
         if self._flight is not None:
             self._flight.begin(free, trace, t_arrival, L)
+            if k0:
+                self._flight.record(free, EV_PREFIX_HIT, tokens=k0,
+                                    blocks=len(shared))
             self._flight.record(free, EV_HANDOFF_STAGED, job_id=job.job_id,
-                                pages=n0)
+                                pages=n0 - len(shared))
         self._remote.submit(PrefillRequest(job.job_id, ids, plen, n0,
                                            record_events=self._flight
-                                           is not None))
+                                           is not None,
+                                           prefix_len=k0,
+                                           prefix_pages=len(shared),
+                                           prefix_staged=prefix_staged))
         return True
 
     def _consume_handoffs(self):
@@ -1212,16 +1377,17 @@ class ContinuousBatcher:
                 continue  # defensive: cancel removes READY records itself
             if h.error is not None:
                 # worker-side failure: fail THIS request, release its slot
-                # and pages — the batch keeps serving
+                # and pages — the batch keeps serving (release before
+                # notifying, like _finish)
+                if self._flight is not None:
+                    self._flight.complete(job.slot, "error", 0, self._tracer)
+                self._release_slot(job.slot)
                 if job.on_token is not None:
                     try:
                         job.on_token(None)
                     except Exception:
                         pass
                 self._resolve(job.fut, exc=h.error)
-                if self._flight is not None:
-                    self._flight.complete(job.slot, "error", 0, self._tracer)
-                self._release_slot(job.slot)
                 continue
             if self._flight is not None and h.events:
                 # worker-stamped stages (compute, D2D transfer) recorded on
@@ -1233,14 +1399,21 @@ class ContinuousBatcher:
                 import jax
 
                 n0 = -(-job.L // self.page_size)
+                # only the SUFFIX pages travelled (the prefix blocks never
+                # left this device — they are shared trie pages already in
+                # the row's lead); import targets row entries past them
+                n_suffix = n0 - job.prefix_pages
                 # the worker shipped a power-of-two page bucket; the
                 # buffer's own shape names the compile to import it with
                 staged_pages = (jax.tree.leaves(h.staged)[0].shape[0]
                                 - RESERVED_PAGES)
                 imp = self._get_handoff_import(staged_pages)
+                row_suffix = np.full((self.n_pages,), NULL_PAGE, np.int32)
+                row_suffix[:n_suffix] = job.row[
+                    job.prefix_pages:job.prefix_pages + n_suffix]
                 self._caches = imp(self._caches, h.staged,
-                                   jnp.asarray(job.row),
-                                   jnp.asarray(n0, jnp.int32))
+                                   jnp.asarray(row_suffix),
+                                   jnp.asarray(n_suffix, jnp.int32))
                 self._block_tables = self._set_block_row(
                     self._block_tables, jnp.asarray(job.slot, jnp.int32),
                     jnp.asarray(job.row))
@@ -1272,16 +1445,16 @@ class ContinuousBatcher:
             self._allocator.count_shed()
         logger.warning("shedding staged remote prefill (slot %d): %s",
                        job.slot, why)
+        if self._flight is not None:
+            self._flight.record(job.slot, EV_SHED, why=why)
+            self._flight.complete(job.slot, "shed", 0, self._tracer)
+        self._release_slot(job.slot)  # before notifying, like _finish
         if job.on_token is not None:
             try:
                 job.on_token(None)
             except Exception:
                 pass
         self._resolve(job.fut, exc=self._shed_error(why))
-        if self._flight is not None:
-            self._flight.record(job.slot, EV_SHED, why=why)
-            self._flight.complete(job.slot, "shed", 0, self._tracer)
-        self._release_slot(job.slot)
 
     def _fail_remote_jobs(self, exc: BaseException):
         """Shutdown/crash path: no staged request may leave its future
@@ -1289,55 +1462,31 @@ class ContinuousBatcher:
         for job_id in list(self._remote_jobs):
             job = self._remote_jobs.pop(job_id)
             self._transfer.cancel(job_id)
+            if self._flight is not None:
+                self._flight.complete(job.slot, "error", 0, self._tracer)
+            self._release_slot(job.slot)  # before notifying, like _finish
             if job.on_token is not None:
                 try:
                     job.on_token(None)
                 except Exception:
                     pass
             self._resolve(job.fut, exc=exc)
-            if self._flight is not None:
-                self._flight.complete(job.slot, "error", 0, self._tracer)
-            self._release_slot(job.slot)
 
     # ------------------------------------------------------------------
     # Paged admission: page allocation + chunked prefill + activation
     # ------------------------------------------------------------------
-    def _get_prefix_import(self, entry_len: int):
-        """Jitted dense->paged prefix import: copy whole pages of a stored
-        dense prefix-cache entry ([1, entry_len, ...] per layer) into the
-        slot's allocated pool pages. ``n_valid`` (traced) masks the copy to
-        the pages the prefix actually covers — pages past it target
-        TRASH_PAGE, so one compile serves every prefix length under this
-        entry size. The dense entry is NOT donated: it stays live in the
-        prefix cache."""
-        cache = getattr(self, "_import_cache", None)
-        if cache is None:
-            cache = self._import_cache = {}
-        fn = cache.get(entry_len)
-        if fn is not None:
-            return fn
-        import jax
-        import jax.numpy as jnp
-
-        from functools import partial
-
-        n_pages, ps = self.n_pages, self.page_size
-
-        @partial(jax.jit, donate_argnums=(0,))
-        def import_prefix(pools, dense, block_row, n_valid):
-            idx = jnp.clip(
-                jnp.arange(n_pages * ps).reshape(n_pages, ps), 0, entry_len - 1)
-            target = jnp.where(
-                (jnp.arange(n_pages) < n_valid) & (block_row != NULL_PAGE),
-                block_row, TRASH_PAGE)
-            return [
-                tuple(pool.at[target].set(d[0][idx])
-                      for pool, d in zip(pool_layer, dense_layer))
-                for pool_layer, dense_layer in zip(pools, dense)
-            ]
-
-        cache[entry_len] = import_prefix
-        return import_prefix
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Pool allocation with radix-eviction relief: when the free list
+        can't cover ``n``, ask the trie to evict LRU leaf blocks nothing
+        references (refcount 1) before giving up — cached prefixes are a
+        cache, live slots are the tenants, and the cache yields first.
+        Shedding only starts where eviction ends."""
+        got = self._allocator.alloc(n)
+        if got is not None or self._radix is None:
+            return got
+        if not self._radix.evict(n):
+            return None
+        return self._allocator.alloc(n)
 
     def _admit_begin(self, ids: List[int], max_new: int, fut: asyncio.Future,
                      on_token: Optional[Any] = None,
@@ -1345,11 +1494,17 @@ class ContinuousBatcher:
                      seed: Optional[int] = None,
                      t_arrival: Optional[float] = None,
                      trace: Optional[Any] = None) -> bool:
-        """Paged admission, phase 1 (host-side, cheap): allocate prompt
-        pages, reset their stale positions, import any prefix-cache hit,
-        and stage a chunked-prefill job. Returns True when the request was
-        CONSUMED (job staged, activated outright on a full prefix hit, or
-        shed with 503) — False leaves it pending for a later loop turn."""
+        """Paged admission, phase 1 (host-side, cheap): match the prompt
+        against the radix prefix cache (shared full blocks enter the block
+        row as-is — zero copies; a partial-block continuation pays one
+        copy-on-write page copy), allocate fresh pages for the uncached
+        suffix, reset their stale positions, and stage a chunked-prefill
+        job covering ONLY the suffix. The match is capped at L-1 tokens so
+        the last prompt token always prefills — its logits seed the first
+        sampled token on generate()'s exact rng chain (the trie stores
+        pages, never logits). Returns True when the request was CONSUMED
+        (job staged or shed with 503) — False leaves it pending for a
+        later loop turn."""
         import jax.numpy as jnp
 
         free = next((i for i, s in enumerate(self._slots)
@@ -1359,14 +1514,35 @@ class ContinuousBatcher:
         ids, plen = self._truncate_prompt(ids, max_new, info)
         L = len(ids)
         n0 = -(-L // self.page_size)
-        pages = self._allocator.alloc(n0)
-        if pages is None:
+        k0, shared, cow = 0, [], None
+        if self._radix is not None:
+            k0, shared, cow = self._radix.match_and_pin(ids, limit=L - 1)
+        n_fresh = n0 - len(shared) - (1 if cow is not None else 0)
+        fresh = self._alloc_pages(n_fresh + (1 if cow is not None else 0))
+        if fresh is None and cow is not None:
+            # the cow pin itself can be what starves the pool: its source
+            # page is refcount-2 (unevictable) while pinned, so on a
+            # minimum-size pool the eviction pass may be exactly one page
+            # short. A partial-block match is an OPTIMIZATION, never a
+            # requirement — drop it (treat the tail as a miss, keeping
+            # the full-block shares) and retry before parking/shedding,
+            # preserving the invariant that an admission always fits an
+            # otherwise-idle pool.
+            self._allocator.free([cow[0]])
+            k0 -= cow[1]
+            cow = None
+            fresh = self._alloc_pages(n0 - len(shared))
+        if fresh is None:
+            if shared:
+                self._allocator.free(shared)  # drop the pins: retry later
             # Liveness rests entirely on this busy check: _truncate_prompt
             # caps prompts at max_len-1 so n0 <= n_pages, and the
             # constructor rejects pools with capacity < n_pages — an
-            # admission can always fit an empty pool. So if nothing is in
-            # flight to ever free a page, shed now instead of queueing
-            # forever; otherwise wait for in-flight completions.
+            # admission can always fit an empty pool (the radix trie
+            # yields its unreferenced blocks first, via _alloc_pages). So
+            # if nothing is in flight to ever free a page, shed now
+            # instead of queueing forever; otherwise wait for in-flight
+            # completions.
             if not any(s.active or s.prefilling for s in self._slots):
                 self._shed_request(
                     fut, on_token,
@@ -1375,50 +1551,55 @@ class ContinuousBatcher:
                     f"{self._allocator.stats()[1]} in use)")
                 return True
             return False  # wait: in-flight completions will free pages
+        cow_dst = fresh[0] if cow is not None else None
+        plain = fresh[1:] if cow is not None else fresh
         slot = self._slots[free]
-        slot.pages = pages
+        slot.shared = list(shared)
+        slot.pages = ([cow_dst] if cow_dst is not None else []) + plain
         slot.prefilling = True
         slot.future = fut
         slot.on_token = on_token
         if self._flight is not None:
             self._flight.begin(free, trace, t_arrival, L)
-        # neutralize the pages' previous-owner positions BEFORE any write
-        # lands through them (stale real positions would make this slot's
-        # mask attend another sequence's leftover KV)
-        ids_np = np.full((self.n_pages,), TRASH_PAGE, np.int32)
-        ids_np[:n0] = pages
-        self._caches = self._reset_pages(self._caches, jnp.asarray(ids_np))
+        # neutralize the FRESH pages' previous-owner positions BEFORE any
+        # write lands through them (stale real positions would make this
+        # slot's mask attend another sequence's leftover KV). Shared trie
+        # pages are live cached KV — never reset; the cow destination is
+        # fully overwritten (values + masked position row) by the copy.
+        if plain:
+            ids_np = np.full((self.n_pages,), TRASH_PAGE, np.int32)
+            ids_np[:len(plain)] = plain
+            self._caches = self._reset_pages(self._caches,
+                                             jnp.asarray(ids_np))
+        if cow is not None:
+            # one donated jitted page copy: the shared page's valid prefix
+            # moves into this slot's own page, stale positions masked —
+            # the ONLY copy a radix hit can cost (full blocks share). The
+            # source was PINNED by match_and_pin (the _alloc_pages above
+            # may have evicted its leaf; unpinned it could have been
+            # handed back as one of OUR fresh pages) — drop the pin now
+            # that the copy is in device program order before any reuse.
+            self._caches = self._cow_page_copy(
+                self._caches, jnp.asarray(cow[0], jnp.int32),
+                jnp.asarray(cow_dst, jnp.int32),
+                jnp.asarray(cow[1], jnp.int32))
+            self._allocator.free([cow[0]])
         row = np.full((self.n_pages,), NULL_PAGE, np.int32)
-        row[:n0] = pages
+        row[:n0] = slot.shared + slot.pages
         bt_row = jnp.asarray(row[None, :])
-        # prefix-cache hit lands directly in the paged slot: whole pages of
-        # the stored dense entry are copied into the allocated pages, and
-        # only the suffix chunk-prefills
-        p0 = 0
-        first_logits = None
-        if self.server.prefix_cache_size > 0:
-            # page_size filters out entries too short for the whole-page
-            # import inside the scan, so every returned hit serves
-            hit = self.server._prefix_lookup(ids, page_size=self.page_size)
-            if hit is not None:
-                k0, entry_len, dcaches, dlogits = hit
-                n_im = -(-k0 // self.page_size)
-                imp = self._get_prefix_import(entry_len)
-                self._caches = imp(self._caches, dcaches, bt_row[0],
-                                   jnp.asarray(n_im, jnp.int32))
-                p0 = k0
-                if self._flight is not None:
-                    self._flight.record(free, EV_PREFIX_HIT, tokens=k0)
-                if k0 == L:
-                    first_logits = np.asarray(dlogits)[0].astype(np.float32)
-        job = _PrefillJob(free, ids, p0, min(self.prefill_chunk, plen),
-                          max_new, fut, on_token, info, seed, bt_row, pages,
-                          t_arrival=t_arrival)
+        if k0:
+            # counted HERE, once per funded admission — a match that
+            # failed allocation above retries every loop turn and must
+            # not inflate the reuse counters per retry
+            self._radix.record_hit(k0, len(shared), cow is not None)
+            if self._flight is not None:
+                self._flight.record(free, EV_PREFIX_HIT, tokens=k0,
+                                    blocks=len(shared) +
+                                    (1 if cow is not None else 0))
+        job = _PrefillJob(free, ids, k0, min(self.prefill_chunk, plen),
+                          max_new, fut, on_token, info, seed, bt_row,
+                          slot.pages, t_arrival=t_arrival)
         self._prefill = job
-        if first_logits is not None:
-            # full-prompt prefix hit: nothing to prefill, activate now from
-            # the stored next-token logits
-            self._activate(job, first_logits)
         return True
 
     def _prefill_step(self):
@@ -1496,10 +1677,10 @@ class ContinuousBatcher:
             # one would allocate pool pages that nothing ever frees
             return False
         need = min(last_write_pos, self.max_len - 1) // self.page_size + 1
-        n0_pages = len(slot.pages)
+        n0_pages = slot.covered_pages()
         t0_grow = time.perf_counter() if n0_pages < need else 0.0
-        while len(slot.pages) < need:
-            got = self._allocator.alloc(1)
+        while slot.covered_pages() < need:
+            got = self._alloc_pages(1)
             if got is None:
                 victim = self._pick_page_victim()
                 if victim is None:
@@ -1531,15 +1712,15 @@ class ContinuousBatcher:
             self._caches = self._reset_pages(self._caches, jnp.asarray(ids_np))
             self._block_tables = self._set_block_entry(
                 self._block_tables, jnp.asarray(i, jnp.int32),
-                jnp.asarray(len(slot.pages), jnp.int32),
+                jnp.asarray(slot.covered_pages(), jnp.int32),
                 jnp.asarray(page, jnp.int32))
             slot.pages.append(page)
-        if self._flight is not None and len(slot.pages) > n0_pages:
+        if self._flight is not None and slot.covered_pages() > n0_pages:
             # mid-decode page growth is the paged layout's stall risk: the
             # allocation (and any shed it forced) ran between this slot's
             # dispatches — the timeline shows it where the gap opened
             self._flight.record(i, EV_PAGE_GROW,
-                                pages=len(slot.pages) - n0_pages,
+                                pages=slot.covered_pages() - n0_pages,
                                 dur_s=time.perf_counter() - t0_grow)
         return True
 
@@ -1589,17 +1770,20 @@ class ContinuousBatcher:
         self._allocator.count_shed()
         logger.warning(
             "shedding slot %d after %d generated tokens: %s", i, slot.n_new, why)
-        if slot.on_token is not None:
-            try:
-                slot.on_token(None)
-            except Exception:
-                pass
-        if slot.future is not None:
-            self._resolve(slot.future, exc=self._shed_error(why))
+        fut, on_token = slot.future, slot.on_token
         if self._flight is not None:
             self._flight.record(i, EV_SHED, why=why)
             self._flight.complete(i, "shed", slot.n_new, self._tracer)
+        # release BEFORE notifying (same ordering as _finish): the shed
+        # client's 503 handler must never observe its own pages as held
         self._release_slot(i)
+        if on_token is not None:
+            try:
+                on_token(None)
+            except Exception:
+                pass
+        if fut is not None:
+            self._resolve(fut, exc=self._shed_error(why))
 
     def _shed_prefill_job(self, why: str):
         job = self._prefill
@@ -1608,54 +1792,75 @@ class ContinuousBatcher:
         self._prefill = None
         self._allocator.count_shed()
         logger.warning("shedding staged prefill (slot %d): %s", job.slot, why)
+        if self._flight is not None:
+            self._flight.record(job.slot, EV_SHED, why=why)
+            self._flight.complete(job.slot, "shed", 0, self._tracer)
+        self._release_slot(job.slot)  # before notifying, like _finish
         if job.on_token is not None:
             try:
                 job.on_token(None)
             except Exception:
                 pass
         self._resolve(job.fut, exc=self._shed_error(why))
-        if self._flight is not None:
-            self._flight.record(job.slot, EV_SHED, why=why)
-            self._flight.complete(job.slot, "shed", 0, self._tracer)
-        self._release_slot(job.slot)
 
     def _release_slot(self, i: int):
-        """Common slot teardown: return pages to the allocator and point
-        the device block-table row back at trash (in device program order,
-        so in-flight steps finish their reads first — reused pages are
-        reset/rewritten strictly AFTER)."""
+        """Common slot teardown: drop page references (owned pages free
+        to the pool, shared trie pins decrement — the trie keeps its own
+        reference) and point the device block-table row back at trash (in
+        device program order, so in-flight steps finish their reads first
+        — reused pages are reset/rewritten strictly AFTER)."""
         slot = self._slots[i]
         slot.active = False
         slot.prefilling = False
         slot.future = None
         slot.on_token = None
+        slot.ids = None
         if self.paged:
             if slot.pages:
                 self._allocator.free(slot.pages)
                 slot.pages = []
+            if slot.shared:
+                self._allocator.free(slot.shared)  # unpin: refs -= 1
+                slot.shared = []
             import jax.numpy as jnp
 
             self._block_tables = self._set_block_row(
                 self._block_tables, jnp.asarray(i, jnp.int32), self._trash_row)
 
-    def page_stats(self) -> dict:
+    def page_stats(self, radix_stats: Optional[dict] = None) -> dict:
         """Pool gauges for llm_stats/metrics: in-use/total pages plus
         internal fragmentation (1 - tokens written / page tokens held) —
         the slack the page-size knob trades against table overhead.
-        All-zero under the dense layout (no pool exists)."""
+        All-zero under the dense layout (no pool exists). Each allocated
+        page's tokens count exactly ONCE: slots count only their OWNED
+        pages' tokens, trie-held blocks (shared ones included — sharing
+        is the trie's page) count as full blocks via ``radix_stats``
+        (pass a precomputed ``RadixPrefixCache.stats()`` snapshot to
+        avoid a second O(nodes) walk per scrape)."""
         if not self.paged:
             return {"kv_pages_total": 0, "kv_pages_in_use": 0,
                     "kv_page_size": 0, "kv_page_fragmentation": 0.0,
                     "kv_page_sheds": 0}
         total, in_use, sheds = self._allocator.stats()
+        ps = self.page_size
         used_tokens = 0
         for s in self._slots:
             if s.active:
-                used_tokens += min(s.true_len + s.disp_new,
-                                   len(s.pages) * self.page_size)
+                used_tokens += min(
+                    max(s.true_len + s.disp_new - len(s.shared) * ps, 0),
+                    len(s.pages) * ps)
         job = self._prefill
         if job is not None:
-            used_tokens += min(job.next, len(job.pages) * self.page_size)
+            jslot = self._slots[job.slot]
+            used_tokens += min(max(job.next - len(jslot.shared) * ps, 0),
+                               len(jslot.pages) * ps)
+        if self._radix is not None:
+            # trie-held blocks count as used capacity (they are the cache
+            # working set, not slack) — once per page, shared or not
+            # (slots above counted owned pages only)
+            rs = radix_stats if radix_stats is not None \
+                else self._radix.stats()
+            used_tokens += rs["prefix_cached_blocks"] * ps
         frag = 0.0
         if in_use > 0:
             frag = 1.0 - used_tokens / float(in_use * self.page_size)
@@ -1693,20 +1898,41 @@ class ContinuousBatcher:
         }
 
     def _finish(self, i: int):
+        """Complete slot ``i``: trie insertion, slot release, THEN client
+        notification. Resolving the future first was a latent race: the
+        awaiting client resumes on the loop thread while this worker is
+        still freeing pages, so a client-side stats read (or an immediate
+        follow-up submit) could observe the finished request's pages as
+        leaked/held — releasing before ``_resolve`` makes completion
+        observable only after the pool is consistent."""
         slot = self._slots[i]
         toks = slot.tokens
         if self.eos_id in toks:
             toks = toks[: toks.index(self.eos_id)]
-        if slot.on_token is not None:
-            slot.on_token(None)  # stream end sentinel
-        if slot.future is not None:
-            self._resolve(slot.future, result=toks)
+        fut, on_token = slot.future, slot.on_token
         if self._flight is not None:
             # ``tokens`` = tokens CREDITED to the slot (n_new): the sum the
             # per-step events must reproduce; an EOS trim shortens the
             # client's list but never the credited count
             self._flight.complete(i, "done", slot.n_new, self._tracer)
+        if self._radix is not None and slot.ids is not None:
+            # insert the slot's prompt+generated blocks back into the trie
+            # IN PLACE — page ownership transfers node-by-node, no dense
+            # export. Only provably-written positions qualify: every token
+            # but the last credited one has been FED to a later step (its
+            # KV write is in device program order before any future
+            # reader); the last token's write is run-ahead-dependent.
+            hist = list(slot.ids) + slot.tokens[:max(slot.n_new - 1, 0)]
+            consumed = self._radix.insert(
+                hist, slot.shared + slot.pages, len(slot.shared))
+            if consumed:
+                # adopted/deduped pages are no longer this slot's to free
+                slot.pages = [p for p in slot.pages if p not in consumed]
         self._release_slot(i)
+        if on_token is not None:
+            on_token(None)  # stream end sentinel
+        if fut is not None:
+            self._resolve(fut, result=toks)
 
     # ------------------------------------------------------------------
     # Pipelined decode: dispatch (producer) / drain (consumer)

@@ -75,17 +75,31 @@ class PrefillRequest:
     truncated) prompt, its dense prefill bucket, and the page count the
     decode side allocated for it (paged layout). ``record_events`` asks
     the worker to stamp flight-recorder stage events into the Handoff
-    (set when the decode side's recorder is running)."""
+    (set when the decode side's recorder is running).
 
-    __slots__ = ("job_id", "ids", "plen", "n_pages", "record_events")
+    Prefix reuse (radix trie, runtime/radix.py): when the decode side
+    already caches the prompt's leading ``prefix_len`` tokens
+    (``prefix_pages`` whole blocks), ``prefix_staged`` carries their KV
+    as an exported page bucket — the worker imports it into its staging
+    pool and computes ONLY positions ``prefix_len..``, then hands back
+    only the suffix pages. The prefix ships forward as a D2D copy (bytes,
+    not FLOPs); the prefill compute saved is the point."""
+
+    __slots__ = ("job_id", "ids", "plen", "n_pages", "record_events",
+                 "prefix_len", "prefix_pages", "prefix_staged")
 
     def __init__(self, job_id: int, ids: List[int], plen: int,
-                 n_pages: int = 0, record_events: bool = False):
+                 n_pages: int = 0, record_events: bool = False,
+                 prefix_len: int = 0, prefix_pages: int = 0,
+                 prefix_staged: Any = None):
         self.job_id = job_id
         self.ids = list(ids)
         self.plen = int(plen)
         self.n_pages = int(n_pages)
         self.record_events = bool(record_events)
+        self.prefix_len = int(prefix_len)
+        self.prefix_pages = int(prefix_pages)
+        self.prefix_staged = prefix_staged
 
 
 class Handoff:
@@ -384,28 +398,51 @@ class PrefillWorker:
         row — the same compiled chunk program type as local paged
         admission (``_prefill_step``), on the prefill device. The staging
         pool is reused across jobs: its pages are position-reset before
-        each prompt so no previous occupant's positions survive."""
+        each prompt so no previous occupant's positions survive.
+
+        Prefix reuse: when the request carries a decode-side radix hit
+        (``prefix_pages`` exported blocks), the bucket imports into the
+        staging pool's leading sequence pages and the chunk loop starts
+        at ``prefix_len`` — the suffix chunks ATTEND over the imported
+        prefix through the same staging row, so the written suffix KV is
+        bit-identical to a cold full prefill, at suffix-only FLOPs."""
+        import jax
         import jax.numpy as jnp
 
         from seldon_core_tpu.models.transformer import (
             NULL_PAGE, PAD_POS, RESERVED_PAGES, TRASH_PAGE)
         from seldon_core_tpu.runtime.batcher import _page_table_ops
 
-        (_, _, reset_pages, _, _) = _page_table_ops()
+        reset_pages = _page_table_ops()[2]
         n0 = req.n_pages or -(-len(req.ids) // self.page_size)
+        n_pre = min(req.prefix_pages, n0) if req.prefix_staged is not None \
+            else 0
         ids_np = np.full((self.n_pages,), TRASH_PAGE, np.int32)
         ids_np[:n0] = np.arange(RESERVED_PAGES, RESERVED_PAGES + n0)
         self._staging = reset_pages(self._staging, jnp.asarray(ids_np))
         row = np.full((self.n_pages,), NULL_PAGE, np.int32)
         row[:n0] = np.arange(RESERVED_PAGES, RESERVED_PAGES + n0)
         bt_row = jnp.asarray(row[None, :])
+        if n_pre:
+            # decode-side cached prefix: D2D the exported bucket onto this
+            # device and scatter it into the sequence's leading staging
+            # pages (the same jitted import program the decode side runs)
+            bucket = jax.device_put(req.prefix_staged, self.device)
+            staged_pages = (jax.tree.leaves(bucket)[0].shape[0]
+                            - RESERVED_PAGES)
+            imp = self.server._get_handoff_import(self.n_pages, staged_pages)
+            pre_row = np.full((self.n_pages,), NULL_PAGE, np.int32)
+            pre_row[:n_pre] = np.arange(RESERVED_PAGES,
+                                        RESERVED_PAGES + n_pre)
+            self._staging = imp(self._staging, bucket, jnp.asarray(pre_row),
+                                jnp.asarray(n_pre, jnp.int32))
 
         C = min(self.prefill_chunk, req.plen) or req.plen
         fn = self.server._get_prefill_chunk(C, self.n_pages)
         L = len(req.ids)
         logits = None
         n = 0
-        start = 0
+        start = n_pre * self.page_size if n_pre else 0
         while start < L:
             part = req.ids[start:start + C]
             n = len(part)
@@ -418,20 +455,21 @@ class PrefillWorker:
             start += n
         # graftlint: allow-host-sync-in-hot-path(admission-time sync on the PREFILL worker thread, once per request: the LAST chunk's logits seed the first sampled token; the decode slice never blocks on it)
         first_logits = np.asarray(logits[0, n - 1]).astype(np.float32)
-        # Ship only a power-of-two page bucket covering the written pages,
-        # not the whole max_len staging pool: interconnect bytes track
-        # prompt length (DECODE_NOTES.md "interconnect math") and the
-        # decode-side import stays at O(log n_pages) compiles. The slice
-        # runs on the prefill device; the import masks rows >= n0 to
-        # TRASH_PAGE so the bucket's padding never lands in a live page.
-        import jax
+        # Ship only a power-of-two page bucket covering the pages THIS
+        # worker wrote (the suffix — imported prefix pages never travel
+        # back: the decode side still holds their originals), not the
+        # whole max_len staging pool: interconnect bytes track the
+        # uncached suffix length (DECODE_NOTES.md "interconnect math")
+        # and the decode-side import stays at O(log n_pages) compiles.
+        # The slice runs on the prefill device; the import masks rows
+        # past the valid count to TRASH_PAGE so bucket padding never
+        # lands in a live page.
+        from seldon_core_tpu.runtime.batcher import pow2_bucket
 
-        b = 1
-        while b < n0:
-            b <<= 1
-        b = min(b, self.n_pages)
-        staged = jax.tree.map(lambda p: p[:RESERVED_PAGES + b],
-                              self._staging)
+        n_suffix = n0 - n_pre
+        b = pow2_bucket(n_suffix, self.n_pages - n_pre)
+        staged = jax.tree.map(
+            lambda p: p[n_pre:n_pre + RESERVED_PAGES + b], self._staging)
         return staged, first_logits
 
 

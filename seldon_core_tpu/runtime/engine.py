@@ -180,16 +180,71 @@ class ReplicaSet(SeldonComponent):
                 best, best_score = r, score
         return best
 
+    def pick_for(self, prompt: Any) -> SeldonComponent:
+        """Prefix-aware dispatch for chat traffic: the replica whose radix
+        prefix cache (runtime/radix.py) already holds the LONGEST cached
+        prefix of ``prompt`` wins — a hit there costs block-table entries
+        while any other replica recomputes the whole prefill — with
+        least-loaded as tiebreak and as fallback when nobody caches
+        anything (``prefix_match_len`` is an O(prompt) host-side probe
+        under the replica's own locks: cheap enough to run per dispatch).
+        Lowest index breaks full ties so routing stays deterministic."""
+        prompt = self._encode_once(prompt)
+        best, best_key = None, None
+        for i, r in enumerate(self.replicas):
+            match = 0
+            probe = getattr(r, "prefix_match_len", None)
+            if probe is not None and prompt is not None:
+                match = int(probe(prompt))
+            key = (-match, replica_load(r), i)
+            if best_key is None or key < best_key:
+                best, best_key = r, key
+        return best
+
+    def _encode_once(self, prompt: Any):
+        """Tokenize a string prompt ONCE before fanning the probe out —
+        per-replica `prefix_match_len(str)` would re-encode a growing
+        chat transcript N times per dispatch (replicas share the
+        tokenizer config by construction; a replica without one just
+        gets the raw prompt)."""
+        if not isinstance(prompt, str):
+            return prompt
+        for r in self.replicas:
+            tok = getattr(r, "_tokenizer", None)
+            if tok is not None:
+                return tok.encode(prompt)
+        return prompt
+
     def loads(self) -> List[Tuple[float, float]]:
         return [replica_load(r) for r in self.replicas]
+
+    def prefix_match_len(self, prompt: Any) -> int:
+        """Fleet-level probe: the best cached-prefix length any replica
+        offers (lets ReplicaSets nest / upstream routers see the fleet's
+        coverage as one number)."""
+        prompt = self._encode_once(prompt)
+        out = 0
+        for r in self.replicas:
+            probe = getattr(r, "prefix_match_len", None)
+            if probe is not None:
+                out = max(out, int(probe(prompt)))
+        return out
 
     # the component surface delegates to the chosen replica; generate is
     # included so LLM graph nodes (and their transports) route too
     def predict(self, X, names, meta=None):
         return self.pick().predict(X, names, meta)
 
-    def generate(self, *a, **kw):
-        return self.pick().generate(*a, **kw)
+    def generate(self, prompts=None, *a, **kw):
+        # route on the FIRST prompt's cached-prefix coverage (single-
+        # prompt requests are the chat shape prefix routing exists for;
+        # multi-prompt batches still benefit from the first's locality)
+        probe = None
+        if prompts is not None and len(prompts) > 0:
+            probe = prompts[0]
+        if probe is None:
+            return self.pick().generate(prompts, *a, **kw)
+        return self.pick_for(probe).generate(prompts, *a, **kw)
 
     def tags(self) -> Dict[str, Any]:
         from seldon_core_tpu.components.component import client_custom_tags

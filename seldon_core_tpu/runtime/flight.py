@@ -50,7 +50,12 @@ from seldon_core_tpu.tracing import Span, TraceContext, Tracer, now as wall_now
 # queue wait are segment FIELDS (begin()), not ring events
 EV_PREFILL_CHUNK = "prefill_chunk"  # one chunked-prefill dispatch
 EV_PREFILL = "prefill"              # one-shot dense prefill
-EV_PREFIX_HIT = "prefix_hit"        # prefix-cache tokens imported
+EV_PREFIX_HIT = "prefix_hit"        # radix prefix-cache hit: tokens served
+#                                     from shared pages (fields: tokens
+#                                     matched, blocks = block-table entries
+#                                     written instead of prefilled) —
+#                                     materializes as the llm.prefix_hit
+#                                     span child with the matched-block count
 EV_FIRST_TOKEN = "first_token"      # commit: prefill-sampled token surfaced
 EV_STEP = "step"                    # drained decode step credited to a slot
 EV_PAGE_GROW = "page_grow"          # mid-decode page allocation (stall risk)

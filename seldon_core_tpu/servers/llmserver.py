@@ -139,6 +139,73 @@ def _slot_sampler(top_k: int):
 from seldon_core_tpu.utils import bucket as _bucket  # single bucketing policy
 
 
+# terminal marker in the dense prefix-cache index trie: an object() can
+# never collide with an int token id
+_TERM = object()
+
+
+class _PrefixTrieIndex:
+    """Token trie over the dense prefix-cache entry keys, so
+    ``_prefix_lookup`` walks the PROMPT once instead of scanning every
+    entry (the old OrderedDict scan was O(entries x prefix length) under
+    ``_prefix_lock`` — at fleet cache sizes the lock hold time scaled
+    with cache population, not prompt length). ``candidates`` returns
+    every stored key that is a prefix of the probe, shortest to longest,
+    in O(len(probe)) node steps; the caller picks the longest one whose
+    entry passes its predicates (dtype/geometry). ``work`` counts node
+    visits — the regression signal tests/test_kv_cache.py pins to the
+    prompt length, independent of entry count. NOT thread-safe on its
+    own: every call happens under the server's ``_prefix_lock``, exactly
+    like the OrderedDict it indexes."""
+
+    __slots__ = ("_root", "work")
+
+    def __init__(self):
+        self._root: Dict[Any, Any] = {}
+        self.work = 0
+
+    def add(self, key: Tuple[int, ...]) -> None:
+        node = self._root
+        for t in key:
+            node = node.setdefault(t, {})
+        node[_TERM] = key
+
+    def remove(self, key: Tuple[int, ...]) -> None:
+        path = [(None, self._root)]
+        node = self._root
+        for t in key:
+            nxt = node.get(t)
+            if nxt is None:
+                return
+            path.append((t, nxt))
+            node = nxt
+        node.pop(_TERM, None)
+        # prune now-empty suffix nodes so dead entries cost no walk time
+        for i in range(len(path) - 1, 0, -1):
+            tok, n = path[i]
+            if n:
+                break
+            del path[i - 1][1][tok]
+
+    def candidates(self, tokens: Sequence[int]) -> List[Tuple[int, ...]]:
+        node = self._root
+        out: List[Tuple[int, ...]] = []
+        self.work += 1
+        for t in tokens:
+            if _TERM in node:
+                out.append(node[_TERM])
+            node = node.get(t)
+            if node is None:
+                return out
+            self.work += 1
+        if _TERM in node:
+            out.append(node[_TERM])
+        return out
+
+    def clear(self) -> None:
+        self._root = {}
+
+
 # f32 init trees above this stream leaf-by-leaf through the quantizer
 # instead of materializing whole (27 GB at 7B vs 16 GB single-chip HBM).
 STREAM_INIT_THRESHOLD_BYTES = 2 << 30
@@ -319,6 +386,10 @@ class LLMServer(SeldonComponent):
         self.prefix_cache_bytes = int(prefix_cache_bytes) or (
             512 * 1024 * 1024 if self.prefix_cache_size else 0)
         self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # longest-prefix lookups walk this trie index in O(prompt) instead
+        # of scanning the OrderedDict (which stays for LRU order + byte
+        # accounting); membership is mirrored add/remove under _prefix_lock
+        self._prefix_index = _PrefixTrieIndex()
         self._prefix_bytes = 0
         self._prefix_lock = threading.Lock()
         self._prefix_hits = 0
@@ -759,41 +830,49 @@ class LLMServer(SeldonComponent):
         OrderedDict directly instead leaves ``_prefix_bytes`` stuck at the
         old total, and once that phantom total nears the budget every later
         store immediately self-evicts — a permanent, silent 0% hit rate
-        (found at 7B where one entry is ~300 MB of the 512 MB default)."""
+        (found at 7B where one entry is ~300 MB of the 512 MB default).
+        The continuous batcher's radix prefix cache (runtime/radix.py)
+        clears alongside: both layers must read as cold together."""
         with self._prefix_lock:
             self._prefix_cache.clear()
+            self._prefix_index.clear()
             self._prefix_bytes = 0
+        svc = getattr(self, "_batcher_service", None)
+        radix = getattr(svc.batcher, "_radix", None) if svc is not None else None
+        if radix is not None:
+            radix.clear()
 
-    def _prefix_lookup(self, tokens: List[int], max_len: Optional[int] = None,
-                       page_size: Optional[int] = None):
+    def _prefix_lookup(self, tokens: List[int],
+                       max_len: Optional[int] = None):
         """Longest cached prefix of ``tokens`` with a compatible
         kv_cache_dtype; returns (prefix_len, entry_max_len, caches,
         last_logits) or None. With ``max_len`` set, only entries of exactly
         that cache length serve — generate()'s dense path reuses the whole
-        cache object, so its geometry must match. ``max_len=None`` accepts
-        any length: the paged batcher imports only the entry's first
-        ``prefix_len`` positions into pool pages, so any dense entry long
-        enough to hold the prefix serves — with ``page_size`` set, entries
-        too short for that whole-page import are skipped DURING the scan
-        (a shorter importable prefix can still win, and the hit counter /
-        LRU promotion only ever record hits that actually serve). Exact
-        full-prompt hits return the stored logits so prefill is skipped
-        entirely. The dtype check matters: a bf16 3-tuple cache fed to an
-        int8-configured decode (or vice versa) would be structurally
-        wrong, so a dtype flip must read as a miss, never a crash."""
+        cache object, so its geometry must match. Exact full-prompt hits
+        return the stored logits so prefill is skipped entirely. The dtype
+        check matters: a bf16 3-tuple cache fed to an int8-configured
+        decode (or vice versa) would be structurally wrong, so a dtype
+        flip must read as a miss, never a crash.
+
+        Lookup walks the trie index (one pass over the prompt, O(prompt)
+        node steps) instead of scanning entries: the lock hold time no
+        longer scales with cache population
+        (tests/test_kv_cache.py pins the regression). The continuous
+        batcher does NOT call this — its prefix reuse is the page-pool
+        radix trie (runtime/radix.py), which shares pages instead of
+        reusing dense cache objects."""
         with self._prefix_lock:
             best = None
-            for key, (entry_max_len, entry_kvd, caches, last_logits, _nb) in self._prefix_cache.items():
-                k = len(key)
-                if entry_kvd != self.kv_cache_dtype or k > len(tokens):
+            for key in self._prefix_index.candidates(tokens):
+                entry_max_len, entry_kvd, caches, last_logits, _nb = \
+                    self._prefix_cache[key]
+                if entry_kvd != self.kv_cache_dtype:
                     continue
                 if max_len is not None and entry_max_len != max_len:
                     continue
-                if page_size is not None and \
-                        -(-k // page_size) * page_size > entry_max_len:
-                    continue  # entry ends mid-page: whole-page import can't
-                if list(key) == tokens[:k] and (best is None or k > best[0]):
-                    best = (k, entry_max_len, caches, last_logits)
+                # candidates arrive shortest -> longest: the last passer
+                # is the longest compatible prefix
+                best = (len(key), entry_max_len, caches, last_logits)
             if best is not None:
                 self._prefix_cache.move_to_end(tuple(tokens[: best[0]]))
                 # hit accounting lives under the same lock as the cache it
@@ -820,6 +899,8 @@ class LLMServer(SeldonComponent):
             old = self._prefix_cache.pop(key, None)
             if old is not None:
                 self._prefix_bytes -= old[-1]
+            else:
+                self._prefix_index.add(key)
             self._prefix_cache[key] = (
                 max_len, self.kv_cache_dtype, caches, last_logits, nbytes)
             self._prefix_bytes += nbytes
@@ -828,7 +909,8 @@ class LLMServer(SeldonComponent):
                 or (self.prefix_cache_bytes
                     and self._prefix_bytes > self.prefix_cache_bytes)
             ):
-                _, entry = self._prefix_cache.popitem(last=False)
+                evicted_key, entry = self._prefix_cache.popitem(last=False)
+                self._prefix_index.remove(evicted_key)
                 self._prefix_bytes -= entry[-1]
 
     def _get_prefill(self, b: int, plen: int, max_len: int):
@@ -1616,6 +1698,29 @@ class LLMServer(SeldonComponent):
                 out["prefix_cache_entries"] = len(self._prefix_cache)
         return out
 
+    def prefix_match_len(self, prompt: Any) -> int:
+        """Cached-prefix length (tokens) this server already holds for
+        ``prompt`` — the cheap probe ReplicaSet's prefix-aware routing
+        calls before dispatch (runtime/engine.py). Reads the batcher's
+        page-pool radix trie when continuous batching is on, else the
+        dense entry index; both are O(prompt) walks under their own
+        locks, no device work, no pinning."""
+        if not self.ready:
+            return 0
+        if isinstance(prompt, str):
+            ids = self._tokenizer.encode(prompt)
+        else:
+            # graftlint: allow-host-sync-in-hot-path(routing probe ingress: prompt is caller-supplied host tokens, never a device array)
+            ids = [int(t) for t in np.asarray(prompt).ravel()]
+        svc = getattr(self, "_batcher_service", None)
+        radix = getattr(svc.batcher, "_radix", None) if svc is not None \
+            else None
+        if radix is not None:
+            return radix.match_len(ids)
+        with self._prefix_lock:
+            cands = self._prefix_index.candidates(ids)
+            return len(cands[-1]) if cands else 0
+
     def flight_recorder(self):
         """The active batcher's flight recorder (runtime/flight.py), or
         None when tracing is off / no batcher service exists — the
@@ -1660,6 +1765,13 @@ class LLMServer(SeldonComponent):
                          "handoffs_total": 0,
                          "handoff_transfer_bytes_total": 0,
                          "handoff_queue_depth": 0}
+        # radix prefix cache (runtime/radix.py): cached/shared block
+        # gauges + the hit/cow/eviction/bytes-saved lifetime counters
+        # (metrics/registry.py seldon_llm_prefix_*)
+        prefix_stats = {"prefix_cached_blocks": 0, "prefix_shared_pages": 0,
+                        "prefix_hit_blocks": 0, "prefix_hit_tokens": 0,
+                        "prefix_cow_copies": 0, "prefix_evicted_blocks": 0,
+                        "prefix_bytes_saved": 0}
         svc = getattr(self, "_batcher_service", None)
         if svc is not None:
             batcher = svc.batcher
@@ -1669,8 +1781,13 @@ class LLMServer(SeldonComponent):
             inflight_hwm = batcher._inflight_hwm
             depth = batcher.pipeline_depth
             fuse = batcher.fuse_steps
+            radix_stats = None
+            if getattr(batcher, "_radix", None) is not None:
+                # ONE trie walk per scrape: page_stats reuses the snapshot
+                radix_stats = batcher._radix.stats()
+                prefix_stats.update(radix_stats)
             if getattr(batcher, "paged", False):
-                page_stats = batcher.page_stats()
+                page_stats = batcher.page_stats(radix_stats=radix_stats)
             if getattr(batcher, "spec_mode", "off") != "off":
                 spec_stats.update(batcher.spec_stats())
             if getattr(batcher, "_remote", None) is not None:
@@ -1716,4 +1833,7 @@ class LLMServer(SeldonComponent):
             # transfer + import) and the transfer-queue counters
             **handoff_stats,
             "handoff_times_s": drain(self._handoff_times),
+            # radix prefix cache: block-level reuse counters + the
+            # shared-page gauge (docs/performance.md "Radix prefix cache")
+            **prefix_stats,
         }

@@ -237,6 +237,37 @@ def test_prefix_store_lookup_roundtrip(kvd):
     assert hit2 is not None and hit2[0] == len(prompt)
 
 
+def test_prefix_lookup_work_independent_of_entry_count():
+    """The ISSUE 12 satellite regression: _prefix_lookup walks the trie
+    index in O(prompt) node steps under _prefix_lock — its work must NOT
+    scale with how many entries the cache holds (the old implementation
+    compared the probe against EVERY entry)."""
+    s = make_server(prefix_cache_size=256, prefix_cache_bytes=1 << 40)
+    probe = [200 + i for i in range(12)]  # shares no prefix with entries
+
+    def store(n):
+        # synthetic entries (lookup only reads the key/metadata tuple):
+        # distinct first tokens, so the index rejects each at one node
+        for i in range(n):
+            s._prefix_store([i, 1, 2, 3, 4, 5, 6, 7], 64, [], None)
+
+    store(4)
+    s._prefix_index.work = 0
+    assert s._prefix_lookup(probe, 64) is None
+    work_small = s._prefix_index.work
+    store(128)
+    s._prefix_index.work = 0
+    assert s._prefix_lookup(probe, 64) is None
+    work_big = s._prefix_index.work
+    assert work_big == work_small, (
+        f"lookup work scaled with entries: {work_small} -> {work_big}")
+    # a real longest-prefix hit costs O(prompt), entries notwithstanding
+    s._prefix_index.work = 0
+    hit = s._prefix_lookup([3, 1, 2, 3, 4, 5, 6, 7, 9, 9], 64)
+    assert hit is not None and hit[0] == 8
+    assert s._prefix_index.work <= 11  # root + one node per probe token
+
+
 @pytest.mark.parametrize("kvd", [
     "bf16",
     # tier-1 870s budget keeps bf16; int8 rides CI's unfiltered steps

@@ -308,34 +308,88 @@ def test_page_allocator_exact_accounting():
 @pytest.mark.parametrize("kvd", [
     "bf16",
     pytest.param("int8", marks=pytest.mark.slow),  # tier-1 keeps bf16;
-    # the int8 import path still runs in CI's unfiltered unit step
+    # the int8 sharing path still runs in CI's unfiltered unit step
 ])
-def test_prefix_cache_hit_lands_in_paged_slot(kvd):
-    """A prefix cached by generate() (dense entry) is imported into pool
-    pages at admission: full-prompt hits skip prefill entirely, prefix+
-    suffix prompts chunk-prefill only the suffix — tokens exact either
-    way (both KV dtypes: the int8 import copies value AND scale planes),
-    and the hit counter records both."""
+def test_radix_prefix_hit_lands_in_paged_slot(kvd):
+    """The radix prefix cache (runtime/radix.py): a completed request's
+    prompt+generated blocks re-enter the trie IN PLACE, so a repeat of
+    the same prompt serves its prefix as shared block-table entries (only
+    the final token chunk-prefills — the match caps at L-1) and a
+    chat-style continuation part-way into a cached block pays exactly one
+    copy-on-write page copy — tokens bit-exact vs cold generate() either
+    way (both KV dtypes: sharing covers value AND scale planes)."""
     s = make_server(prefix_cache_size=4, len_buckets=(16,),
                     kv_cache_dtype=kvd)
     system = [9, 8, 7, 6, 5, 4, 3, 2, 1]
     full = s.generate([system], max_new_tokens=8)["tokens"][0]
-    assert len(s._prefix_cache) == 1
     longer = system + [30, 31, 32]
     e_longer = s.generate([longer], max_new_tokens=8)["tokens"][0]
-    s.clear_prefix_cache()
-    s.generate([system], max_new_tokens=8)  # repopulate exactly one entry
-    hits0 = s._prefix_hits
 
-    outs, _ = run_batch(s, [system], max_slots=2, max_len=32,
-                        len_buckets=(16,), prefill_chunk=4)
-    assert outs[0] == full                  # full-prompt hit, no prefill
-    assert s._prefix_hits == hits0 + 1
+    async def go():
+        b = ContinuousBatcher(s, max_slots=2, max_len=32, len_buckets=(16,),
+                              layout="paged", page_size=4, prefill_chunk=4)
+        assert b._radix is not None
+        o1 = await b.submit(system, max_new_tokens=8)
+        st1 = dict(b._radix.stats())
+        o2 = await b.submit(system, max_new_tokens=8)
+        st2 = dict(b._radix.stats())
+        o3 = await b.submit(longer, max_new_tokens=8)
+        st3 = dict(b._radix.stats())
+        pages = b.page_stats()
+        await b.close()
+        return o1, o2, o3, st1, st2, st3, pages
 
-    outs2, _ = run_batch(s, [longer], max_slots=2, max_len=32,
-                         len_buckets=(16,), prefill_chunk=4)
-    assert outs2[0] == e_longer             # suffix chunked onto the import
-    assert s._prefix_hits == hits0 + 2
+    o1, o2, o3, st1, st2, st3, pages = asyncio.run(go())
+    assert o1 == full and o2 == full        # repeat: bit-exact via sharing
+    assert o3 == e_longer                   # continuation: bit-exact
+    # first completion populated the trie (prompt 9 + 7 provably-written
+    # generated tokens = 16 tokens = 4 blocks of 4)
+    assert st1["prefix_cached_blocks"] == 4
+    assert st1["prefix_hit_tokens"] == 0
+    # the repeat matched 8 tokens (two whole blocks; L-1 cap leaves the
+    # last prompt token to prefill) with ZERO page copies
+    assert st2["prefix_hit_tokens"] - st1["prefix_hit_tokens"] == 8
+    assert st2["prefix_hit_blocks"] - st1["prefix_hit_blocks"] == 2
+    assert st2["prefix_cow_copies"] == st1["prefix_cow_copies"]
+    # the continuation ran INTO block 2 (its 9th token matches the cached
+    # history's) — two shared blocks plus one copy-on-write page
+    assert st3["prefix_hit_tokens"] - st2["prefix_hit_tokens"] >= 8
+    assert st3["prefix_cow_copies"] == st2["prefix_cow_copies"] + 1
+    assert st3["prefix_bytes_saved"] > 0
+    # cached blocks stay resident (that is the cache); no slot holds pages
+    assert pages["kv_pages_in_use"] == st3["prefix_cached_blocks"]
+    assert pages["kv_page_sheds"] == 0
+
+
+def test_radix_lookup_work_independent_of_population():
+    """The O(entries x prefix) scan regression (ISSUE 12 satellite): trie
+    match work scales with the PROBE length, not with how many sequences
+    the cache holds. Measured in node visits on the real trie."""
+    from seldon_core_tpu.runtime.radix import RadixPrefixCache
+
+    def populate(n_seqs):
+        alloc = PageAllocator(total_pages=4 * n_seqs + 8, page_size=4)
+        trie = RadixPrefixCache(alloc, page_size=4)
+        for i in range(n_seqs):
+            pages = alloc.alloc(2)
+            # every sequence starts with a distinct token: the probe can
+            # reject each candidate at its first block token
+            trie.insert([100 + i, 1, 2, 3, 4, 5, 6, 7], pages, 0)
+        return trie
+
+    probe = [7, 7, 7, 7, 7, 7, 7, 7]
+    small = populate(4)
+    small.match_len(probe)
+    work_small = small.match_work_total
+    big = populate(64)
+    big.match_len(probe)
+    work_big = big.match_work_total
+    # the old OrderedDict scan did O(entries) comparisons per lookup; the
+    # trie visits the (at most one) candidate bucket per block step
+    assert work_big <= work_small + 2
+    # and a full-path match costs O(blocks), entries notwithstanding
+    big.match_len([100, 1, 2, 3, 4, 5, 6, 7])
+    assert big.match_work_total - work_big <= 4
 
 
 # ------------------------------------------------------------- metrics

@@ -161,6 +161,65 @@ def test_page_allocator_locked_free_is_clean(tmp_path):
     assert rules_of(reported) == []
 
 
+RADIX_TRIE = """
+    import threading
+
+    class RadixPrefixCache:
+        # the ISSUE 12 trie discipline: match_and_pin/insert/evict run on
+        # the batcher's offload threads while match_len (the ReplicaSet
+        # routing probe) and stats run on transport threads — every
+        # structure walk and counter bump belongs under the trie lock
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._blocks = 0
+            self.hit_blocks_total = 0
+
+        def insert(self, n):
+            with self._lock:
+                self._blocks += n
+
+        def evict(self, n):
+            with self._lock:
+                self._blocks -= n
+
+        def match_and_pin(self, n):
+            self.hit_blocks_total += n     # pre-fix: unlocked counter RMW
+            return self._blocks            # pre-fix: unlocked read
+
+        def stats(self):
+            with self._lock:
+                return (self._blocks, self.hit_blocks_total)
+"""
+
+
+def test_radix_trie_unlocked_match_fires(tmp_path):
+    """The trie/refcount discipline (ISSUE 12 satellite): insert/evict/
+    stats establish the guarded pattern on the block count and hit
+    counter; an unlocked match path is the lost-hit/torn-read race the
+    schedules suite explores dynamically."""
+    root = write_tree(tmp_path / "pkg", {"runtime/radix.py": RADIX_TRIE})
+    reported, _, _ = lint(root)
+    us = [f for f in reported if f.rule == "unguarded-shared-state"]
+    assert us, "the unlocked match_and_pin accesses must fire"
+    assert any("hit_blocks_total" in f.message or "_blocks" in f.message
+               for f in us)
+
+
+def test_radix_trie_locked_match_is_clean(tmp_path):
+    fixed = RADIX_TRIE.replace(
+        "        def match_and_pin(self, n):\n"
+        "            self.hit_blocks_total += n     # pre-fix: unlocked counter RMW\n"
+        "            return self._blocks            # pre-fix: unlocked read",
+        "        def match_and_pin(self, n):\n"
+        "            with self._lock:\n"
+        "                self.hit_blocks_total += n\n"
+        "                return self._blocks")
+    assert fixed != RADIX_TRIE
+    root = write_tree(tmp_path / "pkg", {"runtime/radix.py": fixed})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
 SPEC_CONTROLLER = """
     import threading
 

@@ -382,6 +382,77 @@ def test_page_allocator_unlocked_reconstruction_double_allocates():
     assert g[0] == g[1] or len(a._free) != 2
 
 
+def test_refcount_unlocked_reconstruction_double_frees():
+    """Reconstruction of the bug the refcounted allocator's lock exists
+    to prevent (ISSUE 12): two concurrent unlocked releases of a shared
+    page (trie unpin racing slot release) both read refcount 2, both
+    write 1 — the page never frees (leak) — or interleave into a
+    double-append onto the free list (the double-allocation corruption).
+    Found by opcode exploration, replayed deterministically."""
+
+    class UnlockedRefcounts:
+        def __init__(self):
+            self._refs = {5: 2}          # one page, trie + one pin
+            self._free = []
+
+        def release(self, p):
+            rc = self._refs[p]           # read
+            if rc > 1:
+                self._refs[p] = rc - 1   # ...modify-write, not atomic
+            else:
+                del self._refs[p]
+                self._free.append(p)
+
+    def scenario(sched):
+        a = UnlockedRefcounts()
+        sched.spawn(lambda: a.release(5), name="unpin")
+        sched.spawn(lambda: a.release(5), name="release")
+        return a
+
+    def ok(a):
+        # both refs dropped: the page must be free exactly once
+        return a._free == [5] and 5 not in a._refs
+
+    bad = find_race(scenario, ok, granularity="opcode",
+                    max_schedules=200, stall_s=STALL)
+    assert bad is not None, "unlocked refcount RMW must lose a release"
+    a, _, sched = run_schedule(scenario, schedule=bad.to_list(),
+                               granularity="opcode", stall_s=STALL)
+    assert not sched.errors()
+    # the corruption, replayed: leaked (never freed) or double-freed
+    assert a._free != [5] or 5 in a._refs
+
+
+def test_real_allocator_retain_free_exact_under_exploration():
+    """The REAL refcounted PageAllocator: a retain/free pin cycle racing
+    the owner's final free can never leak the page, free it twice (the
+    ValueError would surface as a scheduler error), or leave a stale
+    refcount — whatever the interleaving."""
+    from seldon_core_tpu.runtime.batcher import PageAllocator
+
+    def scenario(sched):
+        a = PageAllocator(total_pages=8, page_size=16)
+        page = a.alloc(1)[0]             # owner's reference
+        a.retain([page])                 # the trie's pin
+        a._page = page
+
+        def unpin():
+            a.free([a._page])
+
+        def owner_free():
+            a.free([a._page])
+
+        sched.spawn(unpin, name="unpin")
+        sched.spawn(owner_free, name="owner")
+        return a
+
+    def ok(a):
+        return a.refs_of(a._page) == 0 and a.stats()[1] == 0
+
+    assert find_race(scenario, ok, granularity="opcode",
+                     max_schedules=120, stall_s=STALL) is None
+
+
 def test_page_allocator_concurrent_admit_free_exact():
     """The REAL allocator (runtime/batcher.py) under exploration: two
     admit/free cycles racing a third concurrent admission can never

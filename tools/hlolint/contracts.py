@@ -42,6 +42,9 @@ POOL_PAGES = 10
 # the serving default, so the cost budget records the K+1=5-token-wide
 # verify forward serving actually dispatches
 SPEC_K = 4
+# reserved rows leading every staged page bucket (models/transformer.py
+# RESERVED_PAGES — named locally so the contract dims read in one place)
+RESERVED_PAGES_N = 2
 
 
 def ensure_platform() -> None:
@@ -375,6 +378,27 @@ def _build_set_hist_row():
                              _sds((), "int32"), _sds((MAX_LEN,), "int32"))
 
 
+def _build_cow_page_copy():
+    """Radix prefix cache, copy-on-write page copy (PR 12): ONE page's
+    values move src -> dst across layers, the position row masked to the
+    valid token count — the only copy a prefix hit can cost (full shared
+    blocks are block-table entries)."""
+    b = _paged_batcher()
+    return b._cow_page_copy, (_paged_cache_specs(), _sds((), "int32"),
+                              _sds((), "int32"), _sds((), "int32"))
+
+
+def _build_prefix_export():
+    """Radix prefix cache, disaggregated prefix export (PR 12): gather the
+    decode pool's cached-prefix pages into a handoff-shaped bucket (2
+    reserved rows + a power-of-two page bucket) for the D2D ship to a
+    prefill worker — the pool is NOT donated (the trie's pages stay
+    live), and the bytes are the bucket's, never the pool's."""
+    b = _paged_batcher()
+    return b._export_pages, (_paged_cache_specs(),
+                             _sds((RESERVED_PAGES_N + 2,), "int32"))
+
+
 def _build_jaxserver_predict():
     ensure_platform()
     import jax.numpy as jnp
@@ -592,6 +616,35 @@ def all_contracts() -> List[Contract]:
                         "the committed budget",
             build=_build_handoff_import,
             donated=(0,),
+            forbid_dtypes=((_f32_pool_sig(), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="batcher.cow_page_copy",
+            description="radix prefix cache copy-on-write page copy "
+                        "(PR 12): a slot continuing part-way into a "
+                        "shared cached block copies that ONE page into "
+                        "its own (values whole-page, position row masked "
+                        "past the valid tokens) — pool donated so the "
+                        "copy scatters in place, zero host transfers, "
+                        "bytes budgeted at one page not a prefix gather",
+            build=_build_cow_page_copy,
+            donated=(0,),
+            forbid_dtypes=((_f32_pool_sig(), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="disagg.prefix_export",
+            description="radix prefix cache disaggregated export "
+                        "(PR 12): cached-prefix pages gather into a "
+                        "handoff-shaped bucket for the D2D ship to a "
+                        "prefill worker (which then computes ONLY the "
+                        "uncached suffix) — the pool is NOT donated (the "
+                        "trie's pages stay live) and the cost budget "
+                        "pins the bucket's bytes, never the pool's",
+            build=_build_prefix_export,
             forbid_dtypes=((_f32_pool_sig(), F32_CACHE_WHY),),
             collectives={},
             cost=True,
