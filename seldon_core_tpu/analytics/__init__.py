@@ -3,6 +3,7 @@ transformers (capability of the reference's `components/routers/` and
 `components/outlier-detection/` trees, rebuilt JAX-native)."""
 
 from seldon_core_tpu.analytics.routers import EpsilonGreedy, ThompsonSampling
+from seldon_core_tpu.analytics.canary import CanaryRouter, ShadowNode
 from seldon_core_tpu.analytics.explainers import SaliencyExplainer
 from seldon_core_tpu.analytics.outliers import (
     MahalanobisOutlierDetector,
@@ -12,8 +13,10 @@ from seldon_core_tpu.analytics.outliers import (
 )
 
 __all__ = [
+    "CanaryRouter",
     "EpsilonGreedy",
     "SaliencyExplainer",
+    "ShadowNode",
     "ThompsonSampling",
     "MahalanobisOutlierDetector",
     "IsolationForestOutlierDetector",

@@ -196,6 +196,42 @@ class MahalanobisOutlierDetector(_OutlierTransformer):
                 jnp.asarray(rows, jnp.float32))
         return np.asarray(scores)[:rows]
 
+    def reset_stats(self) -> None:
+        """Zero the running statistics while KEEPING the compiled step:
+        the readiness-time prewarm pattern (score a dummy batch to pay
+        the jit compile up front, then reset) — the canary router uses it
+        so its first real evaluation, which runs under the router lock on
+        the serving thread, is a sub-ms compiled dispatch instead of a
+        multi-second trace+compile."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._state is None:
+                return
+            d = int(self._state[0].shape[0])
+            self._state = (
+                jnp.zeros((d,), jnp.float32),
+                jnp.eye(d, dtype=jnp.float32),
+                jnp.asarray(0.0, jnp.float32),
+            )
+
+    def score_frozen(self, X: np.ndarray) -> np.ndarray:
+        """Score WITHOUT folding the batch into the running statistics:
+        the state is saved before and restored after the (score-then-fold)
+        step.  The canary comparison needs this (analytics/canary.py):
+        candidate windows scored against the baseline distribution must
+        not shift that distribution toward themselves — a sustained
+        degradation would otherwise normalize itself out of rollback.
+        The save/score/restore triple is not atomic against concurrent
+        ``score`` calls; callers that mix both serialize externally (the
+        canary router holds its own lock)."""
+        with self._lock:
+            saved = self._state
+        scores = self.score(X)
+        with self._lock:
+            self._state = saved
+        return scores
+
     # jax buffers don't pickle portably; persist as numpy.
     def __getstate__(self):
         state = super().__getstate__()

@@ -1,8 +1,26 @@
 """Control plane: defaulting/validation and manifest rendering for
 SeldonDeployment-compatible CRs (capability of the reference's external Go
-operator + webhooks — SURVEY.md §2.8, §3.4)."""
+operator + webhooks — SURVEY.md §2.8, §3.4), plus the signal-driven
+autoscaler that closes the elastic loop (controlplane/autoscaler.py,
+docs/control-plane.md)."""
 
+from seldon_core_tpu.controlplane.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    ReplicaSignals,
+    decide_rebalance,
+    decide_scale,
+)
 from seldon_core_tpu.controlplane.validate import default_deployment, validate_deployment
 from seldon_core_tpu.controlplane.render import render_manifests
 
-__all__ = ["default_deployment", "validate_deployment", "render_manifests"]
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "ReplicaSignals",
+    "decide_rebalance",
+    "decide_scale",
+    "default_deployment",
+    "validate_deployment",
+    "render_manifests",
+]

@@ -282,6 +282,8 @@ class Operator:
         reconciler: Reconciler,
         interval: float = 2.0,
         status_dir: Optional[str] = None,
+        clock=None,
+        sleep=None,
     ):
         self.cr_dir = cr_dir
         self.reconciler = reconciler
@@ -289,8 +291,16 @@ class Operator:
         # separate from cr_dir when the CR source is read-only (e.g. a
         # mounted ConfigMap)
         self.status_dir = status_dir or os.path.join(cr_dir, ".status")
+        # Injectable time pair (the autoscaler's idiom,
+        # controlplane/autoscaler.py): ``clock`` stamps, ``sleep`` waits
+        # between passes. Tests hand in testing.faults.FaultClock and its
+        # advance so whole reconcile loops run in zero wall time — no
+        # time.sleep dependence in any operator test.
+        self.clock = clock if clock is not None else time.monotonic
+        self._sleep = sleep if sleep is not None else time.sleep
         self._seen: Dict[str, str] = {}  # cr name -> content hash
         self._sources: Dict[str, str] = {}  # cr name -> file path
+        self._wrote_status: set = set()  # names written THIS pass
         self._stop = False
 
     # ------------------------------------------------------------------
@@ -338,6 +348,33 @@ class Operator:
         with open(tmp, "w") as f:
             json.dump(status, f, indent=2)
         os.replace(tmp, path)
+        self._wrote_status.add(name)
+
+    def _sweep_stale_status(self, crs: Dict[str, Any]) -> List[str]:
+        """Remove status files no live CR backs: deleting a CR used to
+        orphan ``.status/<name>.json`` forever (the owned objects were
+        pruned but the status record accumulated).  A just-written status
+        (this pass — the 'Deleted' tombstone included, so one pass can
+        still read it) and any tracked or parsed CR's status are kept;
+        everything else is a leftover from a removed CR or a previous
+        operator incarnation."""
+        if not os.path.isdir(self.status_dir):
+            return []
+        swept = []
+        for fn in sorted(os.listdir(self.status_dir)):
+            if not fn.endswith(".json"):
+                continue
+            name = os.path.splitext(fn)[0]
+            if (name in crs or name in self._sources
+                    or name in self._wrote_status):
+                continue
+            try:
+                os.remove(os.path.join(self.status_dir, fn))
+            except OSError:
+                continue  # racing writer/reader: retry next pass
+            swept.append(name)
+            logger.info("swept stale status for removed CR %s", name)
+        return swept
 
     def read_status(self, name: str) -> Optional[Dict[str, Any]]:
         path = os.path.join(self.status_dir, f"{name}.json")
@@ -350,6 +387,7 @@ class Operator:
     def run_once(self) -> Dict[str, ReconcileResult]:
         """One reconcile pass; returns results for CRs that were acted on."""
         results: Dict[str, ReconcileResult] = {}
+        self._wrote_status = set()
         crs, parsed_paths = self._load_crs()
 
         # Deletions first, keyed on the tracked source path (covers CRs whose
@@ -390,6 +428,9 @@ class Operator:
                 name, "ok" if res.ok else f"FAILED: {res.problems}",
                 len(res.applied), len(res.deleted),
             )
+        # last: sweep status files no live CR backs (a 'Deleted' tombstone
+        # written above survives this pass and is swept on the next)
+        self._sweep_stale_status(crs)
         return results
 
     def run_forever(self) -> None:
@@ -397,11 +438,17 @@ class Operator:
         signal.signal(signal.SIGINT, lambda *_: setattr(self, "_stop", True))
         logger.info("operator watching %s every %.1fs", self.cr_dir, self.interval)
         while not self._stop:
+            t0 = self.clock()
             try:
                 self.run_once()
             except Exception:
                 # a broken pass (unwritable status dir, backend outage) must
                 # not crash-loop the controller; retry next tick
                 logger.exception("reconcile pass failed")
-            time.sleep(self.interval)
+            # constant cadence on the injected clock: the wait shrinks by
+            # the pass's own duration, so a slow reconcile (big cluster,
+            # kubectl round-trips) doesn't stretch the watch period to
+            # interval + pass time
+            elapsed = self.clock() - t0
+            self._sleep(max(self.interval - elapsed, 0.0))
         logger.info("operator stopped")

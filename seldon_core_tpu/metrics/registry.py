@@ -397,6 +397,79 @@ class MetricsRegistry:
             base + ["mode"],
             registry=self.registry,
         )
+        # Elastic control plane (controlplane/autoscaler.py +
+        # analytics/canary.py; docs/control-plane.md): fleet shape the
+        # autoscaler drives (replicas serving vs draining, scale/rebalance
+        # events), the canary rollout state machine, and the shadow
+        # divergence record — the loop's own observability, synced at
+        # scrape time by sync_controlplane (same catch-up idiom as the
+        # resilience counters).
+        self._autoscaler_replicas = Gauge(
+            "seldon_autoscaler_replicas",
+            "Replicas currently attached to the autoscaled ReplicaSet "
+            "(draining included until detach)",
+            base,
+            registry=self.registry,
+        )
+        self._autoscaler_draining = Gauge(
+            "seldon_autoscaler_draining_replicas",
+            "Replicas draining toward detach (no fleet traffic; in-flight "
+            "work completing)",
+            base,
+            registry=self.registry,
+        )
+        self._autoscaler_events = Counter(
+            "seldon_autoscaler_scale_events_total",
+            "Autoscaler actions applied, by kind (scale_up / scale_down / "
+            "rebalance / collect)",
+            base + ["action"],
+            registry=self.registry,
+        )
+        self._canary_phase = Gauge(
+            "seldon_canary_phase",
+            "Canary rollout phase per router node (0 canary, 1 promoted, "
+            "2 rolled back)",
+            base + ["node"],
+            registry=self.registry,
+        )
+        self._canary_rollbacks = Counter(
+            "seldon_canary_rollbacks_total",
+            "Automatic or manual canary rollbacks",
+            base + ["node"],
+            registry=self.registry,
+        )
+        self._canary_error_rate = Gauge(
+            "seldon_canary_error_rate",
+            "Windowed error rate per canary branch (baseline / candidate)",
+            base + ["node", "branch"],
+            registry=self.registry,
+        )
+        self._shadow_mirrors = Counter(
+            "seldon_shadow_mirrors_total",
+            "Requests mirrored to a shadow candidate (responses discarded)",
+            base + ["node"],
+            registry=self.registry,
+        )
+        self._shadow_divergences = Counter(
+            "seldon_shadow_divergences_total",
+            "Mirrored requests whose shadow output diverged from the "
+            "primary's",
+            base + ["node"],
+            registry=self.registry,
+        )
+        self._shadow_errors = Counter(
+            "seldon_shadow_errors_total",
+            "Shadow-side failures (swallowed — the client never sees them)",
+            base + ["node"],
+            registry=self.registry,
+        )
+        self._shadow_max_diff = Gauge(
+            "seldon_shadow_max_abs_diff",
+            "Largest absolute output divergence observed on the shadow "
+            "path",
+            base + ["node"],
+            registry=self.registry,
+        )
         # breakers publish transitions through on_transition; remember which
         # are wired so scrape-time syncs are idempotent
         self._bound_breakers: set = set()
@@ -489,6 +562,84 @@ class MetricsRegistry:
             delta = total - retained._value.get()
             if delta > 0:
                 retained.inc(delta)
+
+    # ------------------------------------------------------------------
+    # Elastic control plane observability (controlplane/autoscaler.py +
+    # analytics/canary.py)
+    # ------------------------------------------------------------------
+    def _counter_catch_up(self, counter, value: float, **labels) -> None:
+        """Counter catch-up from a component's lifetime tally (the
+        sync_resilience idiom: events are counted locally on the hot/loop
+        path; the scrape raises the Prometheus counter to match)."""
+        bound = counter.labels(**self._base(), **labels)
+        delta = value - bound._value.get()
+        if delta > 0:
+            bound.inc(delta)
+
+    def sync_controlplane(self, source: Any = None) -> None:
+        """Refresh autoscaler / canary / shadow series at scrape time.
+        ``source`` is an engine (its graph nodes are walked for canary and
+        shadow components, ``engine.autoscaler`` for the loop), a bare
+        component, or an Autoscaler; anything without the stats surfaces
+        is a no-op — the handler never needs to know what is deployed."""
+        if source is None:
+            return
+        named = []  # (node label, object)
+        autoscalers = []
+        state = getattr(source, "state", None)
+        if state is not None and hasattr(state, "walk"):
+            for unit in state.walk():
+                if unit.component is not None:
+                    named.append((unit.name, unit.component))
+        else:
+            named.append((getattr(source, "name", "") or "", source))
+        for obj in (source, getattr(source, "autoscaler", None)):
+            if obj is not None and hasattr(obj, "autoscaler_stats"):
+                autoscalers.append(obj)
+        for a in autoscalers:
+            stats = a.autoscaler_stats()
+            self._autoscaler_replicas.labels(**self._base()).set(
+                stats.get("autoscaler_replicas", 0))
+            self._autoscaler_draining.labels(**self._base()).set(
+                stats.get("autoscaler_draining", 0))
+            for action, key in (
+                ("scale_up", "autoscaler_scale_ups_total"),
+                ("scale_down", "autoscaler_scale_downs_total"),
+                ("rebalance", "autoscaler_rebalances_total"),
+                ("collect", "autoscaler_collected_total"),
+            ):
+                self._counter_catch_up(self._autoscaler_events,
+                                       stats.get(key, 0), action=action)
+        for node, comp in named:
+            canary_fn = getattr(comp, "canary_stats", None)
+            if canary_fn is not None:
+                stats = canary_fn()
+                self._canary_phase.labels(**self._base(), node=node).set(
+                    stats.get("canary_phase_code", 0))
+                self._counter_catch_up(
+                    self._canary_rollbacks,
+                    stats.get("canary_rollbacks_total", 0), node=node)
+                for branch, key in (
+                    ("baseline", "canary_baseline_error_rate"),
+                    ("candidate", "canary_candidate_error_rate"),
+                ):
+                    self._canary_error_rate.labels(
+                        **self._base(), node=node, branch=branch).set(
+                        stats.get(key, 0.0))
+            shadow_fn = getattr(comp, "shadow_stats", None)
+            if shadow_fn is not None:
+                stats = shadow_fn()
+                self._counter_catch_up(
+                    self._shadow_mirrors,
+                    stats.get("shadow_mirrors_total", 0), node=node)
+                self._counter_catch_up(
+                    self._shadow_divergences,
+                    stats.get("shadow_divergences_total", 0), node=node)
+                self._counter_catch_up(
+                    self._shadow_errors,
+                    stats.get("shadow_errors_total", 0), node=node)
+                self._shadow_max_diff.labels(**self._base(), node=node).set(
+                    stats.get("shadow_max_abs_diff", 0.0))
 
     # ------------------------------------------------------------------
     # LLM decode observability (servers/llmserver.py)

@@ -80,12 +80,16 @@ def scaling_snapshot(component: Any, batcher: Any = None,
         "page_pressure": 0.0,
         "page_sheds_total": 0,
         "handoff_queue_depth": 0,
+        "draining": False,
+        "prefill_devices": 0,
+        "decode_devices": 0,
     }
     if batcher is not None:
         snap["active_slots"] = sum(1 for s in batcher._slots if s.active)
         snap["total_slots"] = batcher.S
         snap["queue_depth"] = len(batcher._pending)
         snap["steps_in_flight"] = len(batcher._inflight)
+        snap["draining"] = bool(getattr(batcher, "draining", False))
         if getattr(batcher, "paged", False):
             pages = batcher.page_stats()
             total = max(pages["kv_pages_total"], 1)
@@ -94,6 +98,61 @@ def scaling_snapshot(component: Any, batcher: Any = None,
         if getattr(batcher, "_remote", None) is not None:
             snap["handoff_queue_depth"] = (
                 batcher.handoff_stats()["handoff_queue_depth"])
+            mesh = getattr(batcher, "disagg_mesh", None)
+            if mesh is not None:
+                # the prefill:decode split the autoscaler's rebalance
+                # actuator steers (controlplane/autoscaler.py)
+                snap["prefill_devices"] = len(mesh.prefill_devices)
+                snap["decode_devices"] = len(mesh.decode_devices)
     if recorder is not None:
         snap["requests"] = recorder.snapshot()
     return snap
+
+
+def retry_after_hint(component: Any, default_s: float = 1.0) -> float:
+    """The transport-side dynamic ``Retry-After`` for shed responses
+    (docs/resilience.md "Dynamic backoff"): components with a batcher
+    delegate to its backlog-derived hint
+    (``ContinuousBatcher.retry_after_hint`` — base x the full drain waves
+    queued ahead, doubled near page exhaustion); everything else keeps
+    the configured constant.  Wired into
+    ``AdmissionController.retry_after_fn`` by the REST/gRPC apps, and
+    called OUTSIDE any admission lock."""
+    batcher = _batcher(component)
+    hint = getattr(batcher, "retry_after_hint", None)
+    if hint is None:
+        return float(default_s)
+    # ``default_s`` is the admission controller's CONFIGURED constant
+    # (annotation/env): it stays the floor — the batcher hint (based on
+    # its own shed_retry_after_s knob) may only raise backoff above it,
+    # never silently undercut an operator's explicit setting
+    return max(float(hint()), float(default_s))
+
+
+def engine_retry_after_hint(engine: Any, default_s: float = 1.0) -> float:
+    """The engine-edge variant: the WORST (largest) backlog-derived hint
+    among the graph's in-process components, so a shed at the engine edge
+    reflects the busiest batcher behind it."""
+    comps = getattr(engine, "_components", {}) or {}
+    return max((retry_after_hint(c, default_s) for c in comps.values()),
+               default=float(default_s))
+
+
+def wire_retry_after(admission: Any, component: Any = None,
+                     engine: Any = None) -> Any:
+    """THE one place dynamic shed backoff is wired (docs/resilience.md
+    "Dynamic backoff"): installs ``retry_after_fn`` on an
+    AdmissionController unless one is already set.  All four transport
+    apps (REST/gRPC x component/engine) call this — hand-kept copies of
+    the closure were exactly the drift :func:`parse_n` exists to
+    prevent.  The fn runs outside the admission lock by the controller's
+    contract."""
+    if admission.retry_after_fn is not None:
+        return admission
+    if engine is not None:
+        admission.retry_after_fn = (
+            lambda: engine_retry_after_hint(engine, admission.retry_after_s))
+    elif component is not None:
+        admission.retry_after_fn = (
+            lambda: retry_after_hint(component, admission.retry_after_s))
+    return admission

@@ -513,35 +513,56 @@ class BatcherService:
 
         self.batcher = asyncio.run_coroutine_threadsafe(make(), self._loop).result()
         self.submitted = 0
+        # Requests handed to the loop whose futures have not resolved yet.
+        # This covers the drain blind window the batcher itself cannot see:
+        # between run_coroutine_threadsafe and the submit coroutine actually
+        # running on the loop thread, a request exists in NO batcher
+        # structure (_pending/_slots/_inflight) — is_idle() must still
+        # count it, or collect_drained could close a batcher holding a
+        # live client request.
+        self._inflight_reqs = 0
         # submit() runs on transport loops and submit_sync() on gRPC worker
-        # threads at once; the counter bump is a read-modify-write, and
+        # threads at once; the counter bumps are read-modify-writes, and
         # unlocked concurrent increments lose updates
         self._stats_lock = threading.Lock()
+
+    def _track(self, cfut):
+        """Count one submission in flight until its future settles (any
+        outcome — tokens, shed, error: settled means the batcher no longer
+        owes the client anything). Incremented BEFORE the caller can
+        observe the future, so is_idle() has no window where a submitted
+        request is invisible."""
+        with self._stats_lock:
+            self.submitted += 1
+            self._inflight_reqs += 1
+
+        def _settled(_f):
+            with self._stats_lock:
+                self._inflight_reqs -= 1
+
+        cfut.add_done_callback(_settled)
+        return cfut
 
     def submit_sync(self, prompt: Any, max_new_tokens: Optional[int] = None,
                     timeout_s: float = 600.0,
                     info: Optional[dict] = None,
                     seed: Optional[int] = None,
                     trace: Optional[Any] = None) -> List[int]:
-        with self._stats_lock:
-            self.submitted += 1
-        return asyncio.run_coroutine_threadsafe(
+        return self._track(asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, info=info, seed=seed,
                                 trace=trace),
             self._loop
-        ).result(timeout_s)
+        )).result(timeout_s)
 
     async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None,
                      on_token: Optional[Any] = None,
                      info: Optional[dict] = None,
                      seed: Optional[int] = None,
                      trace: Optional[Any] = None) -> List[int]:
-        with self._stats_lock:
-            self.submitted += 1
-        cfut = asyncio.run_coroutine_threadsafe(
+        cfut = self._track(asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
                                 info=info, seed=seed, trace=trace),
-            self._loop)
+            self._loop))
         return await asyncio.wrap_future(cfut)
 
     def submit_stream(self, prompt: Any,
@@ -554,12 +575,29 @@ class BatcherService:
         servicer): returns the concurrent.futures.Future of the final token
         list while ``on_token`` fires per token from the batcher's worker
         thread — the caller pumps its own response stream from them."""
-        with self._stats_lock:
-            self.submitted += 1
-        return asyncio.run_coroutine_threadsafe(
+        return self._track(asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
                                 info=info, seed=seed, trace=trace),
-            self._loop)
+            self._loop))
+
+    def drain(self) -> None:
+        """Scale-down drain mark (docs/control-plane.md): flips the
+        batcher's advisory flag — in-flight and queued work is untouched."""
+        self.batcher.drain()
+
+    def resume(self) -> None:
+        """Cancel a drain (scale-up arrived before detach): the warm
+        batcher rejoins fleet dispatch."""
+        self.batcher.resume()
+
+    def is_idle(self) -> bool:
+        """Detach gate for the autoscaler's collect sweep: the batcher's
+        own idle check AND zero unsettled service-level submissions — the
+        latter closes the window where a request scheduled onto the loop
+        thread is not yet visible in any batcher structure."""
+        with self._stats_lock:
+            busy = self._inflight_reqs
+        return busy == 0 and self.batcher.is_idle()
 
     def close(self) -> None:
         asyncio.run_coroutine_threadsafe(self.batcher.close(), self._loop).result(30)
@@ -754,6 +792,13 @@ class ContinuousBatcher:
                     cfg, server.kv_cache_dtype))
         self._prefill: Optional[_PrefillJob] = None
         self._admit_seq = 0
+        # Drain state (docs/control-plane.md "Drain semantics"): set by the
+        # autoscaler's scale-down path through ReplicaSet.drain_replica —
+        # fleet routing stops targeting this replica, but anything already
+        # queued or in flight here runs to completion, and a request that
+        # slipped through the routing race window is still served (a drain
+        # may delay detach; it must never fail a client).
+        self.draining = False
         self._inflight: Any = deque()
         self._inflight_hwm = 0       # max steps in flight ever reached
         self._last_admit_inflight = 0  # steps in flight at the last admit
@@ -934,6 +979,62 @@ class ContinuousBatcher:
             prefill_chunk=self.prefill_chunk if self.paged else 0)
         self._transfer = self._remote.queue
 
+    def rebalance_disagg(self, prefill_devices: int) -> bool:
+        """Move the prefill:decode device split to ``prefill_devices``
+        prefill devices — the autoscaler's TPU-native actuator
+        (controlplane/autoscaler.py; docs/control-plane.md "Rebalancing
+        the disagg split").  Zero requests are dropped and generation is
+        bit-exact across the move:
+
+        - the NEW worker pool publishes into the SAME TransferQueue, so
+          every registered job keeps its exactly-once delivery path;
+        - the OLD pool's close() drains its backlog first — workers
+          finish staged jobs and publish them before their threads join;
+        - workers run the server's own cached compiled prefill programs,
+          so WHERE prefill runs changes, never which KV bits come out
+          (tests/test_autoscaler.py parity, dense + paged).
+
+        Returns False when disaggregation is off, the split is already
+        there, or the requested split is infeasible (decode must keep the
+        process default device — the slot pool lives on it)."""
+        if self._remote is None:
+            return False
+        import jax
+
+        from seldon_core_tpu.parallel.mesh import disaggregated_mesh
+        from seldon_core_tpu.runtime.disagg import PrefillWorkerPool
+
+        n_pre = int(prefill_devices)
+        world = jax.devices()
+        if n_pre < 1 or n_pre >= len(world):
+            return False
+        if n_pre == len(self.disagg_mesh.prefill_devices):
+            return False
+        mesh = disaggregated_mesh(n_pre, 0)
+        default = world[0]
+        if default not in mesh.decode_devices:
+            return False
+        old = self._remote
+        new_pool = PrefillWorkerPool(
+            self.server, mesh.prefill_devices, default,
+            layout="paged" if self.paged else "dense",
+            max_len=self.max_len,
+            page_size=self.page_size if self.paged else 0,
+            n_pages=self.n_pages if self.paged else 0,
+            prefill_chunk=self.prefill_chunk if self.paged else 0,
+            queue=self._transfer)
+        self.disagg_mesh = mesh
+        # swap first (new admissions land on the new pool), then drain the
+        # old pool: an admission that grabbed the old reference mid-swap
+        # either submits before close (job drains normally) or gets the
+        # closed error and retries on the new pool (_admit_remote)
+        self._remote = new_pool
+        old.close()
+        logger.info("rebalanced disagg split to %d prefill / %d decode "
+                    "devices", len(mesh.prefill_devices),
+                    len(mesh.decode_devices))
+        return True
+
     def _get_handoff_import(self, staged_pages: Optional[int] = None):
         """Jitted staged-pool -> slot-pool page import (the decode-side
         half of the KV handoff). ``staged_pages`` is the page count of the
@@ -945,6 +1046,52 @@ class ContinuousBatcher:
         ``disagg.import_pages`` in tools/hlolint (zero host transfers,
         donation intact, bytes within budget)."""
         return self.server._get_handoff_import(self.n_pages, staged_pages)
+
+    def drain(self) -> None:
+        """Mark this batcher draining (scale-down): purely advisory state —
+        admission keeps working so nothing routed here can ever fail, but
+        the fleet dispatcher (ReplicaSet) stops targeting the replica and
+        the scaling snapshot reports the state."""
+        self.draining = True
+
+    def resume(self) -> None:
+        self.draining = False
+
+    def is_idle(self) -> bool:
+        """True when detaching this batcher cannot drop work: no queued
+        request, no occupied or prefilling slot, no in-flight step, no
+        staged local or remote prefill job.  The autoscaler's
+        ``collect_drained`` gate."""
+        return (not self._pending and not self._inflight
+                and self._prefill is None and not self._remote_jobs
+                and not any(s.active or s.prefilling for s in self._slots))
+
+    def retry_after_hint(self) -> float:
+        """Dynamic ``Retry-After`` for shed responses, derived from the
+        actual backlog instead of the fixed constant: the drain capacity
+        is S slots per wave, so a client retrying after
+        ``base x ceil(queued work / S)`` seconds arrives roughly when the
+        work ahead of it has drained — backoff scales with the exact
+        spike the autoscaler is reacting to, instead of stampeding back
+        into it.  Near page-pool exhaustion the hint doubles (pages free
+        slower than slots under LIFO shedding).  Clamped to
+        [base, 30s]."""
+        from seldon_core_tpu.runtime.resilience import DEFAULT_RETRY_AFTER_S
+
+        base = float(getattr(self.server, "shed_retry_after_s",
+                             DEFAULT_RETRY_AFTER_S))
+        queued = len(self._pending) + sum(
+            1 for s in self._slots if s.active or s.prefilling)
+        waves = -(-queued // max(self.S, 1))
+        hint = base * max(waves, 1)
+        if self.paged:
+            total, in_use, _ = self._allocator.stats()
+            usable = max(total - RESERVED_PAGES, 1)
+            if in_use / usable >= 0.9:
+                hint *= 2
+        # the cap must never undercut an explicitly configured base: a
+        # 60s floor stays 60s, it does not become 30s
+        return float(min(max(hint, base), max(30.0, base)))
 
     def handoff_stats(self) -> dict:
         """Transfer-queue counters for llm_stats/metrics: handoffs
@@ -997,7 +1144,15 @@ class ContinuousBatcher:
         decodes the identical token sequence through the batcher (each slot
         carries its own per-request key device-side)."""
         if self._closed:
-            raise RuntimeError("batcher closed")
+            # retryable, not a hard failure: the only way a live request
+            # reaches a closed batcher is the stale-dispatch tail of a
+            # scale-down (a pick held across multiple autoscaler ticks —
+            # docs/control-plane.md "Drain semantics"); a 503+Retry-After
+            # sends the client back through routing onto a live replica
+            from seldon_core_tpu.runtime.resilience import ShedError
+
+            raise ShedError("batcher closed (replica detached by "
+                            "scale-down); retry routes to a live replica")
         import time
 
         if isinstance(prompt, str):
@@ -1350,12 +1505,21 @@ class ContinuousBatcher:
                                     blocks=len(shared))
             self._flight.record(free, EV_HANDOFF_STAGED, job_id=job.job_id,
                                 pages=n0 - len(shared))
-        self._remote.submit(PrefillRequest(job.job_id, ids, plen, n0,
-                                           record_events=self._flight
-                                           is not None,
-                                           prefix_len=k0,
-                                           prefix_pages=len(shared),
-                                           prefix_staged=prefix_staged))
+        req = PrefillRequest(job.job_id, ids, plen, n0,
+                             record_events=self._flight is not None,
+                             prefix_len=k0,
+                             prefix_pages=len(shared),
+                             prefix_staged=prefix_staged)
+        pool = self._remote
+        try:
+            pool.submit(req)
+        except RuntimeError:
+            # a rebalance swapped the worker pool between our read of
+            # self._remote and the submit: the old pool is closing (its
+            # backlog drains into the SHARED TransferQueue, so nothing
+            # already staged is lost) — retry once on the new pool, which
+            # publishes into the same queue
+            self._remote.submit(req)
         return True
 
     def _consume_handoffs(self):
@@ -1743,12 +1907,14 @@ class ContinuousBatcher:
         return max(active, key=lambda j: self._slots[j].admit_seq)
 
     def _shed_error(self, why: str):
-        from seldon_core_tpu.runtime.resilience import (
-            DEFAULT_RETRY_AFTER_S, ShedError)
+        from seldon_core_tpu.runtime.resilience import ShedError
 
-        retry = getattr(self.server, "shed_retry_after_s", DEFAULT_RETRY_AFTER_S)
+        # Retry-After derived from the live backlog (retry_after_hint),
+        # not the fixed constant: during the exact spikes that cause
+        # sheds, a constant backoff stampedes every shed client back at
+        # once while the queue is still draining.
         return ShedError(f"kv page pool exhausted: {why}",
-                         retry_after_s=retry)
+                         retry_after_s=self.retry_after_hint())
 
     def _shed_request(self, fut: asyncio.Future, on_token: Optional[Any],
                       why: str):

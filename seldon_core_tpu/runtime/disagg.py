@@ -481,8 +481,14 @@ class PrefillWorkerPool:
 
     def __init__(self, server: Any, devices: Sequence, decode_device: Any,
                  *, layout: str, max_len: int, page_size: int = 0,
-                 n_pages: int = 0, prefill_chunk: int = 0):
-        self.queue = TransferQueue()
+                 n_pages: int = 0, prefill_chunk: int = 0,
+                 queue: Optional[TransferQueue] = None):
+        # ``queue``: adopt an EXISTING TransferQueue instead of creating
+        # one — the disagg-rebalance actuator builds the replacement pool
+        # on the batcher's live queue so jobs staged on the outgoing pool
+        # keep their exactly-once delivery path (runtime/batcher.py
+        # ``rebalance_disagg``).
+        self.queue = queue if queue is not None else TransferQueue()
         self.workers = [
             PrefillWorker(server, self.queue, dev, decode_device,
                           layout=layout, max_len=max_len,

@@ -30,6 +30,7 @@ import asyncio
 import inspect
 import logging
 import secrets
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
@@ -158,23 +159,198 @@ class ReplicaSet(SeldonComponent):
     dispatch is deterministic under equal load. With
     ``disaggregation="remote_prefill"`` replicas, this is the "N decode
     replicas + M prefill workers behind one predictor" topology
-    (docs/performance.md "Disaggregated serving")."""
+    (docs/performance.md "Disaggregated serving").
+
+    Elastic membership (docs/control-plane.md): the autoscaler
+    (controlplane/autoscaler.py) grows the set with ``add_replica`` and
+    shrinks it with ``drain_replica`` -> ``collect_drained``.  Draining
+    is the no-drop half of scale-down: a draining replica leaves the
+    dispatch pool IMMEDIATELY (no new fleet traffic), keeps serving its
+    queued and in-flight requests to completion, and is detached only
+    once provably idle — a scale decision can therefore never fail a
+    live request.  Membership mutates under ``self._lock`` (the
+    autoscaler thread races transport dispatch threads); dispatch works
+    on a locked snapshot so a mid-pick mutation can never index past the
+    list."""
 
     def __init__(self, replicas: List[SeldonComponent]):
         if not replicas:
             raise SeldonError("ReplicaSet needs >= 1 replica", status_code=500)
         self.replicas = list(replicas)
+        self._draining: List[SeldonComponent] = []
+        # replicas observed idle on the PREVIOUS collect sweep (by id):
+        # detach needs two consecutive idle observations — see
+        # collect_drained for the dispatch race this grace absorbs
+        self._idle_once: set = set()
+        self._lock = threading.Lock()
+        # one collect sweep at a time (non-blocking): concurrent sweeps
+        # (run_forever tick racing an admin tick) would otherwise count
+        # as two consecutive idle sightings microseconds apart —
+        # collapsing the grace — and double-close the detached batcher
+        self._collect_guard = threading.Lock()
+
+    # -- membership (autoscaler actuator surface) -----------------------
+    def members(self) -> List[SeldonComponent]:
+        """Snapshot of every attached replica, draining included (their
+        metrics/stats still aggregate until detach)."""
+        with self._lock:
+            return list(self.replicas)
+
+    def draining_members(self) -> List[SeldonComponent]:
+        with self._lock:
+            return list(self._draining)
+
+    def _dispatchable(self) -> List[SeldonComponent]:
+        """The replicas fleet dispatch may target: everyone not draining —
+        or, if literally everyone is draining (a config error the
+        autoscaler's min_replicas floor prevents), the full set, because
+        black-holing traffic is strictly worse than touching a draining
+        replica."""
+        with self._lock:
+            live = [r for r in self.replicas if r not in self._draining]
+            return live or list(self.replicas)
+
+    def add_replica(self, replica: SeldonComponent) -> None:
+        """Attach (and load) one replica; it becomes dispatchable
+        immediately."""
+        if hasattr(replica, "load"):
+            replica.load()
+        with self._lock:
+            self.replicas.append(replica)
+
+    def drain_replica(self, replica: Optional[SeldonComponent] = None
+                      ) -> Optional[SeldonComponent]:
+        """Begin draining ``replica`` (default: the newest non-draining
+        one — LIFO mirrors the page-shed victim order: the newest member
+        has the coldest caches).  Returns the replica now draining, or
+        None when nothing is eligible (a lone serving replica never
+        drains).  The replica's own ``drain()`` hook (BatcherService /
+        ContinuousBatcher) is informed so its admission surface reports
+        the state, but its in-flight work keeps running untouched."""
+        with self._lock:
+            candidates = [r for r in self.replicas
+                          if r not in self._draining]
+            if len(candidates) <= 1:
+                return None  # the last serving replica never drains
+            if replica is None:
+                replica = candidates[-1]
+            elif replica not in candidates:
+                return None
+            self._draining.append(replica)
+        hook = self._replica_hook(replica, "drain")
+        if hook is not None:
+            hook()
+        return replica
+
+    def undrain_replica(self) -> Optional[SeldonComponent]:
+        """Cancel the newest drain (the autoscaler's scale-up-mid-drain
+        path): the still-warm replica rejoins dispatch — loaded params,
+        hot KV/prefix caches — instead of a cold factory build.  Returns
+        the resumed replica, or None when nothing is draining."""
+        with self._lock:
+            if not self._draining:
+                return None
+            replica = self._draining.pop()
+            self._idle_once.discard(id(replica))
+        hook = self._replica_hook(replica, "resume")
+        if hook is not None:
+            hook()
+        return replica
+
+    @staticmethod
+    def _replica_hook(replica: SeldonComponent, name: str):
+        """The replica's drain/is_idle surface: on the component itself,
+        else on its batcher service (LLM replicas keep their serving
+        state there)."""
+        hook = getattr(replica, name, None)
+        if hook is not None:
+            return hook
+        svc = getattr(replica, "_batcher_service", None)
+        return getattr(svc, name, None) if svc is not None else None
+
+    def collect_drained(self) -> List[SeldonComponent]:
+        """Detach every draining replica that has gone idle (its own
+        ``is_idle()`` when exposed, else a zeroed ``replica_load``) and
+        close its batcher service.  Replicas still holding work stay
+        attached and keep serving it — this is the "let in-flight slots
+        finish, then detach" half of the drain contract.
+
+        Detach needs TWO consecutive idle sweeps plus an idle re-check
+        after removal (with reattach on failure): a dispatcher that
+        picked this replica just before the drain could submit after a
+        single idle observation, and closing under it would fail a live
+        request.  The grace bounds the remaining exposure to a pick held
+        across two full autoscaler ticks — and even that tail is
+        retryable, not fatal (a closed batcher sheds 503+Retry-After
+        back through routing).  One sweep runs at a time (concurrent
+        callers return [] immediately): overlapping sweeps would count
+        two "consecutive" sightings in one instant and detach twice."""
+        if not self._collect_guard.acquire(blocking=False):
+            return []
+        try:
+            return self._collect_locked()
+        finally:
+            self._collect_guard.release()
+
+    def _collect_locked(self) -> List[SeldonComponent]:
+        with self._lock:
+            draining = list(self._draining)
+        done = []
+        for r in draining:
+            idle_fn = self._replica_hook(r, "is_idle")
+
+            def idle() -> bool:
+                return idle_fn() if idle_fn is not None else \
+                    replica_load(r) == (0.0, 0.0)
+
+            if not idle():
+                with self._lock:
+                    self._idle_once.discard(id(r))
+                continue
+            with self._lock:
+                if id(r) not in self._idle_once:
+                    self._idle_once.add(id(r))  # first sighting: grace
+                    first_sighting = True
+                else:
+                    first_sighting = False
+            if first_sighting:
+                continue
+            with self._lock:
+                if r in self.replicas:
+                    self.replicas.remove(r)
+                if r in self._draining:
+                    self._draining.remove(r)
+            if not idle():
+                # a submit landed between the sweep check and removal:
+                # reattach and try again next tick — never close under it
+                with self._lock:
+                    self.replicas.append(r)
+                    self._draining.append(r)
+                    self._idle_once.discard(id(r))
+                continue
+            with self._lock:
+                self._idle_once.discard(id(r))
+            svc = getattr(r, "_batcher_service", None)
+            if svc is not None:
+                try:
+                    svc.close()
+                except Exception:  # detaching must not fail the tick
+                    logger.exception("closing drained replica's batcher")
+            done.append(r)
+        return done
 
     def load(self) -> None:
-        for r in self.replicas:
+        for r in self.members():
             if hasattr(r, "load"):
                 r.load()
 
     def pick(self) -> SeldonComponent:
-        """The least-loaded replica right now (scores re-read per call —
-        the signals mutate under their own locks on the serving path)."""
-        best, best_score = self.replicas[0], replica_load(self.replicas[0])
-        for r in self.replicas[1:]:
+        """The least-loaded dispatchable replica right now (scores re-read
+        per call — the signals mutate under their own locks on the
+        serving path)."""
+        reps = self._dispatchable()
+        best, best_score = reps[0], replica_load(reps[0])
+        for r in reps[1:]:
             score = replica_load(r)
             if score < best_score:
                 best, best_score = r, score
@@ -189,9 +365,10 @@ class ReplicaSet(SeldonComponent):
         anything (``prefix_match_len`` is an O(prompt) host-side probe
         under the replica's own locks: cheap enough to run per dispatch).
         Lowest index breaks full ties so routing stays deterministic."""
-        prompt = self._encode_once(prompt)
+        reps = self._dispatchable()
+        prompt = self._encode_once(prompt, reps)
         best, best_key = None, None
-        for i, r in enumerate(self.replicas):
+        for i, r in enumerate(reps):
             match = 0
             probe = getattr(r, "prefix_match_len", None)
             if probe is not None and prompt is not None:
@@ -201,7 +378,8 @@ class ReplicaSet(SeldonComponent):
                 best, best_key = r, key
         return best
 
-    def _encode_once(self, prompt: Any):
+    def _encode_once(self, prompt: Any,
+                     reps: Optional[List[SeldonComponent]] = None):
         """Tokenize a string prompt ONCE before fanning the probe out —
         per-replica `prefix_match_len(str)` would re-encode a growing
         chat transcript N times per dispatch (replicas share the
@@ -209,22 +387,23 @@ class ReplicaSet(SeldonComponent):
         gets the raw prompt)."""
         if not isinstance(prompt, str):
             return prompt
-        for r in self.replicas:
+        for r in (reps if reps is not None else self.members()):
             tok = getattr(r, "_tokenizer", None)
             if tok is not None:
                 return tok.encode(prompt)
         return prompt
 
     def loads(self) -> List[Tuple[float, float]]:
-        return [replica_load(r) for r in self.replicas]
+        return [replica_load(r) for r in self.members()]
 
     def prefix_match_len(self, prompt: Any) -> int:
         """Fleet-level probe: the best cached-prefix length any replica
         offers (lets ReplicaSets nest / upstream routers see the fleet's
         coverage as one number)."""
-        prompt = self._encode_once(prompt)
+        reps = self.members()
+        prompt = self._encode_once(prompt, reps)
         out = 0
-        for r in self.replicas:
+        for r in reps:
             probe = getattr(r, "prefix_match_len", None)
             if probe is not None:
                 out = max(out, int(probe(prompt)))
@@ -249,8 +428,9 @@ class ReplicaSet(SeldonComponent):
     def tags(self) -> Dict[str, Any]:
         from seldon_core_tpu.components.component import client_custom_tags
 
-        out: Dict[str, Any] = {"replicas": len(self.replicas)}
-        for i, r in enumerate(self.replicas):
+        reps = self.members()
+        out: Dict[str, Any] = {"replicas": len(reps)}
+        for i, r in enumerate(reps):
             for k, v in client_custom_tags(r).items():
                 out[f"replica_{i}_{k}"] = v
         return out
@@ -259,7 +439,7 @@ class ReplicaSet(SeldonComponent):
         """Aggregated snapshot for /metrics: numeric gauges/counters sum,
         drained lists concatenate (each replica's deques drain exactly
         once, same as solo), strings/configs come from replica 0."""
-        stats_list = [r.llm_stats() for r in self.replicas
+        stats_list = [r.llm_stats() for r in self.members()
                       if hasattr(r, "llm_stats")]
         if not stats_list:
             return {}
@@ -698,7 +878,36 @@ class GraphEngine:
                         reason="CIRCUIT_OPEN",
                     )
             else:
-                child_outputs = [await self._get_output(state.children[branch], transformed)]
+                # Routed-branch outcome observation: routers exposing
+                # ``observe_outcome(branch, latency_s, error)`` (the canary
+                # router, analytics/canary.py) see every routed request's
+                # subtree wall + error on the engine's INJECTABLE clock —
+                # which is what makes SLO comparison deterministic under
+                # FaultClock (tests/test_canary.py). Absent the hook this
+                # is one getattr per routed request.
+                observe = getattr(state.component, "observe_outcome", None)
+                if observe is None:
+                    child_outputs = [await self._get_output(
+                        state.children[branch], transformed)]
+                else:
+                    t0 = self.resilience.clock()
+                    try:
+                        child_outputs = [await self._get_output(
+                            state.children[branch], transformed)]
+                    except asyncio.CancelledError:
+                        # client disconnect says nothing about the branch
+                        # (the breaker rule, failure_counts_for_breaker):
+                        # a disconnect burst during a canary must not
+                        # land spurious errors in the candidate's small
+                        # window and roll back a healthy candidate
+                        raise
+                    except BaseException:
+                        self._observe_routed(
+                            observe, branch, self.resilience.clock() - t0,
+                            True)
+                        raise
+                    self._observe_routed(
+                        observe, branch, self.resilience.clock() - t0, False)
         else:
             child_outputs = []
 
@@ -735,6 +944,16 @@ class GraphEngine:
 
         self._record_path(out, state)
         return out
+
+    @staticmethod
+    def _observe_routed(observe, branch: int, latency_s: float,
+                        error: bool) -> None:
+        """Feed a routed request's outcome to the router's observation
+        hook; observability must never fail the data path."""
+        try:
+            observe(branch, latency_s, error=error)
+        except Exception:
+            logger.exception("router observe_outcome hook failed")
 
     @staticmethod
     def _subtree_available(state: UnitState) -> bool:

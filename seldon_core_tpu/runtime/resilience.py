@@ -324,10 +324,21 @@ class AdmissionController:
         max_inflight: int = 0,
         max_queue: int = 0,
         retry_after_s: float = DEFAULT_RETRY_AFTER_S,
+        retry_after_fn: Optional[Callable[[], float]] = None,
     ):
         self.max_inflight = int(max_inflight)
         self.max_queue = int(max_queue)
         self.retry_after_s = float(retry_after_s)
+        # Dynamic Retry-After (docs/resilience.md "Dynamic backoff"):
+        # when set, every shed's Retry-After is refined through this
+        # callable — transports wire it to the component's scaling
+        # snapshot (observability/timeline.py retry_after_hint) so
+        # backoff scales with the live queue depth / drain rate instead
+        # of the fixed constant. Called OUTSIDE self._lock: the hint
+        # reads batcher/allocator state under THEIR locks, and calling
+        # through while holding ours would create a cross-module lock
+        # order for an error path.
+        self.retry_after_fn = retry_after_fn
         self.inflight = 0
         self.shed_total = 0
         self.admitted_total = 0
@@ -371,13 +382,29 @@ class AdmissionController:
         """Build (and count) one shed. Callers hold ``self._lock``: the
         counter bump is a read-modify-write and the message reads the
         waiter queue — unlocked, concurrent sheds lose counts
-        (tests/test_schedules.py replays the exact interleaving)."""
+        (tests/test_schedules.py replays the exact interleaving). The
+        dynamic Retry-After refinement happens in ``_refine`` AFTER the
+        lock is released — never here."""
         self.shed_total += 1
         return ShedError(
             f"server at capacity: {self.inflight} in flight, "
             f"{len(self._waiters)}/{self.max_queue} queued",
             retry_after_s=self.retry_after_s,
         )
+
+    def _refine(self, err: ShedError) -> ShedError:
+        """Apply the dynamic Retry-After hint (``retry_after_fn``) to a
+        shed built under the lock. Called OUTSIDE ``self._lock`` by
+        contract (the hint reads batcher/allocator state under their own
+        locks); a failing hint falls back to the constant already on the
+        error."""
+        fn = self.retry_after_fn
+        if fn is not None:
+            try:
+                err.retry_after_s = float(fn())
+            except Exception:
+                pass  # a backoff hint must never mask the shed itself
+        return err
 
     def _try_admit_locked(self) -> bool:
         if self.inflight < self.max_inflight:
@@ -394,10 +421,14 @@ class AdmissionController:
             if self._try_admit_locked():
                 return
             if len(self._waiters) >= self.max_queue:
-                raise self._shed()
-            loop = asyncio.get_running_loop()
-            fut: asyncio.Future = loop.create_future()
-            self._waiters.append(("async", loop, fut))
+                err = self._shed()
+            else:
+                err = None
+                loop = asyncio.get_running_loop()
+                fut: asyncio.Future = loop.create_future()
+                self._waiters.append(("async", loop, fut))
+        if err is not None:
+            raise self._refine(err)
         try:
             await fut
         except asyncio.CancelledError:
@@ -415,10 +446,14 @@ class AdmissionController:
             if self._try_admit_locked():
                 return
             if len(self._waiters) >= self.max_queue:
-                raise self._shed()
-            event = threading.Event()
-            entry = ("sync", event)
-            self._waiters.append(entry)
+                err = self._shed()
+            else:
+                err = None
+                event = threading.Event()
+                entry = ("sync", event)
+                self._waiters.append(entry)
+        if err is not None:
+            raise self._refine(err)
         if not event.wait(timeout_s):
             with self._lock:
                 try:
@@ -430,10 +465,11 @@ class AdmissionController:
                     granted = False
                     err = self._shed()
             if not granted:
-                raise err
+                raise self._refine(err)
             self.release()  # outside the lock: release() takes it itself
             with self._lock:
-                raise self._shed()
+                err = self._shed()
+            raise self._refine(err)
 
     def release(self) -> None:
         """Finish one admitted request; hand its slot to the oldest waiter."""

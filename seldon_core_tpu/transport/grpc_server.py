@@ -99,6 +99,11 @@ def _component_methods(
 ) -> Dict[str, Dict[str, Callable]]:
     """method table: service -> rpc name -> (deserializer applied by handler)."""
     admission = admission or AdmissionController()
+    # dynamic Retry-After from the component's live backlog — the shared
+    # wiring keeps REST and gRPC in agreement (docs/resilience.md)
+    from seldon_core_tpu.observability.timeline import wire_retry_after
+
+    wire_retry_after(admission, component=component)
 
     def wrap(fn, req_from, method_name):
         def handler(request, context):
@@ -382,6 +387,9 @@ def make_engine_server(
     defaults from annotations/env — disabled unless configured."""
     metrics = metrics or MetricsRegistry()
     admission = admission or AdmissionController.from_annotations(annotations)
+    from seldon_core_tpu.observability.timeline import wire_retry_after
+
+    wire_retry_after(admission, engine=engine)
     own_loop = loop
     if own_loop is None:
         own_loop = asyncio.new_event_loop()

@@ -142,6 +142,11 @@ def make_component_app(
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or MetricsRegistry()
     admission = admission or AdmissionController.from_annotations(annotations)
+    # dynamic Retry-After: shed backoff derived from the component's live
+    # backlog instead of the fixed constant (docs/resilience.md)
+    from seldon_core_tpu.observability.timeline import wire_retry_after
+
+    wire_retry_after(admission, component=component)
     tracer = get_tracer()
 
     def handler(fn: Callable, parser: Callable, method_name: str):
@@ -207,6 +212,7 @@ def make_component_app(
     async def prom(request):
         metrics.sync_resilience(admission=admission, transport="rest")
         metrics.sync_llm(component)
+        metrics.sync_controlplane(component)
         metrics.sync_tracing()
         return web.Response(body=metrics.expose(), content_type="text/plain")
 
@@ -447,6 +453,11 @@ def _add_generate_routes(app: web.Application, component: Any,
         except Exception as e:
             code = str(getattr(e, "status_code", 500))
             metrics.observe_api_call("generate", code, time.perf_counter() - t0)
+            if isinstance(e, ShedError):
+                # page-exhaustion sheds surface here (the batcher's own
+                # 503 path): render the Retry-After header so clients see
+                # the backlog-derived backoff, not just the status body
+                return shed_response(e)
             return error_response(e)
 
     app.router.add_post("/v1/generate", generate)
@@ -471,6 +482,9 @@ def make_engine_app(
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or MetricsRegistry()
     admission = admission or AdmissionController.from_annotations(annotations)
+    from seldon_core_tpu.observability.timeline import wire_retry_after
+
+    wire_retry_after(admission, engine=engine)
     tracer = get_tracer()
     state = {"paused": False, "ready": True}
     app[web.AppKey("state", dict)] = state
@@ -595,6 +609,7 @@ def make_engine_app(
         metrics.sync_resilience(engine=engine, admission=admission, transport="rest")
         for comp in getattr(engine, "_components", {}).values():
             metrics.sync_llm(comp)
+        metrics.sync_controlplane(engine)
         metrics.sync_tracing()
         return web.Response(body=metrics.expose(), content_type="text/plain")
 

@@ -117,6 +117,58 @@ def test_bandit_graph_end_to_end():
     assert tag[1] == pytest.approx(1.0)
 
 
+@pytest.mark.parametrize("impl,params", [
+    ("EPSILON_GREEDY", [
+        {"name": "n_branches", "value": "2", "type": "INT"},
+        {"name": "epsilon", "value": "0.2", "type": "FLOAT"},
+        {"name": "seed", "value": "0", "type": "INT"},
+    ]),
+    ("THOMPSON_SAMPLING", [
+        {"name": "n_branches", "value": "2", "type": "INT"},
+        {"name": "seed", "value": "0", "type": "INT"},
+    ]),
+])
+def test_bandit_feedback_shifts_routing_mass(impl, params):
+    """ISSUE 14 satellite regression: send-feedback through the engine's
+    replay path must actually MOVE routing mass — not just flip a single
+    greedy argmax — for both bandit families, because the canary router
+    (analytics/canary.py) shares this exact reward path.  Seeded, so the
+    mass comparison is deterministic."""
+    graph = {
+        "name": "b",
+        "type": "ROUTER",
+        "implementation": impl,
+        "parameters": params,
+        "children": [
+            {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+            {"name": "c", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+    engine = GraphEngine(PredictorSpec.from_dict({"name": "p", "graph": graph}))
+
+    def mass(n=40):
+        counts = [0, 0]
+        for _ in range(n):
+            out = run(engine.predict(msg([1.0], [1, 1]))).to_dict()
+            counts[out["meta"]["routing"]["b"]] += 1
+        return counts
+
+    before = mass()
+    for _ in range(15):  # reward branch 1, punish branch 0 — end to end
+        for branch, reward in ((1, 1.0), (0, 0.0)):
+            fb = Feedback.from_dict({
+                "request": {"data": {"ndarray": [[1.0]]}},
+                "response": {"meta": {"routing": {"b": branch}}},
+                "reward": reward,
+            })
+            run(engine.send_feedback(fb))
+    after = mass()
+    assert after[1] > before[1], (
+        f"{impl}: feedback did not shift routing mass "
+        f"(before {before}, after {after})")
+    assert after[1] >= 30  # the rewarded branch now dominates
+
+
 # ------------------------------------------------------------- outliers
 def test_mahalanobis_scores_outliers_higher():
     rng = np.random.default_rng(0)

@@ -139,12 +139,20 @@ def test_batcher_truncation_reported_via_info(server):
 
 
 def test_batcher_rejects_after_close(server):
+    """A closed batcher rejects with a RETRYABLE shed (503 + Retry-After),
+    not a hard RuntimeError: since the elastic control plane (ISSUE 14)
+    a batcher is closed by scale-down detach, and a stale dispatch that
+    reaches it must bounce back through routing onto a live replica
+    instead of failing the client (docs/control-plane.md)."""
+    from seldon_core_tpu.runtime.resilience import ShedError
+
     async def go():
         batcher = ContinuousBatcher(server, max_slots=1, max_len=32, len_buckets=(8,))
         await batcher.submit([1, 2], max_new_tokens=2)
         await batcher.close()
-        with pytest.raises(RuntimeError):
+        with pytest.raises(ShedError) as e:
             await batcher.submit([3], max_new_tokens=2)
+        assert e.value.status_code == 503
 
     asyncio.run(go())
 

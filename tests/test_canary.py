@@ -1,0 +1,392 @@
+"""Canary + shadow rollout components (ISSUE 14): deterministic traffic
+splits, automatic rollback on TTFT/error-rate degradation vs baseline
+(compared through the analytics outlier machinery), and shadow mirroring
+that can never fail a client.  Everything runs on the injectable clock —
+latency is "measured" by FaultyComponent advancing a FaultClock the engine
+also times with, so the whole warmup -> canary -> rollback cycle replays
+exactly with zero wall-clock dependence."""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.analytics.canary import (
+    BASELINE,
+    CANARY,
+    CANDIDATE,
+    PROMOTED,
+    ROLLED_BACK,
+    CanaryRouter,
+    ShadowNode,
+    canary_split,
+    evaluate_canary,
+)
+from seldon_core_tpu.components.component import SeldonComponent
+from seldon_core_tpu.contracts.graph import PredictorSpec
+from seldon_core_tpu.contracts.payload import Feedback, SeldonMessage
+from seldon_core_tpu.runtime.engine import GraphEngine
+from seldon_core_tpu.runtime.resilience import ResilienceConfig
+from seldon_core_tpu.testing.faults import (
+    FaultClock,
+    FaultSchedule,
+    FaultSpec,
+    FaultyComponent,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def msg(values=(1.0,), shape=(1, 1)):
+    return SeldonMessage.from_dict(
+        {"data": {"tensor": {"shape": list(shape), "values": list(values)}}})
+
+
+class Echo(SeldonComponent):
+    def predict(self, X, names, meta=None):
+        return X
+
+
+X = np.array([[1.0]])
+
+
+# ------------------------------------------------------------- split math
+def test_canary_split_is_deterministic_and_proportional():
+    seq = [canary_split(n, 0.25) for n in range(100)]
+    assert seq == [canary_split(n, 0.25) for n in range(100)]  # pure
+    assert sum(seq) == 25  # exactly the fraction over a whole window
+    # candidate requests are spread, not front-loaded
+    assert canary_split(3, 0.25) == CANDIDATE
+    assert canary_split(0, 0.25) == BASELINE
+    assert all(canary_split(n, 0.0) == BASELINE for n in range(10))
+    assert all(canary_split(n, 1.0) == CANDIDATE for n in range(10))
+
+
+def test_router_split_and_phase_routing():
+    r = CanaryRouter(fraction=0.5, min_samples=1000)  # never evaluates
+    routes = [r.route(X, []) for _ in range(20)]
+    assert routes.count(CANDIDATE) == 10
+    r.rollback("operator said so")
+    assert all(r.route(X, []) == BASELINE for _ in range(10))
+    assert r.canary_stats()["canary_rollbacks_total"] == 1
+    assert r.tags()["canary_phase"] == ROLLED_BACK
+
+
+# ------------------------------------------------------- decision function
+def _fed_detector(baseline_rows):
+    from seldon_core_tpu.analytics.outliers import MahalanobisOutlierDetector
+
+    det = MahalanobisOutlierDetector(threshold=3.0)
+    det.score(np.asarray(baseline_rows, dtype=np.float64)[:, None])
+    return det
+
+
+def test_evaluate_canary_latency_degradation():
+    det = _fed_detector([0.01] * 16)
+    reason = evaluate_canary(
+        [0.01] * 16, [0.5] * 8, [], [], det,
+        min_samples=8, outlier_fraction=0.5, max_error_rate_excess=0.2)
+    assert reason is not None and "outlier" in reason
+
+
+def test_evaluate_canary_holds_on_parity():
+    det = _fed_detector([0.01] * 16)
+    reason = evaluate_canary(
+        [0.01] * 16, [0.01] * 8, [0] * 16, [0] * 8, det,
+        min_samples=8, outlier_fraction=0.5, max_error_rate_excess=0.2)
+    assert reason is None
+
+
+def test_evaluate_canary_error_excess():
+    det = _fed_detector([0.01] * 16)
+    reason = evaluate_canary(
+        [0.01] * 16, [0.01] * 8, [0] * 16, [1] * 8, det,
+        min_samples=8, outlier_fraction=0.5, max_error_rate_excess=0.2)
+    assert reason is not None and "error rate" in reason
+
+
+def test_evaluate_canary_needs_min_samples():
+    det = _fed_detector([0.01] * 16)
+    assert evaluate_canary(
+        [0.01] * 16, [9.9] * 3, [], [], det,
+        min_samples=8, outlier_fraction=0.5, max_error_rate_excess=0.2
+    ) is None
+
+
+# --------------------------------------------- engine-fed rollback (TTFT)
+def _canary_engine(router, candidate, clock):
+    graph = {
+        "name": "cr",
+        "type": "ROUTER",
+        "children": [
+            {"name": "base", "type": "MODEL"},
+            {"name": "cand", "type": "MODEL"},
+        ],
+    }
+    return GraphEngine(
+        PredictorSpec.from_dict({"name": "p", "graph": graph}),
+        components={"cr": router, "base": Echo(), "cand": candidate},
+        resilience=ResilienceConfig(clock=clock),
+    )
+
+
+def test_engine_canary_rolls_back_on_latency_and_drops_no_request():
+    """The rollback half of the ISSUE 14 scenario: the candidate answers
+    CORRECTLY but slowly (FaultClock latency injection — no request ever
+    fails), the engine times every routed branch on the same clock and
+    feeds the router's observe_outcome, and the canary rolls back once the
+    candidate's latency is a statistical outlier vs baseline.  Zero failed
+    client requests: before, during, and after the rollback."""
+    clock = FaultClock()
+    router = CanaryRouter(fraction=0.25, min_samples=4, eval_every=4,
+                          outlier_fraction=0.5)
+    slow = FaultyComponent(FaultSchedule.always_ok(latency_s=0.5),
+                           clock=clock)
+    engine = _canary_engine(router, slow, clock)
+
+    ok = 0
+    for _ in range(40):
+        out = run(engine.predict(msg()))
+        assert out.data is not None
+        ok += 1
+        if router.phase == ROLLED_BACK:
+            break
+    assert router.phase == ROLLED_BACK
+    assert "outlier" in router.rollback_reason
+    candidate_hits = slow.calls
+    # after rollback everything routes to baseline and still succeeds
+    for _ in range(20):
+        out = run(engine.predict(msg()))
+        assert out.data is not None
+        ok += 1
+        assert out.meta.routing["cr"] == BASELINE
+    assert slow.calls == candidate_hits  # candidate never touched again
+    stats = router.canary_stats()
+    assert stats["canary_rollbacks_total"] == 1
+    assert stats["canary_phase_code"] == 2
+    # every request of every phase succeeded: the slow-but-correct canary
+    # and the rollback itself failed ZERO client requests
+    assert ok >= 21
+
+
+def test_engine_canary_holds_on_healthy_candidate():
+    clock = FaultClock()
+    router = CanaryRouter(fraction=0.25, min_samples=4, eval_every=4)
+    healthy = FaultyComponent(FaultSchedule.always_ok(latency_s=0.0),
+                              clock=clock)
+    engine = _canary_engine(router, healthy, clock)
+    for _ in range(40):
+        run(engine.predict(msg()))
+    assert router.phase == CANARY
+    assert router.evaluations_total >= 1  # it DID evaluate, and held
+
+
+def test_error_rate_rollback_via_shared_feedback_path():
+    """The canary shares the bandit reward path: feedback rewards < 0.5
+    count as errors, and a candidate error-rate excess rolls back without
+    any latency signal at all."""
+    router = CanaryRouter(fraction=0.5, min_samples=4, eval_every=2,
+                          max_error_rate_excess=0.2)
+    for _ in range(8):
+        router.send_feedback(X, [], 1.0, None, routing=BASELINE)
+    for _ in range(8):
+        router.send_feedback(X, [], 0.0, None, routing=CANDIDATE)
+    assert router.phase == ROLLED_BACK
+    assert "error rate" in router.rollback_reason
+    # the inherited bandit counters kept counting too (shared plumbing)
+    assert router.pulls[BASELINE] == 8 and router.pulls[CANDIDATE] == 8
+    assert router.fail_sum[CANDIDATE] == pytest.approx(8.0)
+
+
+def test_promotion_after_clean_evaluations():
+    router = CanaryRouter(fraction=0.5, min_samples=2, eval_every=2,
+                          promote_after=3)
+    clock = FaultClock()
+    engine = _canary_engine(
+        router, FaultyComponent(FaultSchedule.always_ok(), clock=clock),
+        clock)
+    for _ in range(30):
+        run(engine.predict(msg()))
+        if router.phase == PROMOTED:
+            break
+    assert router.phase == PROMOTED
+    assert all(router.route(X, []) == CANDIDATE for _ in range(5))
+
+
+def test_rollback_through_engine_feedback_replay():
+    """End-to-end over the engine's feedback REPLAY path (the same wire
+    the bandit regression in tests/test_analytics.py pins): feedback
+    carrying the response's routing meta reaches the router keyed by unit
+    name."""
+    clock = FaultClock()
+    router = CanaryRouter(fraction=0.5, min_samples=3, eval_every=1,
+                          max_error_rate_excess=0.2)
+    # sync candidate: feedback replays down the routed branch, and the
+    # replay path delivers to each unit's component synchronously
+    engine = _canary_engine(router, Echo(), clock)
+    for branch, reward in ((BASELINE, 1.0), (BASELINE, 1.0), (BASELINE, 1.0),
+                           (CANDIDATE, 0.0), (CANDIDATE, 0.0),
+                           (CANDIDATE, 0.0)):
+        fb = Feedback.from_dict({
+            "request": {"data": {"ndarray": [[1.0]]}},
+            "response": {"meta": {"routing": {"cr": branch}}},
+            "reward": reward,
+        })
+        run(engine.send_feedback(fb))
+    assert router.phase == ROLLED_BACK
+
+
+# ----------------------------------------------------------- shadow node
+class Doubler(SeldonComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+
+class Crasher(SeldonComponent):
+    def predict(self, X, names, meta=None):
+        raise RuntimeError("shadow boom")
+
+
+def test_shadow_mirrors_and_records_divergence():
+    clock = FaultClock()
+    node = ShadowNode(Echo(), Doubler(), mirror_fraction=0.5, clock=clock)
+    for _ in range(10):
+        out = node.predict(X, ["a"])
+        assert np.array_equal(out, X)  # client always sees the primary
+    stats = node.shadow_stats()
+    assert stats["shadow_mirrors_total"] == 5
+    assert stats["shadow_divergences_total"] == 5
+    assert stats["shadow_max_abs_diff"] == pytest.approx(1.0)
+    assert stats["shadow_errors_total"] == 0
+
+
+def test_shadow_failure_never_reaches_the_client():
+    node = ShadowNode(Echo(), Crasher(), mirror_fraction=1.0,
+                      clock=FaultClock())
+    for _ in range(5):
+        assert np.array_equal(node.predict(X, ["a"]), X)
+    stats = node.shadow_stats()
+    assert stats["shadow_errors_total"] == 5
+    assert stats["shadow_divergences_total"] == 0
+
+
+def test_shadow_latency_delta_on_fault_clock():
+    clock = FaultClock()
+    slow = FaultyComponent(FaultSchedule.always_ok(latency_s=0.3),
+                           clock=clock, is_async=False)
+
+    class SlowSync(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            clock.advance(0.3)
+            return X
+
+    node = ShadowNode(Echo(), SlowSync(), mirror_fraction=1.0, clock=clock)
+    node.predict(X, ["a"])
+    stats = node.shadow_stats()
+    assert stats["shadow_latency_delta_s_sum"] == pytest.approx(0.3)
+    assert stats["shadow_divergences_total"] == 0
+    assert slow.calls == 0  # unrelated faulty component untouched
+
+
+def test_shadow_generate_compares_token_lists():
+    class Gen(SeldonComponent):
+        def __init__(self, toks):
+            super().__init__()
+            self.toks = toks
+
+        def generate(self, prompts=None, **kw):
+            return [list(self.toks)]
+
+    node = ShadowNode(Gen([1, 2, 3]), Gen([1, 2, 4]), mirror_fraction=1.0,
+                      clock=FaultClock())
+    assert node.generate(prompts=[[5]]) == [[1, 2, 3]]
+    assert node.shadow_stats()["shadow_divergences_total"] == 1
+    same = ShadowNode(Gen([7]), Gen([7]), mirror_fraction=1.0,
+                      clock=FaultClock())
+    same.generate(prompts=[[5]])
+    assert same.shadow_stats()["shadow_divergences_total"] == 0
+
+
+def test_shadow_in_engine_graph():
+    graph = {"name": "sh", "type": "MODEL"}
+    node = ShadowNode(Echo(), Doubler(), mirror_fraction=1.0,
+                      clock=FaultClock())
+    engine = GraphEngine(
+        PredictorSpec.from_dict({"name": "p", "graph": graph}),
+        components={"sh": node})
+    out = run(engine.predict(msg())).to_dict()
+    assert out["data"]["tensor"]["values"] == [1.0]
+    assert node.shadow_stats()["shadow_mirrors_total"] == 1
+
+
+def test_score_frozen_does_not_fold_candidate_into_baseline():
+    """Review regression: candidate windows are scored WITHOUT folding —
+    a sustained degradation must not drag the baseline statistics toward
+    itself and normalize out of rollback."""
+    import numpy as np
+
+    det = _fed_detector([0.01] * 32)
+    first = det.score_frozen(np.full((8, 1), 0.5))
+    # score the SAME degraded window many times: with score() each pass
+    # would fold 0.5s into the running stats and the scores would decay;
+    # frozen scoring is idempotent
+    for _ in range(5):
+        again = det.score_frozen(np.full((8, 1), 0.5))
+    np.testing.assert_allclose(again, first)
+    assert (again > det.threshold).all()
+
+
+def test_sustained_degradation_still_rolls_back_after_many_evals():
+    """The end-to-end shape of the same regression: a candidate that is
+    steadily 50x baseline keeps scoring as an outlier across repeated
+    evaluations (windows re-scored every eval_every observations) instead
+    of normalizing itself into acceptance."""
+    router = CanaryRouter(fraction=0.5, min_samples=16, eval_every=2,
+                          outlier_fraction=0.5)
+    # interleave: baseline fast, candidate slow, many evaluation rounds
+    # before the sample floor is reached — every pre-floor eval re-scores
+    # (and with the old fold bug would have re-folded) the window
+    for _ in range(16):
+        router.observe_outcome(BASELINE, 0.01)
+        router.observe_outcome(CANDIDATE, 0.5)
+    assert router.phase == ROLLED_BACK
+
+
+def test_terminal_phase_stops_baseline_accumulation():
+    """Review regression: a rolled-back router serves baseline traffic
+    forever but never evaluates again — it must not keep buffering
+    baseline latencies (one float per request, unbounded)."""
+    router = CanaryRouter(fraction=0.5, min_samples=2, eval_every=1,
+                          max_error_rate_excess=0.1)
+    router.rollback("test")
+    for _ in range(100):
+        router.observe_outcome(BASELINE, 0.01)
+    assert len(router._baseline_unfolded) == 0
+    # and in CANARY phase the buffer is bounded regardless
+    live = CanaryRouter(fraction=0.5, window=8, min_samples=10_000)
+    for _ in range(10_000):
+        live.observe_outcome(BASELINE, 0.01)
+    assert len(live._baseline_unfolded) <= max(4 * live.window, 256)
+
+
+def test_client_cancellation_is_not_a_branch_error():
+    """Review regression: a client disconnect (CancelledError) mid-branch
+    says nothing about the branch — a disconnect burst during a canary
+    must not land spurious errors in the candidate's window and roll back
+    a healthy candidate (the breaker's failure_counts_for_breaker rule)."""
+    clock = FaultClock()
+    router = CanaryRouter(fraction=1.0, min_samples=2, eval_every=1,
+                          max_error_rate_excess=0.1)
+    cancel = FaultyComponent(
+        FaultSchedule([FaultSpec(error=asyncio.CancelledError())]),
+        clock=clock)
+    engine = _canary_engine(router, cancel, clock)
+    for _ in range(6):
+        with pytest.raises(asyncio.CancelledError):
+            run(engine.predict(msg()))
+    assert list(router._err[CANDIDATE]) == []  # no error samples recorded
+    assert router.phase == CANARY              # and no rollback

@@ -274,3 +274,98 @@ def test_readonly_cr_dir_separate_status(tmp_path):
         assert not (cr_dir / ".status").exists()
     finally:
         os.chmod(cr_dir, stat.S_IRWXU)
+
+
+# -----------------------------------------------------------------------
+# ISSUE 14 satellites: injectable clock through the watch loop + stale
+# status sweep on CR deletion
+# -----------------------------------------------------------------------
+def test_run_forever_under_fault_clock(tmp_path):
+    """The reconcile loop runs entirely on the injected clock/sleep pair:
+    N passes complete in zero wall time (the FaultClock advances instead
+    of time.sleep), and the loop stops cleanly from the sleep hook."""
+    from seldon_core_tpu.testing.faults import FaultClock
+
+    clock = FaultClock()
+    cr_dir = tmp_path / "crs"
+    cr_dir.mkdir()
+    cluster = FileCluster(str(tmp_path / "cluster"))
+    passes = []
+
+    op = Operator(str(cr_dir), Reconciler(cluster), interval=2.0)
+
+    def fake_sleep(seconds):
+        clock.advance(seconds)
+        passes.append(clock.now())
+        if len(passes) >= 3:
+            op._stop = True
+
+    op.clock = clock
+    op._sleep = fake_sleep
+    write_cr(cr_dir, "m1", single_model_cr())
+    op.run_forever()
+    assert passes == [1002.0, 1004.0, 1006.0]  # 3 passes x 2.0s, no wall time
+    assert op.read_status("m1")["state"] == "Available"
+
+    # constant cadence: a pass that burns clock time shortens the wait
+    # (interval - elapsed), so the watch period never stretches
+    op2 = Operator(str(cr_dir), Reconciler(cluster), interval=2.0,
+                   clock=clock, sleep=None)
+    waits = []
+
+    def slow_pass():
+        clock.advance(0.5)
+        return {}
+
+    op2.run_once = slow_pass
+    op2._sleep = lambda s: (waits.append(s),
+                            setattr(op2, "_stop", True))
+    op2.run_forever()
+    assert waits == [1.5]
+
+
+def test_deleted_cr_status_swept_after_tombstone_pass(tmp_path):
+    """Deleting a CR used to orphan .status/<name>.json forever: the
+    deletion pass still writes the 'Deleted' tombstone (readable for one
+    pass), and the NEXT pass sweeps it — .status converges to exactly the
+    live CRs."""
+    op, cluster, cr_dir = make_operator(tmp_path)
+    write_cr(cr_dir, "m1", single_model_cr("m1"))
+    write_cr(cr_dir, "m2", single_model_cr("m2"))
+    op.run_once()
+    os.remove(cr_dir / "m1.json")
+    op.run_once()
+    assert op.read_status("m1")["state"] == "Deleted"  # tombstone readable
+    op.run_once()
+    assert op.read_status("m1") is None                # swept
+    assert op.read_status("m2")["state"] == "Available"  # live CR kept
+
+
+def test_sweep_ignores_unparseable_cr_status(tmp_path):
+    """A torn-write CR reports Failed every pass; its status must survive
+    the sweep while the broken file exists, and go away once the file is
+    removed."""
+    op, cluster, cr_dir = make_operator(tmp_path)
+    (cr_dir / "junk.json").write_text("{not json")
+    op.run_once()
+    assert op.read_status("junk")["state"] == "Failed"
+    op.run_once()
+    assert op.read_status("junk")["state"] == "Failed"  # kept while present
+    os.remove(cr_dir / "junk.json")
+    op.run_once()
+    op.run_once()
+    assert op.read_status("junk") is None
+
+
+def test_sweep_clears_leftovers_from_previous_incarnation(tmp_path):
+    """Status files from a dead operator (no CR file backs them) are
+    swept on the first pass of a fresh one."""
+    op, cluster, cr_dir = make_operator(tmp_path)
+    write_cr(cr_dir, "m1", single_model_cr("m1"))
+    op.run_once()
+    # a fresh operator over the same dirs, with m1's CR gone
+    os.remove(cr_dir / "m1.json")
+    op2 = Operator(str(cr_dir), Reconciler(cluster),
+                   status_dir=op.status_dir)
+    op2.run_once()
+    assert op2.read_status("m1") is None

@@ -273,6 +273,63 @@ def test_spec_controller_locked_observe_is_clean(tmp_path):
     assert rules_of(reported) == []
 
 
+AUTOSCALER = """
+    import threading
+
+    class Autoscaler:
+        # the PR 14 elastic-control-loop shape: tick() runs on the
+        # controller thread (run_forever) AND from admin triggers, while
+        # autoscaler_stats() serves /metrics scrape threads — the
+        # tally/history block is the only shared state (decisions are
+        # pure functions)
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._ticks_total = 0
+            self._scale_ups_total = 0
+
+        def tick(self, over):
+            self._ticks_total += 1           # pre-fix: unlocked RMW
+            if over:
+                self._scale_ups_total += 1   # pre-fix: unlocked RMW
+
+        def autoscaler_stats(self):
+            with self._lock:
+                return {"ticks": self._ticks_total,
+                        "ups": self._scale_ups_total}
+"""
+
+
+def test_autoscaler_unlocked_tick_fires(tmp_path):
+    """The PR 14 controller discipline: autoscaler_stats establishes the
+    guarded pattern on the tallies; an unlocked tick() is the lost-update
+    race tests/test_schedules.py finds and replays dynamically."""
+    root = write_tree(tmp_path / "pkg",
+                      {"controlplane/autoscaler.py": AUTOSCALER})
+    reported, _, _ = lint(root)
+    us = [f for f in reported if f.rule == "unguarded-shared-state"]
+    assert us, "the unlocked tick tallies must fire"
+    assert any("_ticks_total" in f.message or "_scale_ups_total" in f.message
+               for f in us)
+
+
+def test_autoscaler_locked_tick_is_clean(tmp_path):
+    fixed = AUTOSCALER.replace(
+        "        def tick(self, over):\n"
+        "            self._ticks_total += 1           # pre-fix: unlocked RMW\n"
+        "            if over:\n"
+        "                self._scale_ups_total += 1   # pre-fix: unlocked RMW",
+        "        def tick(self, over):\n"
+        "            with self._lock:\n"
+        "                self._ticks_total += 1\n"
+        "                if over:\n"
+        "                    self._scale_ups_total += 1")
+    assert fixed != AUTOSCALER
+    root = write_tree(tmp_path / "pkg",
+                      {"controlplane/autoscaler.py": fixed})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
 def test_unguarded_read_against_guarded_writes_fires(tmp_path):
     """The CircuitBreaker.state_code class: guarded writes establish the
     discipline, an unguarded public read violates it."""

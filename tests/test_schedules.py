@@ -1012,3 +1012,144 @@ def test_flight_recorder_completions_exact_under_exploration():
 
     assert find_race(_real_recorder_scenario, ok, granularity="line",
                      max_schedules=120, stall_s=STALL) is None
+
+
+# ---------------------------------------------------------------------------
+# PR 14: elastic-control-loop controller state (controlplane/autoscaler.py)
+# — the controller thread's tick() races the /metrics scrape's
+# autoscaler_stats() and a second (admin-triggered) tick; the decision
+# functions are pure, so the ONLY shared state is the tally/history block
+# the lock guards.  The reconstruction below drops that lock and loses a
+# tick under a found opcode schedule; the real Autoscaler survives the
+# same concurrent shape.
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_autoscaler_tick_tally_lost_update():
+    """Reconstruction of the bug Autoscaler._lock exists to prevent: two
+    concurrent control passes (the run_forever thread and an admin
+    trigger) bump the tick/scale tallies with unlocked read-modify-writes
+    — an interleaving loses a scale-up, so /metrics under-reports the
+    actions actually applied.  Found by opcode exploration, replayed
+    deterministically."""
+
+    class UnlockedTallies:
+        # the tally block of Autoscaler.tick(), lock dropped
+        def __init__(self):
+            self._ticks_total = 0
+            self._scale_ups_total = 0
+
+        def note_tick(self, scaled_up):
+            self._ticks_total += 1
+            if scaled_up:
+                self._scale_ups_total += 1
+
+    def scenario(sched):
+        t = UnlockedTallies()
+        sched.spawn(lambda: t.note_tick(True), name="loop-tick")
+        sched.spawn(lambda: t.note_tick(True), name="admin-tick")
+        return t
+
+    def ok(t):
+        return t._ticks_total == 2 and t._scale_ups_total == 2
+
+    bad = find_race(scenario, ok, granularity="opcode",
+                    max_schedules=200, stall_s=STALL)
+    assert bad is not None, "unlocked tick tallies must lose an update"
+    t, _, _ = run_schedule(scenario, schedule=bad.to_list(),
+                           granularity="opcode", stall_s=STALL)
+    assert not ok(t)  # the lost tick, replayed
+
+
+class _SchedStubReplica:
+    def __init__(self):
+        self.draining = False
+
+    def load(self):
+        pass
+
+    def drain(self):
+        self.draining = True
+
+    def is_idle(self):
+        return False  # never collected mid-scenario: membership is stable
+
+
+def _real_autoscaler_scenario(sched):
+    """The REAL Autoscaler under the threads that actually share it: two
+    concurrent ticks (run_forever + admin trigger) over an overloaded
+    snapshot, racing a /metrics scrape.  Config makes every tick decide
+    scale-up (stability window 1, cooldown 0, clock pinned)."""
+    from seldon_core_tpu.controlplane.autoscaler import (
+        Autoscaler, AutoscalerConfig)
+    from seldon_core_tpu.runtime.engine import ReplicaSet
+
+    rs = ReplicaSet([_SchedStubReplica()])
+    auto = Autoscaler(
+        rs,
+        config=AutoscalerConfig(
+            min_replicas=1, max_replicas=8, up_queue_per_slot=1.0,
+            up_stable_ticks=1, cooldown_s=0.0),
+        replica_factory=_SchedStubReplica,
+        clock=lambda: 100.0,
+        snapshot_fn=lambda r: {"queue_depth": 8, "total_slots": 2},
+    )
+    auto._rs = rs
+    sched.spawn(auto.tick, name="loop-tick")
+    sched.spawn(auto.tick, name="admin-tick")
+    sched.spawn(auto.autoscaler_stats, name="scrape")
+    return auto
+
+
+def test_real_autoscaler_tallies_exact_under_exploration():
+    """Both ticks decide scale-up; whatever the interleaving, the tallies
+    come out exact, the fleet grows by exactly two replicas, and the
+    racing scrape never observes corruption (tick counter can only be
+    0..2)."""
+
+    def ok(auto):
+        stats = auto.autoscaler_stats()
+        return (stats["autoscaler_ticks_total"] == 2
+                and stats["autoscaler_scale_ups_total"] == 2
+                and len(auto._rs.members()) == 3)
+
+    assert find_race(_real_autoscaler_scenario, ok, granularity="line",
+                     max_schedules=80, stall_s=STALL) is None
+
+
+def _replica_set_membership_scenario(sched):
+    """Controller-vs-serving interleaving: the autoscaler's actuators
+    (add_replica / drain_replica / collect sweep) race live dispatch
+    (pick) on the fleet."""
+    from seldon_core_tpu.runtime.engine import ReplicaSet
+
+    r1, r2 = _SchedStubReplica(), _SchedStubReplica()
+    rs = ReplicaSet([r1, r2])
+    picks = []
+    rs._picks = picks
+    sched.spawn(lambda: rs.add_replica(_SchedStubReplica()),
+                name="scale-up")
+    sched.spawn(rs.drain_replica, name="scale-down")
+    sched.spawn(lambda: picks.append(rs.pick()), name="dispatch")
+    sched.spawn(rs.collect_drained, name="sweep")
+    return rs
+
+
+def test_replica_set_membership_safe_under_exploration():
+    """Whatever order the actuators and dispatch interleave in: dispatch
+    always lands on an attached replica, exactly one replica ends up
+    draining (none were idle, so none detached), and membership is
+    consistent."""
+
+    def ok(rs):
+        members = rs.members()
+        draining = rs.draining_members()
+        return (len(members) == 3
+                and len(draining) == 1
+                and all(d in members for d in draining)
+                and len(rs._picks) == 1
+                and rs._picks[0] in members)
+
+    assert find_race(_replica_set_membership_scenario, ok,
+                     granularity="line", max_schedules=100,
+                     stall_s=STALL) is None
