@@ -128,18 +128,24 @@ def _maybe_continuous_batch(component: Any, request: SeldonMessage):
 
     import asyncio
 
+    # multi-tenant identity as jsonData fields (docs/multitenancy.md) —
+    # the /predict surface carries no custom headers, so tenant / SLO
+    # class / adapter ride the body here
+    ident = dict(tenant=body.get("tenant"), slo_class=body.get("slo_class"),
+                 adapter=body.get("adapter"))
+
     try:
         asyncio.get_running_loop()
     except RuntimeError:
         # sync transport (gRPC worker thread): block this thread only
         return to_msg(svc.submit_sync(body["prompt"], body.get("max_new_tokens"),
-                                      info=info, trace=trace))
+                                      info=info, trace=trace, **ident))
 
     async def run():
         # async transport (graph engine, REST app, ring handler): never block
         # the event loop while the shared batch decodes
         toks = await svc.submit(body["prompt"], body.get("max_new_tokens"),
-                                info=info, trace=trace)
+                                info=info, trace=trace, **ident)
         return to_msg(toks)
 
     return run()

@@ -367,6 +367,61 @@ class MetricsRegistry:
             base,
             registry=self.registry,
         )
+        # Multi-tenant serving (runtime/adapters.py + runtime/scheduler.py;
+        # docs/multitenancy.md): the adapter pool's occupancy/churn/bytes,
+        # per-(tenant, SLO-class) admission/shed/token tallies — the quota
+        # and fairness audit trail — and per-class TTFT so the interactive
+        # SLO is observable separately from the batch class it shares the
+        # slots with.
+        self._adapter_loaded = Gauge(
+            "seldon_llm_adapter_loaded",
+            "LoRA adapters currently resident in the dense pool "
+            "(identity row excluded)",
+            base,
+            registry=self.registry,
+        )
+        self._adapter_evictions = Counter(
+            "seldon_llm_adapter_evictions_total",
+            "Adapters evicted from the pool (refcount-zero rows freed "
+            "for reuse)",
+            base,
+            registry=self.registry,
+        )
+        self._adapter_pool_bytes = Gauge(
+            "seldon_llm_adapter_pool_bytes",
+            "HBM bytes held by the dense LoRA adapter pool (all rows, "
+            "loaded or free)",
+            base,
+            registry=self.registry,
+        )
+        self._tenant_admitted = Counter(
+            "seldon_tenant_admitted_total",
+            "Requests admitted into the continuous batch, by tenant and "
+            "SLO class",
+            base + ["tenant", "slo_class"],
+            registry=self.registry,
+        )
+        self._tenant_shed = Counter(
+            "seldon_tenant_shed_total",
+            "Requests shed (quota breach at push, page-exhaustion victim, "
+            "staged-job shed), by tenant and SLO class",
+            base + ["tenant", "slo_class"],
+            registry=self.registry,
+        )
+        self._tenant_tokens = Counter(
+            "seldon_tenant_tokens_total",
+            "Tokens generated and credited, by tenant and SLO class",
+            base + ["tenant", "slo_class"],
+            registry=self.registry,
+        )
+        self._tenant_ttft = Histogram(
+            "seldon_llm_tenant_ttft_seconds",
+            "Time to first token by SLO class (the interactive-isolation "
+            "signal bench phase L gates on)",
+            base + ["slo_class"],
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
         # Tracing/flight-recorder observability (tracing/__init__.py +
         # runtime/flight.py): spans lost to export failures (a batch is
         # re-enqueued once; the second failure drops it — without this
@@ -752,6 +807,30 @@ class MetricsRegistry:
         delta = stats.get("spec_slot_steps_total", 0) - steps._value.get()
         if delta > 0:
             steps.inc(delta)
+        # multi-tenant serving: adapter-pool gauges refresh from the
+        # registry snapshot; per-(tenant, class) counters catch up from
+        # the scheduler's lifetime tallies (admissions/sheds/tokens are
+        # counted on the batcher loop — same idiom as the page-shed
+        # counter), and per-class TTFT observations drain into the
+        # labelled histogram
+        self._adapter_loaded.labels(**self._base()).set(
+            stats.get("adapter_loaded", 0))
+        self._adapter_pool_bytes.labels(**self._base()).set(
+            stats.get("adapter_pool_bytes", 0))
+        self._counter_catch_up(self._adapter_evictions,
+                               stats.get("adapter_evictions_total", 0))
+        for row in stats.get("tenant_counters", ()):
+            labels = {"tenant": row.get("tenant", ""),
+                      "slo_class": row.get("slo_class", "")}
+            self._counter_catch_up(self._tenant_admitted,
+                                   row.get("admitted", 0), **labels)
+            self._counter_catch_up(self._tenant_shed,
+                                   row.get("shed", 0), **labels)
+            self._counter_catch_up(self._tenant_tokens,
+                                   row.get("tokens", 0), **labels)
+        for cls, seconds in stats.get("ttft_by_class", ()):
+            self._tenant_ttft.labels(
+                **self._base(), slo_class=cls).observe(seconds)
 
     # ------------------------------------------------------------------
     def register_custom(self, response: SeldonMessage) -> None:

@@ -259,13 +259,37 @@ def gather_paged_view(cache, block_tables: jnp.ndarray, dtype):
     return k_all, v_all, pos_pool[bt].reshape(b, L)
 
 
+def lora_delta(x: jnp.ndarray, A: jnp.ndarray, B: jnp.ndarray,
+               adapter_ids: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Batched low-rank delta for one adapted projection (S-LoRA /
+    Punica-style): gather each sequence's factors from the dense adapter
+    pool by its slot's ``adapter_ids`` entry, then one einsum pair —
+    ``(x @ A[id]) @ B[id] * scale[id]``.
+
+    x: [b, s, d_in]; A: [n_adapters, d_in, r]; B: [n_adapters, r, d_out];
+    adapter_ids: [b] int32; scale: [n_adapters] f32 (alpha / rank).
+    Row 0 is the reserved identity (zero factors, zero scale), so a batch
+    of untenanted slots computes an exact-zero delta through the SAME
+    program — ``base + 0`` is bitwise ``base``, which is what lets one
+    compiled step serve adapted and base traffic with identical outputs
+    for the base slots (runtime/adapters.py)."""
+    dt = x.dtype
+    a = A[adapter_ids]                      # [b, d_in, r]   (the gather)
+    b = B[adapter_ids]                      # [b, r, d_out]
+    s = scale[adapter_ids].astype(dt)       # [b]
+    h = jnp.einsum("bsd,bdr->bsr", x, a.astype(dt))
+    return jnp.einsum("bsr,bro->bso", h, b.astype(dt)) * s[:, None, None]
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x, positions, cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
                  cache_index: Optional[jnp.ndarray] = None,
-                 block_tables: Optional[jnp.ndarray] = None):
+                 block_tables: Optional[jnp.ndarray] = None,
+                 adapters: Optional[dict] = None,
+                 adapter_ids: Optional[jnp.ndarray] = None):
         """x: [b, s, d]. With cache=(k_cache, v_cache, pos_cache) of
         [b, max_len, kvh, hd] / [b, max_len] — or the int8 layout
         (k_q, k_scale, v_q, v_scale, pos_cache) with int8 values and
@@ -311,7 +335,15 @@ class Attention(nn.Module):
         )
 
         dt = cfg.dtype
-        q = (x @ wq.astype(dt)).reshape(b, s, cfg.n_heads, hd)
+        q_flat = x @ wq.astype(dt)
+        if adapters is not None:
+            # batched LoRA (runtime/adapters.py): per-slot low-rank delta
+            # on q and (below) o — NEVER on k/v, so the KV written from a
+            # given hidden state is base-model-pure for every tenant and
+            # the paged pool/prefix machinery stays tenant-agnostic
+            q_flat = q_flat + lora_delta(x, *adapters["wq"], adapter_ids,
+                                         adapters["scale"])
+        q = q_flat.reshape(b, s, cfg.n_heads, hd)
         k = (x @ wk.astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
         v = (x @ wv.astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
 
@@ -464,15 +496,19 @@ class Attention(nn.Module):
             probs = jax.nn.softmax(logits, axis=-1).astype(dt)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all.astype(dt))
         out = out.reshape(b, s, cfg.n_heads * hd)
-        out = out @ wo.astype(dt)
-        return out, new_cache
+        proj = out @ wo.astype(dt)
+        if adapters is not None:
+            proj = proj + lora_delta(out, *adapters["wo"], adapter_ids,
+                                     adapters["scale"])
+        return proj, new_cache
 
 
 class DenseFFN(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapters: Optional[dict] = None,
+                 adapter_ids: Optional[jnp.ndarray] = None):
         cfg = self.cfg
         w1 = param_with_axes("w1", nn.initializers.lecun_normal(), (cfg.dim, cfg.ffn_dim), jnp.float32,
                              axes=("embed", "mlp"))
@@ -481,7 +517,19 @@ class DenseFFN(nn.Module):
         w3 = param_with_axes("w3", nn.initializers.lecun_normal(), (cfg.dim, cfg.ffn_dim), jnp.float32,
                              axes=("embed", "mlp"))
         dt = cfg.dtype
-        return (jax.nn.silu(x @ w1.astype(dt)) * (x @ w3.astype(dt))) @ w2.astype(dt)
+        up = x @ w1.astype(dt)
+        gate = x @ w3.astype(dt)
+        if adapters is not None:
+            up = up + lora_delta(x, *adapters["w1"], adapter_ids,
+                                 adapters["scale"])
+            gate = gate + lora_delta(x, *adapters["w3"], adapter_ids,
+                                     adapters["scale"])
+        h = jax.nn.silu(up) * gate
+        down = h @ w2.astype(dt)
+        if adapters is not None:
+            down = down + lora_delta(h, *adapters["w2"], adapter_ids,
+                                     adapters["scale"])
+        return down
 
 
 class MoEFFN(nn.Module):
@@ -528,11 +576,11 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, cache=None, cache_index=None,
-                 block_tables=None):
+                 block_tables=None, adapters=None, adapter_ids=None):
         cfg = self.cfg
         h, new_cache = Attention(cfg, name="attention")(
             RMSNorm(cfg.dim, cfg.norm_eps, name="attention_norm")(x), positions, cache, cache_index,
-            block_tables,
+            block_tables, adapters, adapter_ids,
         )
         ffn_norm = RMSNorm(cfg.dim, cfg.norm_eps, name="ffn_norm")
         if cfg.fused_norm:
@@ -549,7 +597,7 @@ class TransformerBlock(nn.Module):
         if cfg.n_experts > 0:
             f = MoEFFN(cfg, name="moe")(ffn_in)
         else:
-            f = DenseFFN(cfg, name="ffn")(ffn_in)
+            f = DenseFFN(cfg, name="ffn")(ffn_in, adapters, adapter_ids)
         return x + f, new_cache
 
 
@@ -558,14 +606,25 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, caches=None, cache_index=None,
-                 block_tables=None):
+                 block_tables=None, adapters=None, adapter_ids=None):
         """tokens: [b, s] int32. Returns (logits [b, s, vocab], new_caches).
         ``block_tables`` ([b, n_pages] int32, shared by every layer) switches
-        the caches to the paged-pool layout — see Attention."""
+        the caches to the paged-pool layout — see Attention.
+
+        ``adapters`` (the dense LoRA pool pytree from
+        runtime/adapters.py: {proj: (A [N, L, d_in, r], B [N, L, r,
+        d_out]), "scale": [N]}) plus ``adapter_ids`` ([b] int32) turn on
+        per-sequence batched low-rank deltas on the q/o/FFN projections —
+        each layer slices its own factors out of the pool and applies one
+        gather+einsum pair per adapted projection (``lora_delta``).
+        adapter id 0 is the reserved zero-delta identity."""
         cfg = self.cfg
         b, s = tokens.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        if adapters is not None and adapter_ids is None:
+            raise ValueError("adapters need adapter_ids (one id per "
+                             "sequence; 0 = identity)")
         emb = param_with_axes(
             "tok_embeddings", nn.initializers.normal(stddev=0.02), (cfg.vocab_size, cfg.dim),
             jnp.float32, axes=("vocab", "embed"),
@@ -575,8 +634,17 @@ class Transformer(nn.Module):
         new_caches = []
         for i in range(cfg.n_layers):
             layer_cache = caches[i] if caches is not None else None
+            layer_adapters = None
+            if adapters is not None:
+                # slice this layer's factors: [N, L, ...] -> [N, ...]
+                layer_adapters = {
+                    proj: (ab[0][:, i], ab[1][:, i])
+                    for proj, ab in adapters.items() if proj != "scale"
+                }
+                layer_adapters["scale"] = adapters["scale"]
             x, nc = TransformerBlock(cfg, name=f"layer_{i}")(
-                x, positions, layer_cache, cache_index, block_tables)
+                x, positions, layer_cache, cache_index, block_tables,
+                layer_adapters, adapter_ids)
             new_caches.append(nc)
         x = RMSNorm(cfg.dim, cfg.norm_eps, name="norm")(x)
         if cfg.tie_embeddings:

@@ -83,11 +83,23 @@ def scaling_snapshot(component: Any, batcher: Any = None,
         "draining": False,
         "prefill_devices": 0,
         "decode_devices": 0,
+        # multi-tenant: queued admissions per SLO class (the weighted-fair
+        # scheduler's split of queue_depth — runtime/scheduler.py)
+        "queue_by_class": {},
     }
     if batcher is not None:
         snap["active_slots"] = sum(1 for s in batcher._slots if s.active)
         snap["total_slots"] = batcher.S
-        snap["queue_depth"] = len(batcher._pending)
+        sched = batcher._pending
+        if hasattr(sched, "depths"):
+            # ONE scheduler-lock read: queue_depth derives from the same
+            # snapshot as its per-class split, so the two can never
+            # disagree within one scaling snapshot
+            by_class = sched.depths()
+            snap["queue_by_class"] = by_class
+            snap["queue_depth"] = sum(by_class.values())
+        else:
+            snap["queue_depth"] = len(sched)
         snap["steps_in_flight"] = len(batcher._inflight)
         snap["draining"] = bool(getattr(batcher, "draining", False))
         if getattr(batcher, "paged", False):

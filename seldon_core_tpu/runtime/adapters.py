@@ -1,0 +1,415 @@
+"""Batched LoRA adapter registry: many tenants' low-rank deltas in one
+dense HBM pool, applied inside the shared decode/prefill/verify programs.
+
+The multi-tenant story (ROADMAP item 5, the reference platform's
+multi-model graphs made TPU-native): hundreds of tenants share one set of
+base weights, each bringing a small low-rank adapter (LoRA; Hu et al.
+2021), and heterogeneous tenants ride ONE continuous batch at near-base
+throughput — the S-LoRA / Punica design (Sheng et al. 2023, Chen et al.
+2023): adapters live in a dense ``[n_adapters, ...]`` pool, each batch
+slot carries an ``adapter_id``, and the compiled step gathers the slot's
+A/B factors and adds ``(x @ A) @ B * scale`` per adapted projection — one
+extra gather+einsum pair, no per-tenant program, no recompilation when
+tenants come and go.
+
+Design points:
+
+- **adapter_id 0 is the reserved identity.** Row 0 of every pool factor
+  is zeros and its scale is 0, so untenanted traffic runs THE SAME
+  compiled program with a provably-zero delta (``x @ 0 = 0`` exactly, and
+  ``q + 0 == q`` bitwise — identity-adapter slots are bit-exact against
+  the unadapted program; tests/test_adapters.py pins it). One program
+  shape serves base and adapted traffic alike.
+- **q / o / FFN projections only — never K/V.** The K and V projection
+  weights stay base-model weights for every tenant, so the per-layer KV
+  computation from a given hidden state is identical across tenants and
+  the paged pool holds every tenant's cache in one shape/dtype. Loading
+  an adapter that carries k/v factors raises ValueError at load time —
+  adapting K/V would fork the KV-cache semantics per tenant (see
+  docs/multitenancy.md "The KV-purity invariant" for what this does and
+  does not buy: hidden states downstream of an adapted projection still
+  embed the delta, so the radix prefix trie serves BASE-adapter traffic
+  only; adapted admissions skip trie match/insert).
+- **load/evict through the storage layer, refcounted like pool pages.**
+  ``load_uri`` fetches ``adapter.json`` + ``weights.npz`` via
+  seldon_core_tpu.storage (gs://, s3://, file://...); ``load`` takes
+  in-memory factors. A live batcher slot ``pin``s its adapter at
+  admission and ``unpin``s at release, and ``evict`` refuses while the
+  refcount is nonzero — the pool can never drop an adapter a live slot's
+  next dispatch would gather (the PR 7/12 page-refcount invariant, proven
+  under deterministic interleaving in tests/test_schedules.py).
+- **pool writes are NOT donated.** Loading swaps in fresh pool arrays
+  (functional ``.at[row].set``) under the lock instead of donating the
+  old buffers: a dispatch that read the old pool reference microseconds
+  earlier still holds valid arrays, so adapter management can never
+  invalidate an in-flight step. Loads are control-plane-rate events; the
+  one-row copy is noise next to that safety.
+
+Concurrency: every public method takes ``self._lock``. Loads/evicts come
+from management calls on transport threads, pins/unpins from the batcher
+loop's offload context, ``pool()`` from every dispatch, and ``stats()``
+from /metrics scrape threads. racelint models the class
+(tests/test_racelint.py fixture pair) and tests/test_schedules.py proves
+the unlocked reconstruction loses updates while the real registry
+survives opcode exploration.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["AdapterRegistry", "ADAPTED_PROJECTIONS", "FORBIDDEN_PROJECTIONS",
+           "IDENTITY_ADAPTER_ID", "DEFAULT_LORA_RANK"]
+
+# The adapted projections, by base-weight name: attention q and o plus the
+# three SwiGLU FFN mats. K and V are deliberately absent — the KV-purity
+# invariant above; load() rejects factors for them by name.
+ADAPTED_PROJECTIONS = ("wq", "wo", "w1", "w2", "w3")
+FORBIDDEN_PROJECTIONS = ("wk", "wv")
+
+IDENTITY_ADAPTER_ID = 0
+DEFAULT_LORA_RANK = 8
+
+
+def projection_dims(cfg) -> Dict[str, Tuple[int, int]]:
+    """(d_in, d_out) per adapted projection for a TransformerConfig —
+    the ONE place the pool shapes come from, shared by the registry and
+    the load-time shape validation."""
+    attn = cfg.n_heads * cfg.head_dim
+    return {
+        "wq": (cfg.dim, attn),
+        "wo": (attn, cfg.dim),
+        "w1": (cfg.dim, cfg.ffn_dim),
+        "w2": (cfg.ffn_dim, cfg.dim),
+        "w3": (cfg.dim, cfg.ffn_dim),
+    }
+
+
+def _row_write_op():
+    """Jitted pool-row writes, process-shared like the batcher's
+    _page_table_ops (jax.jit caches per shape). NOT donated — see the
+    module docstring: the old pool buffers must stay valid for any
+    dispatch that already fetched them."""
+    op = _row_write_op.__dict__.get("op")
+    if op is not None:
+        return op
+    import jax
+
+    @jax.jit
+    def set_row(pool, row, value):
+        return pool.at[row].set(value)
+
+    _row_write_op.op = set_row
+    return set_row
+
+
+class _AdapterMeta:
+    __slots__ = ("name", "row", "alpha", "pins")
+
+    def __init__(self, name: str, row: int, alpha: float):
+        self.name = name
+        self.row = row
+        self.alpha = alpha
+        self.pins = 0  # live slots referencing this adapter
+
+
+class AdapterRegistry:
+    """See module docstring. ``cfg`` is the model's TransformerConfig
+    (pool shapes derive from it), ``rank`` the shared pool rank (every
+    adapter in one pool has one rank — the gather is dense), and
+    ``max_adapters`` the pool row count INCLUDING the reserved identity
+    row 0."""
+
+    def __init__(self, cfg, rank: int, max_adapters: int = 8,
+                 dtype: Optional[Any] = None):
+        import jax
+        import jax.numpy as jnp
+
+        if rank < 1:
+            raise ValueError(f"lora_rank={rank} must be >= 1")
+        if max_adapters < 2:
+            raise ValueError(
+                f"lora_max_adapters={max_adapters} must be >= 2 (row 0 is "
+                f"the reserved identity adapter)")
+        self.cfg = cfg
+        self.rank = int(rank)
+        self.max_adapters = int(max_adapters)
+        self.n_layers = int(cfg.n_layers)
+        self.dtype = jnp.dtype(dtype if dtype is not None else cfg.dtype)
+        self._lock = threading.Lock()
+        self._dims = projection_dims(cfg)
+        # dense pools: per projection (A [N, L, d_in, r], B [N, L, r, d_out])
+        # plus the per-adapter scale vector [N] (alpha / rank; 0 for
+        # identity and for freed rows). Row 0 stays all-zero forever.
+        N, L, r = self.max_adapters, self.n_layers, self.rank
+        pool: Dict[str, Any] = {}
+        for proj, (din, dout) in self._dims.items():
+            pool[proj] = (
+                jax.jit(lambda s=(N, L, din, r): jnp.zeros(s, self.dtype))(),
+                jax.jit(lambda s=(N, L, r, dout): jnp.zeros(s, self.dtype))(),
+            )
+        pool["scale"] = jnp.zeros((N,), jnp.float32)
+        self._pool = pool
+        self._by_name: Dict[str, _AdapterMeta] = {}
+        self._by_row: Dict[int, _AdapterMeta] = {}
+        self._free_rows: List[int] = list(range(self.max_adapters - 1, 0, -1))
+        self.evictions_total = 0
+        self.loads_total = 0
+        self._pool_bytes = sum(
+            int(leaf.nbytes) for leaf in jax.tree.leaves(pool))
+
+    # ------------------------------------------------------------------
+    # validation (shared by load / load_uri)
+    # ------------------------------------------------------------------
+    def _validate(self, name: str, weights: Dict[str, Any], rank: int):
+        if not name:
+            raise ValueError("adapter name must be non-empty (row 0 is the "
+                             "reserved identity adapter)")
+        if rank != self.rank:
+            raise ValueError(
+                f"adapter {name!r} rank {rank} != pool rank {self.rank}: "
+                f"one dense pool holds one rank (size the pool for the "
+                f"largest adapter and zero-pad smaller ones offline)")
+        for proj in weights:
+            base = proj.lower()
+            if base in FORBIDDEN_PROJECTIONS or base.startswith(("wk", "wv")):
+                raise ValueError(
+                    f"adapter {name!r} carries factors for {proj!r}: k/v "
+                    f"projections are never adapted — adapting them would "
+                    f"fork the KV cache per tenant and break cross-tenant "
+                    f"page/prefix sharing (docs/multitenancy.md, the "
+                    f"KV-purity invariant)")
+            if base not in self._dims:
+                raise ValueError(
+                    f"adapter {name!r} names unknown projection {proj!r}: "
+                    f"expected a subset of {ADAPTED_PROJECTIONS}")
+        L, r = self.n_layers, self.rank
+        for proj, (a, b) in weights.items():
+            din, dout = self._dims[proj]
+            a = np.asarray(a)
+            b = np.asarray(b)
+            if a.shape != (L, din, r) or b.shape != (L, r, dout):
+                raise ValueError(
+                    f"adapter {name!r} {proj} factors have shapes "
+                    f"{a.shape}/{b.shape}; expected A {(L, din, r)} and "
+                    f"B {(L, r, dout)} for this model config")
+
+    # ------------------------------------------------------------------
+    # load / evict
+    # ------------------------------------------------------------------
+    def load(self, name: str, weights: Dict[str, Any],
+             alpha: Optional[float] = None,
+             rank: Optional[int] = None) -> int:
+        """Load (or replace) adapter ``name`` from in-memory factors
+        ``{proj: (A [L, d_in, r], B [L, r, d_out])}`` — a subset of
+        ADAPTED_PROJECTIONS; missing projections contribute zero delta.
+        Returns the adapter id (pool row). Replacing a PINNED adapter
+        raises — a live slot's gather must never change under it."""
+        import jax.numpy as jnp
+
+        alpha = float(alpha if alpha is not None else self.rank)
+        self._validate(name, weights, int(rank or self.rank))
+        set_row = _row_write_op()
+        with self._lock:
+            meta = self._by_name.get(name)
+            if meta is not None and meta.pins > 0:
+                raise ValueError(
+                    f"adapter {name!r} is pinned by {meta.pins} live "
+                    f"slot(s); a reload would change an in-flight "
+                    f"request's weights mid-generation")
+            if meta is None:
+                if not self._free_rows:
+                    raise ValueError(
+                        f"adapter pool full ({self.max_adapters - 1} rows "
+                        f"+ identity); evict an unpinned adapter first")
+                meta = _AdapterMeta(name, self._free_rows.pop(), alpha)
+                self._by_name[name] = meta
+                self._by_row[meta.row] = meta
+            meta.alpha = alpha
+            row = jnp.asarray(meta.row, jnp.int32)
+            pool = dict(self._pool)
+            L, r = self.n_layers, self.rank
+            for proj, (din, dout) in self._dims.items():
+                if proj in weights:
+                    a = np.asarray(weights[proj][0], np.float32)
+                    b = np.asarray(weights[proj][1], np.float32)
+                else:
+                    a = np.zeros((L, din, r), np.float32)
+                    b = np.zeros((L, r, dout), np.float32)
+                A, B = pool[proj]
+                pool[proj] = (
+                    set_row(A, row, jnp.asarray(a, self.dtype)),
+                    set_row(B, row, jnp.asarray(b, self.dtype)),
+                )
+            pool["scale"] = set_row(
+                pool["scale"], row,
+                jnp.asarray(alpha / self.rank, jnp.float32))
+            self._pool = pool
+            self.loads_total += 1
+            logger.info("loaded adapter %r into pool row %d (alpha=%s)",
+                        name, meta.row, alpha)
+            return meta.row
+
+    def load_uri(self, name: str, uri: str) -> int:
+        """Fetch an adapter artifact through the storage layer and load
+        it: a directory holding ``adapter.json`` ({"rank": r, "alpha": a})
+        and ``weights.npz`` with ``<proj>.A`` / ``<proj>.B`` arrays."""
+        from seldon_core_tpu import storage
+
+        path = storage.download(uri)
+        with open(os.path.join(path, "adapter.json")) as f:
+            meta = json.load(f)
+        blob = np.load(os.path.join(path, "weights.npz"))
+        weights: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        for key in blob.files:
+            proj, _, part = key.rpartition(".")
+            if part not in ("A", "B"):
+                raise ValueError(
+                    f"adapter {name!r} weights.npz key {key!r} must end in "
+                    f".A or .B")
+            a, b = weights.get(proj, (None, None))
+            if part == "A":
+                weights[proj] = (blob[key], b)
+            else:
+                weights[proj] = (a, blob[key])
+        for proj, (a, b) in weights.items():
+            if a is None or b is None:
+                raise ValueError(
+                    f"adapter {name!r} projection {proj!r} needs both "
+                    f"{proj}.A and {proj}.B in weights.npz")
+        return self.load(name, weights, alpha=meta.get("alpha"),
+                         rank=int(meta.get("rank", self.rank)))
+
+    def evict(self, name: str) -> bool:
+        """Free adapter ``name``'s pool row for reuse. Returns False —
+        and frees NOTHING — while any live slot pins it: the refcount
+        invariant (acceptance bar, schedules-proven). The row's factors
+        are zeroed so a stale id gathered by mistake reads as identity,
+        never as another tenant's weights."""
+        import jax.numpy as jnp
+
+        set_row = _row_write_op()
+        with self._lock:
+            meta = self._by_name.get(name)
+            if meta is None:
+                return False
+            if meta.pins > 0:
+                return False
+            del self._by_name[name]
+            del self._by_row[meta.row]
+            row = jnp.asarray(meta.row, jnp.int32)
+            pool = dict(self._pool)
+            L, r = self.n_layers, self.rank
+            for proj, (din, dout) in self._dims.items():
+                A, B = pool[proj]
+                pool[proj] = (
+                    set_row(A, row, jnp.zeros((L, din, r), self.dtype)),
+                    set_row(B, row, jnp.zeros((L, r, dout), self.dtype)),
+                )
+            pool["scale"] = set_row(pool["scale"], row,
+                                    jnp.asarray(0.0, jnp.float32))
+            self._pool = pool
+            self._free_rows.append(meta.row)
+            self.evictions_total += 1
+            logger.info("evicted adapter %r (pool row %d freed)",
+                        name, meta.row)
+            return True
+
+    # ------------------------------------------------------------------
+    # serving-path surface
+    # ------------------------------------------------------------------
+    def resolve(self, name: Optional[str]) -> int:
+        """Adapter id for ``name`` (None/"" = the identity adapter).
+        Raises KeyError on an unknown name — the transport maps it to a
+        400, never a silent base-model fallback."""
+        if not name:
+            return IDENTITY_ADAPTER_ID
+        with self._lock:
+            meta = self._by_name.get(name)
+            if meta is None:
+                raise KeyError(
+                    f"unknown adapter {name!r}: load it first "
+                    f"(loaded: {sorted(self._by_name)})")
+            return meta.row
+
+    def resolve_and_pin(self, name: Optional[str]) -> int:
+        """``resolve`` + ``pin`` under ONE lock hold — the admission
+        path's entry point. Separate resolve()-then-pin() calls would
+        leave a gap where an evict + load repurposes the row, silently
+        pinning (and serving) ANOTHER tenant's adapter; atomically the
+        name either maps to its live row (pinned before the lock drops,
+        so no evict can slip in) or raises KeyError (-> 400 at the
+        transport)."""
+        if not name:
+            return IDENTITY_ADAPTER_ID
+        with self._lock:
+            meta = self._by_name.get(name)
+            if meta is None:
+                raise KeyError(
+                    f"unknown adapter {name!r}: load it first "
+                    f"(loaded: {sorted(self._by_name)})")
+            meta.pins += 1
+            return meta.row
+
+    def pin(self, adapter_id: int) -> None:
+        """One live slot now references ``adapter_id`` (admission path).
+        Identity pins are no-ops — row 0 can never be evicted. Pinning a
+        freed row raises: the request raced an evict and must fail
+        loudly, not serve zeros it didn't ask for."""
+        if adapter_id == IDENTITY_ADAPTER_ID:
+            return
+        with self._lock:
+            meta = self._by_row.get(adapter_id)
+            if meta is None:
+                raise KeyError(f"adapter id {adapter_id} is not loaded")
+            meta.pins += 1
+
+    def unpin(self, adapter_id: int) -> None:
+        if adapter_id == IDENTITY_ADAPTER_ID:
+            return
+        with self._lock:
+            meta = self._by_row.get(adapter_id)
+            if meta is None or meta.pins <= 0:
+                raise ValueError(
+                    f"unbalanced unpin of adapter id {adapter_id}")
+            meta.pins -= 1
+
+    def pool(self) -> Dict[str, Any]:
+        """The current pool pytree ({proj: (A, B), "scale": [N]}), passed
+        as an argument into every adapted compiled step. The returned
+        references stay valid even if a load swaps the pool right after —
+        loads never donate (module docstring)."""
+        with self._lock:
+            return self._pool
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._by_name)
+
+    def refs_of(self, name: str) -> int:
+        with self._lock:
+            meta = self._by_name.get(name)
+            return 0 if meta is None else meta.pins
+
+    def stats(self) -> Dict[str, Any]:
+        """One consistent snapshot for llm_stats -> /metrics:
+        seldon_llm_adapter_{loaded,evictions_total,pool_bytes}."""
+        with self._lock:
+            return {
+                "adapter_loaded": len(self._by_name),
+                "adapter_capacity": self.max_adapters - 1,
+                "adapter_evictions_total": self.evictions_total,
+                "adapter_loads_total": self.loads_total,
+                "adapter_pool_bytes": self._pool_bytes,
+                "adapter_rank": self.rank,
+                "adapter_pins": {m.name: m.pins
+                                 for m in self._by_name.values()},
+            }

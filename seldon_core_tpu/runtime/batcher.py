@@ -214,6 +214,13 @@ def _page_table_ops():
     def set_hist_row(hist, slot, row):
         return hist.at[slot].set(row)
 
+    # Per-slot adapter-id write (batched LoRA, runtime/adapters.py): the
+    # admitted tenant's adapter row, gathered by every adapted step.
+    # Donated like the other per-slot admission state.
+    @partial(jax.jit, donate_argnums=(0,))
+    def set_adapter_id(ids, slot, aid):
+        return ids.at[slot].set(aid)
+
     # Copy-on-write page copy (radix prefix cache, runtime/radix.py): a
     # slot that must WRITE into a shared cached page gets a fresh page
     # plus this one donated copy — values move whole-page, but the
@@ -249,7 +256,7 @@ def _page_table_ops():
         return [tuple(pool[idx] for pool in layer) for layer in caches]
 
     ops = (set_block_row, set_block_entry, reset_pages, set_slot,
-           set_hist_row, cow_page_copy, export_pages)
+           set_hist_row, cow_page_copy, export_pages, set_adapter_id)
     _page_table_ops.ops = ops
     return ops
 
@@ -364,10 +371,11 @@ class _PrefillJob:
     dispatches interleave between its chunks."""
 
     __slots__ = ("slot", "ids", "L", "next", "chunk", "max_new", "fut",
-                 "on_token", "info", "seed", "bt_row", "pages", "t_arrival")
+                 "on_token", "info", "seed", "bt_row", "pages", "t_arrival",
+                 "req")
 
     def __init__(self, slot, ids, start, chunk, max_new, fut, on_token,
-                 info, seed, bt_row, pages, t_arrival=None):
+                 info, seed, bt_row, pages, t_arrival=None, req=None):
         self.slot = slot
         self.ids = ids
         self.L = len(ids)
@@ -381,6 +389,10 @@ class _PrefillJob:
         self.bt_row = bt_row         # device [1, n_pages] int32
         self.pages = pages           # host mirror of the allocated pages
         self.t_arrival = t_arrival   # submit() wall clock, for TTFT
+        # the scheduler's PendingRequest: tenant/SLO identity, adapter id,
+        # and the preemption return path (an interactive admission may
+        # push a staged batch-class job back into the queue)
+        self.req = req
 
 
 class _RemoteJob:
@@ -396,10 +408,11 @@ class _RemoteJob:
 
     __slots__ = ("job_id", "slot", "ids", "L", "plen", "max_new", "fut",
                  "on_token", "info", "seed", "pages", "row", "prefix_pages",
-                 "t_arrival")
+                 "t_arrival", "req")
 
     def __init__(self, job_id, slot, ids, plen, max_new, fut, on_token,
-                 info, seed, pages, row, t_arrival, prefix_pages=0):
+                 info, seed, pages, row, t_arrival, prefix_pages=0,
+                 req=None):
         self.job_id = job_id
         self.slot = slot
         self.ids = ids
@@ -414,15 +427,24 @@ class _RemoteJob:
         self.row = row               # host [n_pages] int32 block row, or None
         self.prefix_pages = int(prefix_pages)  # shared trie pages leading row
         self.t_arrival = t_arrival
+        self.req = req               # scheduler PendingRequest (tenant/SLO)
 
 
 class _Slot:
     __slots__ = ("future", "tokens", "true_len", "n_new", "max_new", "active",
                  "on_token", "gen", "disp_new", "pages", "shared", "ids",
-                 "prefilling", "admit_seq", "t_last")
+                 "prefilling", "admit_seq", "t_last", "tenant", "slo_class",
+                 "adapter_id")
 
     def __init__(self):
         self.active = False
+        # multi-tenant identity (runtime/scheduler.py): who this occupant
+        # belongs to, which SLO class its latency counts against, and the
+        # LoRA adapter row every adapted step gathers for it (0=identity).
+        # The adapter is PINNED in the registry while this slot holds it.
+        self.tenant = ""
+        self.slo_class = "interactive"
+        self.adapter_id = 0
         # wall clock of the last token surfaced for this occupant (TTFT /
         # inter-token-gap observability; reset at every commit)
         self.t_last = None
@@ -547,10 +569,16 @@ class BatcherService:
                     timeout_s: float = 600.0,
                     info: Optional[dict] = None,
                     seed: Optional[int] = None,
-                    trace: Optional[Any] = None) -> List[int]:
+                    trace: Optional[Any] = None,
+                    tenant: Optional[str] = None,
+                    slo_class: Optional[str] = None,
+                    adapter: Optional[str] = None,
+                    deadline_s: Optional[float] = None) -> List[int]:
         return self._track(asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, info=info, seed=seed,
-                                trace=trace),
+                                trace=trace, tenant=tenant,
+                                slo_class=slo_class, adapter=adapter,
+                                deadline_s=deadline_s),
             self._loop
         )).result(timeout_s)
 
@@ -558,10 +586,16 @@ class BatcherService:
                      on_token: Optional[Any] = None,
                      info: Optional[dict] = None,
                      seed: Optional[int] = None,
-                     trace: Optional[Any] = None) -> List[int]:
+                     trace: Optional[Any] = None,
+                     tenant: Optional[str] = None,
+                     slo_class: Optional[str] = None,
+                     adapter: Optional[str] = None,
+                     deadline_s: Optional[float] = None) -> List[int]:
         cfut = self._track(asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
-                                info=info, seed=seed, trace=trace),
+                                info=info, seed=seed, trace=trace,
+                                tenant=tenant, slo_class=slo_class,
+                                adapter=adapter, deadline_s=deadline_s),
             self._loop))
         return await asyncio.wrap_future(cfut)
 
@@ -570,14 +604,20 @@ class BatcherService:
                       on_token: Optional[Any] = None,
                       info: Optional[dict] = None,
                       seed: Optional[int] = None,
-                      trace: Optional[Any] = None):
+                      trace: Optional[Any] = None,
+                      tenant: Optional[str] = None,
+                      slo_class: Optional[str] = None,
+                      adapter: Optional[str] = None,
+                      deadline_s: Optional[float] = None):
         """Streaming submit from a SYNC thread (the gRPC server-streaming
         servicer): returns the concurrent.futures.Future of the final token
         list while ``on_token`` fires per token from the batcher's worker
         thread — the caller pumps its own response stream from them."""
         return self._track(asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
-                                info=info, seed=seed, trace=trace),
+                                info=info, seed=seed, trace=trace,
+                                tenant=tenant, slo_class=slo_class,
+                                adapter=adapter, deadline_s=deadline_s),
             self._loop))
 
     def drain(self) -> None:
@@ -698,7 +738,25 @@ class ContinuousBatcher:
         self._slots = [_Slot() for _ in range(self.S)]
         from collections import deque
 
-        self._pending: Any = deque()  # FIFO, peek-without-pop on full slots
+        # SLO-aware weighted-fair admission queue (runtime/scheduler.py,
+        # ISSUE 15): replaces the FIFO deque — requests order by SLO class
+        # (interactive vs batch) and tenant under stride-scheduled
+        # weighted fairness, with per-tenant quotas shedding early and
+        # deadline-carrying requests ordered EDF within their tenant. The
+        # peek-try-commit admission idiom is unchanged: a failed admit
+        # keeps the request queued.
+        from seldon_core_tpu.runtime.scheduler import WeightedFairScheduler
+
+        self._pending: Any = WeightedFairScheduler(
+            class_weights=getattr(server, "slo_class_weights", None),
+            tenant_weights=getattr(server, "tenant_weights", None),
+            tenant_quota=int(getattr(server, "tenant_quota", 0) or 0),
+            tenant_quotas=getattr(server, "tenant_quotas", None))
+        # Batched LoRA (runtime/adapters.py): when the server carries an
+        # AdapterRegistry every compiled step runs the adapted variant —
+        # per-slot adapter ids gather each tenant's low-rank delta, with
+        # id 0 the zero-delta identity for untenanted traffic.
+        self._adapters = getattr(server, "adapter_registry", None)
         self._wakeup = asyncio.Event()
         self._closed = False
         self._task: Optional[asyncio.Task] = None
@@ -901,7 +959,12 @@ class ContinuousBatcher:
         # compile is a serving stall
         (self._set_block_row, self._set_block_entry, self._reset_pages,
          self._set_slot, self._set_hist_row, self._cow_page_copy,
-         self._export_pages) = _page_table_ops()
+         self._export_pages, self._set_adapter_id) = _page_table_ops()
+
+        if self._adapters is not None:
+            # per-slot adapter ids, device-resident like the decode state
+            # (every adapted step gathers by them); 0 = identity
+            self._adapter_ids = jnp.zeros((self.S,), jnp.int32)
 
         if self.spec_mode != "off":
             # Per-slot prompt+generated token history, device-resident: the
@@ -1062,7 +1125,7 @@ class ContinuousBatcher:
         request, no occupied or prefilling slot, no in-flight step, no
         staged local or remote prefill job.  The autoscaler's
         ``collect_drained`` gate."""
-        return (not self._pending and not self._inflight
+        return (len(self._pending) == 0 and not self._inflight
                 and self._prefill is None and not self._remote_jobs
                 and not any(s.active or s.prefilling for s in self._slots))
 
@@ -1118,8 +1181,22 @@ class ContinuousBatcher:
                      on_token: Optional[Any] = None,
                      info: Optional[dict] = None,
                      seed: Optional[int] = None,
-                     trace: Optional[Any] = None) -> List[int]:
+                     trace: Optional[Any] = None,
+                     tenant: Optional[str] = None,
+                     slo_class: Optional[str] = None,
+                     adapter: Optional[str] = None,
+                     deadline_s: Optional[float] = None) -> List[int]:
         """prompt: str or token sequence. Resolves to generated token ids.
+
+        Multi-tenant identity (docs/multitenancy.md): ``tenant`` names the
+        traffic owner (``Seldon-Tenant`` header), ``slo_class`` its
+        scheduling class ("interactive" default / "batch" — the
+        ``Seldon-SLO-Class`` header; unknown values raise), ``adapter``
+        a loaded LoRA adapter (``"adapter"`` body/jsonData field; unknown
+        names raise — never a silent base-model fallback), and
+        ``deadline_s`` a latency budget in seconds that orders this
+        request EDF within its tenant queue and marks it for the
+        interactive preemption path.
 
         ``trace`` (optional ``tracing.TraceContext``) carries the request's
         trace identity from the transport ingress (W3C ``traceparent``) into
@@ -1168,10 +1245,51 @@ class ContinuousBatcher:
             loop = self._loop
             self._transfer.on_ready = lambda: loop.call_soon_threadsafe(
                 self._wakeup.set)
+        from seldon_core_tpu.contracts.payload import SeldonError
+        from seldon_core_tpu.runtime.scheduler import (PendingRequest,
+                                                       normalize_slo_class)
+
+        try:
+            cls = normalize_slo_class(slo_class)
+        except ValueError as e:
+            raise SeldonError(str(e), status_code=400)
+        aid = 0
+        if adapter:
+            if self._adapters is None:
+                raise SeldonError(
+                    f"adapter {adapter!r} requested but the server has no "
+                    f"adapter pool (set lora_rank > 0)", status_code=400)
+            # resolve + pin atomically, from the moment the request
+            # exists anywhere: eviction refuses while this request is
+            # queued or in a slot, so the dispatch-time gather can never
+            # read a freed (or evict+load-repurposed) row. Unpinned
+            # exactly once: on the terminal shed/fail while queued
+            # (_unpin_request), or at slot release once admitted
+            # (ownership moves to the slot at _commit_slot).
+            try:
+                aid = self._adapters.resolve_and_pin(adapter)
+            except KeyError as e:
+                raise SeldonError(str(e.args[0]), status_code=400)
+        now = time.perf_counter()
         fut: asyncio.Future = self._loop.create_future()
-        self._pending.append(
-            (ids, int(max_new_tokens or self.server.max_new_tokens), fut,
-             on_token, info, seed, time.perf_counter(), trace))
+        req = PendingRequest(
+            ids=ids, max_new=int(max_new_tokens or self.server.max_new_tokens),
+            fut=fut, on_token=on_token, info=info, seed=seed,
+            t_arrival=now, trace=trace, tenant=str(tenant or ""),
+            slo_class=cls, adapter_id=aid,
+            deadline_t=((now + float(deadline_s))
+                        if deadline_s is not None else None))
+        if not self._pending.push(req):
+            # tenant over its queued-request quota: shed NOW with the
+            # backlog-derived Retry-After (the scheduler counted it
+            # against the tenant — seldon_tenant_shed_total)
+            if aid:
+                self._adapters.unpin(aid)
+            from seldon_core_tpu.runtime.resilience import ShedError
+
+            raise ShedError(
+                f"tenant {req.tenant!r} over its admission quota",
+                retry_after_s=self.retry_after_hint())
         self._ensure_running()
         self._wakeup.set()
         return await fut
@@ -1291,7 +1409,8 @@ class ContinuousBatcher:
     def _commit_slot(self, i: int, first: int, key, L: int, max_new: int,
                      fut: asyncio.Future, on_token: Optional[Any],
                      ids: Optional[List[int]] = None,
-                     t_arrival: Optional[float] = None):
+                     t_arrival: Optional[float] = None,
+                     req: Optional[Any] = None):
         """Slot bookkeeping shared by dense admission and paged activation:
         thread the new occupant's state into the device arrays and surface
         the first token. Program order on the device stream puts the
@@ -1313,6 +1432,16 @@ class ContinuousBatcher:
         slot.n_new = 1
         slot.tokens = [first]
         slot.on_token = on_token
+        # multi-tenant identity rides the slot for the whole occupancy:
+        # tenant token/shed accounting, per-class TTFT, and the adapter
+        # row every adapted dispatch gathers for this slot
+        slot.tenant = req.tenant if req is not None else ""
+        slot.slo_class = req.slo_class if req is not None else "interactive"
+        slot.adapter_id = req.adapter_id if req is not None else 0
+        if self._adapters is not None:
+            self._adapter_ids = self._set_adapter_id(
+                self._adapter_ids, jnp.asarray(i, jnp.int32),
+                jnp.asarray(slot.adapter_id, jnp.int32))
         # the truncated prompt feeds the radix trie's completion-time
         # insertion (prompt + generated blocks re-enter the cache)
         slot.ids = list(ids) if ids is not None else None
@@ -1321,6 +1450,9 @@ class ContinuousBatcher:
         now = time.perf_counter()
         if t_arrival is not None:
             self.server._ttft_times.append(now - t_arrival)
+            self.server._ttft_by_class.append(
+                (slot.slo_class, now - t_arrival))
+        self._pending.count_tokens(slot.tenant, slot.slo_class, 1)
         slot.t_last = now
         if self._flight is not None:
             self._flight.record(i, EV_FIRST_TOKEN, tokens=1)
@@ -1374,14 +1506,10 @@ class ContinuousBatcher:
         self._draft_caches = self._draft_insert(
             self._draft_caches, dcache, jnp.asarray(i, jnp.int32))
 
-    def _admit(self, ids: List[int], max_new: int, fut: asyncio.Future,
-               on_token: Optional[Any] = None,
-               info: Optional[dict] = None,
-               seed: Optional[int] = None,
-               t_arrival: Optional[float] = None,
-               trace: Optional[Any] = None) -> bool:
+    def _admit(self, req) -> bool:
         """Dense-layout admission: one-shot prefill into a 1-sequence cache,
-        jitted insert into the free slot."""
+        jitted insert into the free slot. ``req`` is the scheduler's
+        PendingRequest (tenant/SLO/adapter identity rides it)."""
         import time
 
         import jax.numpy as jnp
@@ -1389,38 +1517,55 @@ class ContinuousBatcher:
         free = next((i for i, s in enumerate(self._slots) if not s.active), None)
         if free is None:
             return False
-        ids, plen = self._truncate_prompt(ids, max_new, info)
+        ids, plen = self._truncate_prompt(req.ids, req.max_new, req.info)
         L = len(ids)
         if self._flight is not None:
-            self._flight.begin(free, trace, t_arrival, L)
+            self._flight.begin(free, req.trace, req.t_arrival, L,
+                               tags=self._flight_tags(req))
         tokens = np.zeros((1, plen), np.int32)
         positions = np.full((1, plen), PAD_POS, np.int32)
         tokens[0, :L] = ids
         positions[0, :L] = np.arange(L)
 
         t0 = time.perf_counter()
-        prefill = self.server._get_prefill(1, plen, self.max_len)
-        logits, cache1 = prefill(self.server._params, jnp.asarray(tokens), jnp.asarray(positions))
+        if self._adapters is not None:
+            prefill = self.server._get_prefill(1, plen, self.max_len,
+                                               lora=True)
+            logits, cache1 = prefill(
+                self.server._params, jnp.asarray(tokens),
+                jnp.asarray(positions), self._adapters.pool(),
+                jnp.asarray([req.adapter_id], jnp.int32))
+        else:
+            prefill = self.server._get_prefill(1, plen, self.max_len)
+            logits, cache1 = prefill(self.server._params, jnp.asarray(tokens),
+                                     jnp.asarray(positions))
         self._caches = self._insert(self._caches, cache1, free)
         # graftlint: allow-host-sync-in-hot-path(admission-time sync, once per request not per token: the first sampled token must reach the host to seed slot bookkeeping before the slot joins the pipelined batch)
         first_logits = np.asarray(logits[0, L - 1]).astype(np.float32)
         if self._flight is not None:
             self._flight.record(free, EV_PREFILL, tokens=L,
                                 dur_s=time.perf_counter() - t0)
-        first, key = self._sample_first(first_logits, seed)
-        self._commit_slot(free, first, key, L, max_new, fut, on_token,
-                          ids=ids, t_arrival=t_arrival)
+        first, key = self._sample_first(first_logits, req.seed)
+        self._commit_slot(free, first, key, L, req.max_new, req.fut,
+                          req.on_token, ids=ids, t_arrival=req.t_arrival,
+                          req=req)
         return True
+
+    @staticmethod
+    def _flight_tags(req) -> Optional[dict]:
+        """Tenant identity on the request's flight-recorder timeline/root
+        span (None when untenanted — the timeline stays byte-identical to
+        the single-tenant layout)."""
+        if not req.tenant and req.slo_class == "interactive" \
+                and not req.adapter_id:
+            return None
+        return {"tenant": req.tenant, "slo_class": req.slo_class,
+                "adapter_id": req.adapter_id}
 
     # ------------------------------------------------------------------
     # Disaggregated admission: stage remote jobs, consume handoffs
     # ------------------------------------------------------------------
-    def _admit_remote(self, ids: List[int], max_new: int, fut: asyncio.Future,
-                      on_token: Optional[Any] = None,
-                      info: Optional[dict] = None,
-                      seed: Optional[int] = None,
-                      t_arrival: Optional[float] = None,
-                      trace: Optional[Any] = None) -> bool:
+    def _admit_remote(self, req) -> bool:
         """Remote-prefill admission, decode-side half: reserve a slot,
         consult the radix trie so the prefill slice only computes the
         UNCACHED suffix (matched whole blocks stay decode-side, shared
@@ -1436,7 +1581,7 @@ class ContinuousBatcher:
                      if not s.active and not s.prefilling), None)
         if free is None:
             return False
-        ids, plen = self._truncate_prompt(ids, max_new, info)
+        ids, plen = self._truncate_prompt(req.ids, req.max_new, req.info)
         L = len(ids)
         pages: List[int] = []
         shared: List[int] = []
@@ -1462,8 +1607,8 @@ class ContinuousBatcher:
                 # — remote slots hold prefilling=True), nothing will ever
                 # free a page, so shed now instead of queueing forever
                 if not any(s.active or s.prefilling for s in self._slots):
-                    self._shed_request(
-                        fut, on_token,
+                    self._shed_queued_request(
+                        req,
                         f"admission needs {n0} KV pages "
                         f"(pool capacity {self._allocator.capacity}, "
                         f"{self._allocator.stats()[1]} in use)")
@@ -1488,18 +1633,22 @@ class ContinuousBatcher:
         slot.pages = list(pages)
         slot.shared = list(shared)
         slot.prefilling = True
-        slot.future = fut
-        slot.on_token = on_token
+        slot.future = req.fut
+        slot.on_token = req.on_token
+        slot.tenant = req.tenant
+        slot.slo_class = req.slo_class
         self._job_seq += 1
-        job = _RemoteJob(self._job_seq, free, ids, plen, max_new, fut,
-                         on_token, info, seed, pages, row, t_arrival,
-                         prefix_pages=len(shared))
+        job = _RemoteJob(self._job_seq, free, ids, plen, req.max_new,
+                         req.fut, req.on_token, req.info, req.seed, pages,
+                         row, req.t_arrival, prefix_pages=len(shared),
+                         req=req)
         self._remote_jobs[job.job_id] = job
         if k0:
             # once per funded admission, like the local path
             self._radix.record_hit(k0, len(shared), False)
         if self._flight is not None:
-            self._flight.begin(free, trace, t_arrival, L)
+            self._flight.begin(free, req.trace, req.t_arrival, L,
+                               tags=self._flight_tags(req))
             if k0:
                 self._flight.record(free, EV_PREFIX_HIT, tokens=k0,
                                     blocks=len(shared))
@@ -1592,7 +1741,7 @@ class ContinuousBatcher:
             first, key = self._sample_first(h.first_logits, job.seed)
             self._commit_slot(job.slot, first, key, job.L, job.max_new,
                               job.fut, job.on_token, ids=job.ids,
-                              t_arrival=job.t_arrival)
+                              t_arrival=job.t_arrival, req=job.req)
 
     def _shed_remote_job(self, job_id: int, why: str):
         """Shed a staged remote admission (page pressure / shutdown): the
@@ -1607,6 +1756,12 @@ class ContinuousBatcher:
         self._transfer.cancel(job_id)
         if self.paged:
             self._allocator.count_shed()
+        if job.req is not None:
+            self._pending.count_shed(job.req.tenant, job.req.slo_class)
+            # adapters reject disaggregation at load() today, so this is
+            # a no-op — kept so the pin-ownership rule (queue entry owns
+            # it until _commit_slot) survives that restriction lifting
+            self._unpin_request(job.req)
         logger.warning("shedding staged remote prefill (slot %d): %s",
                        job.slot, why)
         if self._flight is not None:
@@ -1652,12 +1807,7 @@ class ContinuousBatcher:
             return None
         return self._allocator.alloc(n)
 
-    def _admit_begin(self, ids: List[int], max_new: int, fut: asyncio.Future,
-                     on_token: Optional[Any] = None,
-                     info: Optional[dict] = None,
-                     seed: Optional[int] = None,
-                     t_arrival: Optional[float] = None,
-                     trace: Optional[Any] = None) -> bool:
+    def _admit_begin(self, req) -> bool:
         """Paged admission, phase 1 (host-side, cheap): match the prompt
         against the radix prefix cache (shared full blocks enter the block
         row as-is — zero copies; a partial-block continuation pays one
@@ -1675,11 +1825,17 @@ class ContinuousBatcher:
                      if not s.active and not s.prefilling), None)
         if free is None:
             return False
-        ids, plen = self._truncate_prompt(ids, max_new, info)
+        ids, plen = self._truncate_prompt(req.ids, req.max_new, req.info)
         L = len(ids)
         n0 = -(-L // self.page_size)
         k0, shared, cow = 0, [], None
-        if self._radix is not None:
+        if self._radix is not None and req.adapter_id == 0:
+            # radix reuse serves BASE-adapter traffic only: an adapted
+            # request's hidden states embed its q/o/FFN deltas from layer
+            # 1 on, so its deep-layer KV is not the trie's KV (the k/v
+            # PROJECTIONS are base for everyone — runtime/adapters.py —
+            # but projection inputs differ). Adapted admissions prefill
+            # their whole prompt and never insert (docs/multitenancy.md).
             k0, shared, cow = self._radix.match_and_pin(ids, limit=L - 1)
         n_fresh = n0 - len(shared) - (1 if cow is not None else 0)
         fresh = self._alloc_pages(n_fresh + (1 if cow is not None else 0))
@@ -1708,8 +1864,8 @@ class ContinuousBatcher:
             # instead of queueing forever; otherwise wait for in-flight
             # completions.
             if not any(s.active or s.prefilling for s in self._slots):
-                self._shed_request(
-                    fut, on_token,
+                self._shed_queued_request(
+                    req,
                     f"admission needs {n0} KV pages "
                     f"(pool capacity {self._allocator.capacity}, "
                     f"{self._allocator.stats()[1]} in use)")
@@ -1721,10 +1877,13 @@ class ContinuousBatcher:
         slot.shared = list(shared)
         slot.pages = ([cow_dst] if cow_dst is not None else []) + plain
         slot.prefilling = True
-        slot.future = fut
-        slot.on_token = on_token
+        slot.future = req.fut
+        slot.on_token = req.on_token
+        slot.tenant = req.tenant
+        slot.slo_class = req.slo_class
         if self._flight is not None:
-            self._flight.begin(free, trace, t_arrival, L)
+            self._flight.begin(free, req.trace, req.t_arrival, L,
+                               tags=self._flight_tags(req))
         # neutralize the FRESH pages' previous-owner positions BEFORE any
         # write lands through them (stale real positions would make this
         # slot's mask attend another sequence's leftover KV). Shared trie
@@ -1761,8 +1920,9 @@ class ContinuousBatcher:
                                     blocks=len(shared) +
                                     (1 if cow is not None else 0))
         job = _PrefillJob(free, ids, k0, min(self.prefill_chunk, plen),
-                          max_new, fut, on_token, info, seed, bt_row,
-                          slot.pages, t_arrival=t_arrival)
+                          req.max_new, req.fut, req.on_token, req.info,
+                          req.seed, bt_row, slot.pages,
+                          t_arrival=req.t_arrival, req=req)
         self._prefill = job
         return True
 
@@ -1788,10 +1948,18 @@ class ContinuousBatcher:
         toks[0, :n] = part
         pos[0, :n] = np.arange(start, start + n)
         t0 = time.perf_counter()
-        fn = self.server._get_prefill_chunk(C, self.n_pages)
-        logits, self._caches = fn(self.server._params, self._caches,
-                                  job.bt_row, jnp.asarray(toks),
-                                  jnp.asarray(pos))
+        if self._adapters is not None:
+            fn = self.server._get_prefill_chunk(C, self.n_pages, lora=True)
+            aid = job.req.adapter_id if job.req is not None else 0
+            logits, self._caches = fn(
+                self.server._params, self._caches, job.bt_row,
+                jnp.asarray(toks), jnp.asarray(pos), self._adapters.pool(),
+                jnp.asarray([aid], jnp.int32))
+        else:
+            fn = self.server._get_prefill_chunk(C, self.n_pages)
+            logits, self._caches = fn(self.server._params, self._caches,
+                                      job.bt_row, jnp.asarray(toks),
+                                      jnp.asarray(pos))
         job.next = start + n
         if self._flight is not None:
             # dispatch wall (enqueue-only); the last chunk's logits sync
@@ -1817,7 +1985,8 @@ class ContinuousBatcher:
             job.bt_row[0])
         self._prefill = None
         self._commit_slot(job.slot, first, key, job.L, job.max_new, job.fut,
-                          job.on_token, ids=job.ids, t_arrival=job.t_arrival)
+                          job.on_token, ids=job.ids, t_arrival=job.t_arrival,
+                          req=job.req)
 
     # ------------------------------------------------------------------
     # Page accounting: growth, exhaustion shedding, release
@@ -1928,12 +2097,32 @@ class ContinuousBatcher:
                 pass
         self._resolve(fut, exc=self._shed_error(why))
 
+    def _unpin_request(self, req):
+        """Drop a queued/staged request's adapter pin. Ownership lives on
+        the queue entry from submit() until _commit_slot moves it to the
+        slot, so every TERMINAL pre-commit path (queued shed, staged
+        local/remote shed, crash drain) funnels here; the id zeroes so a
+        path that fires twice cannot double-unpin."""
+        if self._adapters is not None and req.adapter_id:
+            self._adapters.unpin(req.adapter_id)
+            req.adapter_id = 0
+
+    def _shed_queued_request(self, req, why: str):
+        """Shed a request still sitting in the scheduler: remove it there
+        (which books the shed against its tenant —
+        seldon_tenant_shed_total), drop its adapter pin, then the common
+        shed path."""
+        self._pending.remove(req)
+        self._unpin_request(req)
+        self._shed_request(req.fut, req.on_token, why)
+
     def _shed_slot(self, i: int, why: str):
         """Shed an ACTIVE slot mid-decode to relieve page exhaustion: its
         tokens are discarded and the client gets 503 + Retry-After (the
         dense layout can never hit this — its slots pre-reserve max_len)."""
         slot = self._slots[i]
         self._allocator.count_shed()
+        self._pending.count_shed(slot.tenant, slot.slo_class)
         logger.warning(
             "shedding slot %d after %d generated tokens: %s", i, slot.n_new, why)
         fut, on_token = slot.future, slot.on_token
@@ -1957,6 +2146,13 @@ class ContinuousBatcher:
             return
         self._prefill = None
         self._allocator.count_shed()
+        if job.req is not None:
+            self._pending.count_shed(job.req.tenant, job.req.slo_class)
+            # pre-commit, the QUEUE ENTRY still owns the adapter pin
+            # (slot.adapter_id is only set at _commit_slot, so the slot
+            # release below cannot drop it) — this shed is the terminal
+            # outcome, so the pin dies here
+            self._unpin_request(job.req)
         logger.warning("shedding staged prefill (slot %d): %s", job.slot, why)
         if self._flight is not None:
             self._flight.record(job.slot, EV_SHED, why=why)
@@ -1968,6 +2164,54 @@ class ContinuousBatcher:
             except Exception:
                 pass
         self._resolve(job.fut, exc=self._shed_error(why))
+
+    def _preempt_for_interactive(self) -> bool:
+        """Deadline-aware slot reclamation (docs/multitenancy.md): an
+        interactive admission blocked on occupied slots pushes ONE staged
+        batch-class job back into the scheduler — the local chunked
+        prefill first (its compute is sunk but no client has a token),
+        else the newest staged remote admission. ACTIVE slots are never
+        touched: a slot that has surfaced tokens finishes or sheds on its
+        own terms. The preempted request keeps its sequence number
+        (re-enters its tenant queue where it left) and is immune to a
+        second preemption (``preempted`` flag) — that immunity is what
+        makes a sustained interactive flood unable to livelock batch
+        admissions: a re-staged job always completes. Returns True when
+        something was preempted (the caller retries its admission)."""
+        job = self._prefill
+        if job is not None and job.req is not None \
+                and job.req.slo_class == "batch" and not job.req.preempted:
+            self._prefill = None
+            return self._requeue_preempted(job.slot, job.req, "local prefill")
+        for job_id in reversed(list(self._remote_jobs)):
+            rjob = self._remote_jobs[job_id]
+            if rjob.req is None or rjob.req.slo_class != "batch" \
+                    or rjob.req.preempted:
+                continue
+            del self._remote_jobs[job_id]
+            # exactly-once vs the worker: either the READY handoff leaves
+            # the queue with its payload, or the worker's later put is
+            # refused — same protocol as _shed_remote_job, different fate
+            # for the REQUEST (requeued, not failed)
+            self._transfer.cancel(job_id)
+            return self._requeue_preempted(rjob.slot, rjob.req,
+                                           "staged remote prefill")
+        return False
+
+    def _requeue_preempted(self, slot_i: int, req, what: str) -> bool:
+        logger.info("preempting %s (slot %d, tenant %r) for an "
+                    "interactive admission", what, slot_i, req.tenant)
+        slot = self._slots[slot_i]
+        # the queue entry keeps the adapter pin: ownership returns to it,
+        # so the release below must not unpin (it unpins slot.adapter_id,
+        # zeroed here first)
+        slot.adapter_id = 0
+        if self._flight is not None:
+            self._flight.record(slot_i, EV_SHED, why="preempted: " + what)
+            self._flight.complete(slot_i, "preempted", 0, self._tracer)
+        self._release_slot(slot_i)
+        self._pending.push(req, requeue=True)
+        return True
 
     def _release_slot(self, i: int):
         """Common slot teardown: drop page references (owned pages free
@@ -1981,6 +2225,21 @@ class ContinuousBatcher:
         slot.future = None
         slot.on_token = None
         slot.ids = None
+        slot.tenant = ""
+        slot.slo_class = "interactive"
+        if self._adapters is not None and slot.adapter_id:
+            # the slot's pin was the live reference holding this adapter
+            # in the pool; eviction becomes legal once it drops. The
+            # device id resets to identity so the released slot's
+            # ride-along compute gathers row 0 (zeros), never a row a
+            # later load may repopulate for someone else.
+            self._adapters.unpin(slot.adapter_id)
+            import jax.numpy as _jnp
+
+            self._adapter_ids = self._set_adapter_id(
+                self._adapter_ids, _jnp.asarray(i, _jnp.int32),
+                _jnp.asarray(0, _jnp.int32))
+        slot.adapter_id = 0
         if self.paged:
             if slot.pages:
                 self._allocator.free(slot.pages)
@@ -2081,7 +2340,11 @@ class ContinuousBatcher:
             # per-step events must reproduce; an EOS trim shortens the
             # client's list but never the credited count
             self._flight.complete(i, "done", slot.n_new, self._tracer)
-        if self._radix is not None and slot.ids is not None:
+        if self._radix is not None and slot.ids is not None \
+                and slot.adapter_id == 0:
+            # base-adapter slots only: an adapted slot's KV embeds its
+            # q/o/FFN deltas from layer 1 on, and inserting it would serve
+            # tenant-specific KV to base traffic (docs/multitenancy.md)
             # insert the slot's prompt+generated blocks back into the trie
             # IN PLACE — page ownership transfers node-by-node, no dense
             # export. Only provably-written positions qualify: every token
@@ -2154,18 +2417,27 @@ class ContinuousBatcher:
                         i, self._slots[i].dispatched_pos() + k - 1)
             if not self._dispatch_eligible():
                 return
-            fn = self.server._get_decode_step_paged(self.S, self.n_pages, k)
+        # adapted steps (llm.lora_decode_step): the pool/id pair rides at
+        # the end of either signature, un-donated — same idiom as the
+        # spec-step dispatch below
+        lora = self._adapters is not None
+        extra = () if not lora else (self._adapters.pool(),
+                                     self._adapter_ids)
+        if self.paged:
+            fn = self.server._get_decode_step_paged(
+                self.S, self.n_pages, k, lora=lora)
             t0 = time.perf_counter()
             (self._caches, self._last_tok, self._next_pos, self._keys,
              toks) = fn(self.server._params, self._caches, self._last_tok,
                         self._next_pos, self._keys, self._temp,
-                        self._block_tables)
+                        self._block_tables, *extra)
         else:
-            fn = self.server._get_decode_step(self.S, self.max_len, k)
+            fn = self.server._get_decode_step(self.S, self.max_len, k,
+                                              lora=lora)
             t0 = time.perf_counter()
             (self._caches, self._last_tok, self._next_pos, self._keys,
              toks) = fn(self.server._params, self._caches, self._last_tok,
-                        self._next_pos, self._keys, self._temp)
+                        self._next_pos, self._keys, self._temp, *extra)
         self.server._decode_dispatch_times.append(time.perf_counter() - t0)
         snapshot = [(i, s.gen) for i, s in enumerate(self._slots) if s.active]
         for i, _ in snapshot:
@@ -2209,13 +2481,18 @@ class ContinuousBatcher:
                 return
             fn = self.server._get_spec_step(
                 self.S, K, self.hist_len, mode=self.spec_mode,
-                layout="paged", n_pages=self.n_pages)
+                layout="paged", n_pages=self.n_pages,
+                lora=self._adapters is not None)
         else:
             fn = self.server._get_spec_step(
                 self.S, K, self.hist_len, mode=self.spec_mode,
-                layout="dense")
+                layout="dense", lora=self._adapters is not None)
         cap_dev = jnp.asarray(caps)
         draft = self.spec_mode == "draft"
+        # adapted verify (llm.lora_verify_step): the pool/id pair rides at
+        # the end of every signature variant, un-donated
+        extra = () if self._adapters is None else (
+            self._adapters.pool(), self._adapter_ids)
         t0 = time.perf_counter()
         if self.paged and draft:
             (self._caches, self._last_tok, self._next_pos, self._keys,
@@ -2223,25 +2500,26 @@ class ContinuousBatcher:
                 self.server._params, self._caches, self._last_tok,
                 self._next_pos, self._keys, self._temp, self._block_tables,
                 self._hist, cap_dev, self.server._draft_params,
-                self._draft_caches)
+                self._draft_caches, *extra)
         elif self.paged:
             (self._caches, self._last_tok, self._next_pos, self._keys,
              self._hist, toks, acc) = fn(
                 self.server._params, self._caches, self._last_tok,
                 self._next_pos, self._keys, self._temp, self._block_tables,
-                self._hist, cap_dev)
+                self._hist, cap_dev, *extra)
         elif draft:
             (self._caches, self._last_tok, self._next_pos, self._keys,
              self._hist, toks, acc, self._draft_caches) = fn(
                 self.server._params, self._caches, self._last_tok,
                 self._next_pos, self._keys, self._temp, self._hist,
-                cap_dev, self.server._draft_params, self._draft_caches)
+                cap_dev, self.server._draft_params, self._draft_caches,
+                *extra)
         else:
             (self._caches, self._last_tok, self._next_pos, self._keys,
              self._hist, toks, acc) = fn(
                 self.server._params, self._caches, self._last_tok,
                 self._next_pos, self._keys, self._temp, self._hist,
-                cap_dev)
+                cap_dev, *extra)
         self.server._decode_dispatch_times.append(time.perf_counter() - t0)
         snapshot = [(i, s.gen) for i, s in enumerate(self._slots) if s.active]
         booked = {}
@@ -2313,6 +2591,9 @@ class ContinuousBatcher:
                         or slot.host_pos() >= self.max_len):
                     finish = True
                     break
+            if credited:
+                self._pending.count_tokens(slot.tenant, slot.slo_class,
+                                           credited)
             if self._flight is not None and credited:
                 # one step event per slot per drain, BEFORE any finish
                 # materializes the segment: tokens credited this drain plus
@@ -2372,6 +2653,9 @@ class ContinuousBatcher:
                         or slot.host_pos() >= self.max_len):
                     finish = True
                     break
+            if credited:
+                self._pending.count_tokens(slot.tenant, slot.slo_class,
+                                           credited)
             if self._flight is not None and credited:
                 # per-verify-step event: tokens surfaced, drafts offered,
                 # device-accepted count — the speculative half of the
@@ -2392,27 +2676,46 @@ class ContinuousBatcher:
                 # Admission happens while earlier steps are STILL IN FLIGHT
                 # — the insert/set_slot queue behind them in device program
                 # order, and the gen counter masks their stale tokens.
-                while self._pending and self._prefill is None:
-                    (ids, max_new, fut, on_token, info, seed,
-                     t_arr, trace) = self._pending[0]
+                while True:
+                    req = self._pending.next_request()
+                    if req is None:
+                        break
+                    if self._prefill is not None:
+                        # one local chunked prefill stages at a time. An
+                        # interactive head may preempt a staged BATCH-class
+                        # one (the preemption contract: staged jobs only,
+                        # never active slots, at most once per request) —
+                        # otherwise wait for its chunks to finish
+                        if (req.slo_class == "interactive"
+                                and await asyncio.to_thread(
+                                    self._preempt_for_interactive)):
+                            continue
+                        break
                     if self._remote is not None:
                         # disaggregated: stage the job on the prefill
                         # slice — host-side only, so MULTIPLE admissions
                         # can be in flight while decode keeps dispatching
                         admitted = await asyncio.to_thread(
-                            self._admit_remote, ids, max_new, fut,
-                            on_token, info, seed, t_arr, trace)
+                            self._admit_remote, req)
                     elif self.paged:
                         admitted = await asyncio.to_thread(
-                            self._admit_begin, ids, max_new, fut, on_token,
-                            info, seed, t_arr, trace)
+                            self._admit_begin, req)
                     else:
-                        admitted = await asyncio.to_thread(
-                            self._admit, ids, max_new, fut, on_token, info,
-                            seed, t_arr, trace)
+                        admitted = await asyncio.to_thread(self._admit, req)
                     if not admitted:
+                        # deadline-aware preemption: an interactive head
+                        # blocked on occupied slots may push ONE staged
+                        # batch-class job (local chunked prefill / staged
+                        # remote admission) back into the queue — never
+                        # an active slot — then retry the same head
+                        if (req.slo_class == "interactive"
+                                and await asyncio.to_thread(
+                                    self._preempt_for_interactive)):
+                            continue
                         break  # no free slot/pages — decode frees them
-                    self._pending.popleft()
+                    # an _admit_* shed path already removed req from the
+                    # scheduler (counting the shed); commit is a no-op then
+                    self._pending.commit(req)
                 # disaggregated: activate every finished handoff (import +
                 # commit — one jitted scatter each, no prefill compute on
                 # this slice)
@@ -2485,12 +2788,15 @@ class ContinuousBatcher:
                     slot.active = False
                     slot.prefilling = False
                     slot.future = None
-            while self._pending:
-                _, _, fut, on_token, _, _, _, _ = self._pending.popleft()
-                if on_token is not None:
+            for req in self._pending.drain_all():
+                try:
+                    self._unpin_request(req)
+                except ValueError:
+                    pass  # teardown must not mask the original error
+                if req.on_token is not None:
                     try:
-                        on_token(None)
+                        req.on_token(None)
                     except Exception:
                         pass
-                self._resolve(fut, exc=e)
+                self._resolve(req.fut, exc=e)
             raise

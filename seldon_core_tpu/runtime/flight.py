@@ -80,10 +80,16 @@ class _Segment:
     exactly the long slow requests the recorder exists to explain."""
 
     __slots__ = ("trace", "t_submit", "t_begin", "prompt_tokens", "ring",
-                 "total", "t_first", "last_surface", "worst_gap", "tokens")
+                 "total", "t_first", "last_surface", "worst_gap", "tokens",
+                 "tags")
 
     def __init__(self, trace: TraceContext, t_submit: Optional[float],
-                 t_begin: float, prompt_tokens: int, ring_size: int):
+                 t_begin: float, prompt_tokens: int, ring_size: int,
+                 tags: Optional[dict] = None):
+        # request identity tags (tenant / slo_class / adapter_id for
+        # multi-tenant serving): merged into the materialized timeline
+        # and the root span's tags
+        self.tags = tags
         self.trace = trace
         self.t_submit = t_submit if t_submit is not None else t_begin
         self.t_begin = t_begin
@@ -149,16 +155,20 @@ class FlightRecorder:
 
     # -- single-writer side (batcher loop context only) -----------------
     def begin(self, slot: int, trace: Optional[TraceContext],
-              t_submit: Optional[float], prompt_tokens: int) -> None:
+              t_submit: Optional[float], prompt_tokens: int,
+              tags: Optional[dict] = None) -> None:
         """Start recording a request at the moment its slot is chosen.
         ``trace`` may be None (an untraced submit while the recorder runs
         for others) — the segment still records, rooted at a fresh trace
-        id, so /debug/timeline sees every request."""
+        id, so /debug/timeline sees every request. ``tags`` (optional
+        request identity: tenant / slo_class / adapter_id) ride the
+        timeline dict and the root span."""
         if trace is None:
             trace = TraceContext(trace_id=self._trace_id(),
                                  sampled=True, ingress="internal")
         self._segs[slot] = _Segment(trace, t_submit, self._clock(),
-                                    prompt_tokens, self.ring_size)
+                                    prompt_tokens, self.ring_size,
+                                    tags=tags)
 
     def record(self, slot: int, kind: str, **fields: Any) -> None:
         seg = self._segs[slot]
@@ -252,6 +262,7 @@ class FlightRecorder:
             "ingress": seg.trace.ingress,
             "slot": slot,
             "status": status,
+            **({"request_tags": dict(seg.tags)} if seg.tags else {}),
             "sampling": mode,
             "t_submit_wall": self._wall(seg.t_submit),
             "queue_wait_s": seg.t_begin - seg.t_submit,
@@ -308,6 +319,7 @@ class FlightRecorder:
                 "ttft_ms": round((timeline["ttft_s"] or 0.0) * 1e3, 3),
                 "worst_gap_ms": round((timeline["worst_gap_s"] or 0.0) * 1e3, 3),
                 "events_dropped": timeline["events_dropped"],
+                **(seg.tags or {}),
                 **root_tags_extra,
             })
         spans = [root]

@@ -261,6 +261,13 @@ class LLMServer(SeldonComponent):
         draft_model_uri: str = "",
         prefix_cache_size: int = 0,
         prefix_cache_bytes: int = 0,
+        lora_rank: int = 0,
+        lora_max_adapters: int = 8,
+        lora_adapters: Optional[Dict[str, str]] = None,
+        slo_class_weights: Optional[Dict[str, float]] = None,
+        tenant_weights: Optional[Dict[str, float]] = None,
+        tenant_quota: int = 0,
+        tenant_quotas: Optional[Dict[str, int]] = None,
         seed: int = 0,
         **kwargs: Any,
     ):
@@ -393,6 +400,25 @@ class LLMServer(SeldonComponent):
         self._prefix_bytes = 0
         self._prefix_lock = threading.Lock()
         self._prefix_hits = 0
+        # Batched LoRA multi-tenancy (runtime/adapters.py,
+        # docs/multitenancy.md): lora_rank > 0 builds an AdapterRegistry
+        # at load() — a dense [lora_max_adapters, ...] HBM pool of
+        # low-rank q/o/FFN deltas gathered per slot inside the shared
+        # decode/prefill/verify programs (adapter id 0 = identity).
+        # ``lora_adapters`` maps name -> storage URI, preloaded at load().
+        self.lora_rank = int(lora_rank)
+        self.lora_max_adapters = int(lora_max_adapters)
+        self.lora_adapters = dict(lora_adapters or {})
+        self.adapter_registry: Optional[Any] = None
+        # SLO-aware weighted-fair scheduling (runtime/scheduler.py): the
+        # continuous batcher's admission queue orders requests by SLO
+        # class ("interactive" latency-sensitive vs "batch" throughput)
+        # and tenant under stride-scheduled weighted fairness, with
+        # per-tenant queue quotas shedding 503 + Retry-After on breach.
+        self.slo_class_weights = dict(slo_class_weights or {})
+        self.tenant_weights = dict(tenant_weights or {})
+        self.tenant_quota = int(tenant_quota)
+        self.tenant_quotas = dict(tenant_quotas or {})
         self.seed = int(seed)
         self.ready = False
         self._eos_override = eos_id
@@ -422,6 +448,10 @@ class LLMServer(SeldonComponent):
         # (seldon_llm_ttft_seconds / seldon_llm_inter_token_seconds)
         self._ttft_times: Any = deque(maxlen=4096)
         self._inter_token_times: Any = deque(maxlen=8192)
+        # per-SLO-class TTFT observations (multi-tenant serving): the
+        # batcher appends (class, ttft) pairs at first-token commit; the
+        # scrape drains them into seldon_llm_tenant_ttft_seconds{slo_class}
+        self._ttft_by_class: Any = deque(maxlen=4096)
         # disaggregated serving: per-handoff wall (prefill-slice compute +
         # device-to-device transfer + decode-side import)
         self._handoff_times: Any = deque(maxlen=4096)
@@ -506,6 +536,25 @@ class LLMServer(SeldonComponent):
                 f"prefill_devices={self.prefill_devices} / decode_devices="
                 f"{self.decode_devices} / prefill_workers="
                 f"{self.prefill_workers} must be >= 0")
+        if self.lora_rank < 0:
+            raise ValueError(
+                f"lora_rank={self.lora_rank} must be >= 0 (0 = adapters "
+                f"off)")
+        if self.lora_rank > 0:
+            if self.disaggregation not in ("", "off"):
+                raise ValueError(
+                    "lora_rank > 0 does not yet compose with "
+                    "disaggregation='remote_prefill': the adapter pool "
+                    "lives on the decode slice and prefill-slice workers "
+                    "would need committed replicas — a follow-up")
+            if int(self.model_kwargs.get("n_experts", 0) or 0) > 0:
+                raise ValueError(
+                    "lora_rank > 0 does not support MoE FFNs: adapters "
+                    "target the dense q/o/FFN projections")
+        from seldon_core_tpu.runtime.scheduler import normalize_slo_class
+
+        for cls in self.slo_class_weights:
+            normalize_slo_class(cls)  # unknown class names fail at load()
         if self.disaggregation != "off":
             if self.tensor_parallel > 1 or self.sequence_parallel > 1 \
                     or self.mesh is not None:
@@ -629,6 +678,20 @@ class LLMServer(SeldonComponent):
                     jax.random.PRNGKey(self.seed), jnp.zeros((1, 8), jnp.int32))
             self._draft_params = _cast_params(
                 dparams, self.param_dtype, self._draft_cfg.dtype)
+
+        # Batched LoRA pool: built after params so pool dtype follows the
+        # module compute dtype; preloads any configured adapter URIs
+        # through the storage layer. A registry exists exactly when
+        # lora_rank > 0 — the batcher keys its adapted-program choice on
+        # ``adapter_registry is not None``.
+        if self.lora_rank > 0:
+            from seldon_core_tpu.runtime.adapters import AdapterRegistry
+
+            # racelint: allow-unguarded-shared-state(load()-time build: runs once, before any serving thread or batcher loop exists)
+            self.adapter_registry = AdapterRegistry(
+                self._cfg, self.lora_rank, self.lora_max_adapters)
+            for aname, uri in self.lora_adapters.items():
+                self.adapter_registry.load_uri(aname, uri)
 
         if self.tokenizer_name == "bytes":
             self._tokenizer = ByteTokenizer()
@@ -913,8 +976,13 @@ class LLMServer(SeldonComponent):
                 self._prefix_index.remove(evicted_key)
                 self._prefix_bytes -= entry[-1]
 
-    def _get_prefill(self, b: int, plen: int, max_len: int):
-        key = (b, plen, max_len)
+    def _get_prefill(self, b: int, plen: int, max_len: int,
+                     lora: bool = False):
+        """``lora=True`` compiles the adapted variant: two extra trailing
+        args (adapter_pool pytree, adapter_ids [b]) apply each sequence's
+        low-rank q/o/FFN delta inside the same program
+        (models/transformer.py ``lora_delta``)."""
+        key = (b, plen, max_len, lora)
         fn = self._prefill_cache.get(key)
         if fn is not None:
             return fn
@@ -927,12 +995,22 @@ class LLMServer(SeldonComponent):
 
         kvd = self.kv_cache_dtype
 
-        def prefill(params, tokens, positions):
-            caches = init_kv_caches(cfg, tokens.shape[0], max_len, kvd)
-            logits, caches = module.apply(
-                deq(params), tokens, positions=positions, caches=caches, cache_index=0
-            )
-            return logits, caches
+        if lora:
+            def prefill(params, tokens, positions, adapter_pool, adapter_ids):
+                caches = init_kv_caches(cfg, tokens.shape[0], max_len, kvd)
+                logits, caches = module.apply(
+                    deq(params), tokens, positions=positions, caches=caches,
+                    cache_index=0, adapters=adapter_pool,
+                    adapter_ids=adapter_ids,
+                )
+                return logits, caches
+        else:
+            def prefill(params, tokens, positions):
+                caches = init_kv_caches(cfg, tokens.shape[0], max_len, kvd)
+                logits, caches = module.apply(
+                    deq(params), tokens, positions=positions, caches=caches, cache_index=0
+                )
+                return logits, caches
 
         cache_shardings = self._cache_shardings(b, max_len)
         if cache_shardings is not None:
@@ -1022,7 +1100,8 @@ class LLMServer(SeldonComponent):
         self._decode_cache[key] = decode
         return decode
 
-    def _get_decode_step(self, slots: int, max_len: int, k: int = 1):
+    def _get_decode_step(self, slots: int, max_len: int, k: int = 1,
+                         lora: bool = False):
         """Compiled pipelined decode step for the ContinuousBatcher: runs
         ``k`` decode micro-steps device-side (``lax.scan``) over ``slots``
         cache slots, with the sampling state IN the loop — per-slot rng
@@ -1046,7 +1125,7 @@ class LLMServer(SeldonComponent):
         tools/hlolint (docs/static-analysis.md): changing the carry
         structure here must keep every donated leaf aliasable or CI goes
         red on the dropped donation."""
-        key = ("pipestep", slots, max_len, k)
+        key = ("pipestep", slots, max_len, k, lora)
         fn = self._decode_cache.get(key)
         if fn is not None:
             return fn
@@ -1057,8 +1136,8 @@ class LLMServer(SeldonComponent):
         top_k = self.top_k
         deq = self._dequant
 
-        @partial(jax.jit, donate_argnums=(1, 3, 4))
-        def decode_step(params, caches, last_tok, next_pos, keys, temperature):
+        def core(params, caches, last_tok, next_pos, keys, temperature,
+                 adapter_pool, adapter_ids):
             sample = _slot_sampler(top_k)
 
             def step(carry, _):
@@ -1066,6 +1145,7 @@ class LLMServer(SeldonComponent):
                 logits, caches = module.apply(
                     deq(params), tok[:, None], positions=pos[:, None],
                     caches=caches, cache_index=pos,
+                    adapters=adapter_pool, adapter_ids=adapter_ids,
                 )
                 keys, nxt = sample(keys, logits[:, -1].astype(jnp.float32),
                                    temperature)
@@ -1075,10 +1155,28 @@ class LLMServer(SeldonComponent):
                 step, (caches, last_tok, next_pos, keys), None, length=k)
             return caches, tok, pos, keys, toks.T  # tokens [slots, k]
 
+        if lora:
+            # adapted variant (llm.lora_decode_step hlolint contract): the
+            # pool/id args are NOT donated — the pool is the registry's
+            # long-lived shared state and the ids array is host-managed
+            # like the block tables
+            @partial(jax.jit, donate_argnums=(1, 3, 4))
+            def decode_step(params, caches, last_tok, next_pos, keys,
+                            temperature, adapter_pool, adapter_ids):
+                return core(params, caches, last_tok, next_pos, keys,
+                            temperature, adapter_pool, adapter_ids)
+        else:
+            @partial(jax.jit, donate_argnums=(1, 3, 4))
+            def decode_step(params, caches, last_tok, next_pos, keys,
+                            temperature):
+                return core(params, caches, last_tok, next_pos, keys,
+                            temperature, None, None)
+
         self._decode_cache[key] = decode_step
         return decode_step
 
-    def _get_prefill_chunk(self, chunk: int, n_pages: int):
+    def _get_prefill_chunk(self, chunk: int, n_pages: int,
+                           lora: bool = False):
         """Compiled chunked-prefill step for the PAGED continuous batcher:
         write ``chunk`` prompt tokens (one sequence, PAD_POS padding) into
         the global page pool through the slot's block-table row, reading the
@@ -1088,7 +1186,7 @@ class LLMServer(SeldonComponent):
         Agrawal et al., OSDI 2024). The pool pytree is donated: the scatter
         updates in place, and the batcher threads the returned pool into
         the next dispatch. Returns (logits [1, chunk, vocab], pools)."""
-        key = ("pchunk", chunk, n_pages)
+        key = ("pchunk", chunk, n_pages, lora)
         fn = self._prefill_cache.get(key)
         if fn is not None:
             return fn
@@ -1097,13 +1195,28 @@ class LLMServer(SeldonComponent):
         module = self._module
         deq = self._dequant
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def prefill_chunk(params, pools, block_row, tokens, positions):
-            logits, pools = module.apply(
-                deq(params), tokens, positions=positions, caches=pools,
-                block_tables=block_row,
-            )
-            return logits, pools
+        if lora:
+            # adapted chunked prefill: the admitted sequence's adapter id
+            # rides as a [1] array so its q/o/FFN deltas shape the hidden
+            # states its KV is computed FROM (the k/v projections stay
+            # base — runtime/adapters.py, the KV-purity invariant)
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill_chunk(params, pools, block_row, tokens, positions,
+                              adapter_pool, adapter_ids):
+                logits, pools = module.apply(
+                    deq(params), tokens, positions=positions, caches=pools,
+                    block_tables=block_row, adapters=adapter_pool,
+                    adapter_ids=adapter_ids,
+                )
+                return logits, pools
+        else:
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill_chunk(params, pools, block_row, tokens, positions):
+                logits, pools = module.apply(
+                    deq(params), tokens, positions=positions, caches=pools,
+                    block_tables=block_row,
+                )
+                return logits, pools
 
         self._prefill_cache[key] = prefill_chunk
         return prefill_chunk
@@ -1173,7 +1286,8 @@ class LLMServer(SeldonComponent):
         self._prefill_cache[key] = fn
         return fn
 
-    def _get_decode_step_paged(self, slots: int, n_pages: int, k: int = 1):
+    def _get_decode_step_paged(self, slots: int, n_pages: int, k: int = 1,
+                               lora: bool = False):
         """Compiled pipelined decode step over the PAGED pool: identical
         sampling state machine to ``_get_decode_step`` (per-slot rng keys,
         device-resident token/position state, k-step ``lax.scan``), with the
@@ -1189,7 +1303,7 @@ class LLMServer(SeldonComponent):
         Token parity with the dense step is bit-exact on the gather
         fallback (tests/test_paged_kv.py); the compiled-form contract is
         pinned as llm.paged_decode_step_s4 in tools/hlolint."""
-        key = ("pagedstep", slots, n_pages, k)
+        key = ("pagedstep", slots, n_pages, k, lora)
         fn = self._decode_cache.get(key)
         if fn is not None:
             return fn
@@ -1200,9 +1314,8 @@ class LLMServer(SeldonComponent):
         top_k = self.top_k
         deq = self._dequant
 
-        @partial(jax.jit, donate_argnums=(1, 3, 4))
-        def decode_step(params, pools, last_tok, next_pos, keys, temperature,
-                        block_tables):
+        def core(params, pools, last_tok, next_pos, keys, temperature,
+                 block_tables, adapter_pool, adapter_ids):
             sample = _slot_sampler(top_k)
 
             def step(carry, _):
@@ -1210,6 +1323,7 @@ class LLMServer(SeldonComponent):
                 logits, pools = module.apply(
                     deq(params), tok[:, None], positions=pos[:, None],
                     caches=pools, block_tables=block_tables,
+                    adapters=adapter_pool, adapter_ids=adapter_ids,
                 )
                 keys, nxt = sample(keys, logits[:, -1].astype(jnp.float32),
                                    temperature)
@@ -1218,6 +1332,24 @@ class LLMServer(SeldonComponent):
             (pools, tok, pos, keys), toks = jax.lax.scan(
                 step, (pools, last_tok, next_pos, keys), None, length=k)
             return pools, tok, pos, keys, toks.T  # tokens [slots, k]
+
+        if lora:
+            # adapted paged step (llm.lora_decode_step hlolint contract):
+            # same donation shape as the base step; the adapter pool/ids
+            # ride along un-donated like the block tables
+            @partial(jax.jit, donate_argnums=(1, 3, 4))
+            def decode_step(params, pools, last_tok, next_pos, keys,
+                            temperature, block_tables, adapter_pool,
+                            adapter_ids):
+                return core(params, pools, last_tok, next_pos, keys,
+                            temperature, block_tables, adapter_pool,
+                            adapter_ids)
+        else:
+            @partial(jax.jit, donate_argnums=(1, 3, 4))
+            def decode_step(params, pools, last_tok, next_pos, keys,
+                            temperature, block_tables):
+                return core(params, pools, last_tok, next_pos, keys,
+                            temperature, block_tables, None, None)
 
         self._decode_cache[key] = decode_step
         return decode_step
@@ -1252,7 +1384,7 @@ class LLMServer(SeldonComponent):
 
     def _get_spec_step(self, slots: int, spec_k: int, hist_len: int, *,
                        mode: str = "ngram", layout: str = "paged",
-                       n_pages: int = 0):
+                       n_pages: int = 0, lora: bool = False):
         """Compiled speculative decode step for the ContinuousBatcher: ONE
         dispatch drafts up to K tokens per slot, verifies them in a single
         K+1-token target forward, and accepts the longest prefix that
@@ -1301,7 +1433,8 @@ class LLMServer(SeldonComponent):
         pinned by the llm.verify_step_k4 / llm.draft_verify_step_k4
         contracts in tools/hlolint (zero host transfers, intact aliasing,
         cost bands)."""
-        key = ("specstep", slots, spec_k, hist_len, mode, layout, n_pages)
+        key = ("specstep", slots, spec_k, hist_len, mode, layout, n_pages,
+               lora)
         fn = self._decode_cache.get(key)
         if fn is not None:
             return fn
@@ -1325,7 +1458,8 @@ class LLMServer(SeldonComponent):
             ddeq = self._draft_dequant
 
         def core(params, caches, last_tok, next_pos, keys, temperature,
-                 hist, draft_cap, bt, dparams, dcaches):
+                 hist, draft_cap, bt, dparams, dcaches,
+                 apool=None, aids=None):
             # verification samples through the SAME chain every compiled
             # decode step uses — the bit-exactness contract lives in
             # _slot_sampler, not in a local copy
@@ -1390,14 +1524,21 @@ class LLMServer(SeldonComponent):
             tokens_in = jnp.concatenate([last_tok[:, None], drafts], axis=1)
             positions = jnp.where(cols[None, :] <= dlen[:, None],
                                   next_pos[:, None] + cols[None, :], PAD_POS)
+            # the TARGET verify forward carries the per-slot adapters
+            # (llm.lora_verify_step contract); the draft forwards above
+            # stay base-model — proposals are only proposals, and the
+            # chain-exact accept loop below enforces the ADAPTED target's
+            # distribution either way
             if bt is None:
                 logits, caches = module.apply(
                     deq(params), tokens_in, positions=positions,
-                    caches=caches, cache_index=next_pos)
+                    caches=caches, cache_index=next_pos,
+                    adapters=apool, adapter_ids=aids)
             else:
                 logits, caches = module.apply(
                     deq(params), tokens_in, positions=positions,
-                    caches=caches, block_tables=bt)
+                    caches=caches, block_tables=bt,
+                    adapters=apool, adapter_ids=aids)
             lg32 = logits.astype(jnp.float32)
 
             # chain-exact accept loop: sample column j -> token j+1; rng
@@ -1452,7 +1593,20 @@ class LLMServer(SeldonComponent):
                         toks, a, dcaches)
             return (caches, new_last, next_pos + a, cur_keys, hist, toks, a)
 
-        if paged and draft_mode:
+        # lora=True appends (adapter_pool, adapter_ids) to each signature
+        # (un-donated, like the block tables); the donation shape of the
+        # serving state is identical to the base variant
+        if paged and draft_mode and lora:
+            @partial(jax.jit, donate_argnums=(1, 3, 4, 7, 10))
+            def spec_step(params, pools, last_tok, next_pos, keys,
+                          temperature, block_tables, hist, draft_cap,
+                          draft_params, draft_caches, adapter_pool,
+                          adapter_ids):
+                return core(params, pools, last_tok, next_pos, keys,
+                            temperature, hist, draft_cap, block_tables,
+                            draft_params, draft_caches, adapter_pool,
+                            adapter_ids)
+        elif paged and draft_mode:
             @partial(jax.jit, donate_argnums=(1, 3, 4, 7, 10))
             def spec_step(params, pools, last_tok, next_pos, keys,
                           temperature, block_tables, hist, draft_cap,
@@ -1460,6 +1614,14 @@ class LLMServer(SeldonComponent):
                 return core(params, pools, last_tok, next_pos, keys,
                             temperature, hist, draft_cap, block_tables,
                             draft_params, draft_caches)
+        elif paged and lora:
+            @partial(jax.jit, donate_argnums=(1, 3, 4, 7))
+            def spec_step(params, pools, last_tok, next_pos, keys,
+                          temperature, block_tables, hist, draft_cap,
+                          adapter_pool, adapter_ids):
+                return core(params, pools, last_tok, next_pos, keys,
+                            temperature, hist, draft_cap, block_tables,
+                            None, None, adapter_pool, adapter_ids)
         elif paged:
             @partial(jax.jit, donate_argnums=(1, 3, 4, 7))
             def spec_step(params, pools, last_tok, next_pos, keys,
@@ -1467,6 +1629,15 @@ class LLMServer(SeldonComponent):
                 return core(params, pools, last_tok, next_pos, keys,
                             temperature, hist, draft_cap, block_tables,
                             None, None)
+        elif draft_mode and lora:
+            @partial(jax.jit, donate_argnums=(1, 3, 4, 6, 9))
+            def spec_step(params, caches, last_tok, next_pos, keys,
+                          temperature, hist, draft_cap, draft_params,
+                          draft_caches, adapter_pool, adapter_ids):
+                return core(params, caches, last_tok, next_pos, keys,
+                            temperature, hist, draft_cap, None,
+                            draft_params, draft_caches, adapter_pool,
+                            adapter_ids)
         elif draft_mode:
             @partial(jax.jit, donate_argnums=(1, 3, 4, 6, 9))
             def spec_step(params, caches, last_tok, next_pos, keys,
@@ -1475,6 +1646,14 @@ class LLMServer(SeldonComponent):
                 return core(params, caches, last_tok, next_pos, keys,
                             temperature, hist, draft_cap, None,
                             draft_params, draft_caches)
+        elif lora:
+            @partial(jax.jit, donate_argnums=(1, 3, 4, 6))
+            def spec_step(params, caches, last_tok, next_pos, keys,
+                          temperature, hist, draft_cap, adapter_pool,
+                          adapter_ids):
+                return core(params, caches, last_tok, next_pos, keys,
+                            temperature, hist, draft_cap, None, None, None,
+                            adapter_pool, adapter_ids)
         else:
             @partial(jax.jit, donate_argnums=(1, 3, 4, 6))
             def spec_step(params, caches, last_tok, next_pos, keys,
@@ -1772,6 +1951,17 @@ class LLMServer(SeldonComponent):
                         "prefix_hit_blocks": 0, "prefix_hit_tokens": 0,
                         "prefix_cow_copies": 0, "prefix_evicted_blocks": 0,
                         "prefix_bytes_saved": 0}
+        # multi-tenant serving (docs/multitenancy.md): adapter-pool
+        # occupancy/churn/bytes plus the scheduler's per-(tenant, class)
+        # tallies — seldon_llm_adapter_* / seldon_tenant_*_total
+        adapter_stats = {"adapter_loaded": 0, "adapter_evictions_total": 0,
+                        "adapter_pool_bytes": 0}
+        reg = getattr(self, "adapter_registry", None)
+        if reg is not None:
+            snap = reg.stats()
+            adapter_stats = {k: snap[k] for k in adapter_stats}
+        tenant_counters: List[dict] = []
+        queue_by_class: Dict[str, int] = {}
         svc = getattr(self, "_batcher_service", None)
         if svc is not None:
             batcher = svc.batcher
@@ -1792,6 +1982,10 @@ class LLMServer(SeldonComponent):
                 spec_stats.update(batcher.spec_stats())
             if getattr(batcher, "_remote", None) is not None:
                 handoff_stats.update(batcher.handoff_stats())
+            sched = getattr(batcher, "_pending", None)
+            if hasattr(sched, "counters"):
+                tenant_counters = sched.counters()
+                queue_by_class = sched.depths()
         with self._prefix_lock:
             prefix_bytes = self._prefix_bytes
         return {
@@ -1836,4 +2030,10 @@ class LLMServer(SeldonComponent):
             # radix prefix cache: block-level reuse counters + the
             # shared-page gauge (docs/performance.md "Radix prefix cache")
             **prefix_stats,
+            # multi-tenant serving: adapter pool + per-tenant fairness
+            # tallies + per-class TTFT drains (docs/multitenancy.md)
+            **adapter_stats,
+            "tenant_counters": tenant_counters,
+            "queue_by_class": queue_by_class,
+            "ttft_by_class": drain(self._ttft_by_class),
         }

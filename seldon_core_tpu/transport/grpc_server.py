@@ -75,6 +75,14 @@ def _traceparent_from_context(context: grpc.ServicerContext) -> Optional[str]:
     return None
 
 
+def _metadata_value(context: grpc.ServicerContext, name: str) -> Optional[str]:
+    """One invocation-metadata value by (lowercase) key, or None."""
+    for key, value in context.invocation_metadata() or ():
+        if key == name:
+            return value
+    return None
+
+
 def _deadline_from_context(context: grpc.ServicerContext) -> Deadline | None:
     """The client's gRPC deadline (context.time_remaining()), else the
     ``seldon-deadline-ms`` metadata key for clients that cannot set one."""
@@ -268,12 +276,25 @@ def _make_generate_stream(component: Any):
             return pc.message_to_proto(SeldonMessage.from_json_data(
                 {"token": tok, "text": piece}))
 
+        # multi-tenant identity: the metadata spellings of the REST
+        # headers (Seldon-Tenant / Seldon-SLO-Class), jsonData fields
+        # winning when both are present; the adapter name is a jsonData
+        # field and the gRPC deadline doubles as the scheduler's EDF key
+        tenant = body.get("tenant") or _metadata_value(context,
+                                                       "seldon-tenant")
+        slo_class = body.get("slo_class") or _metadata_value(
+            context, "seldon-slo-class")
+        dl = _deadline_from_context(context)
         q: _queue.Queue = _queue.Queue()
         _DONE = object()
         info: dict = {}
         cfut = svc.submit_stream(prompt, max_new, on_token=q.put,
                                  info=info, seed=body.get("seed"),
-                                 trace=trace)
+                                 trace=trace, tenant=tenant,
+                                 slo_class=slo_class,
+                                 adapter=body.get("adapter"),
+                                 deadline_s=(dl.remaining_s()
+                                             if dl is not None else None))
         # a submit that fails before any token never sends the None
         # sentinel; the done-callback marker keeps the pump from hanging
         cfut.add_done_callback(lambda f: q.put(_DONE))

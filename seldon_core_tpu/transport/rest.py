@@ -268,7 +268,32 @@ def _add_generate_routes(app: web.Application, component: Any,
             if not isinstance(body, dict):
                 raise SeldonError("body must be a JSON object", status_code=400)
             max_new = body.get("max_new_tokens")
+            # multi-tenant identity (docs/multitenancy.md): tenant + SLO
+            # class ride headers (body fields win when both are present,
+            # for clients that cannot set headers); the LoRA adapter name
+            # is a body field like the sampling knobs. The deadline header
+            # doubles as the scheduler's EDF key.
+            tenant = body.get("tenant") or request.headers.get("Seldon-Tenant")
+            slo_class = (body.get("slo_class")
+                         or request.headers.get("Seldon-SLO-Class"))
+            # a typo'd class fails loudly on EVERY path — the non-batched
+            # branches below (prompts batch, per-request temperature)
+            # never reach the batcher's own validation
+            from seldon_core_tpu.runtime.scheduler import normalize_slo_class
+
+            try:
+                normalize_slo_class(slo_class)
+            except ValueError as e:
+                raise SeldonError(str(e), status_code=400)
+            adapter = body.get("adapter")
+            dl = deadline_from_headers(request)
+            deadline_s = dl.remaining_s() if dl is not None else None
             if "prompts" in body:
+                if adapter:
+                    raise SeldonError(
+                        "adapters serve through the continuous batch; use "
+                        "single-prompt requests (the 'prompts' batch runs "
+                        "a private base-model generate())", status_code=400)
                 out = await asyncio.to_thread(
                     component.generate, body["prompts"], max_new_tokens=max_new,
                     temperature=body.get("temperature"), seed=body.get("seed"))
@@ -288,9 +313,27 @@ def _add_generate_routes(app: web.Application, component: Any,
             # clipped budget), where only the private per-request-sized
             # generate() can honor the seeded-reproducibility contract.
             custom_sampling = "temperature" in body
+            if adapter and custom_sampling:
+                raise SeldonError(
+                    "per-request temperature cannot join the shared batch, "
+                    "and adapters only serve through it — drop one",
+                    status_code=400)
             svc = None if custom_sampling else get_batcher_service(component)
+            if svc is None and adapter:
+                # adapters serve ONLY through a batcher (the adapted
+                # compiled programs live there); a component without
+                # continuous batching still serves them via the shared
+                # 1-slot streaming service
+                from seldon_core_tpu.runtime.batcher import ensure_stream_service
+
+                svc = await asyncio.to_thread(ensure_stream_service, component)
             if svc is not None and "seed" in body and not await asyncio.to_thread(
                     svc.batcher.accommodates, prompt, max_new):
+                if adapter:
+                    raise SeldonError(
+                        "seeded adapted prompt exceeds the batcher slot "
+                        "cache and would not reproduce; raise "
+                        "continuous_batching_max_len", status_code=400)
                 svc = None
             stream = bool(body.get("stream"))
             decode = getattr(component, "_tokenizer", None)
@@ -300,7 +343,10 @@ def _add_generate_routes(app: web.Application, component: Any,
                 if svc is not None:
                     toks = await svc.submit(prompt, max_new, info=info,
                                             seed=body.get("seed"),
-                                            trace=trace)
+                                            trace=trace, tenant=tenant,
+                                            slo_class=slo_class,
+                                            adapter=adapter,
+                                            deadline_s=deadline_s)
                 else:
                     out = await asyncio.to_thread(
                         component.generate, [prompt], max_new_tokens=max_new,
@@ -372,7 +418,11 @@ def _add_generate_routes(app: web.Application, component: Any,
                                                    on_token=on_token,
                                                    info=info,
                                                    seed=body.get("seed"),
-                                                   trace=trace))
+                                                   trace=trace,
+                                                   tenant=tenant,
+                                                   slo_class=slo_class,
+                                                   adapter=adapter,
+                                                   deadline_s=deadline_s))
             try:
                 # Wait on the queue AND the future: a submit that fails before
                 # any token (closed batcher, bad prompt) never sends the None

@@ -407,7 +407,7 @@ def test_sse_drain_delivers_tokens_flooded_at_completion():
         submitted = 0
 
         async def submit(self, prompt, max_new_tokens=None, on_token=None,
-                         info=None, seed=None, trace=None):
+                         info=None, seed=None, trace=None, **identity):
             # let the SSE loop park in its queue/future wait first
             await asyncio.sleep(0.05)
             loop = asyncio.get_running_loop()

@@ -330,6 +330,140 @@ def test_autoscaler_locked_tick_is_clean(tmp_path):
     assert rules_of(reported) == []
 
 
+ADAPTER_REGISTRY = """
+    import threading
+
+    class AdapterRegistry:
+        # the ISSUE 15 LoRA-pool discipline: load/evict run on management
+        # (transport) threads, pin/unpin on the batcher loop's offload
+        # context, stats on /metrics scrape threads — the row map and
+        # refcounts are the shared truth evict reads before freeing
+        def __init__(self, n):
+            self._lock = threading.Lock()
+            self._pins = {}
+            self._free_rows = list(range(n, 0, -1))
+            self.evictions_total = 0
+
+        def load(self, name):
+            with self._lock:
+                row = self._free_rows.pop()
+                self._pins[row] = 0
+                return row
+
+        def pin(self, row):
+            self._pins[row] += 1             # pre-fix: unlocked RMW
+
+        def unpin(self, row):
+            self._pins[row] -= 1             # pre-fix: unlocked RMW
+
+        def evict(self, row):
+            with self._lock:
+                if self._pins.get(row, 0) > 0:
+                    return False
+                del self._pins[row]
+                self._free_rows.append(row)
+                self.evictions_total += 1
+                return True
+"""
+
+
+def test_adapter_registry_unlocked_pin_fires(tmp_path):
+    """The adapter-refcount discipline (ISSUE 15 satellite): load/evict
+    establish the guarded pattern on the pin map; an unlocked pin/unpin
+    RMW is exactly the lost-reference race that lets evict free an
+    adapter a live slot is about to gather —
+    tests/test_schedules.py proves it dynamically."""
+    root = write_tree(tmp_path / "pkg",
+                      {"runtime/adapters.py": ADAPTER_REGISTRY})
+    reported, _, _ = lint(root)
+    us = [f for f in reported if f.rule == "unguarded-shared-state"]
+    assert us, "the unlocked pin refcount RMW must fire"
+    assert any("_pins" in f.message for f in us)
+
+
+def test_adapter_registry_locked_pin_is_clean(tmp_path):
+    fixed = ADAPTER_REGISTRY.replace(
+        "        def pin(self, row):\n"
+        "            self._pins[row] += 1             # pre-fix: unlocked RMW\n"
+        "\n"
+        "        def unpin(self, row):\n"
+        "            self._pins[row] -= 1             # pre-fix: unlocked RMW",
+        "        def pin(self, row):\n"
+        "            with self._lock:\n"
+        "                self._pins[row] += 1\n"
+        "\n"
+        "        def unpin(self, row):\n"
+        "            with self._lock:\n"
+        "                self._pins[row] -= 1")
+    assert fixed != ADAPTER_REGISTRY
+    root = write_tree(tmp_path / "pkg",
+                      {"runtime/adapters.py": fixed})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+WFQ_SCHEDULER = """
+    import threading
+
+    class WeightedFairScheduler:
+        # the ISSUE 15 admission-queue discipline: push() runs from
+        # submit coroutines, next_request/commit from the batcher's
+        # admission turns, counters/depths from /metrics scrape threads
+        # — the size, virtual clocks and tenant tallies all share state
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._size = 0
+            self._class_vt = {"interactive": 0.0, "batch": 0.0}
+            self._shed_total = 0
+
+        def push(self, cls):
+            self._size += 1                  # pre-fix: unlocked RMW
+            return True
+
+        def commit(self, cls):
+            with self._lock:
+                self._size -= 1
+                self._class_vt[cls] += 1.0
+
+        def count_shed(self):
+            with self._lock:
+                self._shed_total += 1
+
+        def __len__(self):
+            with self._lock:
+                return self._size
+"""
+
+
+def test_wfq_scheduler_unlocked_push_fires(tmp_path):
+    """The scheduler discipline (ISSUE 15 satellite): commit/count_shed/
+    __len__ establish the guarded pattern on the queue size; an unlocked
+    push() loses admissions under the interleaving
+    tests/test_schedules.py finds."""
+    root = write_tree(tmp_path / "pkg",
+                      {"runtime/scheduler.py": WFQ_SCHEDULER})
+    reported, _, _ = lint(root)
+    us = [f for f in reported if f.rule == "unguarded-shared-state"]
+    assert us, "the unlocked push size RMW must fire"
+    assert any("_size" in f.message for f in us)
+
+
+def test_wfq_scheduler_locked_push_is_clean(tmp_path):
+    fixed = WFQ_SCHEDULER.replace(
+        "        def push(self, cls):\n"
+        "            self._size += 1                  # pre-fix: unlocked RMW\n"
+        "            return True",
+        "        def push(self, cls):\n"
+        "            with self._lock:\n"
+        "                self._size += 1\n"
+        "                return True")
+    assert fixed != WFQ_SCHEDULER
+    root = write_tree(tmp_path / "pkg",
+                      {"runtime/scheduler.py": fixed})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
 def test_unguarded_read_against_guarded_writes_fires(tmp_path):
     """The CircuitBreaker.state_code class: guarded writes establish the
     discipline, an unguarded public read violates it."""

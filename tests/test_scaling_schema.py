@@ -34,6 +34,9 @@ PINNED_FIELDS = {
     "draining": bool,
     "prefill_devices": int,
     "decode_devices": int,
+    # multi-tenant (ISSUE 15): queued admissions per SLO class — the
+    # weighted-fair scheduler's split of queue_depth
+    "queue_by_class": dict,
 }
 PINNED_REQUEST_BLOCKS = ("ttft_s", "queue_wait_s", "worst_gap_s")
 PINNED_QUANTILE_KEYS = {"p50", "p95", "max"}
@@ -117,6 +120,18 @@ def test_componentless_snapshot_keeps_the_schema():
     assert snap["total_slots"] == 0 and snap["draining"] is False
 
 
+def _queue_dummy_requests(batcher, n):
+    """Park n inert requests in the weighted-fair scheduler (the loop
+    never runs: nothing admits them) so backlog-derived hints have a
+    queue to measure."""
+    from seldon_core_tpu.runtime.scheduler import PendingRequest
+
+    reqs = [PendingRequest(ids=[1], max_new=1, fut=None) for _ in range(n)]
+    for r in reqs:
+        assert batcher._pending.push(r)
+    return reqs
+
+
 # ------------------------------------------------- dynamic Retry-After
 def test_retry_after_hint_scales_with_backlog():
     from seldon_core_tpu.runtime.batcher import ContinuousBatcher
@@ -128,11 +143,12 @@ def test_retry_after_hint_scales_with_backlog():
                               layout="paged", page_size=8)
         idle = b.retry_after_hint()
         # 8 queued requests over 2 slots = 4 drain waves ahead (the loop
-        # never ran: no submit ever started it, so poking _pending is
-        # race-free)
-        b._pending.extend([None] * 8)
+        # never ran: no submit ever started it, so poking the scheduler
+        # is race-free)
+        reqs = _queue_dummy_requests(b, 8)
         loaded = b.retry_after_hint()
-        b._pending.clear()
+        for r in reqs:
+            b._pending.remove(r)
         await b.close()
         return idle, loaded
 
@@ -184,9 +200,10 @@ def test_batcher_page_shed_uses_the_hint():
     async def go():
         b = ContinuousBatcher(s, max_slots=2, max_len=40, len_buckets=(8,),
                               layout="paged", page_size=8)
-        b._pending.extend([None] * 8)
+        reqs = _queue_dummy_requests(b, 8)
         err = b._shed_error("test")
-        b._pending.clear()
+        for r in reqs:
+            b._pending.remove(r)
         await b.close()
         return err
 

@@ -1153,3 +1153,163 @@ def test_replica_set_membership_safe_under_exploration():
     assert find_race(_replica_set_membership_scenario, ok,
                      granularity="line", max_schedules=100,
                      stall_s=STALL) is None
+
+
+# ---------------------------------------------------------------------------
+# adapter registry + weighted-fair scheduler (ISSUE 15): the multi-tenant
+# refcount and tally discipline under interleaving
+# ---------------------------------------------------------------------------
+
+
+class UnlockedAdapterRefcounts:
+    """Reconstruction of the race the AdapterRegistry's lock exists to
+    prevent (ISSUE 15): pin() is a liveness-check-then-increment and
+    evict() a refcount-check-then-free; with no lock the two interleave
+    into evict freeing a row a live slot just pinned — exactly the
+    freed-while-referenced corruption the acceptance bar forbids (the
+    slot's next dispatch would gather a row a later load may repopulate
+    with ANOTHER tenant's weights)."""
+
+    def __init__(self):
+        self._pins = {3: 0}        # one loaded adapter, row 3, unpinned
+        self._freed = []
+
+    def pin(self, row):
+        if row in self._pins:          # liveness check (the real _by_row get)
+            n = self._pins.get(row, 0)  # ...then the increment — not atomic
+            self._pins[row] = n + 1
+            return True
+        return False               # raced an evict: fail loudly
+
+    def evict(self, row):
+        if self._pins.get(row, 0) == 0:   # refcount check
+            self._pins.pop(row, None)      # ...then the free
+            self._freed.append(row)
+            return True
+        return False
+
+
+def _unlocked_adapter_scenario(sched):
+    r = UnlockedAdapterRefcounts()
+    out = {}
+    r._out = out
+    sched.spawn(lambda: out.__setitem__("pinned", r.pin(3)),
+                name="slot-pin")
+    sched.spawn(lambda: out.__setitem__("evicted", r.evict(3)),
+                name="evict")
+    return r
+
+
+def test_adapter_refcount_unlocked_reconstruction_frees_pinned_row():
+    """Opcode exploration finds the pin-lost-to-evict update; the exact
+    schedule replays deterministically to the same corruption."""
+
+    def ok(r):
+        # the invariant evict exists to hold: a freed row is never pinned
+        return not (r._freed and r._pins.get(3, 0) > 0)
+
+    bad = find_race(_unlocked_adapter_scenario, ok, granularity="opcode",
+                    max_schedules=200, stall_s=STALL)
+    assert bad is not None, \
+        "unlocked pin/evict must free a pinned row under some schedule"
+    r, _, sched = run_schedule(_unlocked_adapter_scenario,
+                               schedule=bad.to_list(),
+                               granularity="opcode", stall_s=STALL)
+    assert not sched.errors()
+    # the corruption, replayed: BOTH calls reported success — the slot
+    # believes it holds a pin on a row eviction just freed
+    assert r._out["pinned"] and r._out["evicted"]
+    assert r._freed and r._pins.get(3, 0) > 0
+
+
+def _tiny_registry():
+    from seldon_core_tpu.models.transformer import TransformerConfig
+    from seldon_core_tpu.runtime.adapters import AdapterRegistry
+
+    cfg = TransformerConfig(vocab_size=16, dim=8, n_layers=1, n_heads=2,
+                            n_kv_heads=2, ffn_dim=8, max_seq_len=16,
+                            tie_embeddings=True)
+    return AdapterRegistry(cfg, rank=1, max_adapters=3)
+
+
+def test_real_registry_load_evict_pin_exact_under_exploration():
+    """The REAL AdapterRegistry (runtime/adapters.py): a slot pin racing
+    an evict racing a concurrent load can never end freed-while-pinned —
+    either the pin won (adapter stays, exactly one reference) or the
+    evict won (row freed, the pin failed LOUDLY with KeyError) — and the
+    racing load always lands. Line granularity: the registry's jitted
+    row writes dispatch real arrays, prewarmed below so exploration
+    replays cached executables, not compiles."""
+    # prewarm the process-shared jitted row write + zeros-init compiles
+    warm = _tiny_registry()
+    warm.load("w", {})
+    warm.evict("w")
+
+    def scenario(sched):
+        reg = _tiny_registry()
+        reg.load("a", {})
+        out = {}
+        reg._out = out
+
+        def slot_pin():
+            try:
+                reg.pin(reg.resolve("a"))
+                out["pinned"] = True
+            except KeyError:
+                out["pinned"] = False  # raced the evict: failed loudly
+
+        sched.spawn(slot_pin, name="slot-pin")
+        sched.spawn(lambda: out.__setitem__("evicted", reg.evict("a")),
+                    name="evict")
+        sched.spawn(lambda: reg.load("b", {}), name="load")
+        return reg
+
+    def ok(reg):
+        out = reg._out
+        names = reg.names()
+        if "b" not in names:           # the concurrent load always lands
+            return False
+        if out["evicted"]:
+            # freed: the pin must NOT believe it holds a reference
+            return not out["pinned"] and "a" not in names
+        # not freed: the pin holds exactly one live reference
+        return out["pinned"] and reg.refs_of("a") == 1
+
+    # 25 schedules: the jitted row writes make each schedule ~10x a
+    # pure-python one against the tier-1 870 s budget; the CHEAP
+    # reconstruction above explores 200
+    assert find_race(scenario, ok, granularity="line",
+                     max_schedules=25, stall_s=STALL) is None
+
+
+def _wfq_tally_scenario(sched):
+    from seldon_core_tpu.runtime.scheduler import (PendingRequest,
+                                                   WeightedFairScheduler)
+
+    s = WeightedFairScheduler()
+    reqs = [PendingRequest(ids=[1], max_new=1, fut=None, tenant="t",
+                           slo_class="batch") for _ in range(2)]
+    s.push(reqs[0])
+    s._reqs = reqs
+    sched.spawn(lambda: s.push(reqs[1]), name="submit")
+    sched.spawn(lambda: s.commit(reqs[0]), name="admit")
+    sched.spawn(lambda: s.count_shed("t", "batch"), name="page-shed")
+    sched.spawn(s.counters, name="scrape")
+    return s
+
+
+def test_real_wfq_scheduler_tallies_exact_under_exploration():
+    """The REAL WeightedFairScheduler: a submit push racing the admission
+    commit racing a post-admission shed racing a /metrics scrape keeps
+    every tally exact — one admitted, one shed, one still queued —
+    whatever the interleaving (the unlocked reconstruction is the
+    racelint fixture pair in tests/test_racelint.py)."""
+
+    def ok(s):
+        (row,) = [r for r in s.counters()
+                  if r["tenant"] == "t" and r["slo_class"] == "batch"]
+        return (row["admitted"] == 1 and row["shed"] == 1
+                and row["queued"] == 1 and len(s) == 1)
+
+    assert find_race(_wfq_tally_scenario, ok, granularity="opcode",
+                     max_schedules=80, stall_s=STALL) is None

@@ -45,6 +45,16 @@ SPEC_K = 4
 # reserved rows leading every staged page bucket (models/transformer.py
 # RESERVED_PAGES — named locally so the contract dims read in one place)
 RESERVED_PAGES_N = 2
+# batched LoRA (ISSUE 15): the adapted-step contracts compile a small
+# dense adapter pool — rank 2 x 4 rows (identity + 3 tenants). At these
+# toy dims the adapter machinery is a far larger FRACTION of the step
+# than at 7B (the 64-wide projections are nearly free while the
+# gather+einsum overhead is fixed), so the rank is chosen to keep the
+# adapted step INSIDE the plain step's tolerance band — the
+# near-base-model-throughput claim tests/test_adapters.py pins against
+# budgets.json; at serving dims the margin only widens.
+LORA_RANK = 2
+LORA_ADAPTERS = 4
 
 
 def ensure_platform() -> None:
@@ -128,6 +138,30 @@ def _draft_server():
             s.load()
             _STATE["draft_server"] = s
         return _STATE["draft_server"]
+
+
+def _lora_server():
+    """base-server layout plus the batched-LoRA adapter pool
+    (rank LORA_RANK=2, LORA_ADAPTERS=4 rows — see the constants' comment
+    for why rank 2): the adapted decode/verify-step contracts. The
+    pool rides into the compiled step as an un-donated pytree argument
+    plus per-slot adapter ids — the registry swaps pools functionally on
+    load/evict, so the program must never alias them."""
+    with _STATE_LOCK:
+        if "lora_server" not in _STATE:
+            ensure_platform()
+            from seldon_core_tpu.servers.llmserver import LLMServer
+
+            s = LLMServer(
+                model="llama-tiny", model_kwargs={"dtype": "bfloat16"},
+                init_random=True, max_new_tokens=N_STEPS + 1,
+                len_buckets=(PLEN,), batch_buckets=(1, SLOTS), seed=7,
+                kv_cache_dtype="int8", lora_rank=LORA_RANK,
+                lora_max_adapters=LORA_ADAPTERS,
+            )
+            s.load()
+            _STATE["lora_server"] = s
+        return _STATE["lora_server"]
 
 
 def _batcher():
@@ -372,6 +406,37 @@ def _build_draft_verify_step_k4():
                 s._draft_params, dcaches)
 
 
+def _build_lora_decode_step():
+    """Batched-LoRA paged decode step (ISSUE 15): the plain pipelined
+    step plus one gather+einsum pair per adapted q/o/FFN projection,
+    factors gathered from the dense pool by the per-slot adapter ids.
+    Serving state donates exactly like the plain step; the pool and ids
+    are long-lived shared state and must NOT alias."""
+    s = _lora_server()
+    fn = s._get_decode_step_paged(SLOTS, PAGES_PER_SLOT, 1, lora=True)
+    return fn, (s._params, _paged_cache_specs(), _sds((SLOTS,), "int32"),
+                _sds((SLOTS,), "int32"), _sds((SLOTS, 2), "uint32"),
+                _sds((), "float32"),
+                _sds((SLOTS, PAGES_PER_SLOT), "int32"),
+                s.adapter_registry.pool(), _sds((SLOTS,), "int32"))
+
+
+def _build_lora_verify_step():
+    """Batched-LoRA speculative verify step (ISSUE 15): the ngram
+    draft+verify program with the per-slot adapter deltas applied in the
+    TARGET forward (drafting stays base-model — the chain-exact accept
+    loop enforces the adapted distribution either way)."""
+    s = _lora_server()
+    fn = s._get_spec_step(SLOTS, SPEC_K, MAX_LEN, mode="ngram",
+                          layout="paged", n_pages=PAGES_PER_SLOT, lora=True)
+    return fn, (s._params, _paged_cache_specs(), _sds((SLOTS,), "int32"),
+                _sds((SLOTS,), "int32"), _sds((SLOTS, 2), "uint32"),
+                _sds((), "float32"),
+                _sds((SLOTS, PAGES_PER_SLOT), "int32"),
+                _sds((SLOTS, MAX_LEN), "int32"), _sds((SLOTS,), "int32"),
+                s.adapter_registry.pool(), _sds((SLOTS,), "int32"))
+
+
 def _build_set_hist_row():
     b = _batcher()
     return b._set_hist_row, (_sds((SLOTS, MAX_LEN), "int32"),
@@ -573,6 +638,37 @@ def all_contracts() -> List[Contract]:
             build=_build_draft_verify_step_k4,
             donated=(1, 3, 4, 6, 9),
             forbid_dtypes=((_f32_cache_sig(SLOTS), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="llm.lora_decode_step",
+            description="batched-LoRA paged decode step (S=4, k=1, rank-2 "
+                        "pool x 4 rows): the plain pipelined step plus one "
+                        "gather+einsum pair per adapted q/o/FFN projection "
+                        "— adapter id 0 is the zero-delta identity, so this "
+                        "program serves base and adapted slots alike. Same "
+                        "donation shape as the plain step; the pool/ids are "
+                        "shared state and must not alias. Its cost budget "
+                        "must sit within the plain step's tolerance band "
+                        "(tests/test_adapters.py pins it): near-base-model "
+                        "throughput is the design claim",
+            build=_build_lora_decode_step,
+            donated=(1, 3, 4),
+            forbid_dtypes=((_f32_pool_sig(), F32_CACHE_WHY),),
+            collectives={},
+            cost=True,
+        ),
+        Contract(
+            name="llm.lora_verify_step",
+            description="batched-LoRA speculative verify step (S=4, K=4, "
+                        "paged): per-slot adapter deltas in the K+1-token "
+                        "TARGET forward (ngram drafting stays base-model); "
+                        "caches / next_pos / keys / hist donated like the "
+                        "plain verify step, adapter pool/ids un-donated",
+            build=_build_lora_verify_step,
+            donated=(1, 3, 4, 7),
+            forbid_dtypes=((_f32_pool_sig(), F32_CACHE_WHY),),
             collectives={},
             cost=True,
         ),
