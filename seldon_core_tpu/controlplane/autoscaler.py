@@ -81,6 +81,7 @@ class ReplicaSignals:
     page_sheds_total: int = 0
     handoff_queue_depth: int = 0
     draining: bool = False
+    ejected: bool = False
     prefill_devices: int = 0
     decode_devices: int = 0
     ttft_p95_s: Optional[float] = None
@@ -109,6 +110,7 @@ class ReplicaSignals:
             page_sheds_total=int(snap.get("page_sheds_total", 0)),
             handoff_queue_depth=int(snap.get("handoff_queue_depth", 0)),
             draining=bool(snap.get("draining", False)),
+            ejected=bool(snap.get("ejected", False)),
             prefill_devices=int(snap.get("prefill_devices", 0)),
             decode_devices=int(snap.get("decode_devices", 0)),
             ttft_p95_s=q("ttft_s"),
@@ -176,10 +178,13 @@ class ControllerState:
 
 
 def _fleet_pressure(signals: Sequence[ReplicaSignals]) -> Tuple[float, float]:
-    """(queued work per slot, max page pressure) over the NON-draining
-    replicas — a draining replica's emptying queue must not drag the
-    fleet mean down and mask real overload on the survivors."""
-    live = [s for s in signals if not s.draining] or list(signals)
+    """(queued work per slot, max page pressure) over the NON-draining,
+    NON-ejected replicas — a draining replica's emptying queue must not
+    drag the fleet mean down and mask real overload on the survivors,
+    and an ejected corpse's frozen snapshot must not count as serving
+    capacity at all."""
+    live = [s for s in signals
+            if not s.draining and not s.ejected] or list(signals)
     queued = sum(s.queue_depth + s.active_slots for s in live)
     slots = sum(s.total_slots for s in live) or 1
     pages = max((s.page_pressure for s in live), default=0.0)
@@ -193,6 +198,7 @@ def decide_scale(
     now: float,
     n_replicas: int,
     n_draining: int = 0,
+    n_ejected: int = 0,
 ) -> Tuple[Decision, ControllerState]:
     """The pure replica-count decision: (signals, config, history) ->
     (decision, next history).  No clock reads, no I/O — ``now`` comes
@@ -222,8 +228,22 @@ def decide_scale(
         under_ticks=state.under_ticks + 1 if under else 0,
     )
     in_cooldown = now - state.last_scale_t < cfg.cooldown_s
-    serving = n_replicas - n_draining  # replicas taking fleet traffic
+    # replicas taking fleet traffic: drained AND ejected members are out
+    serving = n_replicas - n_draining - n_ejected
 
+    # replace-on-ejection (docs/control-plane.md): an unplanned death is a
+    # capacity loss the load signals may take ticks to notice — replace
+    # the corpse NOW rather than waiting for queues to back up. Stability
+    # windows don't apply (the ejection itself is the sustained signal);
+    # the cooldown still does, so a flapping replica cannot stampede the
+    # fleet.
+    if (n_ejected > 0 and not in_cooldown
+            and serving < cfg.max_replicas):
+        return (
+            Decision(SCALE_UP, serving + 1,
+                     f"{n_ejected} replica(s) ejected — replacing"),
+            replace(state, over_ticks=0, under_ticks=0, last_scale_t=now),
+        )
     if (over and state.over_ticks >= cfg.up_stable_ticks
             and not in_cooldown and serving < cfg.max_replicas):
         return (
@@ -343,11 +363,15 @@ class Autoscaler:
     def signals(self) -> List[ReplicaSignals]:
         reps = self.replica_set.members()
         draining = self.replica_set.draining_members()
+        ej = getattr(self.replica_set, "ejected_members", None)
+        ejected = ej() if ej is not None else []
         out = []
         for r in reps:
             snap = dict(self._snapshot_fn(r))
             if r in draining:
                 snap["draining"] = True
+            if r in ejected:
+                snap["ejected"] = True
             out.append(ReplicaSignals.from_scaling(snap))
         return out
 
@@ -356,14 +380,22 @@ class Autoscaler:
         """One control pass: decide on fresh signals, actuate, and sweep
         drained replicas.  Returns the scale decision (rebalance runs as a
         side decision when enabled)."""
+        # sweep fleet health FIRST: a replica that died since the last
+        # tick must read as ejected in THIS tick's signals, so the replace
+        # branch fires one control pass after the death, not two
+        check = getattr(self.replica_set, "check_health", None)
+        if check is not None:
+            check()
         sigs = self.signals()
         now = self.clock()
         n = len(self.replica_set.members())
         n_drain = len(self.replica_set.draining_members())
+        ej = getattr(self.replica_set, "ejected_members", None)
+        n_ej = len(ej()) if ej is not None else 0
         with self._lock:
             self._ticks_total += 1
             decision, self._state = decide_scale(
-                sigs, self.config, self._state, now, n, n_drain)
+                sigs, self.config, self._state, now, n, n_drain, n_ej)
             reb = Decision(HOLD, 0, "")
             if self.config.rebalance:
                 reb, self._state = decide_rebalance(

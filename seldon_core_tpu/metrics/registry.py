@@ -422,6 +422,56 @@ class MetricsRegistry:
             buckets=LATENCY_BUCKETS,
             registry=self.registry,
         )
+        # Fleet fault tolerance (runtime/engine.py ReplicaSet,
+        # docs/resilience.md): unplanned-death ejections and the
+        # deterministic-recovery machinery. Counters ride the llm_stats ->
+        # sync_llm catch-up idiom like every other fleet tally; the
+        # journal-depth gauge is the live count of fleet generations whose
+        # recovery record is still open (in flight, not yet resolved).
+        self._fleet_ejections = Counter(
+            "seldon_fleet_ejections_total",
+            "Replicas ejected from fleet dispatch after an unplanned "
+            "death (crashed or wedged batcher loop, consecutive dispatch "
+            "failures)",
+            base,
+            registry=self.registry,
+        )
+        self._fleet_reinstatements = Counter(
+            "seldon_fleet_reinstatements_total",
+            "Ejected replicas reinstated into fleet dispatch after a "
+            "successful half-open probe",
+            base,
+            registry=self.registry,
+        )
+        self._fleet_resumes = Counter(
+            "seldon_fleet_resumes_total",
+            "In-flight generations resumed bit-exactly on a surviving "
+            "replica after their replica died mid-stream",
+            base,
+            registry=self.registry,
+        )
+        self._fleet_resumed_tokens = Counter(
+            "seldon_fleet_resumed_tokens_total",
+            "Tokens already delivered at resume time (skipped, never "
+            "re-sent: the at-most-once streaming contract)",
+            base,
+            registry=self.registry,
+        )
+        self._fleet_budget_exhausted = Counter(
+            "seldon_fleet_retry_budget_exhausted_total",
+            "Recoveries refused because the fleet retry budget was "
+            "exhausted (degraded to 503 + Retry-After instead of "
+            "amplifying load)",
+            base,
+            registry=self.registry,
+        )
+        self._fleet_journal_depth = Gauge(
+            "seldon_fleet_resume_journal_depth",
+            "Fleet resume-journal entries currently open (fleet "
+            "generations in flight with recovery records)",
+            base,
+            registry=self.registry,
+        )
         # Tracing/flight-recorder observability (tracing/__init__.py +
         # runtime/flight.py): spans lost to export failures (a batch is
         # re-enqueued once; the second failure drops it — without this
@@ -831,6 +881,21 @@ class MetricsRegistry:
         for cls, seconds in stats.get("ttft_by_class", ()):
             self._tenant_ttft.labels(
                 **self._base(), slo_class=cls).observe(seconds)
+        # fleet fault tolerance (ReplicaSet.llm_stats — solo components
+        # carry none of these keys, so every line is a no-op for them)
+        self._counter_catch_up(self._fleet_ejections,
+                               stats.get("fleet_ejections_total", 0))
+        self._counter_catch_up(self._fleet_reinstatements,
+                               stats.get("fleet_reinstatements_total", 0))
+        self._counter_catch_up(self._fleet_resumes,
+                               stats.get("fleet_resumes_total", 0))
+        self._counter_catch_up(self._fleet_resumed_tokens,
+                               stats.get("fleet_resumed_tokens_total", 0))
+        self._counter_catch_up(self._fleet_budget_exhausted,
+                               stats.get("fleet_retry_budget_exhausted_total",
+                                         0))
+        self._fleet_journal_depth.labels(**self._base()).set(
+            stats.get("fleet_resume_journal_depth", 0))
 
     # ------------------------------------------------------------------
     def register_custom(self, response: SeldonMessage) -> None:

@@ -81,6 +81,11 @@ def scaling_snapshot(component: Any, batcher: Any = None,
         "page_sheds_total": 0,
         "handoff_queue_depth": 0,
         "draining": False,
+        # fleet health (runtime/engine.py ReplicaSet): True when the fleet
+        # quarantined this replica after an unplanned death — the
+        # autoscaler reads it as a replace signal (docs/control-plane.md);
+        # a solo component is never ejected
+        "ejected": False,
         "prefill_devices": 0,
         "decode_devices": 0,
         # multi-tenant: queued admissions per SLO class (the weighted-fair

@@ -138,6 +138,7 @@ from seldon_core_tpu.runtime.flight import (
     EV_PREFILL,
     EV_PREFILL_CHUNK,
     EV_PREFIX_HIT,
+    EV_RESUME,
     EV_SHED,
     EV_STEP,
 )
@@ -573,12 +574,16 @@ class BatcherService:
                     tenant: Optional[str] = None,
                     slo_class: Optional[str] = None,
                     adapter: Optional[str] = None,
-                    deadline_s: Optional[float] = None) -> List[int]:
+                    deadline_s: Optional[float] = None,
+                    on_token: Optional[Any] = None,
+                    resume_tokens: int = 0) -> List[int]:
         return self._track(asyncio.run_coroutine_threadsafe(
-            self.batcher.submit(prompt, max_new_tokens, info=info, seed=seed,
+            self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
+                                info=info, seed=seed,
                                 trace=trace, tenant=tenant,
                                 slo_class=slo_class, adapter=adapter,
-                                deadline_s=deadline_s),
+                                deadline_s=deadline_s,
+                                resume_tokens=resume_tokens),
             self._loop
         )).result(timeout_s)
 
@@ -590,12 +595,14 @@ class BatcherService:
                      tenant: Optional[str] = None,
                      slo_class: Optional[str] = None,
                      adapter: Optional[str] = None,
-                     deadline_s: Optional[float] = None) -> List[int]:
+                     deadline_s: Optional[float] = None,
+                     resume_tokens: int = 0) -> List[int]:
         cfut = self._track(asyncio.run_coroutine_threadsafe(
             self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
                                 info=info, seed=seed, trace=trace,
                                 tenant=tenant, slo_class=slo_class,
-                                adapter=adapter, deadline_s=deadline_s),
+                                adapter=adapter, deadline_s=deadline_s,
+                                resume_tokens=resume_tokens),
             self._loop))
         return await asyncio.wrap_future(cfut)
 
@@ -608,7 +615,8 @@ class BatcherService:
                       tenant: Optional[str] = None,
                       slo_class: Optional[str] = None,
                       adapter: Optional[str] = None,
-                      deadline_s: Optional[float] = None):
+                      deadline_s: Optional[float] = None,
+                      resume_tokens: int = 0):
         """Streaming submit from a SYNC thread (the gRPC server-streaming
         servicer): returns the concurrent.futures.Future of the final token
         list while ``on_token`` fires per token from the batcher's worker
@@ -617,7 +625,8 @@ class BatcherService:
             self.batcher.submit(prompt, max_new_tokens, on_token=on_token,
                                 info=info, seed=seed, trace=trace,
                                 tenant=tenant, slo_class=slo_class,
-                                adapter=adapter, deadline_s=deadline_s),
+                                adapter=adapter, deadline_s=deadline_s,
+                                resume_tokens=resume_tokens),
             self._loop))
 
     def drain(self) -> None:
@@ -661,6 +670,11 @@ def get_batcher_service(component: Any) -> Optional[BatcherService]:
     LLM generate surface; None otherwise. Creation is locked: the first REST
     request (event loop) and first gRPC request (worker thread) can race,
     and two batchers would each allocate slot caches and step the device."""
+    if getattr(component, "is_fleet", False):
+        # a ReplicaSet IS the service: it fans submits across replicas
+        # (with health ejection + deterministic resume — runtime/engine.py)
+        # and must never be wrapped in a batcher of its own
+        return component
     svc = getattr(component, "_batcher_service", None)
     if svc is not None:
         return svc  # reuse even when batching is off (streaming's 1-slot svc)
@@ -677,7 +691,8 @@ def get_batcher_service(component: Any) -> Optional[BatcherService]:
 
 def ensure_stream_service(component: Any) -> BatcherService:
     """Streaming without continuous batching: one shared 1-slot service per
-    component (same double-checked lock; never one per request)."""
+    component (same double-checked lock; never one per request).
+    A fleet (ReplicaSet) short-circuits through get_batcher_service."""
     svc = get_batcher_service(component)
     if svc is not None:
         return svc
@@ -760,6 +775,21 @@ class ContinuousBatcher:
         self._wakeup = asyncio.Event()
         self._closed = False
         self._task: Optional[asyncio.Task] = None
+        # Fleet health view (docs/resilience.md "Fleet fault tolerance"):
+        # the loop stamps ``heartbeat`` once per turn from its single
+        # serialized context and parks its terminal exception in
+        # ``crashed`` — plain single-writer fields ReplicaSet.check_health
+        # reads to eject a dead replica from dispatch. ``clock`` is
+        # injectable so chaos tests drive staleness from a FaultClock, and
+        # ``_chaos`` is the deterministic fault hook the chaos harness
+        # installs (called at the top of every loop turn; raising there
+        # kills the loop exactly where a real device fault would).
+        import time as _time
+
+        self.clock: Any = _time.monotonic
+        self.heartbeat: float = self.clock()
+        self.crashed: Optional[BaseException] = None
+        self._chaos: Optional[Any] = None
         # dispatch-ahead pipeline: how many steps may be in flight before
         # the host drains the oldest (>=2 overlaps host bookkeeping with
         # device compute), and the fused-K knob (0/1 = single steps)
@@ -1185,8 +1215,16 @@ class ContinuousBatcher:
                      tenant: Optional[str] = None,
                      slo_class: Optional[str] = None,
                      adapter: Optional[str] = None,
-                     deadline_s: Optional[float] = None) -> List[int]:
+                     deadline_s: Optional[float] = None,
+                     resume_tokens: int = 0) -> List[int]:
         """prompt: str or token sequence. Resolves to generated token ids.
+
+        ``resume_tokens`` (fleet recovery, docs/resilience.md): non-zero
+        marks this submission as the RESUMPTION of a generation that
+        already delivered that many tokens on a replica that died —
+        ``prompt`` then carries prompt+generated-prefix and the sampling
+        chain fast-forwards past the delivered tokens so the continuation
+        is bit-exact (see _sample_first).
 
         Multi-tenant identity (docs/multitenancy.md): ``tenant`` names the
         traffic owner (``Seldon-Tenant`` header), ``slo_class`` its
@@ -1278,7 +1316,8 @@ class ContinuousBatcher:
             t_arrival=now, trace=trace, tenant=str(tenant or ""),
             slo_class=cls, adapter_id=aid,
             deadline_t=((now + float(deadline_s))
-                        if deadline_s is not None else None))
+                        if deadline_s is not None else None),
+            resume_tokens=int(resume_tokens or 0))
         if not self._pending.push(req):
             # tenant over its queued-request quota: shed NOW with the
             # backlog-derived Retry-After (the scheduler counted it
@@ -1380,10 +1419,25 @@ class ContinuousBatcher:
                 self.max_len - plen, max_new, self.max_len, plen)
         return ids[-plen:], plen
 
-    def _sample_first(self, first_logits: np.ndarray, seed: Optional[int]):
+    def _sample_first(self, first_logits: np.ndarray, seed: Optional[int],
+                      resume_tokens: int = 0):
         """Host-side first-token draw from the prefill logits, on exactly
         generate()'s rng chain (PRNGKey -> split for the first token ->
-        split per decode step). Returns (token, per-slot device key)."""
+        split per decode step). Returns (token, per-slot device key).
+
+        ``resume_tokens`` > 0 means this admission RESUMES a generation
+        interrupted after that many delivered tokens (fleet recovery,
+        docs/resilience.md): the prompt already carries the generated
+        prefix and the token drawn here is token ``resume_tokens`` of the
+        ORIGINAL chain — which the device sampler would have produced. The
+        chain consumes exactly one first-component split per emitted token
+        (host first draw and every device step alike), so fast-forwarding
+        PRNGKey(seed) by ``resume_tokens`` splits and then drawing with the
+        DEVICE sampler's op order (split -> lax.top_k descending ->
+        categorical -> gather) reproduces it bit-exactly. The host path's
+        argsort ordering must NOT be used here: categorical over a
+        differently-ordered top-k draws a different index for the same
+        key."""
         import jax
         import jax.numpy as jnp
 
@@ -1395,7 +1449,21 @@ class ContinuousBatcher:
         else:
             self._rng, key = jax.random.split(self._rng)
         if float(self._temp) <= 0.0:
+            # greedy is key-independent (the device sampler selects argmax
+            # through jnp.where regardless of the key), so resume needs no
+            # fast-forward: argmax over the re-prefilled logits IS token N
             first = int(first_logits.argmax())
+        elif resume_tokens > 0 and seed is not None:
+            from seldon_core_tpu.servers.llmserver import fast_forward_key
+
+            key = fast_forward_key(seed, resume_tokens)
+            key, sub = jax.random.split(key)
+            k = min(self.server.top_k, first_logits.shape[-1])
+            topv, topi = jax.lax.top_k(jnp.asarray(first_logits), k)
+            draw = jax.random.categorical(
+                sub, topv / max(float(self._temp), 1e-6))
+            # graftlint: allow-host-sync-in-hot-path(single admission-time sync of the resumed token, once per recovery; the device sampler's exact op order is required for bit-exact continuation)
+            first = int(np.asarray(topi[draw]))
         else:
             key, sub = jax.random.split(key)
             k = min(self.server.top_k, first_logits.shape[-1])
@@ -1455,6 +1523,12 @@ class ContinuousBatcher:
         self._pending.count_tokens(slot.tenant, slot.slo_class, 1)
         slot.t_last = now
         if self._flight is not None:
+            if req is not None and getattr(req, "resume_tokens", 0):
+                # fleet recovery: this admission continues an interrupted
+                # generation — mark the timeline so the span tree shows
+                # where the failover re-attached (docs/resilience.md)
+                self._flight.record(i, EV_RESUME,
+                                    tokens=int(req.resume_tokens))
             self._flight.record(i, EV_FIRST_TOKEN, tokens=1)
         slot.gen += 1          # invalidates in-flight tokens for the old occupant
         slot.disp_new = 1      # the prefill-sampled first token counts
@@ -1545,7 +1619,8 @@ class ContinuousBatcher:
         if self._flight is not None:
             self._flight.record(free, EV_PREFILL, tokens=L,
                                 dur_s=time.perf_counter() - t0)
-        first, key = self._sample_first(first_logits, req.seed)
+        first, key = self._sample_first(first_logits, req.seed,
+                                        req.resume_tokens)
         self._commit_slot(free, first, key, L, req.max_new, req.fut,
                           req.on_token, ids=ids, t_arrival=req.t_arrival,
                           req=req)
@@ -1707,38 +1782,65 @@ class ContinuousBatcher:
                 # the prefill thread BEFORE the handoff was published —
                 # ownership moved through the TransferQueue's lock
                 self._flight.extend(job.slot, h.events)
-            t0 = time.perf_counter()
-            if self.paged:
-                import jax
+            try:
+                t0 = time.perf_counter()
+                if self.paged:
+                    import jax
 
-                n0 = -(-job.L // self.page_size)
-                # only the SUFFIX pages travelled (the prefix blocks never
-                # left this device — they are shared trie pages already in
-                # the row's lead); import targets row entries past them
-                n_suffix = n0 - job.prefix_pages
-                # the worker shipped a power-of-two page bucket; the
-                # buffer's own shape names the compile to import it with
-                staged_pages = (jax.tree.leaves(h.staged)[0].shape[0]
-                                - RESERVED_PAGES)
-                imp = self._get_handoff_import(staged_pages)
-                row_suffix = np.full((self.n_pages,), NULL_PAGE, np.int32)
-                row_suffix[:n_suffix] = job.row[
-                    job.prefix_pages:job.prefix_pages + n_suffix]
-                self._caches = imp(self._caches, h.staged,
-                                   jnp.asarray(row_suffix),
-                                   jnp.asarray(n_suffix, jnp.int32))
-                self._block_tables = self._set_block_row(
-                    self._block_tables, jnp.asarray(job.slot, jnp.int32),
-                    jnp.asarray(job.row))
-            else:
-                self._caches = self._insert(self._caches, h.staged, job.slot)
+                    n0 = -(-job.L // self.page_size)
+                    # only the SUFFIX pages travelled (the prefix blocks
+                    # never left this device — they are shared trie pages
+                    # already in the row's lead); import targets row
+                    # entries past them
+                    n_suffix = n0 - job.prefix_pages
+                    # the worker shipped a power-of-two page bucket; the
+                    # buffer's own shape names the compile to import it
+                    staged_pages = (jax.tree.leaves(h.staged)[0].shape[0]
+                                    - RESERVED_PAGES)
+                    imp = self._get_handoff_import(staged_pages)
+                    row_suffix = np.full((self.n_pages,), NULL_PAGE,
+                                         np.int32)
+                    row_suffix[:n_suffix] = job.row[
+                        job.prefix_pages:job.prefix_pages + n_suffix]
+                    self._caches = imp(self._caches, h.staged,
+                                       jnp.asarray(row_suffix),
+                                       jnp.asarray(n_suffix, jnp.int32))
+                    self._block_tables = self._set_block_row(
+                        self._block_tables,
+                        jnp.asarray(job.slot, jnp.int32),
+                        jnp.asarray(job.row))
+                else:
+                    self._caches = self._insert(self._caches, h.staged,
+                                                job.slot)
+            except Exception as e:
+                # poisoned handoff (malformed staged payload, import
+                # raising): fail THIS request and free its slot + staging
+                # pages — exactly the h.error semantics above. Letting it
+                # propagate would kill the whole consume sweep and, one
+                # frame up, the batcher loop itself — one bad handoff
+                # must never take down the batch (ISSUE 16 satellite).
+                logger.exception("poisoned handoff (slot %d): %s",
+                                 job.slot, e)
+                if self._flight is not None:
+                    self._flight.complete(job.slot, "error", 0,
+                                          self._tracer)
+                self._release_slot(job.slot)
+                if job.on_token is not None:
+                    try:
+                        job.on_token(None)
+                    except Exception:
+                        pass
+                self._resolve(job.fut, exc=e)
+                continue
             self.server._handoff_times.append(
                 h.prefill_s + (time.perf_counter() - t0))
             if self._flight is not None:
                 self._flight.record(job.slot, EV_HANDOFF_IMPORT,
                                     bytes=h.transfer_bytes,
                                     dur_s=time.perf_counter() - t0)
-            first, key = self._sample_first(h.first_logits, job.seed)
+            first, key = self._sample_first(
+                h.first_logits, job.seed,
+                job.req.resume_tokens if job.req is not None else 0)
             self._commit_slot(job.slot, first, key, job.L, job.max_new,
                               job.fut, job.on_token, ids=job.ids,
                               t_arrival=job.t_arrival, req=job.req)
@@ -1979,7 +2081,9 @@ class ContinuousBatcher:
         order), and commit the slot into the decode batch."""
         import jax.numpy as jnp
 
-        first, key = self._sample_first(first_logits, job.seed)
+        first, key = self._sample_first(
+            first_logits, job.seed,
+            job.req.resume_tokens if job.req is not None else 0)
         self._block_tables = self._set_block_row(
             self._block_tables, jnp.asarray(job.slot, jnp.int32),
             job.bt_row[0])
@@ -2667,8 +2771,15 @@ class ContinuousBatcher:
                 self._finish(i)
 
     async def _run(self):
+        self.crashed = None  # a restarted loop is a recovered loop
         try:
             while True:
+                # liveness heartbeat + deterministic chaos injection: both
+                # happen in the loop's own serialized context, so a raising
+                # chaos hook dies exactly like a device fault mid-turn
+                self.heartbeat = self.clock()
+                if self._chaos is not None:
+                    self._chaos(self)
                 # admit as many pending requests as there are free slots
                 # (FIFO, peek-then-pop so a failed admit keeps the request);
                 # device work runs in a worker thread so the event loop (and
@@ -2765,7 +2876,10 @@ class ContinuousBatcher:
                         return
         except BaseException as e:
             # device/compile failure: fail every in-flight and queued request
-            # instead of leaving their futures hanging
+            # instead of leaving their futures hanging. The crash flag goes
+            # up FIRST so fleet health checks eject this replica before any
+            # failed future routes its client back through dispatch.
+            self.crashed = e
             logger.exception("batcher loop died: %s", e)
             self._inflight.clear()
             self._prefill = None

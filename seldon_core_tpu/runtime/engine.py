@@ -59,6 +59,9 @@ from seldon_core_tpu.runtime.resilience import (
     CircuitBreaker,
     Deadline,
     ResilienceConfig,
+    ResumeMarker,
+    RetryBudget,
+    ShedError,
     current_deadline,
     deadline_scope,
     failure_counts_for_breaker,
@@ -148,6 +151,31 @@ def replica_load(component: Any) -> Tuple[float, float]:
     return (float(queued), pages)
 
 
+class _ResumeEntry:
+    """One fleet-dispatched generation's recovery record
+    (docs/resilience.md "Fleet fault tolerance"): everything needed to
+    re-admit it bit-exactly on a surviving replica — identity
+    (tenant/SLO class/adapter), the pinned seed, the tokenized prompt,
+    and the tokens DELIVERED so far (``len(tokens)`` is also the
+    rng-split count to fast-forward by: the chain consumes exactly one
+    split per emitted token). Appends happen on batcher worker threads
+    while the fleet's retry loop reads — every access under the fleet's
+    ``_journal_lock``."""
+
+    __slots__ = ("prompt_ids", "max_new", "seed", "tenant", "slo_class",
+                 "adapter", "tokens")
+
+    def __init__(self, prompt_ids, max_new, seed, tenant, slo_class,
+                 adapter):
+        self.prompt_ids = prompt_ids
+        self.max_new = int(max_new)
+        self.seed = seed
+        self.tenant = tenant
+        self.slo_class = slo_class
+        self.adapter = adapter
+        self.tokens: List[int] = []
+
+
 class ReplicaSet(SeldonComponent):
     """N identical component replicas behind least-loaded dispatch — the
     in-process analog of the reference's HPA-scaled Deployment fronted by
@@ -171,7 +199,24 @@ class ReplicaSet(SeldonComponent):
     live request.  Membership mutates under ``self._lock`` (the
     autoscaler thread races transport dispatch threads); dispatch works
     on a locked snapshot so a mid-pick mutation can never index past the
-    list."""
+    list.
+
+    Fault tolerance (docs/resilience.md "Fleet fault tolerance"): the
+    fleet also survives UNPLANNED departure. ``check_health`` ejects a
+    replica whose batcher loop crashed or stopped heartbeating
+    (quarantine — distinct from drain: a crashed batcher cannot drain),
+    half-open breaker probes reinstate it once it answers again, and the
+    per-request resume journal lets every in-flight generation on the
+    corpse re-admit on a surviving replica with its rng chain
+    fast-forwarded — the client's token sequence is bit-exact vs an
+    unfaulted run, with at-most-once delivery. Recoveries draw from a
+    RetryBudget so a correlated failure storm sheds honestly instead of
+    amplifying fleet load."""
+
+    # transports' service discovery (runtime/batcher.py
+    # get_batcher_service): the fleet IS the batcher service — it fans
+    # submits across replicas and must never be wrapped in its own batcher
+    is_fleet = True
 
     def __init__(self, replicas: List[SeldonComponent]):
         if not replicas:
@@ -188,6 +233,32 @@ class ReplicaSet(SeldonComponent):
         # as two consecutive idle sightings microseconds apart —
         # collapsing the grace — and double-close the detached batcher
         self._collect_guard = threading.Lock()
+        # -- fleet health (ejection / reinstatement) --------------------
+        # injectable clock: chaos tests drive staleness and breaker reset
+        # windows from a FaultClock instead of wall time
+        self.clock: Callable[[], float] = time.monotonic
+        # a batcher whose loop has not stamped its heartbeat for this long
+        # (while its task claims to be running) counts as wedged; generous
+        # because a first-compile device step legitimately blocks the loop
+        self.heartbeat_timeout_s: float = 30.0
+        # how long an ejected replica sits out before a half-open probe
+        # may try to reinstate it
+        self.reinstate_after_s: float = 5.0
+        self._health: Dict[int, CircuitBreaker] = {}  # id(replica) -> breaker
+        self._ejected: List[SeldonComponent] = []
+        self._ejections_total = 0
+        self._reinstatements_total = 0
+        self._resumes_total = 0
+        self._resumed_tokens_total = 0
+        # -- deterministic request recovery -----------------------------
+        # resume journal: every fleet-dispatched generation in flight,
+        # at token granularity (appended from batcher worker threads,
+        # read by the retry loop — all access under _journal_lock)
+        self._journal: Dict[int, "_ResumeEntry"] = {}
+        self._journal_lock = threading.Lock()
+        self._journal_seq = 0
+        self.retry_budget = RetryBudget(clock=self.clock)
+        self._dispatch_pool = None  # lazy: gRPC submit_stream executor
 
     # -- membership (autoscaler actuator surface) -----------------------
     def members(self) -> List[SeldonComponent]:
@@ -201,13 +272,19 @@ class ReplicaSet(SeldonComponent):
             return list(self._draining)
 
     def _dispatchable(self) -> List[SeldonComponent]:
-        """The replicas fleet dispatch may target: everyone not draining —
-        or, if literally everyone is draining (a config error the
-        autoscaler's min_replicas floor prevents), the full set, because
-        black-holing traffic is strictly worse than touching a draining
-        replica."""
+        """The replicas fleet dispatch may target: everyone not draining
+        and not ejected — or, if that empties the pool (a config error
+        the autoscaler's min_replicas floor prevents, or a total-fleet
+        crash), progressively weaker fallbacks, because black-holing
+        traffic is strictly worse than touching a draining replica (and
+        submitting to a crashed batcher restarts its loop — the built-in
+        half-open probe)."""
         with self._lock:
-            live = [r for r in self.replicas if r not in self._draining]
+            live = [r for r in self.replicas
+                    if r not in self._draining and r not in self._ejected]
+            if live:
+                return live
+            live = [r for r in self.replicas if r not in self._ejected]
             return live or list(self.replicas)
 
     def add_replica(self, replica: SeldonComponent) -> None:
@@ -228,8 +305,12 @@ class ReplicaSet(SeldonComponent):
         ContinuousBatcher) is informed so its admission surface reports
         the state, but its in-flight work keeps running untouched."""
         with self._lock:
+            # ejected replicas are not drain candidates: a crashed batcher
+            # cannot run the drain protocol (quarantine != drain) — the
+            # autoscaler replaces them instead (docs/control-plane.md)
             candidates = [r for r in self.replicas
-                          if r not in self._draining]
+                          if r not in self._draining
+                          and r not in self._ejected]
             if len(candidates) <= 1:
                 return None  # the last serving replica never drains
             if replica is None:
@@ -256,6 +337,111 @@ class ReplicaSet(SeldonComponent):
         if hook is not None:
             hook()
         return replica
+
+    # -- health model (ejection / reinstatement) ------------------------
+    def ejected_members(self) -> List[SeldonComponent]:
+        with self._lock:
+            return list(self._ejected)
+
+    def _breaker_for(self, replica: SeldonComponent) -> CircuitBreaker:
+        """The replica's health breaker (created on first use). Ejected ==
+        breaker not CLOSED; reinstatement rides the breaker's half-open
+        probe machinery. Breaker methods are never called under
+        ``self._lock`` (each breaker has its own lock — a fixed
+        fleet-lock-then-breaker-lock order would invert against the
+        metrics scrape reading breaker state)."""
+        rid = id(replica)
+        with self._lock:
+            br = self._health.get(rid)
+            if br is None:
+                br = CircuitBreaker(
+                    f"replica-{rid:x}", failure_threshold=3,
+                    reset_timeout_s=self.reinstate_after_s,
+                    clock=self.clock)
+                self._health[rid] = br
+        return br
+
+    def _eject(self, replica: SeldonComponent) -> bool:
+        with self._lock:
+            if replica in self.replicas and replica not in self._ejected:
+                self._ejected.append(replica)
+                self._ejections_total += 1
+                return True
+        return False
+
+    def check_health(self) -> List[SeldonComponent]:
+        """Eject every replica observed dead: batcher loop crashed
+        (terminal exception parked in ``batcher.crashed``) or wedged (its
+        task claims to run but the heartbeat the loop stamps every turn
+        has gone stale on the fleet clock). Called by the autoscaler tick
+        and by fleet dispatch after any failure, so a corpse leaves the
+        dispatch pool within one loop turn of dying. Returns the replicas
+        ejected by THIS sweep."""
+        with self._lock:
+            candidates = [r for r in self.replicas
+                          if r not in self._ejected]
+        dead = []
+        for r in candidates:
+            svc = getattr(r, "_batcher_service", None)
+            if svc is None:
+                continue
+            b = svc.batcher
+            if getattr(b, "crashed", None) is not None:
+                dead.append(r)
+                continue
+            task = getattr(b, "_task", None)
+            hb = getattr(b, "heartbeat", None)
+            if (task is not None and not task.done() and hb is not None
+                    and self.heartbeat_timeout_s > 0
+                    and self.clock() - hb > self.heartbeat_timeout_s):
+                dead.append(r)
+        out = []
+        for r in dead:
+            self._breaker_for(r).trip()  # observed dead: force-open
+            if self._eject(r):
+                logger.warning("ejecting dead replica from fleet dispatch")
+                out.append(r)
+        return out
+
+    def _record_dispatch_success(self, replica: SeldonComponent) -> None:
+        """A dispatch answered: close the breaker and, if the replica was
+        serving an ejection probe, reinstate it into the pool."""
+        self._breaker_for(replica).record_success()
+        with self._lock:
+            if replica in self._ejected:
+                self._ejected.remove(replica)
+                self._reinstatements_total += 1
+
+    def _record_dispatch_failure(self, replica: SeldonComponent) -> None:
+        """An infrastructure failure from a dispatch: count it on the
+        breaker (consecutive failures open it; a failed half-open probe
+        re-opens it) and quarantine once the breaker leaves CLOSED."""
+        br = self._breaker_for(replica)
+        br.record_failure()
+        if br.state_code() != 0:  # no longer CLOSED -> quarantine
+            self._eject(replica)
+
+    @staticmethod
+    def _recoverable(exc: BaseException) -> bool:
+        """Which dispatch failures fleet recovery may retry on a sibling:
+        infrastructure deaths only. Backpressure (ShedError/BreakerOpen)
+        passes through honestly — retrying a shed amplifies exactly the
+        load that caused it; client errors (4xx), cancellations and
+        timeouts (the original may still be running — a retry would
+        double-deliver) are the caller's to see."""
+        import concurrent.futures
+
+        if isinstance(exc, (ShedError, BreakerOpen)):
+            return False
+        if isinstance(exc, (asyncio.CancelledError,
+                            concurrent.futures.CancelledError,
+                            TimeoutError)):
+            return False
+        if isinstance(exc, SeldonError):
+            return exc.status_code >= 500
+        if isinstance(exc, (ValueError, TypeError, KeyError)):
+            return False
+        return True
 
     @staticmethod
     def _replica_hook(replica: SeldonComponent, name: str):
@@ -409,6 +595,233 @@ class ReplicaSet(SeldonComponent):
                 out = max(out, int(probe(prompt)))
         return out
 
+    # -- fleet batcher-service protocol ---------------------------------
+    # The transports reach LLM serving through get_batcher_service /
+    # ensure_stream_service (runtime/batcher.py), which short-circuit to
+    # the fleet itself: submit/submit_sync/submit_stream here mirror
+    # BatcherService's surface but fan across replicas with journaled
+    # deterministic recovery (docs/resilience.md "Fleet fault tolerance").
+
+    @property
+    def batcher(self):
+        """Transports call ``svc.batcher.accommodates`` — the fleet
+        answers for itself."""
+        return self
+
+    def accommodates(self, prompt: Any,
+                     max_new_tokens: Optional[int] = None) -> bool:
+        """Delegates to one dispatchable replica's batcher (replicas are
+        identical by construction, so one answer speaks for the set)."""
+        from seldon_core_tpu.runtime.batcher import ensure_stream_service
+
+        for r in self._dispatchable():
+            if hasattr(r, "generate"):
+                return ensure_stream_service(r).batcher.accommodates(
+                    prompt, max_new_tokens)
+        return False
+
+    async def submit(self, prompt: Any, max_new_tokens: Optional[int] = None,
+                     on_token: Optional[Any] = None,
+                     info: Optional[dict] = None,
+                     seed: Optional[int] = None,
+                     trace: Optional[Any] = None,
+                     tenant: Optional[str] = None,
+                     slo_class: Optional[str] = None,
+                     adapter: Optional[str] = None,
+                     deadline_s: Optional[float] = None,
+                     resume_tokens: int = 0) -> List[int]:
+        return await asyncio.to_thread(
+            self._fleet_submit_blocking, prompt, max_new_tokens, on_token,
+            info, seed, trace, tenant, slo_class, adapter, deadline_s)
+
+    def submit_sync(self, prompt: Any, max_new_tokens: Optional[int] = None,
+                    timeout_s: float = 600.0,
+                    info: Optional[dict] = None,
+                    seed: Optional[int] = None,
+                    trace: Optional[Any] = None,
+                    tenant: Optional[str] = None,
+                    slo_class: Optional[str] = None,
+                    adapter: Optional[str] = None,
+                    deadline_s: Optional[float] = None,
+                    on_token: Optional[Any] = None,
+                    resume_tokens: int = 0) -> List[int]:
+        return self._fleet_submit_blocking(
+            prompt, max_new_tokens, on_token, info, seed, trace, tenant,
+            slo_class, adapter, deadline_s, timeout_s=timeout_s)
+
+    def submit_stream(self, prompt: Any,
+                      max_new_tokens: Optional[int] = None,
+                      on_token: Optional[Any] = None,
+                      info: Optional[dict] = None,
+                      seed: Optional[int] = None,
+                      trace: Optional[Any] = None,
+                      tenant: Optional[str] = None,
+                      slo_class: Optional[str] = None,
+                      adapter: Optional[str] = None,
+                      deadline_s: Optional[float] = None,
+                      resume_tokens: int = 0):
+        """Streaming submit from a sync thread (the gRPC servicer):
+        returns a concurrent.futures.Future of the final token list while
+        ``on_token`` pumps — same contract as BatcherService."""
+        with self._lock:
+            pool = self._dispatch_pool
+            if pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = ThreadPoolExecutor(
+                    max_workers=32, thread_name_prefix="fleet-dispatch")
+                self._dispatch_pool = pool
+        return pool.submit(
+            self._fleet_submit_blocking, prompt, max_new_tokens, on_token,
+            info, seed, trace, tenant, slo_class, adapter, deadline_s)
+
+    def _pick_with_probe(self, prompt: Any
+                         ) -> Tuple[SeldonComponent, bool]:
+        """Dispatch target for one attempt: an ejected replica whose
+        breaker grants a half-open probe slot wins (reinstatement rides
+        real traffic — the retry loop absorbs a failed probe), otherwise
+        prefix-aware least-loaded routing over the healthy pool."""
+        with self._lock:
+            ejected = list(self._ejected)
+        for r in ejected:
+            if self._breaker_for(r).allow():
+                return r, True
+        return self.pick_for(prompt), False
+
+    def _fleet_submit_blocking(self, prompt: Any,
+                               max_new_tokens: Optional[int] = None,
+                               on_token: Optional[Any] = None,
+                               info: Optional[dict] = None,
+                               seed: Optional[int] = None,
+                               trace: Optional[Any] = None,
+                               tenant: Optional[str] = None,
+                               slo_class: Optional[str] = None,
+                               adapter: Optional[str] = None,
+                               deadline_s: Optional[float] = None,
+                               timeout_s: float = 600.0) -> List[int]:
+        """One fleet generation, end to end: journal it, dispatch to the
+        best replica, and on an infrastructure death resume the
+        interrupted chain bit-exactly on a survivor.
+
+        Determinism: an unseeded request gets a journaled random seed
+        BEFORE first dispatch, so greedy and sampled generations alike
+        live on one pinned rng chain that a resume can fast-forward
+        (batcher._sample_first). The journal appends each token under
+        ``_journal_lock`` BEFORE forwarding it to the client, so a resume
+        skips exactly the delivered prefix — at-most-once delivery, never
+        a duplicate. The batcher's crash handler fires ``on_token(None)``
+        at its victims; the wrapper swallows it (the fleet owns the
+        terminal None) so a streaming client survives the failover
+        without observing a premature end-of-stream."""
+        from seldon_core_tpu.runtime.batcher import ensure_stream_service
+
+        self.check_health()
+        self.retry_budget.note_request()
+        reps = self._dispatchable()
+        ids = self._encode_once(prompt, reps)
+        can_resume = not isinstance(ids, str)
+        prompt_ids = (list(int(t) for t in np.asarray(ids).ravel())
+                      if can_resume else ids)
+        if max_new_tokens is None:
+            for r in reps:
+                mn = getattr(r, "max_new_tokens", None)
+                if mn is not None:
+                    max_new_tokens = int(mn)
+                    break
+        orig_max_new = int(max_new_tokens or 16)
+        if seed is None:
+            # pin the chain so a resume can replay it (greedy output is
+            # seed-independent; unseeded SAMPLED fleet output was random
+            # anyway — now it is random-but-resumable)
+            seed = secrets.randbits(31)
+        with self._journal_lock:
+            self._journal_seq += 1
+            jid = self._journal_seq
+            entry = _ResumeEntry(prompt_ids, orig_max_new, seed,
+                                 tenant, slo_class, adapter)
+            self._journal[jid] = entry
+
+        def wrapped(tok):
+            if tok is None:
+                return  # crash-handler unblock: the fleet owns the real one
+            if isinstance(tok, ResumeMarker):
+                if on_token is not None:
+                    on_token(tok)
+                return
+            with self._journal_lock:
+                entry.tokens.append(int(tok))
+            if on_token is not None:
+                on_token(tok)
+
+        try:
+            while True:
+                with self._journal_lock:
+                    done = list(entry.tokens)
+                n = len(done)
+                if n >= orig_max_new:
+                    return done  # the crash raced completion
+                if n > 0:
+                    submit_ids = prompt_ids + done
+                    remaining = orig_max_new - n
+                else:
+                    submit_ids, remaining = prompt_ids, orig_max_new
+                replica, probing = self._pick_with_probe(submit_ids)
+                if n > 0:
+                    self._note_resume(n, trace)
+                    wrapped(ResumeMarker(n))
+                try:
+                    svc = ensure_stream_service(replica)
+                    toks = svc.submit_sync(
+                        submit_ids, remaining, timeout_s=timeout_s,
+                        info=info, seed=seed, trace=trace, tenant=tenant,
+                        slo_class=slo_class, adapter=adapter,
+                        deadline_s=deadline_s, on_token=wrapped,
+                        resume_tokens=n)
+                except BaseException as e:
+                    if probing:
+                        self._breaker_for(replica).release_probe()
+                    if not self._recoverable(e):
+                        raise
+                    self._record_dispatch_failure(replica)
+                    self.check_health()  # a crash ejects before the retry
+                    with self._journal_lock:
+                        delivered = len(entry.tokens)
+                    if delivered > 0 and not can_resume:
+                        raise  # mid-stream, no token-level journal: honest
+                    if not self.retry_budget.try_spend():
+                        raise ShedError(
+                            "fleet retry budget exhausted (correlated "
+                            "failures); request not recovered",
+                            retry_after_s=self.reinstate_after_s)
+                    continue
+                self._record_dispatch_success(replica)
+                # the replica's returned segment is authoritative for the
+                # tail (on_token elides EOS; the result never does)
+                return done + [int(t) for t in toks]
+        finally:
+            with self._journal_lock:
+                self._journal.pop(jid, None)
+            if on_token is not None:
+                try:
+                    on_token(None)
+                except Exception:
+                    pass
+
+    def _note_resume(self, tokens_delivered: int,
+                     trace: Optional[Any]) -> None:
+        """Count + trace one mid-stream recovery (``llm.resume`` span)."""
+        with self._lock:
+            self._resumes_total += 1
+            self._resumed_tokens_total += tokens_delivered
+        tp = None
+        if trace is not None and getattr(trace, "trace_id", None):
+            span_id = getattr(trace, "parent_span_id", None) or "0" * 16
+            flag = "01" if getattr(trace, "sampled", True) else "00"
+            tp = f"00-{trace.trace_id}-{span_id}-{flag}"
+        with get_tracer().span("llm.resume", traceparent=tp,
+                               tokens_delivered=tokens_delivered):
+            pass
+
     # the component surface delegates to the chosen replica; generate is
     # included so LLM graph nodes (and their transports) route too
     def predict(self, X, names, meta=None):
@@ -421,9 +834,33 @@ class ReplicaSet(SeldonComponent):
         probe = None
         if prompts is not None and len(prompts) > 0:
             probe = prompts[0]
-        if probe is None:
-            return self.pick().generate(prompts, *a, **kw)
-        return self.pick_for(probe).generate(prompts, *a, **kw)
+        self.retry_budget.note_request()
+        replica = self.pick() if probe is None else self.pick_for(probe)
+        try:
+            out = replica.generate(prompts, *a, **kw)
+        except Exception as e:
+            # pre-first-token failover (ISSUE 16 satellite): generate()
+            # had not delivered anything, so retrying the WHOLE call on a
+            # healthy sibling is idempotent by construction — once, and
+            # only from the bounded retry budget
+            if not self._recoverable(e):
+                raise
+            self._record_dispatch_failure(replica)
+            self.check_health()
+            siblings = [r for r in self._dispatchable() if r is not replica]
+            if not siblings:
+                raise
+            if not self.retry_budget.try_spend():
+                raise ShedError(
+                    "fleet retry budget exhausted (correlated failures); "
+                    "generate not failed over",
+                    retry_after_s=self.reinstate_after_s)
+            alt = min(siblings, key=replica_load)
+            out = alt.generate(prompts, *a, **kw)
+            self._record_dispatch_success(alt)
+            return out
+        self._record_dispatch_success(replica)
+        return out
 
     def tags(self) -> Dict[str, Any]:
         from seldon_core_tpu.components.component import client_custom_tags
@@ -458,6 +895,17 @@ class ReplicaSet(SeldonComponent):
         for k in fractions:  # fractions average; sums would exceed 1.0
             if isinstance(merged.get(k), (int, float)):
                 merged[k] = merged[k] / len(stats_list)
+        # fleet-level fault-tolerance tallies (ours, not the replicas'):
+        # stamped AFTER the merge so a replica key can never shadow them
+        with self._lock:
+            merged["fleet_ejections_total"] = self._ejections_total
+            merged["fleet_reinstatements_total"] = self._reinstatements_total
+            merged["fleet_resumes_total"] = self._resumes_total
+            merged["fleet_resumed_tokens_total"] = self._resumed_tokens_total
+        with self._journal_lock:
+            merged["fleet_resume_journal_depth"] = len(self._journal)
+        merged["fleet_retry_budget_exhausted_total"] = (
+            self.retry_budget.snapshot()["exhausted_total"])
         return merged
 
 

@@ -64,6 +64,9 @@ EV_HANDOFF_COMPUTE = "handoff_compute"      # prefill-slice forward (worker)
 EV_HANDOFF_TRANSFER = "handoff_transfer"    # device-to-device KV move
 EV_HANDOFF_IMPORT = "handoff_import"        # decode-side page import
 EV_SHED = "shed"                    # request shed (503 + Retry-After)
+EV_RESUME = "resume"                # fleet recovery: re-admitted with N
+                                    # already-delivered tokens (the rng
+                                    # chain fast-forwarded past them)
 
 DEFAULT_RING = 512   # events per in-flight request (~max_new steps + admission)
 DEFAULT_KEEP = 64    # completed timelines retained for /debug/timeline

@@ -282,6 +282,16 @@ class CircuitBreaker:
             if self.state == HALF_OPEN:
                 self._probe_inflight = False
 
+    def trip(self) -> None:
+        """Force-open regardless of the failure count: the caller OBSERVED
+        the node dead (batcher loop crashed, heartbeat stale) rather than
+        inferring it from consecutive errors — fleet ejection
+        (docs/resilience.md "Fleet fault tolerance"). Reinstatement still
+        goes through the normal half-open probe path."""
+        with self._lock:
+            if self.state != OPEN:
+                self._transition(OPEN)
+
     def retry_in_s(self) -> float:
         with self._lock:
             if self.state != OPEN:
@@ -564,3 +574,100 @@ def failure_counts_for_breaker(exc: BaseException) -> bool:
     if isinstance(exc, SeldonError):
         return exc.status_code >= 500
     return True
+
+
+# ---------------------------------------------------------------------------
+# Fleet fault tolerance: retry budget + resume marker
+# ---------------------------------------------------------------------------
+
+
+DEFAULT_RETRY_BUDGET_RATIO = 0.2
+DEFAULT_RETRY_BUDGET_MIN = 3
+DEFAULT_RETRY_BUDGET_WINDOW_S = 10.0
+
+
+class RetryBudget:
+    """Bounded recovery budget (docs/resilience.md "Fleet fault
+    tolerance"): resumes and pre-first-token failovers re-dispatch work
+    the fleet already paid for once, so a correlated failure storm (half
+    the replicas die at once) could otherwise double offered load exactly
+    when capacity halved. Every recovery draws from this budget — a
+    sliding-window fraction of recent REQUEST traffic plus a small fixed
+    floor — and exhaustion degrades to an honest ShedError
+    (503 + Retry-After) instead of amplification.
+
+    Invariant: retries granted inside any window never exceed
+    ``ratio * requests_in_window + min_retries``, so fleet load is capped
+    at ``(1 + ratio)`` of offered traffic plus the constant floor.
+
+    Thread-safe: dispatch threads note requests and spend retries
+    concurrently (both are read-modify-writes on the deques/counter)."""
+
+    def __init__(self, ratio: float = DEFAULT_RETRY_BUDGET_RATIO,
+                 min_retries: int = DEFAULT_RETRY_BUDGET_MIN,
+                 window_s: float = DEFAULT_RETRY_BUDGET_WINDOW_S,
+                 clock: Callable[[], float] = time.monotonic):
+        import collections
+        import threading
+
+        self.ratio = float(ratio)
+        self.min_retries = int(min_retries)
+        self.window_s = float(window_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._requests = collections.deque()  # admission timestamps
+        self._retries = collections.deque()   # granted-retry timestamps
+        self.exhausted_total = 0
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._requests and self._requests[0] < horizon:
+            self._requests.popleft()
+        while self._retries and self._retries[0] < horizon:
+            self._retries.popleft()
+
+    def note_request(self) -> None:
+        """One unit of organic traffic entered the fleet (grows the
+        budget; never consumes it)."""
+        now = self.clock()
+        with self._lock:
+            self._prune(now)
+            self._requests.append(now)
+
+    def try_spend(self) -> bool:
+        """Atomically grant one recovery if the window has budget left.
+        False means the caller must shed (503 + Retry-After), and the
+        refusal is counted for /metrics."""
+        now = self.clock()
+        with self._lock:
+            self._prune(now)
+            allowed = self.ratio * len(self._requests) + self.min_retries
+            if len(self._retries) < allowed:
+                self._retries.append(now)
+                return True
+            self.exhausted_total += 1
+            return False
+
+    def snapshot(self) -> Dict[str, float]:
+        """One consistent view for stats/metrics."""
+        now = self.clock()
+        with self._lock:
+            self._prune(now)
+            return {
+                "requests_in_window": len(self._requests),
+                "retries_in_window": len(self._retries),
+                "exhausted_total": self.exhausted_total,
+            }
+
+
+class ResumeMarker:
+    """In-band stream event (never a token): a recovered generation
+    re-attached after ``tokens_delivered`` already-delivered tokens.
+    Flows through the on_token path so SSE emits a ``resumed`` data event
+    and gRPC a ``resumed`` meta chunk at the exact stream position where
+    the failover happened; transports must never decode it."""
+
+    __slots__ = ("tokens_delivered",)
+
+    def __init__(self, tokens_delivered: int):
+        self.tokens_delivered = int(tokens_delivered)

@@ -110,6 +110,13 @@ class PendingRequest:
     adapter_id: int = 0
     seq: int = 0
     preempted: bool = False
+    # Fleet recovery (docs/resilience.md "Fleet fault tolerance"): how many
+    # tokens of this generation were already delivered on a replica that
+    # died. ``ids`` then carries prompt+generated-prefix and the first
+    # sampled token must continue the ORIGINAL request's rng chain — the
+    # batcher fast-forwards the per-request key by this many splits and
+    # draws it exactly as the device sampler would have (_sample_first).
+    resume_tokens: int = 0
 
     def _order_key(self) -> Tuple[float, int]:
         # EDF within a tenant queue; deadline-less requests keep arrival

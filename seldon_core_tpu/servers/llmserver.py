@@ -136,6 +136,25 @@ def _slot_sampler(top_k: int):
     return sample
 
 
+def fast_forward_key(seed: int, n_tokens: int):
+    """The per-request rng key after ``n_tokens`` emitted tokens of a
+    seeded generation — the deterministic-resume half of fleet fault
+    tolerance (docs/resilience.md). The chain consumes EXACTLY one
+    first-component split per emitted token (`_sample_first`'s host draw
+    for the first token, then `_slot_sampler`'s per-step split), so
+    replaying ``n_tokens`` splits from PRNGKey(seed) lands on the key the
+    dead replica's slot held when it died. The caller then draws token
+    ``n_tokens`` with `_slot_sampler`'s exact op order (split ->
+    lax.top_k -> categorical -> gather); any fork of that order re-opens
+    the bit-exactness hazard tests/test_chaos.py pins."""
+    import jax
+
+    key = jax.random.PRNGKey(int(seed))
+    for _ in range(int(n_tokens)):
+        key, _ = jax.random.split(key)
+    return key
+
+
 from seldon_core_tpu.utils import bucket as _bucket  # single bucketing policy
 
 

@@ -25,6 +25,7 @@ infinite behavior).
 from __future__ import annotations
 
 import random
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -217,3 +218,143 @@ def inject_faults(
     """Wrap an existing component with a fault schedule (its methods run only
     when the scheduled call succeeds)."""
     return FaultyComponent(schedule=schedule, clock=clock, inner=component)
+
+
+# ---------------------------------------------------------------------------
+# Fleet chaos (ISSUE 16): deterministic batcher-level crash injection.
+#
+# ContinuousBatcher calls its ``_chaos`` hook at the top of every loop turn
+# with itself as the argument; a raising hook is indistinguishable from a
+# device fault mid-step — the crash handler fails every in-flight slot and
+# the loop dies, exactly the unplanned death the fleet's health model must
+# catch. No sleeps anywhere: triggers are explicit (a threading.Event the
+# test sets, or any predicate over batcher state), so the kill lands
+# mid-decode by construction rather than by timing luck.
+
+
+class BatcherKiller:
+    """A one-shot batcher-loop assassin, installable as ``batcher._chaos``
+    on any number of batchers at once.
+
+    The kill fires on the first loop turn where ``trigger`` is truthy (an
+    ``threading.Event`` works directly — so does any zero-arg callable or
+    a predicate taking the batcher). With ``busiest=True`` and several
+    installed batchers, only the batcher holding the most active slots at
+    trigger time dies — "kill the busiest replica mid-decode" without
+    guessing which replica the router chose. One shot: after the kill the
+    hook disarms everywhere, so the fleet's half-open re-probe (which
+    restarts the very same loop) finds a healthy batcher.
+    """
+
+    def __init__(self, trigger: Optional[Any] = None, busiest: bool = False,
+                 message: str = "chaos: batcher loop killed"):
+        self.trigger = trigger
+        self.busiest = busiest
+        self.message = message
+        self._armed = True
+        self._lock = threading.Lock()
+        self._installed: List[Any] = []
+        self.kills = 0
+        self.killed: Optional[Any] = None  # the batcher that died
+
+    def install(self, *batchers: Any) -> "BatcherKiller":
+        """Attach to each batcher's ``_chaos`` hook; returns self."""
+        for b in batchers:
+            b._chaos = self
+            self._installed.append(b)
+        return self
+
+    def _triggered(self, batcher: Any) -> bool:
+        t = self.trigger
+        if t is None:
+            return True
+        if hasattr(t, "is_set"):
+            return bool(t.is_set())
+        try:
+            return bool(t(batcher))
+        except TypeError:
+            return bool(t())
+
+    @staticmethod
+    def _active_slots(batcher: Any) -> int:
+        return sum(1 for s in batcher._slots if s.active)
+
+    def __call__(self, batcher: Any) -> None:
+        # each batcher loop runs on its own event-loop thread: the disarm
+        # is a check-then-set race between victims, so it sits under a lock
+        with self._lock:
+            if not self._armed or not self._triggered(batcher):
+                return
+            if self.busiest:
+                mine = self._active_slots(batcher)
+                peak = max((self._active_slots(b) for b in self._installed),
+                           default=0)
+                if mine == 0 or mine < peak:
+                    return  # a busier sibling will take the bullet
+            self._armed = False
+            self.kills += 1
+            self.killed = batcher
+        raise SeldonError(self.message, status_code=503,
+                          reason="INJECTED_FAULT")
+
+
+class HandoffPoisoner:
+    """Corrupts the staged KV of finished remote prefills so the decode
+    side's import raises — the "poisoned handoff" fault class.
+
+    Wraps every PrefillWorker's ``_prefill_one``: the prefill itself runs
+    and publishes normally, but the handoff arrives READY with ``staged``
+    replaced by an unimportable payload (a bare string has no pages to
+    slice dense-insert or tree-import, so both layouts raise inside
+    ``_consume_handoffs``). Poisons the first ``first_n`` handoffs, then
+    passes everything through untouched — one bad handoff amid good ones,
+    the shape the batcher's containment must survive."""
+
+    def __init__(self, batcher: Any, first_n: int = 1,
+                 poison: Any = "poisoned-kv-payload"):
+        self.first_n = int(first_n)
+        self.poison = poison
+        self.poisoned = 0
+        self._lock = threading.Lock()
+        if getattr(batcher, "_remote", None) is None:
+            raise ValueError("HandoffPoisoner needs a disaggregated batcher")
+        for worker in batcher._remote.workers:
+            real = worker._prefill_one
+
+            def poisoned_prefill(req, _real=real):
+                h = _real(req)
+                with self._lock:
+                    if self.poisoned < self.first_n:
+                        self.poisoned += 1
+                        h.staged = self.poison
+                return h
+
+            worker._prefill_one = poisoned_prefill
+
+
+class DispatchFailer:
+    """Scripted dispatch-level failure for a replica's BatcherService:
+    wraps ``submit_sync`` so call *i* consults ``schedule[i]`` before
+    delegating — the repeated-failure shape that trips the fleet's
+    per-replica breaker (consecutive dispatch failures) without ever
+    touching the batcher loop. Latency entries advance the FaultClock, so
+    breaker reset windows can elapse in zero wall time."""
+
+    def __init__(self, service: Any, schedule: FaultSchedule,
+                 clock: Optional[FaultClock] = None):
+        self.schedule = schedule
+        self.clock = clock
+        self.calls = 0
+        self._real = service.submit_sync
+        self._lock = threading.Lock()
+        service.submit_sync = self._submit_sync
+
+    def _submit_sync(self, *args, **kwargs):
+        with self._lock:
+            spec = self.schedule[self.calls]
+            self.calls += 1
+        if spec.latency_s and self.clock is not None:
+            self.clock.advance(spec.latency_s)
+        if spec.error is not None:
+            raise spec.error
+        return self._real(*args, **kwargs)

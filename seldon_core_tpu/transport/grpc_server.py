@@ -31,6 +31,7 @@ from seldon_core_tpu.runtime.resilience import (
     DEADLINE_GRPC_METADATA,
     AdmissionController,
     Deadline,
+    ResumeMarker,
     ShedError,
     deadline_scope,
 )
@@ -271,6 +272,13 @@ def _make_generate_stream(component: Any):
         text_mode = isinstance(body["prompt"], str)
 
         def tok_event(tok):
+            if isinstance(tok, ResumeMarker):
+                # fleet recovery re-attached this stream after a replica
+                # death: an in-band meta chunk, never a token (at-most-once
+                # contract, docs/resilience.md) — mirrors the SSE marker
+                return pc.message_to_proto(SeldonMessage.from_json_data(
+                    {"resumed": True,
+                     "tokens_delivered": tok.tokens_delivered}))
             piece = decode.decode([tok]) if (decode is not None
                                              and text_mode) else None
             return pc.message_to_proto(SeldonMessage.from_json_data(

@@ -39,6 +39,7 @@ from seldon_core_tpu.runtime.resilience import (
     DEADLINE_HEADER,
     AdmissionController,
     Deadline,
+    ResumeMarker,
     ShedError,
     current_deadline,
     deadline_scope,
@@ -429,6 +430,13 @@ def _add_generate_routes(app: web.Application, component: Any,
                 # sentinel, and waiting only on the queue would hang the
                 # connection forever.
                 async def write_tok(tok):
+                    if isinstance(tok, ResumeMarker):
+                        # fleet recovery re-attached this stream after a
+                        # replica death: an in-band marker, never a token
+                        # (at-most-once contract, docs/resilience.md)
+                        await resp.write(
+                            f"data: {json.dumps({'resumed': True, 'tokens_delivered': tok.tokens_delivered})}\n\n".encode())
+                        return
                     piece = (decode.decode([tok]) if decode is not None
                              and isinstance(prompt, str) else None)
                     await resp.write(

@@ -464,6 +464,142 @@ def test_wfq_scheduler_locked_push_is_clean(tmp_path):
     assert rules_of(reported) == []
 
 
+FLEET_HEALTH = """
+    import threading
+
+    class ReplicaFleet:
+        # the ISSUE 16 health-model shape: ejection happens from dispatch
+        # threads (after a failed submit) AND from the autoscaler tick
+        # thread (check_health), while ejected_members()/dispatchable()
+        # serve transport and /metrics scrape threads — the quarantine
+        # list and its tally are the shared membership truth
+        def __init__(self, replicas):
+            self._lock = threading.Lock()
+            self._replicas = list(replicas)
+            self._ejected = []
+            self.ejections_total = 0
+
+        def eject(self, r):
+            if r not in self._ejected:       # pre-fix: unlocked check...
+                self._ejected.append(r)      # ...then unlocked act
+                self.ejections_total += 1    # pre-fix: unlocked RMW
+
+        def reinstate(self, r):
+            with self._lock:
+                if r in self._ejected:
+                    self._ejected.remove(r)
+
+        def ejected_members(self):
+            with self._lock:
+                return list(self._ejected)
+
+        def dispatchable(self):
+            with self._lock:
+                return [r for r in self._replicas
+                        if r not in self._ejected]
+"""
+
+
+def test_fleet_health_unlocked_eject_fires(tmp_path):
+    """The fleet-health discipline (ISSUE 16 tentpole): reinstate/
+    ejected_members/dispatchable establish the guarded pattern on the
+    quarantine list; an unlocked eject() is the check-then-act race that
+    double-ejects a replica (and double-counts the ejection) when a
+    dispatch failure and the health sweep observe the same death —
+    tests/test_schedules.py explores the membership interleavings on the
+    REAL ReplicaSet."""
+    root = write_tree(tmp_path / "pkg", {"runtime/fleet.py": FLEET_HEALTH})
+    reported, _, _ = lint(root)
+    us = [f for f in reported if f.rule == "unguarded-shared-state"]
+    assert us, "the unlocked eject membership mutation must fire"
+    assert any("_ejected" in f.message or "ejections_total" in f.message
+               for f in us)
+
+
+def test_fleet_health_locked_eject_is_clean(tmp_path):
+    fixed = FLEET_HEALTH.replace(
+        "        def eject(self, r):\n"
+        "            if r not in self._ejected:       # pre-fix: unlocked check...\n"
+        "                self._ejected.append(r)      # ...then unlocked act\n"
+        "                self.ejections_total += 1    # pre-fix: unlocked RMW",
+        "        def eject(self, r):\n"
+        "            with self._lock:\n"
+        "                if r not in self._ejected:\n"
+        "                    self._ejected.append(r)\n"
+        "                    self.ejections_total += 1")
+    assert fixed != FLEET_HEALTH
+    root = write_tree(tmp_path / "pkg", {"runtime/fleet.py": fixed})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+RESUME_JOURNAL = """
+    import threading
+
+    class ResumeJournal:
+        # the ISSUE 16 recovery-journal shape: batcher worker threads
+        # append each delivered token while the fleet's retry loop
+        # snapshots the prefix it must re-admit after an ejection and the
+        # /metrics scrape reads the depth — the token lists ARE the
+        # at-most-once contract, so a lost append double-delivers
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._entries = {}
+            self._seq = 0
+            self.appended_total = 0
+
+        def open(self, prompt):
+            with self._lock:
+                self._seq += 1
+                self._entries[self._seq] = []
+                return self._seq
+
+        def append(self, jid, tok):
+            self._entries[jid].append(tok)   # pre-fix: unlocked mutate
+            self.appended_total += 1         # pre-fix: unlocked RMW
+
+        def snapshot(self, jid):
+            with self._lock:
+                return list(self._entries[jid])
+
+        def close(self, jid):
+            with self._lock:
+                self._entries.pop(jid, None)
+"""
+
+
+def test_resume_journal_unlocked_append_fires(tmp_path):
+    """The resume-journal discipline (ISSUE 16 tentpole): open/snapshot/
+    close establish the guarded pattern on the entry map; an unlocked
+    append() races the retry loop's snapshot — the resumed replica then
+    replays a token the client already has, breaking at-most-once
+    delivery (the dynamic find-and-replay proof lives in
+    tests/test_schedules.py)."""
+    root = write_tree(tmp_path / "pkg",
+                      {"runtime/journal.py": RESUME_JOURNAL})
+    reported, _, _ = lint(root)
+    us = [f for f in reported if f.rule == "unguarded-shared-state"]
+    assert us, "the unlocked journal append must fire"
+    assert any("_entries" in f.message or "appended_total" in f.message
+               for f in us)
+
+
+def test_resume_journal_locked_append_is_clean(tmp_path):
+    fixed = RESUME_JOURNAL.replace(
+        "        def append(self, jid, tok):\n"
+        "            self._entries[jid].append(tok)   # pre-fix: unlocked mutate\n"
+        "            self.appended_total += 1         # pre-fix: unlocked RMW",
+        "        def append(self, jid, tok):\n"
+        "            with self._lock:\n"
+        "                self._entries[jid].append(tok)\n"
+        "                self.appended_total += 1")
+    assert fixed != RESUME_JOURNAL
+    root = write_tree(tmp_path / "pkg",
+                      {"runtime/journal.py": fixed})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
 def test_unguarded_read_against_guarded_writes_fires(tmp_path):
     """The CircuitBreaker.state_code class: guarded writes establish the
     discipline, an unguarded public read violates it."""

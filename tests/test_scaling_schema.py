@@ -32,6 +32,10 @@ PINNED_FIELDS = {
     "page_sheds_total": int,
     "handoff_queue_depth": int,
     "draining": bool,
+    # fleet fault tolerance (ISSUE 16): True once the fleet quarantined
+    # this replica after an unplanned death — the autoscaler's replace
+    # signal (a solo component is never ejected)
+    "ejected": bool,
     "prefill_devices": int,
     "decode_devices": int,
     # multi-tenant (ISSUE 15): queued admissions per SLO class — the

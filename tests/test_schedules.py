@@ -1156,6 +1156,122 @@ def test_replica_set_membership_safe_under_exploration():
 
 
 # ---------------------------------------------------------------------------
+# fleet fault tolerance (ISSUE 16): the resume-journal and health-model
+# discipline under interleaving
+# ---------------------------------------------------------------------------
+
+
+class UnlockedResumeJournal:
+    """Reconstruction of the race the fleet's ``_journal_lock`` exists to
+    prevent (runtime/engine.py ``_fleet_submit_blocking``): batcher worker
+    threads journal each delivered token (append + delivered-count RMW)
+    while the retry loop snapshots the prefix to re-admit. Unlocked, the
+    count RMW loses an update against a concurrent append — the journal
+    then claims fewer tokens DELIVERED than it holds, so a resume
+    fast-forwards the rng chain by the wrong split count and replays a
+    token the client already has: the exact duplicate-delivery the
+    at-most-once contract (tests/test_chaos.py) forbids."""
+
+    def __init__(self):
+        self.tokens = []
+        self.delivered = 0
+
+    def append(self, tok):
+        self.tokens.append(tok)
+        self.delivered = self.delivered + 1   # pre-fix: unlocked RMW
+
+
+def _unlocked_journal_scenario(sched):
+    j = UnlockedResumeJournal()
+    sched.spawn(lambda: j.append(11), name="worker-a")
+    sched.spawn(lambda: j.append(12), name="worker-b")
+    return j
+
+
+def test_resume_journal_unlocked_reconstruction_desyncs_the_count():
+    """Opcode exploration finds the lost delivered-count update; the
+    exact schedule replays deterministically to a journal whose token
+    list and rng fast-forward count disagree."""
+
+    def ok(j):
+        return j.delivered == len(j.tokens) == 2
+
+    bad = find_race(_unlocked_journal_scenario, ok, granularity="opcode",
+                    max_schedules=200, stall_s=STALL)
+    assert bad is not None, \
+        "the unlocked journal must desync count from tokens"
+    j, _, sched = run_schedule(_unlocked_journal_scenario,
+                               schedule=bad.to_list(),
+                               granularity="opcode", stall_s=STALL)
+    assert not sched.errors()
+    # the corruption, replayed: two tokens delivered, one counted — a
+    # resume would fast-forward one split and re-send token two
+    assert len(j.tokens) == 2 and j.delivered == 1
+
+
+def _fleet_fault_scenario(sched):
+    """The REAL ReplicaSet under the threads fleet fault tolerance adds:
+    a dispatch failure ejecting a replica (quarantine) races live
+    dispatch (pick), the autoscaler's undrain actuator, and the resume
+    journal's locked append/snapshot pair (batcher worker vs retry
+    loop)."""
+    from seldon_core_tpu.runtime.engine import ReplicaSet, _ResumeEntry
+
+    r1, r2, r3 = (_SchedStubReplica(), _SchedStubReplica(),
+                  _SchedStubReplica())
+    rs = ReplicaSet([r1, r2, r3])
+    rs.drain_replica(r3)  # pre-staged: the undrain actuator's target
+    entry = _ResumeEntry([1, 2], 8, seed=5, tenant=None, slo_class=None,
+                         adapter=None)
+    with rs._journal_lock:
+        rs._journal[1] = entry
+    picks = []
+    snap = {}
+    rs._picks, rs._snap, rs._victim = picks, snap, r2
+
+    def eject_dead():
+        # the dispatch-failure path: force the breaker open, quarantine
+        rs._breaker_for(r2).trip()
+        rs._eject(r2)
+
+    def journal_worker():
+        with rs._journal_lock:
+            entry.tokens.append(7)
+
+    def retry_reader():
+        with rs._journal_lock:
+            snap["tokens"] = list(entry.tokens)
+
+    sched.spawn(eject_dead, name="eject")
+    sched.spawn(lambda: picks.append(rs.pick()), name="dispatch")
+    sched.spawn(rs.undrain_replica, name="undrain")
+    sched.spawn(journal_worker, name="journal-append")
+    sched.spawn(retry_reader, name="resume-snapshot")
+    return rs
+
+
+def test_real_fleet_fault_paths_exact_under_exploration():
+    """Whatever order ejection, dispatch, undrain and the journal pair
+    interleave in: membership stays consistent (the corpse quarantined,
+    the drain cancelled, dispatch never lands on a detached replica) and
+    the journal snapshot is always a clean prefix — never a torn read."""
+
+    def ok(rs):
+        with rs._journal_lock:
+            toks = list(rs._journal[1].tokens)
+        return (len(rs.members()) == 3
+                and rs.ejected_members() == [rs._victim]
+                and rs.draining_members() == []
+                and len(rs._picks) == 1
+                and rs._picks[0] in rs.members()
+                and toks == [7]
+                and rs._snap["tokens"] in ([], [7]))
+
+    assert find_race(_fleet_fault_scenario, ok, granularity="line",
+                     max_schedules=100, stall_s=STALL) is None
+
+
+# ---------------------------------------------------------------------------
 # adapter registry + weighted-fair scheduler (ISSUE 15): the multi-tenant
 # refcount and tally discipline under interleaving
 # ---------------------------------------------------------------------------
