@@ -61,6 +61,15 @@ across phases):
      number — plus TTFT / inter-token-gap histogram summaries and the
      handoff counters. Needs >= 2 visible devices (CPU rehearsal:
      XLA_FLAGS=--xla_force_host_platform_device_count=8).
+  N (NETWORK_HANDOFF set). cross-host KV handoff arm (ISSUE 18): reruns
+     the disaggregated batch with the prefill->decode handoff streamed
+     as length-prefixed frames over a real socket instead of
+     jax.device_put, at batch-8 concurrent streaming. Reports the
+     device-vs-network tok/s pair (when does device_put beat the
+     socket), wire bytes per handoff, the handoff-seconds histogram, and
+     the serialization share of end-to-end latency — the <5% acceptance
+     bar of the framing tentpole, reported by the bench. Same >= 2
+     visible devices requirement as the DISAGG arm.
 
 Writes benchmarks/report_llm_7b_serving.json and appends the attribution
 to DECODE_NOTES.md (by hand, from the printed table).
@@ -101,7 +110,7 @@ def main() -> None:
     # phase L builds its OWN lora-enabled server, which does not co-fit
     # with the headline 7B server on chip — on TPU run it alone ("L")
     phases = "".join(sys.argv[1:]).upper() or (
-        "ABCDEPSM" if on_tpu else "ABCDEPSML")
+        "ABCDEPSMN" if on_tpu else "ABCDEPSMLN")
     report = {}
     if os.path.exists(REPORT):
         with open(REPORT) as f:
@@ -220,6 +229,11 @@ def main() -> None:
     # ---- D (DISAGG env). disaggregated prefill/decode arm (ISSUE 9) ----
     if "D" in phases and os.environ.get("DISAGG", ""):
         _disagg_arm(server, report, rng, vocab, plen, max_new, on_tpu)
+
+    # ---- N (NETWORK_HANDOFF env). framed cross-host handoff (ISSUE 18) -
+    if "N" in phases and os.environ.get("NETWORK_HANDOFF", ""):
+        _network_handoff_arm(server, report, rng, vocab, plen, max_new,
+                             on_tpu)
 
     # ---- D. b8 vs b1 decode-step attribution ---------------------------
     if on_tpu and "D" in phases:
@@ -1259,6 +1273,123 @@ def _disagg_arm(server, report, rng, vocab, plen, max_new, on_tpu) -> None:
     }
     report["disagg"] = disagg
     log("disagg", disagg)
+    _write(report)
+
+
+def _network_handoff_arm(server, report, rng, vocab, plen, max_new,
+                         on_tpu) -> None:
+    """Phase N with NETWORK_HANDOFF set (ISSUE 18): the framed socket
+    handoff vs jax.device_put on the SAME batch-8 concurrent streaming
+    workload. The headline is the serialization share — total frame
+    encode+decode seconds (the codec's own timers, the same samples
+    seldon_frame_{encode,decode}_seconds scrape) over the network run's
+    end-to-end wall — with the <5% acceptance bar reported alongside,
+    plus wire bytes per handoff and the handoff-seconds histogram."""
+    import asyncio
+
+    import jax
+
+    from seldon_core_tpu.codec import framing
+    from seldon_core_tpu.parallel.mesh import disaggregated_mesh
+    from seldon_core_tpu.runtime.batcher import ContinuousBatcher
+
+    if len(jax.devices()) < 2:
+        note = (f"devices={len(jax.devices())}: arm needs >= 2 (CPU "
+                "rehearsal: XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8)")
+        report["network_handoff"] = {"note": note}
+        log("network_handoff", report["network_handoff"])
+        return
+    pre_n = int(os.environ.get("PREFILL_DEVICES", "0")) or 1
+    page_size = int(os.environ.get("KV_PAGE_SIZE", "0")) or (
+        64 if on_tpu else 8)
+    clients = 8
+    # the handoff (and so the codec) is paid once per request while the
+    # stream pays per token: measure at the disagg arm's steady-request
+    # length so the per-handoff cost amortizes the way serving does
+    gen = 4 * max_new
+    mesh = disaggregated_mesh(pre_n)
+    prompts = [rng.integers(1, vocab, size=plen).tolist()
+               for _ in range(clients)]
+
+    def run(transport):
+        async def go():
+            b = ContinuousBatcher(
+                server, max_slots=clients, max_len=plen + gen,
+                layout="paged", page_size=page_size,
+                disaggregation="remote_prefill", disagg_mesh=mesh,
+                handoff_transport=transport)
+            # a per-token callback keeps this the batch-8 CONCURRENT
+            # STREAMING shape the acceptance bar names
+            streamed = [0]
+
+            def on_tok(t):
+                if t is not None:
+                    streamed[0] += 1
+
+            t0 = time.perf_counter()
+            outs = await asyncio.gather(*[
+                b.submit(p, max_new_tokens=gen, on_token=on_tok)
+                for p in prompts])
+            wall = time.perf_counter() - t0
+            stats = b.handoff_stats()
+            await b.close()
+            assert streamed[0] == sum(len(t) for t in outs)
+            return outs, wall, stats
+
+        return asyncio.run(go())
+
+    # warm both transports: prefill/decode/import programs (and the
+    # workers' committed param copies) compile outside the timed windows
+    run("device")
+    run("network")
+    server.llm_stats()      # drain latency deques
+    framing.frame_stats()   # drain codec timers: the window owns its samples
+    base_outs, wall_dev, _ = run("device")
+    outs, wall_net, hstats = run("network")
+    fstats = framing.frame_stats()
+    st = server.llm_stats()
+    assert outs == base_outs, "network handoff broke bit-exactness"
+
+    ser_s = (sum(fstats["frame_encode_times_s"]) +
+             sum(fstats["frame_decode_times_s"]))
+    tokens = sum(len(t) for t in outs)
+    wire_bytes = hstats["handoff_network_bytes_total"]
+    n_handoffs = hstats["handoffs_total"]
+
+    def _hist(samples_s):
+        if not samples_s:
+            return None
+        ms = np.asarray(samples_s) * 1e3
+        return {"n": int(ms.size),
+                "p50_ms": round(float(np.percentile(ms, 50)), 2),
+                "p90_ms": round(float(np.percentile(ms, 90)), 2),
+                "p99_ms": round(float(np.percentile(ms, 99)), 2),
+                "max_ms": round(float(np.max(ms)), 2)}
+
+    entry = {
+        "clients": clients, "max_new_tokens": gen,
+        "prompt_tokens": plen,
+        "prefill_devices": len(mesh.prefill_devices),
+        "tok_per_s": {"device": round(tokens / wall_dev, 1),
+                      "network": round(tokens / wall_net, 1)},
+        # when device_put beats the socket: the same-host rehearsal pays
+        # the codec + TCP for nothing — the ratio quantifies that tax;
+        # cross-host there is no device path at all (DECODE_NOTES PR 18)
+        "network_vs_device": round(wall_dev / wall_net, 3),
+        "handoffs_total": n_handoffs,
+        "handoff_wire_mb": round(wire_bytes / 1e6, 3),
+        "bytes_per_handoff": round(wire_bytes / max(n_handoffs, 1)),
+        # the framing tentpole's acceptance bar, reported: codec seconds
+        # over end-to-end wall at batch-8 concurrent streaming
+        "serialization_s": round(ser_s, 4),
+        "serialization_share_pct": round(100.0 * ser_s / wall_net, 2),
+        "serialization_share_limit_pct": 5.0,
+        "handoff_hist": _hist(st.get("handoff_times_s", [])),
+        "ttft_hist": _hist(st.get("ttft_s", [])),
+    }
+    report["network_handoff"] = entry
+    log("network_handoff", entry)
     _write(report)
 
 

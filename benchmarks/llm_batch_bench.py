@@ -23,6 +23,78 @@ REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 
 
+def _remote_hop_phase(wire_format, array, reps=64):
+    """One arm of the wire-format A/B (ISSUE 18): ``reps`` sequential
+    predict_raw round trips over a loopback REST hop — RemoteComponent on
+    one end, ``make_component_app`` over an echo component on the other —
+    with the tensor body encoded per ``wire_format``. Both ends live in
+    this process, so for frames the codec's own timers hold all four
+    serialization legs (client+server, encode+decode); for JSON the same
+    four legs are microbenched outside the hop (they run inside aiohttp
+    handlers where they can't be isolated)."""
+    import asyncio
+    import socket
+
+    from aiohttp import web
+
+    from seldon_core_tpu.codec import framing
+    from seldon_core_tpu.contracts.graph import Endpoint
+    from seldon_core_tpu.contracts.payload import SeldonMessage
+    from seldon_core_tpu.runtime.remote import RemoteComponent
+    from seldon_core_tpu.transport.rest import make_component_app
+
+    class _Echo:
+        def predict(self, X, names, meta=None):
+            return X
+
+    msg = SeldonMessage.from_array(array)
+    body_bytes = (len(framing.encode_message(msg)) if wire_format == "frame"
+                  else len(json.dumps(msg.to_dict()).encode()))
+
+    async def go():
+        app = make_component_app(_Echo())
+        runner = web.AppRunner(app)
+        await runner.setup()
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        site = web.SockSite(runner, sock)
+        await site.start()
+        comp = RemoteComponent(
+            Endpoint(service_host="127.0.0.1", service_port=port,
+                     type="REST"), wire_format=wire_format)
+        try:
+            await comp.predict_raw(msg)  # warm: connection + frame probe
+            framing.frame_stats()        # the timed window owns its samples
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                await comp.predict_raw(msg)
+            return time.perf_counter() - t0
+        finally:
+            await comp.close()
+            await runner.cleanup()
+
+    wall = asyncio.run(go())
+    if wire_format == "frame":
+        st = framing.frame_stats()
+        ser_s = (sum(st["frame_encode_times_s"]) +
+                 sum(st["frame_decode_times_s"]))
+    else:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            SeldonMessage.from_dict(json.loads(json.dumps(msg.to_dict())))
+        ser_s = 2.0 * (time.perf_counter() - t0)  # request + response legs
+    return {
+        "wire_format": wire_format,
+        "requests": reps,
+        "body_bytes": body_bytes,
+        "ms_per_request": round(1e3 * wall / reps, 3),
+        "req_per_s": round(reps / wall, 1),
+        "serialization_ms_per_request": round(1e3 * ser_s / reps, 3),
+        "serialization_share_pct": round(100.0 * ser_s / wall, 2),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tpu", action="store_true")
@@ -59,6 +131,13 @@ def main() -> None:
                          "drafter's home turf (the acceptance-rate "
                          "headline scenario); random = un-draftable "
                          "worst case")
+    ap.add_argument("--wire-format", default="", choices=("", "json", "frame"),
+                    help="remote-hop A/B arm (ISSUE 18): after the serving "
+                         "phases, drive tensor bodies through a loopback "
+                         "REST hop (RemoteComponent -> component app) with "
+                         "the chosen encoding; reports per-request latency, "
+                         "bytes on the wire, and the serialization share — "
+                         "run once per format and diff the report entries")
     ap.add_argument("--tracing", action="store_true",
                     help="tracing-overhead guard arm: rerun the concurrent "
                          "phase with the flight recorder enabled and "
@@ -321,6 +400,21 @@ def main() -> None:
         # report JSON is written — a failing CI run must leave the
         # numbers it failed on in the artifact, not just a stdout line
 
+    # --wire-format: the remote-hop A/B (ISSUE 18). Both arms run so one
+    # invocation carries the comparison; the flag picks the headline the
+    # summary line reports.
+    remote_hop = None
+    if args.wire_format:
+        hop_array = np.random.default_rng(1).standard_normal(
+            (args.clients, plen, kwargs["dim"]), dtype=np.float32)
+        remote_hop = {
+            fmt: _remote_hop_phase(fmt, hop_array)
+            for fmt in ("json", "frame")}
+        remote_hop["frame_vs_json_speedup"] = round(
+            remote_hop["json"]["ms_per_request"] /
+            remote_hop["frame"]["ms_per_request"], 2)
+        remote_hop["headline"] = args.wire_format
+
     platform = jax.devices()[0].platform
     # per-token KV bytes alongside tok/s so BENCH rounds can attribute
     # bandwidth regressions (decode attention streams the whole static
@@ -363,6 +457,8 @@ def main() -> None:
         # the --tracing guard arm: enabled-vs-disabled flight-recorder
         # throughput at this batch (CI enforces the limit via exit code)
         entry["tracing"] = tracing_entry
+    if remote_hop is not None:
+        entry["remote_hop"] = remote_hop
     if platform == "tpu":
         entry["note"] = (
             "this harness reaches the chip over a ~75ms-RTT tunnel; the "
@@ -397,6 +493,13 @@ def main() -> None:
         if tracing_entry["overhead_pct"] > tracing_entry["limit_pct"]:
             print(json.dumps({"tracing_overhead_violation": tracing_entry}))
             sys.exit(1)
+    if remote_hop is not None:
+        head = remote_hop[args.wire_format]
+        summary["remote_hop_ms"] = head["ms_per_request"]
+        summary["remote_hop_serialization_share_pct"] = head[
+            "serialization_share_pct"]
+        summary["remote_hop_frame_vs_json_x"] = remote_hop[
+            "frame_vs_json_speedup"]
     if spec.get("spec_mode", "off") != "off":
         summary["spec_mode"] = spec["spec_mode"]
         summary["spec_k"] = spec["spec_k"]

@@ -1,0 +1,459 @@
+"""Zero-copy tensor framing: the binary wire format for inter-hop tensors.
+
+The reference shipped an experimental FlatBuffers transport because the
+JSON codec was the data-plane tax on every engine->node hop (PAPER.md
+language census; `fbs/prediction.fbs`): a float64 rides proto-JSON as
+~18 text bytes plus the `ravel().tolist()` boxing on encode and a
+float64 re-parse on decode. This module replaces that with a
+length-delimited frame — fixed header, JSON metadata section, raw
+concatenated tensor buffers — so a hop ships ndarray BYTES:
+
+    offset  size  field
+    0       4     magic  b"SFRM"
+    4       2     version (u16 LE)
+    6       2     flags   (u16 LE, reserved)
+    8       4     n_tensors (u32 LE)
+    12      4     meta_len  (u32 LE) — UTF-8 JSON byte length
+    16      8     payload_len (u64 LE) — total raw tensor bytes
+    24      ...   n_tensors table entries:
+                    dtype_code u8 | ndim u8 | reserved u16 |
+                    offset u64 | nbytes u64 | ndim x dim u64
+    ...     ...   meta JSON (UTF-8)
+    ...     ...   tensor payload (concatenated raw buffers; offsets in
+                  the table are relative to the payload start)
+
+Header + table + metadata come BEFORE the payload on purpose: a frame
+truncated mid-payload still yields its metadata (job ids, status) via
+``decode_frame(buf, meta_only=True)``, which is how the network KV
+handoff surfaces a per-request error instead of losing the request.
+
+Robustness contract (the fuzz suite, tests/test_framing.py): every
+malformed input raises :class:`FrameError` (a 400 ``SeldonError``) —
+never a hang, never a partial ndarray, and never an allocation sized by
+attacker-controlled fields. The decoder only ever SLICES the received
+buffer: declared lengths are validated against ``len(buf)`` before any
+``np.frombuffer``, so an "oversized declared length" costs a comparison,
+not memory.
+
+Transfer discipline: frame assembly makes exactly ONE bulk
+device->host transfer (`jax.device_get` on the full leaf list), never a
+per-tensor sync — enforced by the graftlint host-sync checker's framing
+egress scope (tools/graftlint/checkers/hostsync.py).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from seldon_core_tpu.contracts.payload import (
+    DefaultData,
+    Meta,
+    SeldonError,
+    SeldonMessage,
+    Status,
+)
+
+CONTENT_TYPE_FRAME = "application/x-seldon-frame"
+
+MAGIC = b"SFRM"
+VERSION = 1
+
+_HEADER = struct.Struct("<4sHHIIQ")          # magic, version, flags, n, meta, payload
+_ENTRY = struct.Struct("<BBHQQ")             # dtype, ndim, reserved, offset, nbytes
+_DIM = struct.Struct("<Q")
+
+# sanity bounds: a frame violating these is malformed, not merely large.
+# They cap TABLE/METADATA parsing work — tensor payload size is bounded by
+# the transport (client_max_size / grpc max message size), and the decoder
+# never allocates from declared fields anyway.
+MAX_TENSORS = 4096
+MAX_NDIM = 16
+MAX_META_BYTES = 64 << 20
+
+# wire dtype codes. Order is the wire contract — append only.
+_DTYPE_NAMES = (
+    "float32", "float64", "float16", "bfloat16",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "bool",
+)
+_CODE_BY_NAME = {n: i for i, n in enumerate(_DTYPE_NAMES)}
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+class FrameError(SeldonError):
+    """Malformed frame: always a clean 400 at the transport edge."""
+
+    def __init__(self, message: str, status_code: int = 400):
+        super().__init__(message, status_code=status_code,
+                         reason="MALFORMED_FRAME")
+
+
+# ---------------------------------------------------------------------------
+# codec stats (the metrics satellite): lifetime byte tallies per path plus
+# encode/decode time samples, drained by MetricsRegistry.sync_framing()
+# ---------------------------------------------------------------------------
+
+_stats_lock = threading.Lock()
+_encode_times_s: List[float] = []
+_decode_times_s: List[float] = []
+_bytes_total: Dict[str, int] = {}
+
+
+def _record(kind: str, path: str, nbytes: int, dur_s: float) -> None:
+    with _stats_lock:
+        if kind == "encode":
+            _encode_times_s.append(dur_s)
+        else:
+            _decode_times_s.append(dur_s)
+        _bytes_total[path] = _bytes_total.get(path, 0) + int(nbytes)
+
+
+def frame_stats(drain: bool = True) -> Dict[str, Any]:
+    """Codec tallies for /metrics: time samples (drained — each observed
+    once) and lifetime byte totals per path label (monotonic; the
+    registry's counter catch-up converts them to increments)."""
+    with _stats_lock:
+        out = {
+            "frame_encode_times_s": list(_encode_times_s),
+            "frame_decode_times_s": list(_decode_times_s),
+            "frame_bytes_total": dict(_bytes_total),
+        }
+        if drain:
+            _encode_times_s.clear()
+            _decode_times_s.clear()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# frame codec: (meta dict, [ndarray]) <-> bytes
+# ---------------------------------------------------------------------------
+
+def _host_tensors(tensors: Sequence[Any]) -> List[np.ndarray]:
+    """Materialize every tensor on the host with ONE bulk transfer.
+
+    Device arrays (anything that is not already np.ndarray) are pulled in
+    a single ``jax.device_get`` over the whole list — per-tensor syncs
+    would serialize host and device once per leaf (the PR 3 stall class;
+    the graftlint framing-egress rule pins this shape).
+    """
+    if any(not isinstance(t, np.ndarray) for t in tensors):
+        import jax
+
+        # graftlint: allow-host-sync-in-hot-path(THE single bulk device->host transfer per frame — the framing contract; everything below works on host views)
+        tensors = jax.device_get(list(tensors))
+    # np.asarray(order="C") rather than ascontiguousarray: the latter
+    # promotes 0-d arrays to 1-d, which would corrupt scalar shapes on
+    # the wire. Inputs here are host values (the bulk transfer above).
+    return [np.asarray(t, order="C") for t in tensors]
+
+
+def encode_frame(meta: Dict[str, Any], tensors: Sequence[Any] = (),
+                 path: str = "rest") -> bytes:
+    """Serialize (JSON-able metadata, tensors) into one frame."""
+    t0 = time.perf_counter()
+    arrs = _host_tensors(list(tensors))
+    if len(arrs) > MAX_TENSORS:
+        raise FrameError(f"{len(arrs)} tensors exceeds the frame cap "
+                         f"{MAX_TENSORS}")
+    meta_b = json.dumps(meta, separators=(",", ":")).encode("utf-8")
+    if len(meta_b) > MAX_META_BYTES:
+        raise FrameError("frame metadata section exceeds "
+                         f"{MAX_META_BYTES} bytes")
+    table = bytearray()
+    offset = 0
+    for arr in arrs:
+        name = arr.dtype.name
+        code = _CODE_BY_NAME.get(name)
+        if code is None:
+            raise FrameError(f"dtype {name!r} has no frame encoding")
+        if arr.ndim > MAX_NDIM:
+            raise FrameError(f"ndim {arr.ndim} exceeds the frame cap "
+                             f"{MAX_NDIM}")
+        table += _ENTRY.pack(code, arr.ndim, 0, offset, arr.nbytes)
+        for dim in arr.shape:
+            table += _DIM.pack(dim)
+        offset += arr.nbytes
+    header = _HEADER.pack(MAGIC, VERSION, 0, len(arrs), len(meta_b), offset)
+    buf = b"".join([header, bytes(table), meta_b]
+                   + [arr.tobytes() for arr in arrs])
+    _record("encode", path, len(buf), time.perf_counter() - t0)
+    return buf
+
+
+def decode_frame(buf: bytes, *, meta_only: bool = False,
+                 path: str = "rest") -> Tuple[Dict[str, Any],
+                                              List[np.ndarray]]:
+    """Parse one frame back into (metadata, tensors).
+
+    ``meta_only=True`` validates the header/table/metadata but skips
+    tensor materialization and payload bounds — a frame whose payload was
+    truncated or corrupted in flight still yields its metadata (the
+    network handoff recovers the job id this way).
+
+    Tensors are zero-copy views over ``buf`` (``np.frombuffer``); callers
+    that outlive the buffer get their own copy implicitly when they move
+    the array onto a device.
+    """
+    t0 = time.perf_counter()
+    buf = bytes(buf) if isinstance(buf, (bytearray, memoryview)) else buf
+    if not isinstance(buf, bytes):
+        raise FrameError(f"frame must be bytes, got {type(buf).__name__}")
+    if len(buf) < _HEADER.size:
+        raise FrameError(f"truncated frame header: {len(buf)} bytes "
+                         f"< {_HEADER.size}")
+    magic, version, _flags, n_tensors, meta_len, payload_len = \
+        _HEADER.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise FrameError(f"bad frame magic {magic!r}")
+    if version != VERSION:
+        raise FrameError(f"frame version {version} not supported "
+                         f"(this build speaks {VERSION})")
+    if n_tensors > MAX_TENSORS:
+        raise FrameError(f"declared tensor count {n_tensors} exceeds the "
+                         f"frame cap {MAX_TENSORS}")
+    if meta_len > MAX_META_BYTES:
+        raise FrameError(f"declared metadata length {meta_len} exceeds "
+                         f"{MAX_META_BYTES} bytes")
+    pos = _HEADER.size
+    entries = []
+    for i in range(n_tensors):
+        if pos + _ENTRY.size > len(buf):
+            raise FrameError(f"truncated tensor table at entry {i}")
+        code, ndim, _res, offset, nbytes = _ENTRY.unpack_from(buf, pos)
+        pos += _ENTRY.size
+        if code >= len(_DTYPE_NAMES):
+            raise FrameError(f"unknown dtype code {code} in entry {i}")
+        if ndim > MAX_NDIM:
+            raise FrameError(f"ndim {ndim} exceeds the frame cap "
+                             f"{MAX_NDIM} in entry {i}")
+        if pos + ndim * _DIM.size > len(buf):
+            raise FrameError(f"truncated shape dims in entry {i}")
+        shape = tuple(_DIM.unpack_from(buf, pos + k * _DIM.size)[0]
+                      for k in range(ndim))
+        pos += ndim * _DIM.size
+        entries.append((code, shape, offset, nbytes))
+    if pos + meta_len > len(buf):
+        raise FrameError("truncated metadata section: declared "
+                         f"{meta_len} bytes, {len(buf) - pos} remain")
+    try:
+        meta = json.loads(buf[pos:pos + meta_len].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise FrameError(f"frame metadata is not valid JSON: {e}")
+    if not isinstance(meta, dict):
+        raise FrameError("frame metadata must be a JSON object, got "
+                         f"{type(meta).__name__}")
+    if meta_only:
+        return meta, []
+    payload_start = pos + meta_len
+    actual_payload = len(buf) - payload_start
+    if payload_len != actual_payload:
+        raise FrameError(f"payload length mismatch: header declares "
+                         f"{payload_len} bytes, frame carries "
+                         f"{actual_payload}")
+    tensors: List[np.ndarray] = []
+    for i, (code, shape, offset, nbytes) in enumerate(entries):
+        # bounds BEFORE any materialization: a lying offset/nbytes must
+        # never read past the buffer or allocate
+        if offset + nbytes > payload_len:
+            raise FrameError(f"tensor {i} spans [{offset}, "
+                             f"{offset + nbytes}) past the "
+                             f"{payload_len}-byte payload")
+        dtype = _np_dtype(_DTYPE_NAMES[code])
+        count = 1
+        for dim in shape:
+            count *= dim
+        if count * dtype.itemsize != nbytes:
+            raise FrameError(f"tensor {i} dtype/shape mismatch: shape "
+                             f"{shape} x {dtype.name} needs "
+                             f"{count * dtype.itemsize} bytes, entry "
+                             f"declares {nbytes}")
+        arr = np.frombuffer(buf, dtype=dtype, count=count,
+                            offset=payload_start + offset).reshape(shape)
+        tensors.append(arr)
+    _record("decode", path, len(buf), time.perf_counter() - t0)
+    return meta, tensors
+
+
+# ---------------------------------------------------------------------------
+# SeldonMessage codec: the REST/gRPC hop payload
+# ---------------------------------------------------------------------------
+
+def frameable(msg: Any) -> bool:
+    """True when framing ``msg`` actually avoids a JSON tensor round-trip:
+    a data message with a live array, or a binData message (whose JSON
+    form pays base64). Everything else rides JSON unchanged."""
+    if not isinstance(msg, SeldonMessage):
+        return False
+    if msg.which == "data" and msg.data is not None \
+            and msg.data.array is not None:
+        return getattr(msg.data.array, "dtype", np.dtype(object)) != object \
+            and np.asarray(msg.data.array).dtype.name in _CODE_BY_NAME
+    return msg.which == "binData"
+
+
+def encode_message(msg: SeldonMessage, path: str = "rest") -> bytes:
+    """One SeldonMessage as a frame. The message's JSON shape minus the
+    tensor values rides the metadata section; the array (or binData
+    bytes) rides raw."""
+    meta: Dict[str, Any] = {"kind": "SeldonMessage", "which": msg.which}
+    if msg.status is not None:
+        meta["status"] = msg.status.to_dict()
+    meta["meta"] = msg.meta.to_dict()
+    tensors: List[Any] = []
+    if msg.which == "data" and msg.data is not None \
+            and msg.data.array is not None:
+        meta["data"] = {"names": list(msg.data.names),
+                        "encoding": msg.data.encoding, "tensorRef": 0}
+        tensors = [msg.data.array]
+    elif msg.which == "binData":
+        meta["binDataRef"] = 0
+        tensors = [np.frombuffer(msg.bin_data or b"", dtype=np.uint8)]
+    elif msg.which == "data" and msg.data is not None:
+        # object ndarray (raw nested lists): no raw-buffer form — the
+        # JSON dict rides the metadata section whole
+        meta["data"] = msg.data.to_dict()
+    elif msg.which == "strData":
+        meta["strData"] = msg.str_data
+    elif msg.which == "jsonData":
+        meta["jsonData"] = msg.json_data
+    return encode_frame(meta, tensors, path=path)
+
+
+def decode_message(buf: bytes, path: str = "rest") -> SeldonMessage:
+    meta, tensors = decode_frame(buf, path=path)
+    if meta.get("kind") != "SeldonMessage":
+        raise FrameError("frame does not carry a SeldonMessage "
+                         f"(kind={meta.get('kind')!r})")
+    msg = SeldonMessage(
+        status=Status.from_dict(meta["status"]) if "status" in meta
+        else None,
+        meta=Meta.from_dict(meta.get("meta")),
+    )
+    which = meta.get("which", "")
+    d = meta.get("data")
+    if isinstance(d, dict) and "tensorRef" in d:
+        ref = d["tensorRef"]
+        if not isinstance(ref, int) or not 0 <= ref < len(tensors):
+            raise FrameError(f"tensorRef {ref!r} out of range for "
+                             f"{len(tensors)} tensors")
+        msg.data = DefaultData(names=list(d.get("names", []) or []),
+                               array=tensors[ref],
+                               encoding=d.get("encoding", "tensor"))
+        msg.which = "data"
+    elif isinstance(d, dict):
+        msg.data = DefaultData.from_dict(d)
+        msg.which = "data"
+    elif "binDataRef" in meta:
+        ref = meta["binDataRef"]
+        if not isinstance(ref, int) or not 0 <= ref < len(tensors):
+            raise FrameError(f"binDataRef {ref!r} out of range for "
+                             f"{len(tensors)} tensors")
+        msg.bin_data = tensors[ref].tobytes()
+        msg.which = "binData"
+    elif "strData" in meta:
+        msg.str_data = meta["strData"]
+        msg.which = "strData"
+    elif "jsonData" in meta:
+        msg.json_data = meta["jsonData"]
+        msg.which = "jsonData"
+    if which and msg.which and which != msg.which:
+        raise FrameError(f"frame declares which={which!r} but carries "
+                         f"{msg.which!r}")
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# gRPC mirror: a frame rides the proto binData arm (raw bytes on the wire —
+# proto binData never base64s), tagged via meta so the server can tell a
+# frame envelope from user binData
+# ---------------------------------------------------------------------------
+
+FRAME_TAG = "content-type"
+
+
+def grpc_wrap(msg: SeldonMessage) -> SeldonMessage:
+    """Envelope a message as frame-bytes-in-binData for a gRPC hop."""
+    return SeldonMessage.from_bytes(
+        encode_message(msg, path="grpc"),
+        meta=Meta(tags={FRAME_TAG: CONTENT_TYPE_FRAME}))
+
+
+def grpc_is_framed(msg: Any) -> bool:
+    return (isinstance(msg, SeldonMessage) and msg.which == "binData"
+            and msg.meta.tags.get(FRAME_TAG) == CONTENT_TYPE_FRAME)
+
+
+def grpc_unwrap(msg: SeldonMessage) -> SeldonMessage:
+    return decode_message(msg.bin_data or b"", path="grpc")
+
+
+# ---------------------------------------------------------------------------
+# pytree skeleton: JSON-able structure encoding for the KV-handoff frames
+# (runtime/disagg.py NetworkHandoff). Treedefs are not JSON-serializable
+# and unpickling from a socket is not acceptable in a frame decoder, so
+# the standard containers are encoded explicitly.
+# ---------------------------------------------------------------------------
+
+def tree_skeleton(tree: Any) -> Tuple[Dict[str, Any], List[Any]]:
+    """(JSON-able skeleton, leaves) for a pytree of dict/list/tuple
+    containers. Leaves are replaced by their index into the leaf list."""
+    leaves: List[Any] = []
+
+    def enc(x: Any) -> Dict[str, Any]:
+        if isinstance(x, tuple):
+            return {"T": "tuple", "items": [enc(i) for i in x]}
+        if isinstance(x, list):
+            return {"T": "list", "items": [enc(i) for i in x]}
+        if isinstance(x, dict):
+            keys = list(x.keys())
+            if not all(isinstance(k, str) for k in keys):
+                raise FrameError("tree skeleton requires string dict keys")
+            return {"T": "dict", "keys": keys,
+                    "items": [enc(x[k]) for k in keys]}
+        leaves.append(x)
+        return {"T": "leaf", "i": len(leaves) - 1}
+
+    return enc(tree), leaves
+
+
+def tree_unskeleton(skel: Any, leaves: Sequence[Any]) -> Any:
+    """Rebuild the pytree from ``tree_skeleton`` output. Malformed
+    skeletons raise FrameError (the network handoff treats that like any
+    other corrupt frame)."""
+
+    def dec(s: Any) -> Any:
+        if not isinstance(s, dict) or "T" not in s:
+            raise FrameError("malformed tree skeleton node")
+        t = s["T"]
+        if t == "leaf":
+            i = s.get("i")
+            if not isinstance(i, int) or not 0 <= i < len(leaves):
+                raise FrameError(f"tree skeleton leaf {i!r} out of range")
+            return leaves[i]
+        if t == "tuple":
+            return tuple(dec(i) for i in s.get("items", []))
+        if t == "list":
+            return [dec(i) for i in s.get("items", [])]
+        if t == "dict":
+            keys = s.get("keys", [])
+            items = s.get("items", [])
+            if len(keys) != len(items):
+                raise FrameError("tree skeleton dict keys/items mismatch")
+            return {k: dec(v) for k, v in zip(keys, items)}
+        raise FrameError(f"unknown tree skeleton node type {t!r}")
+
+    return dec(skel)

@@ -279,6 +279,39 @@ class MetricsRegistry:
             base,
             registry=self.registry,
         )
+        self._handoff_network_bytes = Counter(
+            "seldon_llm_handoff_network_bytes_total",
+            "KV handoff frame bytes received over the network transport "
+            "(handoff_transport='network'; 0 on the device_put fast path)",
+            base,
+            registry=self.registry,
+        )
+        # Wire framing (codec/framing.py): encode/decode walls and bytes
+        # moved per egress path (rest / grpc / handoff) — the serialization
+        # share of end-to-end latency the frame format exists to shrink
+        # (docs/performance.md "Wire framing")
+        self._frame_encode = Histogram(
+            "seldon_frame_encode_seconds",
+            "Frame encode wall (metadata pack + single bulk device->host "
+            "transfer + buffer concat)",
+            base,
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self._frame_decode = Histogram(
+            "seldon_frame_decode_seconds",
+            "Frame decode wall (header/table validation + zero-copy "
+            "ndarray views)",
+            base,
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self._frame_bytes = Counter(
+            "seldon_frame_bytes_total",
+            "Frame bytes encoded+decoded, by egress path",
+            base + ["path"],
+            registry=self.registry,
+        )
         # Pipelined decode (runtime/batcher.py): the per-step wall above
         # splits into dispatch (enqueue the compiled step, no sync) vs sync
         # (host blocked on the oldest in-flight step's tokens); the gauge +
@@ -821,6 +854,10 @@ class MetricsRegistry:
         self._handoff_queue_depth.labels(**self._base()).set(
             stats.get("handoff_queue_depth", 0)
         )
+        # wire bytes received by the network KV transport (the receiver's
+        # lifetime tally — same catch-up idiom as handoffs_total)
+        self._counter_catch_up(self._handoff_network_bytes,
+                               stats.get("handoff_network_bytes_total", 0))
         disp = self._decode_dispatch.labels(**self._base())
         for seconds in stats.get("decode_dispatch_times_s", ()):
             disp.observe(seconds)
@@ -896,6 +933,24 @@ class MetricsRegistry:
                                          0))
         self._fleet_journal_depth.labels(**self._base()).set(
             stats.get("fleet_resume_journal_depth", 0))
+
+    def sync_framing(self) -> None:
+        """Drain the frame codec's module-level tallies (codec/framing.py
+        ``frame_stats``) into the frame histograms and per-path byte
+        counter. Process-wide, not per-component — every egress path
+        (remote-hop REST, gRPC binData, KV handoff) funnels through the
+        one codec, so both /metrics handlers call this once per scrape."""
+        from seldon_core_tpu.codec.framing import frame_stats
+
+        stats = frame_stats()
+        enc = self._frame_encode.labels(**self._base())
+        for seconds in stats.get("frame_encode_times_s", ()):
+            enc.observe(seconds)
+        dec = self._frame_decode.labels(**self._base())
+        for seconds in stats.get("frame_decode_times_s", ()):
+            dec.observe(seconds)
+        for path, nbytes in stats.get("frame_bytes_total", {}).items():
+            self._counter_catch_up(self._frame_bytes, nbytes, path=path)
 
     # ------------------------------------------------------------------
     def register_custom(self, response: SeldonMessage) -> None:

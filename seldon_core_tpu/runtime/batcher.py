@@ -722,6 +722,7 @@ class ContinuousBatcher:
         disaggregation: Optional[str] = None,
         disagg_mesh: Optional[Any] = None,
         prefill_workers: Optional[int] = None,
+        handoff_transport: Optional[str] = None,
         tracing: Optional[bool] = None,
     ):
         server.load()
@@ -900,8 +901,22 @@ class ContinuousBatcher:
         disagg = disaggregation if disaggregation is not None else getattr(
             server, "disaggregation", "off")
         self.disaggregation = normalize_disaggregation(disagg)
+        # How finished prefills reach the decode slice: "device" keeps the
+        # jax.device_put fast path; "network" frames the KV bucket and
+        # streams it through a HandoffReceiver (cross-host decode —
+        # bit-exact vs device, tests/test_network_handoff.py).
+        from seldon_core_tpu.runtime.disagg import HANDOFF_TRANSPORTS
+
+        ht = handoff_transport if handoff_transport is not None else getattr(
+            server, "handoff_transport", "") or "device"
+        if ht not in HANDOFF_TRANSPORTS:
+            raise ValueError(
+                f"unknown handoff_transport {ht!r}: expected one of "
+                f"{HANDOFF_TRANSPORTS}")
+        self.handoff_transport = ht
         self._remote = None
         self._transfer = None
+        self._receiver = None
         self._remote_jobs: "dict[int, _RemoteJob]" = {}
         self._job_seq = 0
         # Flight recorder (module docstring, runtime/flight.py): built only
@@ -1042,7 +1057,9 @@ class ContinuousBatcher:
         import jax
 
         from seldon_core_tpu.parallel.mesh import disaggregated_mesh
-        from seldon_core_tpu.runtime.disagg import PrefillWorkerPool
+        from seldon_core_tpu.runtime.disagg import (HandoffReceiver,
+                                                    PrefillWorkerPool,
+                                                    TransferQueue)
 
         server = self.server
         mesh = disagg_mesh or getattr(server, "disagg_mesh", None)
@@ -1063,13 +1080,23 @@ class ContinuousBatcher:
                          mesh.prefill_devices)
         devices = [mesh.prefill_devices[i % len(mesh.prefill_devices)]
                    for i in range(int(n_workers))]
+        # the queue is built here (not inside the pool) so the network
+        # receiver and the worker pool share it from birth — rebalance
+        # swaps pools around BOTH
+        queue = TransferQueue()
+        receiver_addr = None
+        if self.handoff_transport == "network":
+            self._receiver = HandoffReceiver(queue, default)
+            receiver_addr = self._receiver.addr
         self._remote = PrefillWorkerPool(
             server, devices, default,
             layout="paged" if self.paged else "dense",
             max_len=self.max_len,
             page_size=self.page_size if self.paged else 0,
             n_pages=self.n_pages if self.paged else 0,
-            prefill_chunk=self.prefill_chunk if self.paged else 0)
+            prefill_chunk=self.prefill_chunk if self.paged else 0,
+            queue=queue, transport=self.handoff_transport,
+            receiver_addr=receiver_addr)
         self._transfer = self._remote.queue
 
     def rebalance_disagg(self, prefill_devices: int) -> bool:
@@ -1115,7 +1142,8 @@ class ContinuousBatcher:
             page_size=self.page_size if self.paged else 0,
             n_pages=self.n_pages if self.paged else 0,
             prefill_chunk=self.prefill_chunk if self.paged else 0,
-            queue=self._transfer)
+            queue=self._transfer, transport=old.transport,
+            receiver_addr=old.receiver_addr)
         self.disagg_mesh = mesh
         # swap first (new admissions land on the new pool), then drain the
         # old pool: an admission that grabbed the old reference mid-swap
@@ -1194,8 +1222,11 @@ class ContinuousBatcher:
         if self._remote is None:
             return {"disaggregation": "off", "handoffs_total": 0,
                     "handoff_transfer_bytes_total": 0,
-                    "handoff_queue_depth": 0}
+                    "handoff_queue_depth": 0,
+                    "handoff_network_bytes_total": 0}
         total, nbytes, depth = self._transfer.stats()
+        net = (self._receiver.stats()["handoff_network_bytes_total"]
+               if self._receiver is not None else 0)
         return {
             "disaggregation": self.disaggregation,
             "handoffs_total": total,
@@ -1204,6 +1235,10 @@ class ContinuousBatcher:
             # waits in a worker backlog, runs, and sits ready — exactly
             # the prefill-side congestion a replica router cares about)
             "handoff_queue_depth": depth,
+            # wire payload bytes received by the network transport (0 on
+            # the device fast path — the split tells an operator which
+            # transport is actually carrying the KV)
+            "handoff_network_bytes_total": net,
         }
 
     # ------------------------------------------------------------------
@@ -1380,8 +1415,12 @@ class ContinuousBatcher:
         if self._task is not None:
             await self._task
         if self._remote is not None:
-            # bounded worker joins (runtime/disagg.py close uses timeouts)
+            # bounded worker joins (runtime/disagg.py close uses timeouts);
+            # workers first — their last frames must land before the
+            # receiver's listener goes away
             await asyncio.to_thread(self._remote.close)
+        if self._receiver is not None:
+            await asyncio.to_thread(self._receiver.close)
 
     # ------------------------------------------------------------------
     def _truncate_prompt(self, ids: List[int], max_new: int,

@@ -49,6 +49,18 @@ logger = logging.getLogger(__name__)
 
 DISAGGREGATION_MODES = ("off", "remote_prefill")
 
+# How a finished prefill's KV reaches the decode slice: "device" is the
+# direct jax.device_put fast path (shared topology); "network" frames the
+# page bucket header+raw and streams it over a socket to the decode host's
+# HandoffReceiver (no shared topology — ROADMAP multi-host decode).
+HANDOFF_TRANSPORTS = ("device", "network")
+
+# Outer wire format of one network handoff: an 8-byte little-endian length
+# prefix, then that many frame bytes (codec/framing.py layout). The length
+# is bounded before ANY allocation — a corrupt prefix must not let the
+# receiver allocate attacker-controlled gigabytes.
+MAX_HANDOFF_FRAME_BYTES = 1 << 33  # 8 GiB: > any pow2 bucket we ship
+
 # TransferQueue record states (values are only compared for identity)
 _STAGED = "staged"        # registered; the worker has not finished yet
 _READY = "ready"          # handoff published, waiting for the batcher
@@ -176,12 +188,15 @@ class TransferQueue:
 
     def put(self, handoff: Handoff) -> bool:
         """Publish a finished prefill. False = the job was cancelled while
-        the worker ran; the payload is dropped (nothing to free here —
-        the canceller already freed the decode-side pages)."""
+        the worker ran (payload dropped — the canceller already freed the
+        decode-side pages), OR the job is unknown / already READY. Only a
+        STAGED job can become READY: with the network transport a frame
+        replayed over a reconnected socket must not double-deliver."""
         with self._lock:
             st = self._state.get(handoff.job_id)
-            if st is _CANCELLED:
-                del self._state[handoff.job_id]
+            if st is not _STAGED:
+                if st is _CANCELLED:
+                    del self._state[handoff.job_id]
                 return False
             self._state[handoff.job_id] = _READY
             self._ready.append(handoff)
@@ -264,7 +279,16 @@ class PrefillWorker:
     def __init__(self, server: Any, queue: TransferQueue, device: Any,
                  decode_device: Any, *, layout: str, max_len: int,
                  page_size: int = 0, n_pages: int = 0,
-                 prefill_chunk: int = 0, name: str = "prefill-worker"):
+                 prefill_chunk: int = 0, name: str = "prefill-worker",
+                 transport: str = "device",
+                 receiver_addr: Optional[tuple] = None):
+        if transport not in HANDOFF_TRANSPORTS:
+            raise ValueError(
+                f"unknown handoff transport {transport!r}: expected one of "
+                f"{HANDOFF_TRANSPORTS}")
+        if transport == "network" and receiver_addr is None:
+            raise ValueError("network handoff transport needs the decode "
+                             "side's HandoffReceiver address")
         self.server = server
         self.queue = queue
         self.device = device
@@ -275,6 +299,9 @@ class PrefillWorker:
         self.n_pages = int(n_pages)
         self.prefill_chunk = int(prefill_chunk)
         self.name = name
+        self.transport = transport
+        self.receiver_addr = receiver_addr
+        self._sock = None  # persistent frame socket, worker thread only
         self._cond = threading.Condition()
         self._backlog: deque = deque()
         self._closing = False
@@ -302,6 +329,12 @@ class PrefillWorker:
             self._cond.notify_all()
         # bounded: a wedged device dispatch must not hang server shutdown
         self._thread.join(timeout=timeout_s)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     # -- worker side ---------------------------------------------------
     def _next_job(self) -> Optional[PrefillRequest]:
@@ -323,7 +356,7 @@ class PrefillWorker:
                 logger.exception("prefill worker %s failed job %d",
                                  self.name, req.job_id)
                 handoff = Handoff(req.job_id, error=e)
-            self.queue.put(handoff)
+            self._publish(handoff)
 
     def _ensure_state(self):
         import jax
@@ -351,6 +384,22 @@ class PrefillWorker:
         import jax
 
         t1 = time.perf_counter()
+        from seldon_core_tpu.runtime.flight import (
+            EV_HANDOFF_COMPUTE, EV_HANDOFF_TRANSFER)
+
+        if self.transport == "network":
+            # cross-host: no shared topology for a device-to-device put.
+            # The KV stays on the prefill device here; ``_frame_handoff``
+            # pulls it to host in ONE bulk transfer and ships it as a
+            # frame. The transfer event is stamped by the RECEIVER (it
+            # owns the wire-bytes count and the decode-side import time).
+            events = []
+            if req.record_events:
+                events = [(t1, EV_HANDOFF_COMPUTE,
+                           {"worker": self.name, "dur_s": t1 - t0})]
+            return Handoff(req.job_id, staged=staged,
+                           first_logits=first_logits, prefill_s=t1 - t0,
+                           events=events)
         # THE handoff: a direct device-to-device copy onto the decode
         # slice — the KV never rounds through host memory (the jitted
         # decode-side import is hlolint-checked for zero infeed/outfeed)
@@ -360,9 +409,6 @@ class PrefillWorker:
         t2 = time.perf_counter()
         events = []
         if req.record_events:
-            from seldon_core_tpu.runtime.flight import (
-                EV_HANDOFF_COMPUTE, EV_HANDOFF_TRANSFER)
-
             events = [
                 (t1, EV_HANDOFF_COMPUTE,
                  {"worker": self.name, "dur_s": t1 - t0}),
@@ -372,6 +418,86 @@ class PrefillWorker:
         return Handoff(req.job_id, staged=moved, first_logits=first_logits,
                        prefill_s=t2 - t0,
                        transfer_bytes=nbytes, events=events)
+
+    # -- network transport (worker side) -------------------------------
+    def _publish(self, handoff: Handoff) -> None:
+        """Deliver a finished handoff. Device transport (and every error
+        handoff) goes straight into the TransferQueue; network transport
+        frames the staged KV and streams it to the decode host's
+        ``HandoffReceiver``, which runs the SAME ``queue.put`` there — so
+        the exactly-once staged/cancel protocol is identical on both
+        transports."""
+        if self.transport != "network" or handoff.error is not None:
+            self.queue.put(handoff)
+            return
+        try:
+            import jax
+
+            # the worker thread pays this wait either way (the encoder's
+            # bulk device_get blocks on the async prefill values); taking
+            # it BEFORE the codec keeps seldon_frame_encode_seconds a
+            # serialization number instead of a compute-tail number; the
+            # decode side never waits here — this is the worker's thread
+            jax.block_until_ready(handoff.staged)
+            payload = self._frame_handoff(handoff)
+            self._send_frame(payload)
+        except BaseException as e:  # noqa: BLE001 — worker must not die
+            logger.exception("prefill worker %s could not ship job %d over "
+                             "the network handoff", self.name,
+                             handoff.job_id)
+            self.queue.put(Handoff(handoff.job_id, error=e))
+
+    def _frame_handoff(self, handoff: Handoff) -> bytes:
+        """Serialize one handoff as a frame: tree skeleton + job metadata
+        in the JSON section, KV pages and first-token logits as raw
+        tensor buffers. ``encode_frame`` pulls every device leaf to host
+        in one bulk ``jax.device_get`` — the framing contract graftlint
+        enforces on this path."""
+        from seldon_core_tpu.codec import framing
+
+        skel, leaves = framing.tree_skeleton(handoff.staged)
+        tensors = list(leaves)
+        fl_ref = None
+        if handoff.first_logits is not None:
+            fl_ref = len(tensors)
+            tensors.append(handoff.first_logits)
+        meta = {
+            "kind": "KVHandoff",
+            "job_id": handoff.job_id,
+            "prefill_s": handoff.prefill_s,
+            "skeleton": skel,
+            "first_logits_ref": fl_ref,
+            "record_events": bool(handoff.events),
+            "events": [[t, kind, fields]
+                       for (t, kind, fields) in handoff.events],
+        }
+        return framing.encode_frame(meta, tensors, path="handoff")
+
+    def _send_frame(self, payload: bytes) -> None:
+        """Ship one length-prefixed frame over the persistent socket,
+        reconnecting once on a broken pipe (the receiver tolerates
+        reconnects; the TransferQueue refuses replayed job_ids)."""
+        import socket
+        import struct
+
+        wire = struct.pack("<Q", len(payload)) + payload
+        for attempt in (0, 1):
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        self.receiver_addr, timeout=30.0)
+                    self._sock.setsockopt(socket.IPPROTO_TCP,
+                                          socket.TCP_NODELAY, 1)
+                self._sock.sendall(wire)
+                return
+            except OSError:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    finally:
+                        self._sock = None
+                if attempt:
+                    raise
 
     def _prefill_dense(self, req: PrefillRequest):
         """One-shot dense prefill at the request's bucket — the same
@@ -473,6 +599,194 @@ class PrefillWorker:
         return staged, first_logits
 
 
+def _recv_exact(conn, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes from a socket, or None on clean EOF.
+    A mid-message EOF raises — a half-frame must never decode."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            if buf:
+                raise ConnectionError(
+                    f"handoff stream truncated: wanted {n} bytes, "
+                    f"got {len(buf)}")
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class HandoffReceiver:
+    """Decode-host side of the network KV handoff: a TCP listener whose
+    reader threads decode incoming frames, land the KV on the decode
+    device with one ``jax.device_put``, and publish through the SAME
+    ``TransferQueue.put`` the device transport uses — cancel/shed and
+    exactly-once semantics are transport-independent by construction.
+
+    A malformed frame never kills the receiver: the frame layout puts
+    the metadata section before the payload, so a corrupt tensor region
+    still yields the ``job_id`` (``decode_frame(meta_only=True)``) and
+    the job is resolved with an error handoff — one request fails, the
+    batch survives (the chaos-harness poison contract). A frame whose
+    metadata is unreadable is logged and dropped; the outer length
+    prefix is bounds-checked before ANY allocation."""
+
+    def __init__(self, queue: TransferQueue, device: Any,
+                 host: str = "127.0.0.1"):
+        import socket
+
+        self.queue = queue
+        self.device = device
+        self._lock = threading.Lock()
+        self.network_bytes_total = 0  # wire payload bytes, under _lock
+        self._closing = False
+        self._conns: List[Any] = []
+        self._threads: List[threading.Thread] = []
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.bind((host, 0))
+        self._listener.listen(16)
+        self.addr = self._listener.getsockname()
+        t = threading.Thread(target=self._accept_loop,
+                             name="handoff-receiver", daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed by close()
+            with self._lock:
+                if self._closing:
+                    conn.close()
+                    return
+                self._conns.append(conn)
+                t = threading.Thread(target=self._read_loop, args=(conn,),
+                                     name="handoff-reader", daemon=True)
+                self._threads.append(t)
+            t.start()
+
+    def _read_loop(self, conn) -> None:
+        import struct
+
+        try:
+            while True:
+                head = _recv_exact(conn, 8)
+                if head is None:
+                    return
+                (n,) = struct.unpack("<Q", head)
+                if n > MAX_HANDOFF_FRAME_BYTES:
+                    logger.error(
+                        "handoff frame declares %d bytes (cap %d); "
+                        "dropping connection", n, MAX_HANDOFF_FRAME_BYTES)
+                    return
+                payload = _recv_exact(conn, n)
+                if payload is None:
+                    return
+                handoff = self._materialize(payload)
+                if handoff is not None:
+                    self.queue.put(handoff)
+        except (OSError, ConnectionError) as e:
+            with self._lock:
+                closing = self._closing
+            if not closing:
+                logger.warning("handoff connection dropped: %s", e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _materialize(self, payload: bytes) -> Optional[Handoff]:
+        """One received frame -> one Handoff with the KV resident on the
+        decode device. Decode failures become error handoffs when the
+        metadata (and so the job_id) survives, else None (drop)."""
+        import time
+
+        import jax
+
+        from seldon_core_tpu.codec import framing
+        from seldon_core_tpu.runtime.flight import EV_HANDOFF_TRANSFER
+
+        t0 = time.perf_counter()
+        try:
+            meta, tensors = framing.decode_frame(payload, path="handoff")
+            if meta.get("kind") != "KVHandoff":
+                raise framing.FrameError(
+                    f"expected a KVHandoff frame, got {meta.get('kind')!r}")
+            skel = meta["skeleton"]
+            fl_ref = meta.get("first_logits_ref")
+            first_logits = None
+            if fl_ref is not None:
+                # .copy() releases the frame buffer once the tree's leaves
+                # are device-resident — the [vocab] logits are the only
+                # host-side survivor of the payload
+                first_logits = tensors[fl_ref].copy()
+            staged = framing.tree_unskeleton(skel, tensors)
+            staged = jax.device_put(staged, self.device)
+            t1 = time.perf_counter()
+            events = [(e[0], e[1], e[2]) for e in meta.get("events", ())]
+            if meta.get("record_events"):
+                events.append((t1, EV_HANDOFF_TRANSFER,
+                               {"bytes": len(payload), "dur_s": t1 - t0}))
+            with self._lock:
+                self.network_bytes_total += len(payload)
+            return Handoff(meta["job_id"], staged=staged,
+                           first_logits=first_logits,
+                           prefill_s=meta.get("prefill_s", 0.0),
+                           transfer_bytes=len(payload), events=events)
+        except Exception as e:  # noqa: BLE001 — receiver must not die
+            job_id = None
+            try:
+                meta, _ = framing.decode_frame(payload, meta_only=True,
+                                               path="handoff")
+                job_id = meta.get("job_id")
+            except Exception:  # noqa: BLE001
+                pass
+            if job_id is None:
+                logger.exception("dropping undecodable handoff frame "
+                                 "(no recoverable job_id)")
+                return None
+            logger.exception("handoff frame for job %s failed to decode; "
+                             "resolving with error", job_id)
+            return Handoff(job_id, error=e)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"handoff_network_bytes_total": self.network_bytes_total}
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        import socket
+
+        with self._lock:
+            self._closing = True
+            conns = list(self._conns)
+        for c in conns:
+            # close() from another thread does not interrupt a blocked
+            # recv(); shutdown() does — the reader sees EOF and exits
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        # likewise a blocked accept() survives listener.close(); a
+        # zero-byte self-connect wakes it so it can observe _closing
+        try:
+            with socket.create_connection(self.addr, timeout=1.0):
+                pass
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=timeout_s)
+
+
 class PrefillWorkerPool:
     """M prefill workers behind least-backlog dispatch, publishing into
     one shared TransferQueue. One worker per prefill-slice device is the
@@ -482,19 +796,24 @@ class PrefillWorkerPool:
     def __init__(self, server: Any, devices: Sequence, decode_device: Any,
                  *, layout: str, max_len: int, page_size: int = 0,
                  n_pages: int = 0, prefill_chunk: int = 0,
-                 queue: Optional[TransferQueue] = None):
+                 queue: Optional[TransferQueue] = None,
+                 transport: str = "device",
+                 receiver_addr: Optional[tuple] = None):
         # ``queue``: adopt an EXISTING TransferQueue instead of creating
         # one — the disagg-rebalance actuator builds the replacement pool
         # on the batcher's live queue so jobs staged on the outgoing pool
         # keep their exactly-once delivery path (runtime/batcher.py
         # ``rebalance_disagg``).
         self.queue = queue if queue is not None else TransferQueue()
+        self.transport = transport
+        self.receiver_addr = receiver_addr
         self.workers = [
             PrefillWorker(server, self.queue, dev, decode_device,
                           layout=layout, max_len=max_len,
                           page_size=page_size, n_pages=n_pages,
                           prefill_chunk=prefill_chunk,
-                          name=f"prefill-worker-{i}")
+                          name=f"prefill-worker-{i}",
+                          transport=transport, receiver_addr=receiver_addr)
             for i, dev in enumerate(devices)
         ]
 

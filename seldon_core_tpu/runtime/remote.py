@@ -40,6 +40,16 @@ ANNOTATION_REST_READ_TIMEOUT = "seldon.io/rest-read-timeout"        # ms
 ANNOTATION_REST_CONNECTION_TIMEOUT = "seldon.io/rest-connection-timeout"  # ms
 ANNOTATION_REST_RETRIES = "seldon.io/rest-connect-retries"
 ANNOTATION_GRPC_READ_TIMEOUT = "seldon.io/grpc-read-timeout"        # ms
+# wire format for tensor payloads on this hop (codec/framing.py):
+#   json  — today's proto-JSON, byte-for-byte (the default)
+#   frame — binary frames both ways (requests framed when the message
+#           carries tensor/binData payloads; falls back to JSON once if
+#           the peer rejects frames, then stays on JSON)
+#   auto  — JSON requests + Accept: application/x-seldon-frame, so an
+#           updated peer may frame RESPONSES; safe against old peers
+ANNOTATION_WIRE_FORMAT = "seldon.io/wire-format"
+
+WIRE_FORMATS = ("json", "frame", "auto")
 
 
 def config_from_annotations(annotations: Optional[dict]) -> dict:
@@ -57,11 +67,16 @@ def config_from_annotations(annotations: Optional[dict]) -> dict:
         retries = int(annotations[ANNOTATION_REST_RETRIES])
     except (KeyError, TypeError, ValueError):
         retries = DEFAULT_RETRIES
+    wire_format = str(annotations.get(ANNOTATION_WIRE_FORMAT, "json") or
+                      "json").strip().lower()
+    if wire_format not in WIRE_FORMATS:
+        wire_format = "json"
     return {
         "retries": max(retries, 1),
         "timeout_s": ms(ANNOTATION_REST_READ_TIMEOUT, DEFAULT_TIMEOUT_S),
         "connect_timeout_s": ms(ANNOTATION_REST_CONNECTION_TIMEOUT, DEFAULT_CONNECT_TIMEOUT_S),
         "grpc_timeout_s": ms(ANNOTATION_GRPC_READ_TIMEOUT, DEFAULT_TIMEOUT_S),
+        "wire_format": wire_format,
     }
 
 
@@ -113,6 +128,7 @@ class RemoteComponent(SeldonComponent):
         connect_timeout_s: Optional[float] = DEFAULT_CONNECT_TIMEOUT_S,
         grpc_timeout_s: Optional[float] = None,
         annotations: Optional[dict] = None,
+        wire_format: str = "json",
     ):
         super().__init__()
         self.endpoint = endpoint
@@ -122,10 +138,20 @@ class RemoteComponent(SeldonComponent):
             timeout_s = cfg["timeout_s"]
             connect_timeout_s = cfg["connect_timeout_s"]
             grpc_timeout_s = cfg["grpc_timeout_s"]
+            if cfg["wire_format"] != "json":
+                wire_format = cfg["wire_format"]
+        if wire_format not in WIRE_FORMATS:
+            raise ValueError(f"wire_format {wire_format!r}: expected one of "
+                             f"{WIRE_FORMATS}")
         self.retries = retries
         self.timeout_s = timeout_s
         self.connect_timeout_s = connect_timeout_s
         self.grpc_timeout_s = grpc_timeout_s if grpc_timeout_s is not None else timeout_s
+        self.wire_format = wire_format
+        # latched when a peer rejects a framed request (old server): this
+        # hop downgrades to JSON permanently instead of paying a rejected
+        # round trip per call — the "JSON fallback" half of the contract
+        self._frame_unsupported = False
         self._client = client
         # ClientSessions bind to the event loop they were created on; engines
         # may be driven from several short-lived loops (predict_sync), so keep
@@ -150,8 +176,18 @@ class RemoteComponent(SeldonComponent):
             self._sessions[id(loop)] = session
         return session
 
-    async def _rest_call(self, path: str, payload: dict) -> dict:
+    async def _rest_call(self, path: str, payload: Optional[dict], *,
+                         frame: Optional[bytes] = None,
+                         accept_frame: bool = False):
+        """One REST hop. JSON request/response by default (``payload``);
+        ``frame`` ships a binary frame body instead, and ``accept_frame``
+        advertises that a framed RESPONSE is welcome. Returns the parsed
+        JSON dict, or a decoded SeldonMessage when the peer responded
+        with ``application/x-seldon-frame``."""
         import aiohttp
+
+        from seldon_core_tpu.codec.framing import (
+            CONTENT_TYPE_FRAME, decode_message)
 
         session = self._get_session()
         url = f"http://{self.endpoint.service_host}:{self.endpoint.service_port}{path}"
@@ -159,7 +195,13 @@ class RemoteComponent(SeldonComponent):
         # retry), so the remote node's own spans join this request's trace
         # — the reference's engine->node span chain (PAPER.md §5)
         tp = current_traceparent()
-        headers = {"traceparent": tp} if tp else None
+        headers = {"traceparent": tp} if tp else {}
+        if accept_frame:
+            headers["Accept"] = f"{CONTENT_TYPE_FRAME}, application/json"
+        body_kw: dict = {"json": payload}
+        if frame is not None:
+            headers["Content-Type"] = CONTENT_TYPE_FRAME
+            body_kw = {"data": frame}
         last_err: Optional[Exception] = None
         for attempt in range(self.retries):
             # each attempt (not just the first) is clamped to the remaining
@@ -169,12 +211,21 @@ class RemoteComponent(SeldonComponent):
             try:
                 async with session.post(
                     url,
-                    json=payload,
-                    headers=headers,
+                    headers=headers or None,
                     timeout=aiohttp.ClientTimeout(
                         total=hop_timeout, connect=self.connect_timeout_s
                     ),
+                    **body_kw,
                 ) as resp:
+                    if resp.content_type == CONTENT_TYPE_FRAME:
+                        raw = await resp.read()
+                        if resp.status != 200:
+                            raise SeldonError(
+                                f"Remote node {url} returned {resp.status}",
+                                status_code=resp.status,
+                                reason="REMOTE_NODE_ERROR",
+                            )
+                        return decode_message(raw)
                     body = await resp.text()
                     if resp.status != 200:
                         raise SeldonError(
@@ -211,9 +262,43 @@ class RemoteComponent(SeldonComponent):
         )
 
     async def _call(self, rest_path: str, grpc_method: str, msg: Any) -> SeldonMessage:
+        from seldon_core_tpu.codec.framing import (
+            frameable, grpc_is_framed, grpc_unwrap, grpc_wrap)
+
+        wf = self.wire_format if not self._frame_unsupported else "json"
         if self.endpoint.type == EndpointType.GRPC.value:
+            if wf == "frame" and frameable(msg):
+                # binData passthrough: the frame rides the proto binData
+                # arm raw (proto never base64s bytes), tagged in meta so
+                # the server can tell an envelope from user binData
+                out = await self._grpc_call(grpc_method, grpc_wrap(msg))
+                return grpc_unwrap(out) if grpc_is_framed(out) else out
             return await self._grpc_call(grpc_method, msg)
-        out = await self._rest_call(rest_path, msg.to_dict())
+        if wf == "json":
+            # byte-for-byte the pre-framing hop: same body, same headers
+            out = await self._rest_call(rest_path, msg.to_dict())
+            return SeldonMessage.from_dict(out)
+        frame = None
+        if wf == "frame" and frameable(msg):
+            from seldon_core_tpu.codec.framing import encode_message
+
+            frame = encode_message(msg, path="rest")
+        try:
+            out = await self._rest_call(
+                rest_path, None if frame is not None else msg.to_dict(),
+                frame=frame, accept_frame=True)
+        except SeldonError as e:
+            # an old peer 400/415s a framed request: fall back to JSON for
+            # this call and latch the downgrade for the rest of this hop
+            if frame is None or e.status_code not in (400, 415):
+                raise
+            logger.warning("peer %s rejected a framed request (%s); "
+                           "downgrading this hop to JSON",
+                           self.endpoint.service_host, e.status_code)
+            self._frame_unsupported = True
+            out = await self._rest_call(rest_path, msg.to_dict())
+        if isinstance(out, SeldonMessage):
+            return out
         return SeldonMessage.from_dict(out)
 
     async def close(self) -> None:

@@ -274,6 +274,7 @@ class LLMServer(SeldonComponent):
         prefill_devices: int = 0,
         decode_devices: int = 0,
         prefill_workers: int = 0,
+        handoff_transport: str = "",
         disagg_mesh: Optional[Any] = None,
         draft_model: Optional[str] = None,
         draft_model_kwargs: Optional[Dict[str, Any]] = None,
@@ -394,6 +395,11 @@ class LLMServer(SeldonComponent):
         # prefill workers (one thread+device each; 0 = one per
         # prefill-slice device)
         self.prefill_workers = int(prefill_workers)
+        # "" / "device" = direct jax.device_put KV handoff (shared
+        # topology); "network" = frame the KV bucket and stream it over a
+        # socket to the decode host (runtime/disagg.py HandoffReceiver) —
+        # bit-exact either way, validated at load()
+        self.handoff_transport = handoff_transport
         self.disagg_mesh = disagg_mesh
         # optional draft model: registry name + kwargs (random init on the
         # server's seed) or a jaxserver-style checkpoint dir. Must share
@@ -587,6 +593,16 @@ class LLMServer(SeldonComponent):
                     "disaggregation='remote_prefill' needs >= 2 devices "
                     "(one per slice); this process sees "
                     f"{len(jax.devices())}")
+        if self.handoff_transport not in ("", "device", "network"):
+            raise ValueError(
+                f"unknown handoff_transport {self.handoff_transport!r}: "
+                "expected '', 'device' or 'network'")
+        if self.handoff_transport == "network" \
+                and self.disaggregation == "off":
+            raise ValueError(
+                "handoff_transport='network' only applies to "
+                "disaggregation='remote_prefill' (there is no KV handoff "
+                "without a prefill/decode split)")
 
         cfg_kwargs = dict(self.model_kwargs)
         name = self.model_name
@@ -1962,7 +1978,8 @@ class LLMServer(SeldonComponent):
         handoff_stats = {"disaggregation": self.disaggregation or "off",
                          "handoffs_total": 0,
                          "handoff_transfer_bytes_total": 0,
-                         "handoff_queue_depth": 0}
+                         "handoff_queue_depth": 0,
+                         "handoff_network_bytes_total": 0}
         # radix prefix cache (runtime/radix.py): cached/shared block
         # gauges + the hit/cow/eviction/bytes-saved lifetime counters
         # (metrics/registry.py seldon_llm_prefix_*)

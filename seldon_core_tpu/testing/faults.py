@@ -308,7 +308,15 @@ class HandoffPoisoner:
     slice dense-insert or tree-import, so both layouts raise inside
     ``_consume_handoffs``). Poisons the first ``first_n`` handoffs, then
     passes everything through untouched — one bad handoff amid good ones,
-    the shape the batcher's containment must survive."""
+    the shape the batcher's containment must survive.
+
+    Network transport (``handoff_transport="network"``): the poison moves
+    to the WIRE — ``_frame_handoff``'s framed bytes are truncated inside
+    the tensor region, so the decode host's HandoffReceiver hits the
+    frame codec's bounds check (metadata — and so the job_id — stays
+    parseable, by the frame's meta-before-payload layout) and resolves
+    the job with an error handoff. Same containment contract, proven one
+    layer deeper."""
 
     def __init__(self, batcher: Any, first_n: int = 1,
                  poison: Any = "poisoned-kv-payload"):
@@ -319,6 +327,19 @@ class HandoffPoisoner:
         if getattr(batcher, "_remote", None) is None:
             raise ValueError("HandoffPoisoner needs a disaggregated batcher")
         for worker in batcher._remote.workers:
+            if getattr(worker, "transport", "device") == "network":
+                real_frame = worker._frame_handoff
+
+                def poisoned_frame(h, _real=real_frame):
+                    payload = _real(h)
+                    with self._lock:
+                        if self.poisoned < self.first_n:
+                            self.poisoned += 1
+                            payload = payload[:-16]
+                    return payload
+
+                worker._frame_handoff = poisoned_frame
+                continue
             real = worker._prefill_one
 
             def poisoned_prefill(req, _real=real):

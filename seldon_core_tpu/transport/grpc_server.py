@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, Optional
 
 import grpc
 
+from seldon_core_tpu.codec import framing
 from seldon_core_tpu.components import dispatch
 from seldon_core_tpu.contracts.payload import SeldonError
 from seldon_core_tpu.metrics.registry import MetricsRegistry
@@ -115,6 +116,11 @@ def _component_methods(
     wire_retry_after(admission, component=component)
 
     def wrap(fn, req_from, method_name):
+        # frames ride gRPC as binData payloads tagged in meta — only the
+        # SeldonMessage-parsered methods can carry them (aggregate/feedback
+        # have list/feedback request types and skip the unwrap)
+        frames = req_from is pc.message_from_proto
+
         def handler(request, context):
             tracer = get_tracer()
             try:
@@ -127,9 +133,15 @@ def _component_methods(
                     with tracer.span("grpc:" + method_name,
                                      traceparent=_traceparent_from_context(
                                          context)):
-                        result = fn(component, req_from(request))
+                        inbound = req_from(request)
+                        framed_in = frames and framing.grpc_is_framed(inbound)
+                        if framed_in:
+                            inbound = framing.grpc_unwrap(inbound)
+                        result = fn(component, inbound)
                         if asyncio.iscoroutine(result):
                             result = asyncio.run(result)
+                        if framed_in and framing.frameable(result):
+                            result = framing.grpc_wrap(result)
                 return pc.message_to_proto(result)
             except Exception as e:  # noqa: BLE001
                 _abort(context, e)
@@ -452,9 +464,14 @@ def make_engine_server(
         try:
             deadline = _deadline_from_context(context)
             msg = pc.message_from_proto(request)
+            framed_in = framing.grpc_is_framed(msg)
+            if framed_in:
+                msg = framing.grpc_unwrap(msg)
             out = run_coro(_predict_with_deadline(
                 msg, deadline, _traceparent_from_context(context)))
             metrics.observe_prediction(engine, out, time.perf_counter() - t0)
+            if framed_in and framing.frameable(out):
+                out = framing.grpc_wrap(out)
             return pc.message_to_proto(out)
         except Exception as e:  # noqa: BLE001
             if getattr(e, "status_code", None) == 504:

@@ -27,6 +27,12 @@ from typing import Any, Callable, Optional
 
 from aiohttp import web
 
+from seldon_core_tpu.codec.framing import (
+    CONTENT_TYPE_FRAME,
+    decode_message,
+    encode_message,
+    frameable,
+)
 from seldon_core_tpu.components import dispatch
 from seldon_core_tpu.contracts.payload import (
     Feedback,
@@ -129,6 +135,27 @@ def _json(msg: SeldonMessage) -> web.Response:
     return web.json_response(msg.to_dict())
 
 
+def _wants_frame(request: web.Request) -> bool:
+    return CONTENT_TYPE_FRAME in request.headers.get("Accept", "")
+
+
+def _respond(request: web.Request, msg: SeldonMessage) -> web.Response:
+    """Frame the response only when the client ASKED for frames (Accept)
+    and the payload actually benefits (tensor/binData); everything else —
+    including every error path — stays JSON, so clients that never opted
+    in see byte-identical behavior."""
+    if _wants_frame(request) and frameable(msg):
+        return web.Response(body=encode_message(msg, path="rest"),
+                            content_type=CONTENT_TYPE_FRAME)
+    return _json(msg)
+
+
+async def parse_framed_message(request: web.Request) -> SeldonMessage:
+    """Decode an ``application/x-seldon-frame`` request body. Frames carry
+    SeldonMessage only — aggregate lists and feedback stay JSON."""
+    return decode_message(await request.read(), path="rest")
+
+
 # ---------------------------------------------------------------------------
 # Microservice app: one component
 # ---------------------------------------------------------------------------
@@ -160,7 +187,16 @@ def make_component_app(
                 return shed_response(e)
             try:
                 deadline = deadline_from_headers(request)
-                payload = parser(await parse_request(request))
+                if request.content_type == CONTENT_TYPE_FRAME:
+                    if getattr(parser, "__func__", parser) \
+                            is not SeldonMessage.from_dict.__func__:
+                        raise SeldonError(
+                            f"{method_name} does not accept framed bodies "
+                            "(frames carry SeldonMessage only)",
+                            status_code=415)
+                    payload = await parse_framed_message(request)
+                else:
+                    payload = parser(await parse_request(request))
                 with deadline_scope(deadline):
                     # inbound W3C traceparent roots this request's server
                     # span in the caller's trace (sampled flag honored)
@@ -171,7 +207,7 @@ def make_component_app(
                         if asyncio.iscoroutine(result):
                             result = await result
                 metrics.observe_api_call(method_name, "200", time.perf_counter() - t0)
-                return _json(result)
+                return _respond(request, result)
             except Exception as e:
                 code = str(getattr(e, "status_code", 500))
                 metrics.observe_api_call(method_name, code, time.perf_counter() - t0)
@@ -214,6 +250,7 @@ def make_component_app(
         metrics.sync_resilience(admission=admission, transport="rest")
         metrics.sync_llm(component)
         metrics.sync_controlplane(component)
+        metrics.sync_framing()
         metrics.sync_tracing()
         return web.Response(body=metrics.expose(), content_type="text/plain")
 
@@ -605,8 +642,12 @@ def make_engine_app(
             return shed_response(e)
         try:
             deadline = deadline_from_headers(request)
-            body = await parse_request(request)
-            msg = SeldonMessage.from_dict(body)
+            if request.content_type == CONTENT_TYPE_FRAME:
+                body = None
+                msg = await parse_framed_message(request)
+            else:
+                body = await parse_request(request)
+                msg = SeldonMessage.from_dict(body)
             with deadline_scope(deadline):
                 with tracer.span("predictions",
                                  traceparent=request.headers.get(
@@ -617,8 +658,11 @@ def make_engine_app(
                     metrics.observe_remaining_budget(d.remaining_s())
             metrics.observe_prediction(engine, out, time.perf_counter() - t0)
             if log_requests or log_responses or logger_url:
-                _spawn_log(body, out.to_dict())
-            return _json(out)
+                # framed requests have no JSON body; the logger pair pays
+                # the to_dict() tax only when logging is actually on
+                _spawn_log(body if body is not None else msg.to_dict(),
+                           out.to_dict())
+            return _respond(request, out)
         except Exception as e:
             code = getattr(e, "status_code", 500)
             if code == 504:
@@ -668,6 +712,7 @@ def make_engine_app(
         for comp in getattr(engine, "_components", {}).values():
             metrics.sync_llm(comp)
         metrics.sync_controlplane(engine)
+        metrics.sync_framing()
         metrics.sync_tracing()
         return web.Response(body=metrics.expose(), content_type="text/plain")
 

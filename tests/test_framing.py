@@ -1,0 +1,447 @@
+"""Zero-copy tensor framing (ISSUE 18 tentpole): the binary wire format.
+
+Three layers of contract:
+
+- **Codec**: encode/decode round trips bit-exactly for every wire dtype
+  (bf16 included), any shape (0-d scalars, empty, non-contiguous views),
+  and carries the SeldonMessage JSON shape losslessly in the metadata
+  section.
+- **Robustness** (the fuzz satellite): every malformed input — truncated
+  header, bad magic, version skew, lying declared lengths, dtype/shape
+  mismatches, corrupt bytes — raises FrameError (a clean 400), never a
+  hang, a partial ndarray, or an allocation sized by attacker-controlled
+  fields. ``meta_only`` recovers metadata from payload-truncated frames.
+- **Negotiation**: a frame-mode RemoteComponent against a framing-aware
+  server ships binary both ways and produces byte-identical results to
+  JSON mode; against a JSON-only (old) server it falls back to JSON after
+  one 415 and latches, so mixed fleets keep working; clients that never
+  opt in see byte-for-byte the old JSON behavior. The gRPC mirror wraps
+  frames in the proto binData arm.
+
+Tier-1: in-process aiohttp servers (test_remote_keepalive idiom), tiny
+tensors, no jax compile beyond a device_get.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+from seldon_core_tpu.codec import framing
+from seldon_core_tpu.codec.framing import (
+    CONTENT_TYPE_FRAME,
+    FrameError,
+    decode_frame,
+    decode_message,
+    encode_frame,
+    encode_message,
+    frameable,
+)
+from seldon_core_tpu.contracts.graph import Endpoint
+from seldon_core_tpu.contracts.payload import Meta, SeldonError, SeldonMessage
+from seldon_core_tpu.runtime.remote import RemoteComponent
+
+
+# ---------------------------------------------------------------- codec
+WIRE_DTYPES = ("float32", "float64", "float16", "int8", "int16", "int32",
+               "int64", "uint8", "uint16", "uint32", "uint64", "bool")
+
+
+@pytest.mark.parametrize("dtype", WIRE_DTYPES)
+def test_roundtrip_every_wire_dtype(dtype):
+    rng = np.random.default_rng(7)
+    arr = (rng.random((3, 5)) * 40).astype(dtype)
+    meta, out = decode_frame(encode_frame({"k": 1}, [arr]))
+    assert meta == {"k": 1}
+    assert out[0].dtype == arr.dtype and out[0].shape == arr.shape
+    assert np.array_equal(out[0], arr)
+
+
+def test_roundtrip_bfloat16():
+    import ml_dtypes
+
+    arr = np.arange(6, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    _, out = decode_frame(encode_frame({}, [arr]))
+    assert out[0].dtype == arr.dtype
+    assert np.array_equal(out[0].astype(np.float32), arr.astype(np.float32))
+
+
+def test_roundtrip_odd_shapes():
+    """0-d scalars keep their rank (ascontiguousarray would promote them),
+    empty tensors survive, and non-contiguous views are packed dense."""
+    scalar = np.array(True)
+    empty = np.zeros((0, 4), np.int64)
+    strided = np.arange(24, dtype=np.float32).reshape(4, 6)[::2, ::3]
+    _, out = decode_frame(encode_frame({}, [scalar, empty, strided]))
+    assert out[0].shape == () and out[0] == scalar
+    assert out[1].shape == (0, 4) and out[1].dtype == np.int64
+    assert np.array_equal(out[2], strided)
+
+
+def test_decoded_tensors_are_zero_copy_views():
+    arr = np.arange(8, dtype=np.float32)
+    buf = encode_frame({}, [arr])
+    _, out = decode_frame(buf)
+    assert out[0].base is not None  # a view over the frame, not a copy
+
+
+def test_message_roundtrip_data():
+    msg = SeldonMessage.from_array(
+        np.arange(6, dtype=np.float32).reshape(2, 3), names=["a", "b", "c"])
+    msg.meta = Meta(puid="req-1", tags={"x": "y"})
+    out = decode_message(encode_message(msg))
+    assert out.which == "data"
+    assert np.array_equal(out.data.array, msg.data.array)
+    assert out.data.array.dtype == np.float32
+    assert out.data.names == ["a", "b", "c"]
+    assert out.meta.puid == "req-1" and out.meta.tags == {"x": "y"}
+    assert out.to_dict() == msg.to_dict()
+
+
+@pytest.mark.parametrize("msg", [
+    SeldonMessage.from_bytes(b"\x00\x01binary\xff"),
+    SeldonMessage.from_str("hello frames"),
+    SeldonMessage.from_json_data({"nested": [1, {"a": 2}]}),
+])
+def test_message_roundtrip_other_arms(msg):
+    out = decode_message(encode_message(msg))
+    assert out.which == msg.which
+    assert out.to_dict() == msg.to_dict()
+
+
+def test_frameable_selects_binary_wins_only():
+    assert frameable(SeldonMessage.from_array(np.ones((2, 2), np.float32)))
+    assert frameable(SeldonMessage.from_bytes(b"x"))
+    # object arrays / strData / jsonData gain nothing from raw buffers
+    ragged = SeldonMessage.from_array(np.array([1, "a"], dtype=object))
+    assert not frameable(ragged)
+    assert not frameable(SeldonMessage.from_str("s"))
+    assert not frameable(SeldonMessage.from_json_data({"a": 1}))
+    assert not frameable({"not": "a message"})
+
+
+def test_device_arrays_pack_via_one_bulk_transfer():
+    import jax.numpy as jnp
+
+    dev = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+    _, out = decode_frame(encode_frame({}, [dev, dev * 2]))
+    assert np.array_equal(out[0], np.asarray(dev))
+    assert np.array_equal(out[1], np.asarray(dev) * 2)
+
+
+def test_tree_skeleton_roundtrip_preserves_containers():
+    tree = ({"a": np.ones(2), "b": [np.zeros(1), (np.full(3, 7),)]},
+            np.arange(4))
+    skel, leaves = framing.tree_skeleton(tree)
+    json.dumps(skel)  # the skeleton must ride the JSON metadata section
+    out = framing.tree_unskeleton(skel, leaves)
+    assert isinstance(out, tuple) and isinstance(out[0]["b"][1], tuple)
+    assert np.array_equal(out[0]["b"][1][0], tree[0]["b"][1][0])
+    with pytest.raises(FrameError):
+        framing.tree_unskeleton({"T": "leaf", "i": 99}, leaves)
+    with pytest.raises(FrameError):
+        framing.tree_skeleton({1: np.ones(2)})  # non-string dict keys
+
+
+# ----------------------------------------------------------- fuzz matrix
+def _valid_frame():
+    return encode_frame({"kind": "SeldonMessage", "which": "data",
+                         "data": {"names": [], "tensorRef": 0}},
+                        [np.arange(10, dtype=np.float32)])
+
+
+@pytest.mark.parametrize("mutate, what", [
+    (lambda b: b[:10], "truncated header"),
+    (lambda b: b"JUNK" + b[4:], "bad magic"),
+    (lambda b: b[:4] + (99).to_bytes(2, "little") + b[6:], "version skew"),
+    (lambda b: b[:8] + (2 ** 20).to_bytes(4, "little") + b[12:],
+     "lying tensor count"),
+    (lambda b: b[:12] + (2 ** 31).to_bytes(4, "little") + b[16:],
+     "oversized declared meta length"),
+    (lambda b: b[:16] + (2 ** 62).to_bytes(8, "little") + b[24:],
+     "oversized declared payload length"),
+    (lambda b: b[:-12], "truncated payload"),
+    (lambda b: b + b"\x00" * 7, "trailing garbage"),
+    (lambda b: b[:24] + bytes([200]) + b[25:], "unknown dtype code"),
+    (lambda b: b[:25] + bytes([33]) + b[26:], "ndim over cap"),
+    (lambda b: b"", "empty"),
+])
+def test_fuzz_malformed_frames_raise_clean_400(mutate, what):
+    """The robustness satellite: every corruption is a FrameError (status
+    400) — never a hang, never a partial tensor, and the oversized-length
+    rows cost a comparison, not an allocation."""
+    bad = mutate(_valid_frame())
+    with pytest.raises(FrameError) as ei:
+        decode_frame(bad)
+    assert ei.value.status_code == 400, what
+    with pytest.raises(SeldonError):
+        decode_message(bad)
+
+
+def test_fuzz_dtype_shape_mismatch():
+    # shrink the declared nbytes so shape x itemsize no longer matches
+    buf = bytearray(_valid_frame())
+    # entry layout after the 24-byte header: code u8 | ndim u8 | res u16 |
+    # offset u64 | nbytes u64
+    buf[36:44] = (36).to_bytes(8, "little")
+    with pytest.raises(FrameError, match="mismatch|spans|payload"):
+        decode_frame(bytes(buf))
+
+
+def test_fuzz_tensor_bounds_checked_before_materialization():
+    # point the tensor past the payload: bounds fire before np.frombuffer
+    buf = bytearray(_valid_frame())
+    buf[24 + 4:24 + 12] = (2 ** 40).to_bytes(8, "little")  # offset u64
+    with pytest.raises(FrameError, match="spans|mismatch|payload"):
+        decode_frame(bytes(buf))
+
+
+def test_fuzz_byte_flips_never_hang_or_leak():
+    """Deterministic single-byte corruption sweep: every flip either still
+    decodes (flips in tensor bytes change values, not structure) or raises
+    FrameError/SeldonError — no other exception type escapes."""
+    base = _valid_frame()
+    rng = np.random.default_rng(18)
+    for pos in rng.choice(len(base), size=64, replace=False):
+        bad = bytearray(base)
+        bad[pos] ^= 0xFF
+        try:
+            decode_message(bytes(bad))
+        except SeldonError:
+            pass  # FrameError included
+
+
+def test_meta_only_recovers_metadata_from_truncated_payload():
+    buf = _valid_frame()[:-12]
+    meta, tensors = decode_frame(buf, meta_only=True)
+    assert meta["kind"] == "SeldonMessage" and tensors == []
+    with pytest.raises(FrameError):
+        decode_frame(buf)  # the full decode still refuses it
+
+
+def test_bad_refs_in_message_meta():
+    bad_ref = encode_frame({"kind": "SeldonMessage", "which": "data",
+                            "data": {"names": [], "tensorRef": 5}},
+                           [np.ones(2, np.float32)])
+    with pytest.raises(FrameError, match="tensorRef"):
+        decode_message(bad_ref)
+    not_msg = encode_frame({"kind": "other"}, [])
+    with pytest.raises(FrameError, match="SeldonMessage"):
+        decode_message(not_msg)
+
+
+# ------------------------------------------------------ REST negotiation
+class _Doubler:
+    """Minimal component: predict doubles the tensor."""
+
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+
+def _serve(app_factory, body):
+    """Run an app and a client coroutine on one loop (the keepalive test
+    idiom); returns the coroutine's result."""
+
+    async def go():
+        app = app_factory()
+        runner = web.AppRunner(app)
+        await runner.setup()
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        site = web.SockSite(runner, s)
+        await site.start()
+        try:
+            return await body(port)
+        finally:
+            await runner.cleanup()
+
+    return asyncio.run(go())
+
+
+def _component_app():
+    from seldon_core_tpu.transport.rest import make_component_app
+
+    return make_component_app(_Doubler())
+
+
+def test_remote_hop_frame_vs_json_parity():
+    """The tentpole acceptance shape: the SAME request through wire_format
+    'json' and 'frame' yields identical SeldonMessages, and frame mode
+    actually moved frame bytes both ways."""
+    msg = SeldonMessage.from_array(
+        np.arange(12, dtype=np.float32).reshape(3, 4), names=["a"])
+
+    async def body(port):
+        results = {}
+        for wf in ("json", "frame"):
+            comp = RemoteComponent(
+                Endpoint(service_host="127.0.0.1", service_port=port,
+                         type="REST"), wire_format=wf)
+            try:
+                results[wf] = await comp.predict_raw(msg)
+            finally:
+                await comp.close()
+        return results
+
+    framing.frame_stats()  # reset time samples, snapshot byte baseline
+    before = framing.frame_stats()["frame_bytes_total"].get("rest", 0)
+    res = _serve(_component_app, body)
+    assert res["json"].to_dict() == res["frame"].to_dict()
+    assert np.array_equal(res["frame"].data.array,
+                          np.asarray(msg.data.array) * 2)
+    after = framing.frame_stats()["frame_bytes_total"].get("rest", 0)
+    assert after > before, "frame mode moved no frame bytes"
+
+
+def test_accept_header_drives_response_framing():
+    """Accept-driven negotiation: a framed POST with the frame Accept gets
+    a framed response; a JSON POST without it gets byte-identical JSON
+    (clients that never opt in see the old wire exactly)."""
+    import aiohttp
+
+    msg = SeldonMessage.from_array(np.ones((2, 2), np.float32))
+
+    async def body(port):
+        url = f"http://127.0.0.1:{port}/predict"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, json=msg.to_dict()) as r:
+                plain = (r.content_type, await r.json())
+            async with s.post(
+                    url, data=encode_message(msg),
+                    headers={"Content-Type": CONTENT_TYPE_FRAME,
+                             "Accept": f"{CONTENT_TYPE_FRAME}, "
+                                       "application/json"}) as r:
+                framed = (r.content_type, await r.read())
+        return plain, framed
+
+    (plain_ct, plain_body), (framed_ct, framed_body) = _serve(
+        _component_app, body)
+    assert plain_ct == "application/json"
+    assert framed_ct == CONTENT_TYPE_FRAME
+    out = decode_message(framed_body)
+    assert out.to_dict() == SeldonMessage.from_dict(plain_body).to_dict()
+
+
+def test_garbage_frame_body_is_clean_400_json():
+    import aiohttp
+
+    async def body(port):
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"http://127.0.0.1:{port}/predict",
+                    data=b"SFRM" + b"\xde\xad\xbe\xef" * 8,
+                    headers={"Content-Type": CONTENT_TYPE_FRAME}) as r:
+                return r.status, r.content_type, await r.json()
+
+    status, ctype, err = _serve(_component_app, body)
+    assert status == 400 and ctype == "application/json"
+    assert err["status"]["reason"] == "MALFORMED_FRAME"
+
+
+def test_feedback_rejects_framed_bodies():
+    """Only SeldonMessage-parsered routes accept frames; /send-feedback
+    parses a Feedback and must refuse the content type with a 415."""
+    import aiohttp
+
+    async def body(port):
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"http://127.0.0.1:{port}/send-feedback",
+                    data=_valid_frame(),
+                    headers={"Content-Type": CONTENT_TYPE_FRAME}) as r:
+                return r.status
+
+    assert _serve(_component_app, body) == 415
+
+
+def test_frame_mode_falls_back_to_json_against_old_server():
+    """Mixed-fleet safety: an old JSON-only hop answers the first framed
+    POST with an error status; the client resends THAT request as JSON,
+    latches, and never frames toward that hop again."""
+    seen = []
+
+    def old_app():
+        async def handler(request):
+            seen.append(request.content_type)
+            if request.content_type != "application/json":
+                return web.json_response(
+                    {"status": {"code": 415,
+                                "info": "unsupported content type"}},
+                    status=415)
+            body = await request.json()
+            return web.json_response(body)
+
+        app = web.Application()
+        app.router.add_post("/predict", handler)
+        return app
+
+    msg = SeldonMessage.from_array(np.ones(3, np.float32))
+
+    async def body(port):
+        comp = RemoteComponent(
+            Endpoint(service_host="127.0.0.1", service_port=port,
+                     type="REST"), wire_format="frame")
+        try:
+            outs = [await comp.predict_raw(msg) for _ in range(3)]
+        finally:
+            await comp.close()
+        return outs, comp._frame_unsupported
+
+    outs, latched = _serve(old_app, body)
+    assert latched is True
+    for out in outs:
+        assert np.array_equal(np.asarray(out.data.array, dtype=np.float32),
+                              msg.data.array)
+    # exactly one frame attempt, then JSON forever
+    assert seen[0] == CONTENT_TYPE_FRAME
+    assert seen.count(CONTENT_TYPE_FRAME) == 1
+    assert len(seen) == 4  # 1 frame + 1 fallback resend + 2 JSON
+
+
+def test_wire_format_annotation_and_validation():
+    from seldon_core_tpu.runtime.remote import config_from_annotations
+
+    cfg = config_from_annotations({"seldon.io/wire-format": "frame"})
+    assert cfg["wire_format"] == "frame"
+    assert config_from_annotations({})["wire_format"] == "json"
+    assert config_from_annotations(
+        {"seldon.io/wire-format": "banana"})["wire_format"] == "json"
+    with pytest.raises(ValueError):
+        RemoteComponent(Endpoint(service_host="h", service_port=1,
+                                 type="REST"), wire_format="banana")
+
+
+# ----------------------------------------------------------- gRPC mirror
+def test_grpc_wrap_unwrap_binData_passthrough():
+    msg = SeldonMessage.from_array(np.arange(4, dtype=np.int32))
+    msg.meta = Meta(puid="g-1")
+    wrapped = framing.grpc_wrap(msg)
+    # the envelope is a plain binData SeldonMessage — any proto layer
+    # (message_to_proto/message_from_proto) carries it without base64
+    assert wrapped.which == "binData"
+    assert wrapped.meta.tags[framing.FRAME_TAG] == CONTENT_TYPE_FRAME
+    assert framing.grpc_is_framed(wrapped)
+    out = framing.grpc_unwrap(wrapped)
+    assert np.array_equal(out.data.array, msg.data.array)
+    assert out.meta.puid == "g-1"
+    # user binData without the tag is NOT mistaken for a frame
+    assert not framing.grpc_is_framed(SeldonMessage.from_bytes(b"SFRM..."))
+
+
+def test_grpc_frame_survives_proto_roundtrip():
+    from seldon_core_tpu.transport import proto_convert as pc
+
+    msg = SeldonMessage.from_array(np.arange(6, dtype=np.float64) / 3)
+    wrapped = framing.grpc_wrap(msg)
+    proto = pc.message_to_proto(wrapped)
+    back = pc.message_from_proto(proto)
+    assert framing.grpc_is_framed(back)
+    out = framing.grpc_unwrap(back)
+    assert np.array_equal(out.data.array, msg.data.array)
+    assert out.data.array.dtype == np.float64  # no float round trip loss

@@ -9,7 +9,8 @@ Python thread until the device stream drains — on the decode path that
 is a full pipeline stall per token.
 
 Scope: files under the hot-path packages (``runtime/``, ``servers/``,
-``ops/``, ``transport/``). Within them a finding fires when
+``ops/``, ``transport/``), plus the frame codec (``codec/framing*.py``) —
+see "Framing egress" below. Within the hot packages a finding fires when
 
 * a STRONG sync call (np.asarray / np.array / jax.device_get /
   .block_until_ready()) appears inside a hot-named function (decode /
@@ -19,6 +20,17 @@ Scope: files under the hot-path packages (``runtime/``, ``servers/``,
 * a WEAK sync call (float / int / bool / .item()) has a device-tainted
   argument (these four are pervasive on host values, so the bare
   hot-function heuristic would drown the signal).
+
+Framing egress (PR 18): the frame codec's contract is ONE bulk
+device->host transfer per frame — raw-buffer assembly must never sync
+device arrays per-tensor (each per-leaf ``np.asarray``/``.item()`` in the
+pack loop is a full host/device serialization, the PR 3 stall class at
+frame-encode time). In framing files a STRONG sync (or a bare
+``.item()``) fires whenever it sits inside a loop — loop depth stands in
+for hot-function naming, since every per-tensor iteration is the bug —
+and device-tainted arguments fire anywhere, exactly as in hot files. The
+single legitimate bulk ``jax.device_get`` sits outside any loop and
+carries the mandatory inline suppression telling that story.
 
 Device taint is a per-function, statement-ordered dataflow: an expression
 is device-valued when it mentions ``jnp.*``/``jax.*``/``lax.*``, calls a
@@ -61,6 +73,14 @@ DEVICE_ROOTS = ("jnp", "jax", "lax")
 
 def _is_hot_file(module: Module) -> bool:
     return any(p in HOT_DIRS for p in module.parts[:-1])
+
+
+def _is_framing_file(module: Module) -> bool:
+    """The frame codec: codec/framing*.py (tensorproto and the other codec
+    modules keep the hot-package scoping — their ndarray round trips are
+    the JSON path's job, not frame assembly)."""
+    return ("codec" in module.parts[:-1]
+            and "fram" in module.parts[-1])
 
 
 def _terminal_name(func: ast.AST) -> str:
@@ -204,12 +224,14 @@ class HostSyncChecker:
     def run(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []
         for module in project.modules:
-            if not _is_hot_file(module):
+            framing = _is_framing_file(module)
+            if not (_is_hot_file(module) or framing):
                 continue
-            findings.extend(self._check_module(module))
+            findings.extend(self._check_module(module, framing))
         return findings
 
-    def _check_module(self, module: Module) -> List[Finding]:
+    def _check_module(self, module: Module,
+                      framing: bool = False) -> List[Finding]:
         findings: List[Finding] = []
         seen = set()  # (line, kind) — one finding per sync site
 
@@ -217,7 +239,8 @@ class HostSyncChecker:
             hot = hot_stack or bool(HOT_FN_RE.search(fn.name))
             taint = _Taint()
             self._walk_block(fn.body, module, qualname, hot, taint,
-                             findings, seen, check_function)
+                             findings, seen, check_function,
+                             framing=framing)
 
         for node in module.tree.body:
             self._top_level(node, module, findings, seen, check_function, "")
@@ -233,32 +256,54 @@ class HostSyncChecker:
                 self._top_level(child, module, findings, seen, check_function, q)
 
     def _walk_block(self, stmts, module, qualname, hot, taint, findings,
-                    seen, check_function):
+                    seen, check_function, framing=False, loops=0):
         for stmt in stmts:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                # nested def: inherits hotness, fresh taint scope
+                # nested def: inherits hotness, fresh taint scope (and a
+                # fresh loop depth — its body runs per CALL, not per
+                # enclosing iteration)
                 q = f"{qualname}.{stmt.name}"
                 nested_hot = hot or bool(HOT_FN_RE.search(stmt.name))
                 inner = _Taint()
                 self._walk_block(stmt.body, module, q, nested_hot, inner,
-                                 findings, seen, check_function)
+                                 findings, seen, check_function,
+                                 framing=framing)
                 continue
-            self._check_stmt(stmt, module, qualname, hot, taint, findings, seen)
-            # descend into compound statements with the same taint scope
+            self._check_stmt(stmt, module, qualname, hot, taint, findings,
+                             seen, framing=framing, loops=loops)
+            # descend into compound statements with the same taint scope;
+            # a loop's BODY bumps the depth the framing-egress arm keys on
+            # (orelse runs once, after the loop — it stays at this depth)
+            is_loop = isinstance(stmt, (ast.For, ast.AsyncFor, ast.While))
             for attr in ("body", "orelse", "finalbody"):
                 inner = getattr(stmt, attr, None)
                 if inner:
                     self._walk_block(inner, module, qualname, hot, taint,
-                                     findings, seen, check_function)
+                                     findings, seen, check_function,
+                                     framing=framing,
+                                     loops=loops + (1 if is_loop
+                                                    and attr == "body"
+                                                    else 0))
             for handler in getattr(stmt, "handlers", []) or []:
                 self._walk_block(handler.body, module, qualname, hot, taint,
-                                 findings, seen, check_function)
+                                 findings, seen, check_function,
+                                 framing=framing, loops=loops)
 
-    def _check_stmt(self, stmt, module, qualname, hot, taint, findings, seen):
+    def _check_stmt(self, stmt, module, qualname, hot, taint, findings,
+                    seen, framing=False, loops=0):
         # flag first (against taint state BEFORE this statement's bindings)
         for call, kind, subject in _sync_calls(stmt):
             device = subject is not None and taint.expr_is_device(subject)
-            fire = device or (kind == "strong" and hot)
+            if framing:
+                # framing egress: per-tensor assembly loops are the bug —
+                # a strong sync (or bare .item()) per iteration serializes
+                # host and device once per LEAF instead of once per frame
+                in_loop = loops > 0 and (
+                    kind == "strong"
+                    or _terminal_name(call.func) == "item")
+                fire = device or in_loop
+            else:
+                fire = device or (kind == "strong" and hot)
             if not fire:
                 continue
             key = (call.lineno, kind, ast.dump(call.func))
@@ -266,8 +311,14 @@ class HostSyncChecker:
                 continue
             seen.add(key)
             what = dotted(call.func) or _terminal_name(call.func)
-            why = ("device-valued argument" if device
-                   else f"inside hot-path function {qualname!r}")
+            if device:
+                why = "device-valued argument"
+            elif framing:
+                why = (f"inside a loop in frame codec function {qualname!r}"
+                       " — frame assembly owes ONE bulk transfer per frame,"
+                       " not one sync per tensor")
+            else:
+                why = f"inside hot-path function {qualname!r}"
             findings.append(make_finding(
                 module, RULE, call,
                 f"{what}() forces a device->host sync ({why}); on the "
