@@ -1710,6 +1710,7 @@ class ContinuousBatcher:
                 # a page boundary and partial-block COW stays a local
                 # (decode-side) move — capped at L-1 so the worker always
                 # computes the first-token logits
+                # leaklint: allow-leak-on-path(full_blocks_only=True guarantees cow is None — no cow pin is ever taken, so the discarded third element holds nothing)
                 k0, shared, _ = self._radix.match_and_pin(
                     ids, limit=L - 1, full_blocks_only=True)
             got = self._alloc_pages(n0 - len(shared))

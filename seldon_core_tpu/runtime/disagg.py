@@ -599,6 +599,25 @@ class PrefillWorker:
         return staged, first_logits
 
 
+class TruncatedStream(ConnectionError):
+    """Mid-message EOF. Carries the bytes read so far: the frame layout
+    puts the metadata section (and so the job_id) ahead of the tensor
+    payload, so the receiver can usually still resolve the victim job
+    with an error handoff instead of leaking its staged decode-side
+    slot (the PR 19 leak sweep's truncated-frame finding)."""
+
+    def __init__(self, msg: str, partial: bytes = b""):
+        super().__init__(msg)
+        self.partial = partial
+
+
+# Bounded read for frames the receiver refuses to take fully (declared
+# length over MAX_HANDOFF_FRAME_BYTES): enough for header + tensor table
+# + metadata JSON on any real handoff, so the job_id is recoverable
+# without trusting the hostile length prefix.
+HANDOFF_META_PROBE_BYTES = 1 << 20
+
+
 def _recv_exact(conn, n: int) -> Optional[bytes]:
     """Read exactly ``n`` bytes from a socket, or None on clean EOF.
     A mid-message EOF raises — a half-frame must never decode."""
@@ -607,9 +626,9 @@ def _recv_exact(conn, n: int) -> Optional[bytes]:
         chunk = conn.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             if buf:
-                raise ConnectionError(
+                raise TruncatedStream(
                     f"handoff stream truncated: wanted {n} bytes, "
-                    f"got {len(buf)}")
+                    f"got {len(buf)}", partial=bytes(buf))
             return None
         buf.extend(chunk)
     return bytes(buf)
@@ -676,11 +695,29 @@ class HandoffReceiver:
                     return
                 (n,) = struct.unpack("<Q", head)
                 if n > MAX_HANDOFF_FRAME_BYTES:
-                    logger.error(
-                        "handoff frame declares %d bytes (cap %d); "
-                        "dropping connection", n, MAX_HANDOFF_FRAME_BYTES)
+                    # refusing the frame must not leak the job: read a
+                    # BOUNDED probe (never the hostile declared length) —
+                    # the leading metadata section usually survives, and
+                    # resolving the job with an error handoff frees its
+                    # staged decode-side slot instead of hanging it
+                    probe = b""
+                    try:
+                        probe = _recv_exact(
+                            conn, min(n, HANDOFF_META_PROBE_BYTES)) or b""
+                    except TruncatedStream as te:
+                        probe = te.partial
+                    except (OSError, ConnectionError):
+                        pass
+                    self._refuse(
+                        probe,
+                        f"frame declares {n} bytes "
+                        f"(cap {MAX_HANDOFF_FRAME_BYTES})")
                     return
-                payload = _recv_exact(conn, n)
+                try:
+                    payload = _recv_exact(conn, n)
+                except TruncatedStream as te:
+                    self._refuse(te.partial, str(te))
+                    return
                 if payload is None:
                     return
                 handoff = self._materialize(payload)
@@ -751,6 +788,35 @@ class HandoffReceiver:
                              "resolving with error", job_id)
             return Handoff(job_id, error=e)
 
+    def _refuse(self, prefix: bytes, why: str) -> None:
+        """Last-ditch resolution for a frame the receiver will never
+        fully read (oversized declared length, mid-frame truncation).
+        The metadata section leads the frame, so the prefix usually
+        still decodes with ``meta_only=True`` — publishing an error
+        handoff then releases the job's staged decode-side slot (pages,
+        prefix pins, the client future) through the same exactly-once
+        queue path a poisoned-but-complete frame takes. Without a
+        recoverable job_id the frame is logged and dropped: the slot
+        leak is then the sender's bug to surface, not silently ours."""
+        from seldon_core_tpu.codec import framing
+
+        job_id = None
+        try:
+            meta, _ = framing.decode_frame(prefix, meta_only=True,
+                                           path="handoff")
+            if meta.get("kind") == "KVHandoff":
+                job_id = meta.get("job_id")
+        except Exception:  # noqa: BLE001 — the prefix is hostile input
+            pass
+        if job_id is None:
+            logger.error("dropping unresolvable handoff frame (%s; "
+                         "no recoverable job_id in %d probe bytes)",
+                         why, len(prefix))
+            return
+        logger.error("handoff frame for job %s refused (%s); "
+                     "resolving with error", job_id, why)
+        self.queue.put(Handoff(job_id, error=ConnectionError(why)))
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {"handoff_network_bytes_total": self.network_bytes_total}
@@ -783,7 +849,9 @@ class HandoffReceiver:
             self._listener.close()
         except OSError:
             pass
-        for t in self._threads:
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=timeout_s)
 
 

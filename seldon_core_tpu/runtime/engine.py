@@ -59,6 +59,7 @@ from seldon_core_tpu.runtime.resilience import (
     CircuitBreaker,
     Deadline,
     ResilienceConfig,
+    ResumeJournal,
     ResumeMarker,
     RetryBudget,
     ShedError,
@@ -159,8 +160,8 @@ class _ResumeEntry:
     and the tokens DELIVERED so far (``len(tokens)`` is also the
     rng-split count to fast-forward by: the chain consumes exactly one
     split per emitted token). Appends happen on batcher worker threads
-    while the fleet's retry loop reads — every access under the fleet's
-    ``_journal_lock``."""
+    while the fleet's retry loop reads — every access goes through
+    ``ResumeJournal`` (runtime/resilience.py), which owns the lock."""
 
     __slots__ = ("prompt_ids", "max_new", "seed", "tenant", "slo_class",
                  "adapter", "tokens")
@@ -253,10 +254,8 @@ class ReplicaSet(SeldonComponent):
         # -- deterministic request recovery -----------------------------
         # resume journal: every fleet-dispatched generation in flight,
         # at token granularity (appended from batcher worker threads,
-        # read by the retry loop — all access under _journal_lock)
-        self._journal: Dict[int, "_ResumeEntry"] = {}
-        self._journal_lock = threading.Lock()
-        self._journal_seq = 0
+        # read by the retry loop — all locking inside ResumeJournal)
+        self._journal = ResumeJournal()
         self.retry_budget = RetryBudget(clock=self.clock)
         self._dispatch_pool = None  # lazy: gRPC submit_stream executor
 
@@ -706,8 +705,8 @@ class ReplicaSet(SeldonComponent):
         Determinism: an unseeded request gets a journaled random seed
         BEFORE first dispatch, so greedy and sampled generations alike
         live on one pinned rng chain that a resume can fast-forward
-        (batcher._sample_first). The journal appends each token under
-        ``_journal_lock`` BEFORE forwarding it to the client, so a resume
+        (batcher._sample_first). The ``ResumeJournal`` records each token
+        under its lock BEFORE forwarding it to the client, so a resume
         skips exactly the delivered prefix — at-most-once delivery, never
         a duplicate. The batcher's crash handler fires ``on_token(None)``
         at its victims; the wrapper swallows it (the fleet owns the
@@ -734,12 +733,9 @@ class ReplicaSet(SeldonComponent):
             # seed-independent; unseeded SAMPLED fleet output was random
             # anyway — now it is random-but-resumable)
             seed = secrets.randbits(31)
-        with self._journal_lock:
-            self._journal_seq += 1
-            jid = self._journal_seq
-            entry = _ResumeEntry(prompt_ids, orig_max_new, seed,
-                                 tenant, slo_class, adapter)
-            self._journal[jid] = entry
+        entry = _ResumeEntry(prompt_ids, orig_max_new, seed,
+                             tenant, slo_class, adapter)
+        jid = self._journal.record(entry)
 
         def wrapped(tok):
             if tok is None:
@@ -748,15 +744,13 @@ class ReplicaSet(SeldonComponent):
                 if on_token is not None:
                     on_token(tok)
                 return
-            with self._journal_lock:
-                entry.tokens.append(int(tok))
+            self._journal.append(jid, int(tok))
             if on_token is not None:
                 on_token(tok)
 
         try:
             while True:
-                with self._journal_lock:
-                    done = list(entry.tokens)
+                done = self._journal.delivered(jid)
                 n = len(done)
                 if n >= orig_max_new:
                     return done  # the crash raced completion
@@ -784,11 +778,10 @@ class ReplicaSet(SeldonComponent):
                         raise
                     self._record_dispatch_failure(replica)
                     self.check_health()  # a crash ejects before the retry
-                    with self._journal_lock:
-                        delivered = len(entry.tokens)
+                    delivered = len(self._journal.delivered(jid))
                     if delivered > 0 and not can_resume:
                         raise  # mid-stream, no token-level journal: honest
-                    if not self.retry_budget.try_spend():
+                    if not self.retry_budget.take():
                         raise ShedError(
                             "fleet retry budget exhausted (correlated "
                             "failures); request not recovered",
@@ -799,8 +792,7 @@ class ReplicaSet(SeldonComponent):
                 # tail (on_token elides EOS; the result never does)
                 return done + [int(t) for t in toks]
         finally:
-            with self._journal_lock:
-                self._journal.pop(jid, None)
+            self._journal.discard(jid)
             if on_token is not None:
                 try:
                     on_token(None)
@@ -902,8 +894,7 @@ class ReplicaSet(SeldonComponent):
             merged["fleet_reinstatements_total"] = self._reinstatements_total
             merged["fleet_resumes_total"] = self._resumes_total
             merged["fleet_resumed_tokens_total"] = self._resumed_tokens_total
-        with self._journal_lock:
-            merged["fleet_resume_journal_depth"] = len(self._journal)
+        merged["fleet_resume_journal_depth"] = self._journal.depth()
         merged["fleet_retry_budget_exhausted_total"] = (
             self.retry_budget.snapshot()["exhausted_total"])
         return merged

@@ -35,7 +35,7 @@ import time
 from collections import deque
 from contextlib import contextmanager
 from contextvars import ContextVar
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from seldon_core_tpu.contracts.payload import SeldonError
 
@@ -648,6 +648,11 @@ class RetryBudget:
             self.exhausted_total += 1
             return False
 
+    # the registered acquire-site name in tools/leaklint/effects.py: a
+    # budget spend is the one obligation that is consumed by design (no
+    # static release), but the dynamic sweep still injects at it
+    take = try_spend
+
     def snapshot(self) -> Dict[str, float]:
         """One consistent view for stats/metrics."""
         now = self.clock()
@@ -658,6 +663,70 @@ class RetryBudget:
                 "retries_in_window": len(self._retries),
                 "exhausted_total": self.exhausted_total,
             }
+
+
+class ResumeJournal:
+    """The fleet's token-granularity recovery journal (docs/resilience.md
+    "Fleet fault tolerance"), factored out of ReplicaSet so its locking
+    is a single auditable surface and its entry lifetime is a registered
+    leaklint obligation: ``record()`` acquires a journal-entry, the
+    dispatch loop's ``finally`` must ``discard()`` it on every path
+    (tools/leaklint/effects.py).
+
+    Appends happen on batcher worker threads while the retry loop reads
+    ``delivered()`` — every access takes the journal's own lock, so a
+    mid-append snapshot can never tear (the PR 16 reconstruction in
+    tests/test_schedules.py is the exact interleaving this prevents).
+    ``append``/``delivered`` on a discarded id are no-ops: a straggler
+    token from a crashed replica's worker thread can land after the
+    dispatch completed, and it must not resurrect the entry."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[int, Any] = {}
+        self._seq = 0
+
+    def record(self, entry: Any) -> int:
+        """Admit one in-flight generation; returns its journal id. The
+        caller owes a ``discard(jid)`` on every exit path."""
+        with self._lock:
+            self._seq += 1
+            jid = self._seq
+            self._entries[jid] = entry
+            return jid
+
+    def append(self, jid: int, token: int) -> None:
+        """One delivered token, recorded BEFORE the client sees it — a
+        resume then skips exactly the delivered prefix (at-most-once)."""
+        with self._lock:
+            entry = self._entries.get(jid)
+            if entry is not None:
+                entry.tokens.append(int(token))
+
+    def delivered(self, jid: int) -> List[int]:
+        """Consistent snapshot of the tokens delivered so far ([] after
+        discard)."""
+        with self._lock:
+            entry = self._entries.get(jid)
+            return list(entry.tokens) if entry is not None else []
+
+    def get(self, jid: int) -> Optional[Any]:
+        """The live entry itself (None after discard) — test/debug
+        surface; production code goes through append/delivered."""
+        with self._lock:
+            return self._entries.get(jid)
+
+    def discard(self, jid: int) -> None:
+        """End of the entry's lifetime (idempotent)."""
+        with self._lock:
+            self._entries.pop(jid, None)
+
+    def depth(self) -> int:
+        """Entries in flight — exported as
+        ``fleet_resume_journal_depth`` and asserted back to zero by the
+        leak canary (tests/conftest.py)."""
+        with self._lock:
+            return len(self._entries)
 
 
 class ResumeMarker:

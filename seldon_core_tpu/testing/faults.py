@@ -353,6 +353,250 @@ class HandoffPoisoner:
             worker._prefill_one = poisoned_prefill
 
 
+class LeakSweep:
+    """Error-path leak harness (ISSUE 19): one-shot fault injection at
+    every registered acquire/commit boundary of a live batcher, plus a
+    zero-residue probe over every refcounted resource the runtime owns.
+
+    The static half of PR 19 (``tools/leaklint``) proves each acquire
+    site pairs with a release on every CFG path; this is the dynamic
+    half — it makes those paths actually EXECUTE. For each boundary the
+    harness arms a deterministic one-shot fault, the test drives one
+    request through it (which fails with a contained error — the server
+    must keep serving), and ``assert_clean`` then checks that every
+    counter an unwind path is responsible for is back to zero: pages
+    held by slots, elevated trie pins, adapter pins, staged remote
+    jobs, undelivered handoffs, resume-journal entries.
+
+    Boundaries map 1:1 onto the leaklint effect registry
+    (``tools/leaklint/effects.py``):
+
+    ========================  =============================================
+    boundary                  injected fault (one-shot)
+    ========================  =============================================
+    ``adapter-pin``           ``AdapterRegistry.resolve_and_pin`` raises
+                              KeyError at submit — the 400 path must drop
+                              nothing (no pin was taken under the raise).
+    ``page-alloc``            ``_alloc_pages`` returns None while armed —
+                              admission exhaustion; the unwind must drop
+                              the ``match_and_pin`` prefix pins (the PR 7 /
+                              PR 15 leak class).
+    ``radix-cow``             only the FIRST ``_alloc_pages`` call fails —
+                              the cow-drop retry path runs and the request
+                              SUCCEEDS; the dropped cow-source pin must be
+                              freed exactly once (the PR 12 leak class).
+    ``prefill-stage``         ``PrefillWorker._prefill_one`` raises — the
+                              worker publishes an error handoff and the
+                              decode side releases the staged slot+pages.
+    ``handoff-import``        staged KV replaced with an unimportable
+                              payload — ``_consume_handoffs`` containment
+                              releases slot, suffix pages, prefix pins.
+    ``journal-record``        ``ResumeJournal.record`` raises — the fleet
+                              submit fails before any entry exists; depth
+                              stays zero (the PR 16 leak class).
+    ========================  =============================================
+
+    ``boundaries()`` returns the subset applicable to the batcher's
+    configuration (paged? radix? adapters? disaggregated? fleet engine?),
+    so one parametrized test sweeps every layout without dead arms.
+    """
+
+    POISON = "leaksweep-poisoned-kv"
+
+    def __init__(self, batcher: Any, engine: Any = None):
+        self.batcher = batcher
+        self.engine = engine
+        self.fired = 0
+        self._lock = threading.Lock()
+        self._shots = 0
+        self._restore: List[Any] = []  # (obj, attr, original)
+
+    # -- boundary catalog ----------------------------------------------
+    def boundaries(self) -> List[str]:
+        b, out = self.batcher, []
+        if getattr(b, "_adapters", None) is not None:
+            out.append("adapter-pin")
+        if getattr(b, "paged", False):
+            out.append("page-alloc")
+            if getattr(b, "_radix", None) is not None:
+                out.append("radix-cow")
+        if getattr(b, "_remote", None) is not None:
+            out.append("prefill-stage")
+            out.append("handoff-import")
+        if self.engine is not None and getattr(self.engine, "_journal",
+                                               None) is not None:
+            out.append("journal-record")
+        return out
+
+    # -- one-shot plumbing ---------------------------------------------
+    def _take_shot(self) -> bool:
+        with self._lock:
+            if self._shots <= 0:
+                return False
+            self._shots -= 1
+            self.fired += 1
+            return True
+
+    def _wrap(self, obj: Any, attr: str, wrapper: Callable) -> None:
+        original = getattr(obj, attr)
+        setattr(obj, attr, wrapper(original))
+        self._restore.append((obj, attr, original))
+
+    def disarm(self) -> None:
+        """Restore every wrapped method (idempotent)."""
+        while self._restore:
+            obj, attr, original = self._restore.pop()
+            setattr(obj, attr, original)
+        with self._lock:
+            self._shots = 0
+
+    def arm(self, boundary: str, shots: int = 1) -> "LeakSweep":
+        """Install the one-shot fault for ``boundary``; returns self."""
+        if boundary not in self.boundaries():
+            raise ValueError(
+                f"boundary {boundary!r} not applicable here "
+                f"(have: {self.boundaries()})")
+        self.disarm()
+        with self._lock:
+            self._shots = int(shots)
+        getattr(self, "_arm_" + boundary.replace("-", "_"))()
+        return self
+
+    def _arm_adapter_pin(self) -> None:
+        reg = self.batcher._adapters
+
+        def wrapper(real):
+            def resolve_and_pin(name):
+                if name and self._take_shot():
+                    raise KeyError(
+                        f"leaksweep: injected adapter fault for {name!r}")
+                return real(name)
+            return resolve_and_pin
+
+        self._wrap(reg, "resolve_and_pin", wrapper)
+
+    def _arm_page_alloc(self) -> None:
+        # while armed EVERY _alloc_pages call fails: the admission must
+        # take its exhaustion unwind (shed or park), not the trie-evict
+        # relief retry. Shots gate how many admissions see exhaustion.
+        def wrapper(real):
+            def _alloc_pages(n):
+                if self._take_shot():
+                    return None
+                return real(n)
+            return _alloc_pages
+
+        self._wrap(self.batcher, "_alloc_pages", wrapper)
+
+    def _arm_radix_cow(self) -> None:
+        # identical injection point, but the driver arms exactly ONE shot
+        # and sends a partial-block prefix continuation: the first
+        # (cow-inclusive) allocation fails, the cow pin is dropped, and
+        # the retry allocation succeeds — the admission completes.
+        self._arm_page_alloc()
+
+    def _arm_prefill_stage(self) -> None:
+        from seldon_core_tpu.contracts.payload import SeldonError as _Err
+
+        for worker in self.batcher._remote.workers:
+            def wrapper(real):
+                def _prefill_one(req):
+                    if self._take_shot():
+                        raise _Err("leaksweep: injected prefill fault",
+                                   status_code=503, reason="INJECTED_FAULT")
+                    return real(req)
+                return _prefill_one
+
+            self._wrap(worker, "_prefill_one", wrapper)
+
+    def _arm_handoff_import(self) -> None:
+        for worker in self.batcher._remote.workers:
+            def wrapper(real):
+                def _prefill_one(req):
+                    h = real(req)
+                    if self._take_shot():
+                        h.staged = self.POISON
+                    return h
+                return _prefill_one
+
+            self._wrap(worker, "_prefill_one", wrapper)
+
+    def _arm_journal_record(self) -> None:
+        from seldon_core_tpu.contracts.payload import SeldonError as _Err
+
+        journal = self.engine._journal
+
+        def wrapper(real):
+            def record(entry):
+                if self._take_shot():
+                    raise _Err("leaksweep: injected journal fault",
+                               status_code=503, reason="INJECTED_FAULT")
+                return real(entry)
+            return record
+
+        self._wrap(journal, "record", wrapper)
+
+    # -- residue probe --------------------------------------------------
+    def residue(self) -> dict:
+        """Every refcount the unwind paths are responsible for, as a
+        dict that must be ALL ZEROS at idle. Cached trie blocks are a
+        cache, not a leak — ``slot_pages`` subtracts them, and a leaked
+        PIN shows up as ``shared_pins`` (a cached page with refcount
+        still > 1 while no slot references it)."""
+        b = self.batcher
+        out = {}
+        if getattr(b, "paged", False):
+            _, in_use, _ = b._allocator.stats()
+            cached = 0
+            shared_pins = 0
+            if b._radix is not None:
+                rs = b._radix.stats()
+                cached = rs["prefix_cached_blocks"]
+                shared_pins = rs["prefix_shared_pages"]
+            out["slot_pages"] = in_use - cached
+            out["shared_pins"] = shared_pins
+        if getattr(b, "_adapters", None) is not None:
+            out["adapter_pins"] = sum(
+                b._adapters.stats()["adapter_pins"].values())
+        if getattr(b, "_remote", None) is not None:
+            out["staged_jobs"] = len(b._remote_jobs)
+            out["ready_handoffs"] = b._transfer.ready_depth()
+        if self.engine is not None and getattr(self.engine, "_journal",
+                                               None) is not None:
+            out["journal_depth"] = self.engine._journal.depth()
+        return out
+
+    def assert_clean(self, context: str = "") -> None:
+        leaks = {k: v for k, v in self.residue().items() if v != 0}
+        if leaks:
+            where = f" after {context}" if context else ""
+            raise AssertionError(f"leak residue{where}: {leaks}")
+
+    # -- the sweep ------------------------------------------------------
+    def sweep(self, drive: Callable[[str], None],
+              boundaries: Optional[Sequence[str]] = None) -> List[str]:
+        """Arm each boundary in turn, let ``drive(boundary)`` push one
+        request through the fault, then disarm and assert zero residue.
+        Returns the boundaries actually swept (whose fault FIRED — a
+        boundary the drive never reached raises, so a sweep cannot
+        silently skip a layer)."""
+        swept = []
+        for boundary in (boundaries or self.boundaries()):
+            before = self.fired
+            self.arm(boundary)
+            try:
+                drive(boundary)
+            finally:
+                self.disarm()
+            if self.fired == before:
+                raise AssertionError(
+                    f"leaksweep: fault at {boundary!r} never fired — "
+                    f"the drive did not reach this boundary")
+            self.assert_clean(context=boundary)
+            swept.append(boundary)
+        return swept
+
+
 class DispatchFailer:
     """Scripted dispatch-level failure for a replica's BatcherService:
     wraps ``submit_sync`` so call *i* consults ``schedule[i]`` before

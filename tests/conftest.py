@@ -54,6 +54,55 @@ def pytest_collection_modifyitems(config, items):
             "allow_thread_exceptions") else strict)
 
 
+# ---------------------------------------------------------------------------
+# leakcheck canary (ISSUE 19): for tests marked ``leakcheck``, every
+# ContinuousBatcher constructed DURING the test is tracked, and any that
+# finished the test cleanly closed must show zero resource residue —
+# pages held by slots, elevated trie pins, adapter pins, staged remote
+# jobs, undelivered handoffs (testing/faults.py LeakSweep.residue). A
+# crashed batcher is exempt (its allocator dies with it; the fleet layer
+# owns that recovery), and a still-open one is a shared module-scoped
+# service whose slots may legitimately be warm. This is the standing
+# version of the leak sweep: every disagg/radix/adapter/chaos test run
+# doubles as a leak regression.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _leak_canary(request):
+    if request.node.get_closest_marker("leakcheck") is None:
+        yield
+        return
+    import weakref
+
+    from seldon_core_tpu.runtime import batcher as _bmod
+
+    created = []
+    real_init = _bmod.ContinuousBatcher.__init__
+
+    def tracking_init(self, *args, **kwargs):
+        real_init(self, *args, **kwargs)
+        created.append(weakref.ref(self))
+
+    _bmod.ContinuousBatcher.__init__ = tracking_init
+    try:
+        yield
+    finally:
+        _bmod.ContinuousBatcher.__init__ = real_init
+        from seldon_core_tpu.testing.faults import LeakSweep
+
+        for ref in created:
+            b = ref()
+            if b is None or b.crashed is not None or not b._closed:
+                continue
+            residue = {k: v for k, v in LeakSweep(b).residue().items()
+                       if v != 0}
+            assert not residue, (
+                f"leakcheck: closed batcher left residue {residue} — an "
+                f"error/shed path dropped a release (see docs/"
+                f"static-analysis.md, leaklint)")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

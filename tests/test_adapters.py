@@ -24,6 +24,8 @@ from seldon_core_tpu.runtime.adapters import (
 from seldon_core_tpu.runtime.batcher import ContinuousBatcher
 from seldon_core_tpu.servers.llmserver import LLMServer
 
+pytestmark = pytest.mark.leakcheck  # conftest leak canary (ISSUE 19)
+
 KW = dict(vocab_size=96, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
           ffn_dim=64, max_seq_len=96)
 RANK = 4
@@ -261,7 +263,8 @@ def test_unknown_adapter_and_class_rejected_at_submit():
 # Multi-tenant suite step, the PR 7/9/10 rebalancing idiom.
 @pytest.mark.parametrize(
     "layout,kv_dtype,seed",
-    [("paged", "bf16", None),
+    [pytest.param("paged", "bf16", None, marks=pytest.mark.slow),
+     # tier-1 870s budget: one rep — paged/int8/seeded is the densest cell
      ("paged", "int8", 1234),
      pytest.param("dense", "bf16", 1234, marks=pytest.mark.slow),
      pytest.param("paged", "bf16", 1234, marks=pytest.mark.slow),

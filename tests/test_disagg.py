@@ -26,6 +26,8 @@ from seldon_core_tpu.runtime.disagg import (
 from seldon_core_tpu.runtime.resilience import ShedError
 from seldon_core_tpu.servers.llmserver import LLMServer
 
+pytestmark = pytest.mark.leakcheck  # conftest leak canary (ISSUE 19)
+
 KW = dict(vocab_size=96, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
           ffn_dim=64, max_seq_len=96)
 
@@ -93,11 +95,11 @@ PROMPTS = [[5, 9, 17], [40, 3, 22, 8, 11, 60, 2, 33, 7, 7, 12, 13],
     pytest.param("int8_server", marks=pytest.mark.slow),
 ])
 @pytest.mark.parametrize("layout", [
-    # tier-1 870s budget: greedy keeps the dense axis here, the paged axis
-    # rides test_remote_prefill_seeded_parity[paged]; the pinned disagg CI
-    # step runs this file unfiltered so the full cross still runs
+    # tier-1 870s budget: the full cross rides the pinned unfiltered
+    # disagg CI step; tier-1 keeps seeded[paged] below plus the greedy
+    # paged anchor test_remote_admission_mid_decode_steps_in_flight
     pytest.param("paged", marks=pytest.mark.slow),
-    "dense",
+    pytest.param("dense", marks=pytest.mark.slow),
 ])
 def test_remote_prefill_greedy_parity(fixt, layout, request):
     """The acceptance bar: prefill-on-slice-A + decode-on-slice-B equals

@@ -299,7 +299,8 @@ def test_remote_annotations_config():
         "seldon.io/grpc-read-timeout": "7000",
     })
     assert cfg == {"retries": 5, "timeout_s": 12.0,
-                   "connect_timeout_s": 0.25, "grpc_timeout_s": 7.0}
+                   "connect_timeout_s": 0.25, "grpc_timeout_s": 7.0,
+                   "wire_format": "json"}
     # garbage/missing values keep defaults
     cfg = config_from_annotations({"seldon.io/rest-read-timeout": "soon"})
     assert cfg["timeout_s"] == 5.0 and cfg["retries"] == 3

@@ -147,6 +147,7 @@ def test_span_tree_per_request(server, enabled_tracer, layout, seeded):
             assert c.end <= root.end + 1e-6
 
 
+@pytest.mark.slow  # tier-1 870s budget: runs in CI's unfiltered tracing step
 def test_span_tree_disaggregated(disagg_server, enabled_tracer):
     ctxs = [TraceContext.from_traceparent(None, ingress="grpc:GenerateStream")
             for _ in PROMPTS]

@@ -1,0 +1,568 @@
+"""leaklint self-tests: every rule proven against a minimal reconstruction
+of the leak class it exists to catch (the PR 19 burn-down: the PR 7
+shed-mid-snapshot page leak, the PR 12 cow-source-pin double free, the
+PR 15 staged-shed adapter-pin leak, the PR 16 journal-entry lifetime),
+plus the suppression / baseline mechanics the CI gate relies on.
+
+Tier-1 and stdlib-only, like tests/test_racelint.py: every fixture is a
+synthetic tree under tmp_path and the CLI subprocess tests run in tens of
+milliseconds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.graftlint.core import save_baseline
+from tools.leaklint import RULES, run_lint, run_lint_parallel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "leaklint", "baseline.json")
+
+
+def write_tree(root, files):
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(root)
+
+
+def lint(path, baseline=None, rules=None):
+    return run_lint([path], baseline_path=baseline, rules=rules)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.leaklint", *args],
+        capture_output=True, text=True, cwd=cwd)
+
+
+# ---------------------------------------------------------------------------
+# leak-on-path: the PR 7 / PR 15 / PR 16 reconstructions
+# ---------------------------------------------------------------------------
+
+# the PR 7 shape: admission takes prefix pins, the allocation-failure
+# unwind returns without dropping them
+PR7_PREFIX_PIN = """
+    class Batcher:
+        def _admit(self, req):
+            k0, shared, cow = self._radix.match_and_pin(req.ids, limit=8)
+            if cow is not None:
+                self._allocator.free([cow[0]])
+            fresh = self._allocator.alloc(4)
+            if fresh is None:
+                return False
+            self._commit_slot(fresh, shared)
+            return True
+"""
+
+PR7_FIXED = PR7_PREFIX_PIN.replace(
+    "            if fresh is None:\n"
+    "                return False",
+    "            if fresh is None:\n"
+    "                self._allocator.free(shared)\n"
+    "                return False")
+
+
+def test_pr7_prefix_pin_leak_fires(tmp_path):
+    """The burn-down bug: the exhaustion unwind returns with the
+    match_and_pin prefix pins still held."""
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": PR7_PREFIX_PIN})
+    reported, _, _ = lint(root)
+    leaks = [f for f in reported if f.rule == "leak-on-path"]
+    assert leaks, "the pre-fix unwind must fire"
+    assert any("shared" in f.message for f in leaks)
+
+
+def test_pr7_fixed_unwind_is_clean(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": PR7_FIXED})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+# the PR 15 shape: the staged-shed path drops the request but not the
+# adapter pin resolve_and_pin took at submit
+PR15_ADAPTER_PIN = """
+    class Batcher:
+        def _admit_staged(self, req):
+            aid = self._adapters.resolve_and_pin(req.adapter)
+            slot = self.find_slot()
+            if slot is None:
+                return False
+            self._commit_slot(slot, aid)
+            return True
+"""
+
+PR15_FIXED = PR15_ADAPTER_PIN.replace(
+    "            if slot is None:\n"
+    "                return False",
+    "            if slot is None:\n"
+    "                self._adapters.unpin(aid)\n"
+    "                return False")
+
+
+def test_pr15_staged_shed_pin_leak_fires(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": PR15_ADAPTER_PIN})
+    reported, _, _ = lint(root)
+    leaks = [f for f in reported if f.rule == "leak-on-path"]
+    assert leaks
+    assert any("aid" in f.message for f in leaks)
+
+
+def test_pr15_fixed_shed_is_clean(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": PR15_FIXED})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+# the PR 16 shape: a journal entry recorded before a raising dispatch,
+# discarded only on the success path
+PR16_JOURNAL = """
+    class Fleet:
+        def _fleet_submit(self, prompt):
+            jid = self._journal.record(prompt)
+            self._pool.submit(prompt)
+            self._journal.discard(jid)
+"""
+
+PR16_FIXED = """
+    class Fleet:
+        def _fleet_submit(self, prompt):
+            jid = self._journal.record(prompt)
+            try:
+                self._pool.submit(prompt)
+            finally:
+                self._journal.discard(jid)
+"""
+
+
+def test_pr16_journal_entry_leak_fires_on_raise_path(tmp_path):
+    """``submit`` is a registered raising call: the exception edge leaves
+    the function with the journal entry still recorded."""
+    root = write_tree(tmp_path / "pkg", {"runtime/eng.py": PR16_JOURNAL})
+    reported, _, _ = lint(root)
+    leaks = [f for f in reported if f.rule == "leak-on-path"]
+    assert leaks
+    assert any("jid" in f.message for f in leaks)
+
+
+def test_pr16_try_finally_is_clean(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"runtime/eng.py": PR16_FIXED})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_raise_exit_is_never_exempt_even_in_an_acquirer(tmp_path):
+    """Held-at-normal-exit is exempt inside registered acquirer names
+    (they RETURN the obligation); held-at-raise-exit never is."""
+    src = """
+        class Pool:
+            def _alloc_pages(self, n):
+                pages = self._allocator.alloc(n)
+                self._pool.submit(n)
+                return pages
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/pool.py": src})
+    reported, _, _ = lint(root)
+    assert "leak-on-path" in rules_of(reported)
+
+
+def test_rebind_while_held_is_a_leak(tmp_path):
+    """Loop re-acquire without releasing the previous binding: the old
+    obligation becomes unreachable the moment the name rebinds."""
+    src = """
+        class Pool:
+            def fill(self, n):
+                pages = self._allocator.alloc(n)
+                pages = self._allocator.alloc(n)
+                self._allocator.free(pages)
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/pool.py": src})
+    reported, _, _ = lint(root)
+    leaks = [f for f in reported if f.rule == "leak-on-path"]
+    assert leaks
+    assert any("rebound" in f.message for f in leaks)
+
+
+def test_none_guard_refines_away_the_maybe_obligation(tmp_path):
+    """``alloc`` may return None; a release under ``is not None`` plus a
+    bare return on the None arm is exactly balanced — no false positive."""
+    src = """
+        class Pool:
+            def use(self, n):
+                pages = self._allocator.alloc(n)
+                if pages is None:
+                    return False
+                self.write(pages)
+                self._allocator.free(pages)
+                return True
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/pool.py": src})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_release_on_both_branches_is_clean(tmp_path):
+    src = """
+        class Pool:
+            def use(self, n, fast):
+                pages = self._allocator.alloc(n)
+                if fast:
+                    self._allocator.free(pages)
+                else:
+                    self._allocator.free(pages)
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/pool.py": src})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+# ---------------------------------------------------------------------------
+# double-release: the PR 12 reconstruction
+# ---------------------------------------------------------------------------
+
+# the PR 12 shape: the cow-source pin freed by the copy path AND again by
+# the unwind
+PR12_COW = """
+    class Batcher:
+        def _admit(self, req):
+            k0, shared, cow = self._radix.match_and_pin(req.ids, limit=8)
+            if cow is not None:
+                self.copy_page(cow[0])
+                self._allocator.free([cow[0]])
+            self._allocator.free(shared)
+            if cow is not None:
+                self._allocator.free([cow[0]])
+"""
+
+
+def test_pr12_cow_double_free_fires(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": PR12_COW})
+    reported, _, _ = lint(root)
+    assert "double-release" in rules_of(reported)
+
+
+def test_pr12_single_free_is_clean(tmp_path):
+    fixed = PR12_COW.replace(
+        "            if cow is not None:\n"
+        "                self._allocator.free([cow[0]])\n",
+        "", 1)
+    # keep the SECOND guard block (free after the copy) — order of the
+    # replace above removes the first; re-add the copy without its free
+    fixed = """
+        class Batcher:
+            def _admit(self, req):
+                k0, shared, cow = self._radix.match_and_pin(req.ids, limit=8)
+                if cow is not None:
+                    self.copy_page(cow[0])
+                    self._allocator.free([cow[0]])
+                self._allocator.free(shared)
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": fixed})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_retain_refcount_allows_matching_frees(tmp_path):
+    """``retain`` adds one reference on top of the caller's: one retain,
+    one extra free is balanced — a third free is a double release."""
+    ok = """
+        class Pool:
+            def share(self, n):
+                pages = self._allocator.alloc(n)
+                self._allocator.retain(pages)
+                self._allocator.free(pages)
+                self._allocator.free(pages)
+    """
+    bad = ok.replace(
+        "                self._allocator.free(pages)\n"
+        "                self._allocator.free(pages)\n",
+        "                self._allocator.free(pages)\n" * 3)
+    root = write_tree(tmp_path / "pkg", {"runtime/pool.py": ok})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+    root = write_tree(tmp_path / "pkg2", {"runtime/pool.py": bad})
+    reported, _, _ = lint(root)
+    assert "double-release" in rules_of(reported)
+
+
+# ---------------------------------------------------------------------------
+# transfer-then-use
+# ---------------------------------------------------------------------------
+
+STAGED_USE = """
+    class Worker:
+        def _stage(self, h):
+            staged = self._export_pages(h)
+            self._queue.put(staged)
+            staged.commit()
+"""
+
+
+def test_use_after_consuming_transfer_fires(tmp_path):
+    """``put`` hands the staged buffer to the consumer thread; touching
+    it afterwards races the import on the other side."""
+    root = write_tree(tmp_path / "pkg", {"runtime/dis.py": STAGED_USE})
+    reported, _, _ = lint(root)
+    assert "transfer-then-use" in rules_of(reported)
+
+
+def test_use_before_transfer_is_clean(tmp_path):
+    fixed = """
+        class Worker:
+            def _stage(self, h):
+                staged = self._export_pages(h)
+                staged.commit()
+                self._queue.put(staged)
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/dis.py": fixed})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_nonconsuming_transfer_allows_later_use(tmp_path):
+    """``insert`` (radix) and ``_commit_slot`` share, they don't move —
+    the caller may keep using the pages it inserted."""
+    src = """
+        class Batcher:
+            def _admit(self, req):
+                pages = self._allocator.alloc(4)
+                self._radix.insert(req.ids, pages)
+                self.write(pages)
+                self._allocator.free(pages)
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": src})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+# ---------------------------------------------------------------------------
+# unregistered-acquirer
+# ---------------------------------------------------------------------------
+
+def test_returning_an_obligation_from_unregistered_name_fires(tmp_path):
+    """A helper that returns freshly acquired pages mints an acquire site
+    the registry doesn't know — callers' obligations become invisible.
+    Renaming it to a registered acquirer name (or registering it) fixes
+    the escape hatch."""
+    bad = """
+        class Pool:
+            def grab_pages(self, n):
+                return self._allocator.alloc(n)
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/pool.py": bad})
+    reported, _, _ = lint(root)
+    assert "unregistered-acquirer" in rules_of(reported)
+
+    ok = bad.replace("def grab_pages", "def _alloc_pages")
+    root = write_tree(tmp_path / "pkg2", {"runtime/pool.py": ok})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_scoped_to_runtime_dirs(tmp_path):
+    """Like racelint, the walk only analyzes the concurrent-runtime
+    subtree — a script outside it may hold resources to its exit."""
+    root = write_tree(tmp_path / "pkg", {
+        "tools_local/script.py": PR7_PREFIX_PIN,
+        "runtime/adm.py": PR7_PREFIX_PIN,
+    })
+    reported, _, _ = lint(root)
+    assert reported
+    assert all("runtime/adm.py" in f.path.replace(os.sep, "/")
+               for f in reported)
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason_silences(tmp_path):
+    # findings anchor at the ACQUIRE line — the suppression goes there
+    # (or on the line above), exactly like the live batcher suppression
+    src = PR15_ADAPTER_PIN.replace(
+        "            aid = self._adapters.resolve_and_pin(req.adapter)",
+        "            # leaklint: allow-leak-on-path(reconstruction fixture: the caller owns the pin)\n"
+        "            aid = self._adapters.resolve_and_pin(req.adapter)")
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": src})
+    reported, _, suppressed = lint(root)
+    assert rules_of(reported) == []
+    assert len(suppressed) >= 1
+
+
+def test_suppression_with_empty_reason_is_a_finding(tmp_path):
+    src = PR15_ADAPTER_PIN.replace(
+        "            aid = self._adapters.resolve_and_pin(req.adapter)",
+        "            aid = self._adapters.resolve_and_pin(req.adapter)"
+        "  # leaklint: allow-leak-on-path()")
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": src})
+    reported, _, _ = lint(root)
+    assert "bad-suppression" in rules_of(reported)
+    assert "leak-on-path" in rules_of(reported)  # NOT silenced
+
+
+def test_unknown_rule_suppression_is_flagged(tmp_path):
+    src = PR15_ADAPTER_PIN.replace(
+        "                return False",
+        "                return False  # leaklint: allow-made-up-rule(nope)", 1)
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": src})
+    reported, _, _ = lint(root)
+    assert "bad-suppression" in rules_of(reported)
+
+
+def test_racelint_tag_does_not_silence_leaklint(tmp_path):
+    """The layers answer to different comment tags by construction."""
+    src = PR15_ADAPTER_PIN.replace(
+        "                return False",
+        "                return False  # racelint: allow-leak-on-path(wrong tool)", 1)
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": src})
+    reported, _, _ = lint(root)
+    assert "leak-on-path" in rules_of(reported)
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+def test_baseline_absorbs_then_dies_with_the_code(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": PR15_ADAPTER_PIN})
+    reported, _, _ = lint(root)
+    findings = [f for f in reported if f.rule in RULES]
+    assert findings
+    bpath = str(tmp_path / "baseline.json")
+    save_baseline(bpath, findings)
+    data = json.loads(open(bpath).read())
+    for e in data["entries"]:
+        e["reason"] = "grandfathered for the mechanics test"
+    with open(bpath, "w") as f:
+        json.dump(data, f)
+
+    reported2, absorbed, _ = lint(root, baseline=bpath)
+    assert rules_of(reported2) == []
+    assert len(absorbed) == len(findings)
+
+    # touch the fingerprinted (acquire) line: the entry dies, the
+    # finding resurfaces
+    mutated = PR15_ADAPTER_PIN.replace(
+        "resolve_and_pin(req.adapter)", "resolve_and_pin(req.name)")
+    write_tree(tmp_path / "pkg", {"runtime/adm.py": mutated})
+    reported3, _, _ = lint(root, baseline=bpath)
+    assert "leak-on-path" in rules_of(reported3)
+
+
+def test_baseline_without_reason_is_rejected(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": PR15_ADAPTER_PIN})
+    reported, _, _ = lint(root)
+    bpath = str(tmp_path / "baseline.json")
+    save_baseline(bpath, [f for f in reported if f.rule in RULES])
+    data = json.loads(open(bpath).read())
+    data["entries"][0]["reason"] = "  "
+    with open(bpath, "w") as f:
+        json.dump(data, f)
+    with pytest.raises(ValueError, match="no reason"):
+        lint(root, baseline=bpath)
+
+
+def test_real_tree_has_zero_unsuppressed_findings():
+    """The gate itself: the shipped tree + shipped baseline lint clean.
+    The PR 19 burn-down fixed every real finding instead of baselining
+    it; the one live suppression carries a reviewable reason."""
+    reported, absorbed, _ = run_lint(
+        [os.path.join(REPO, "seldon_core_tpu")],
+        baseline_path=BASELINE if os.path.exists(BASELINE) else None)
+    assert reported == [], "\n".join(f.render() for f in reported)
+    assert absorbed == []  # nothing grandfathered — keep it that way
+
+
+def test_real_baseline_count_only_decreases():
+    """The ratchet: the leaklint baseline shipped EMPTY. It must stay
+    empty — growing it means shipping a known leak; fix it or suppress
+    it inline with a reason a reviewer can judge."""
+    with open(BASELINE) as f:
+        data = json.load(f)
+    assert len(data.get("entries", [])) <= 0
+    for e in data.get("entries", []):
+        assert str(e.get("reason", "")).strip(), f"reason missing: {e}"
+
+
+# ---------------------------------------------------------------------------
+# CLI + parallel runner
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes_and_json(tmp_path):
+    """The acceptance contract: non-zero on EACH mutated fixture class —
+    leak-on-path, double-release, transfer-then-use, unregistered-
+    acquirer, empty-reason suppression — and 0 on a clean tree."""
+    bad = write_tree(tmp_path / "bad", {
+        "runtime/adm.py": PR7_PREFIX_PIN,
+        "runtime/cow.py": PR12_COW,
+        "runtime/dis.py": STAGED_USE,
+        "runtime/pool.py": """
+            class Pool:
+                def grab_pages(self, n):
+                    return self._allocator.alloc(n)
+        """,
+        "runtime/supp.py": PR15_ADAPTER_PIN.replace(
+            "                return False",
+            "                return False  # leaklint: allow-leak-on-path()",
+            1),
+    })
+    ok = write_tree(tmp_path / "ok", {"runtime/c.py": "X = 1\n"})
+
+    r = cli(bad, "--no-baseline", "--format", "json")
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    seen = {f["rule"] for f in payload["findings"]}
+    assert {"leak-on-path", "double-release", "transfer-then-use",
+            "unregistered-acquirer", "bad-suppression"} <= seen
+
+    # each rule's gate bites solo too
+    for rule in RULES:
+        assert cli(bad, "--no-baseline", "--rules", rule).returncode == 1, rule
+
+    assert cli(ok, "--no-baseline").returncode == 0
+    assert cli(str(tmp_path / "missing")).returncode == 2
+    assert cli(bad, "--rules", "not-a-rule").returncode == 2
+
+
+def test_cli_real_tree_is_the_gate():
+    r = cli("seldon_core_tpu/")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.slow  # tier-1 870s budget: runs in CI's unfiltered leaklint proofs step
+def test_parallel_matches_serial(tmp_path):
+    root = write_tree(tmp_path / "pkg", {
+        "runtime/adm.py": PR7_PREFIX_PIN,
+        "runtime/cow.py": PR12_COW,
+        "runtime/bad_supp.py": PR15_ADAPTER_PIN.replace(
+            "                return False",
+            "                return False  # leaklint: allow-leak-on-path()",
+            1),
+    })
+    serial = run_lint([root])
+    parallel = run_lint_parallel([root], None, None, jobs=4)
+    for s, p in zip(serial, parallel):
+        assert [(f.rule, f.path, f.line) for f in s] == \
+            [(f.rule, f.path, f.line) for f in p]
+    # meta findings (the empty-reason suppression) appear exactly once
+    assert sum(1 for f in parallel[0] if f.rule == "bad-suppression") == 1
+
+
+def test_rules_filter(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"runtime/cow.py": PR12_COW})
+    reported, _, _ = lint(root, rules=["leak-on-path"])
+    assert [f for f in reported if f.rule == "double-release"] == []
+    reported, _, _ = lint(root, rules=["double-release"])
+    assert [f for f in reported if f.rule == "double-release"]

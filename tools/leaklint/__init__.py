@@ -1,0 +1,14 @@
+"""leaklint — resource-ownership lifecycle analysis.
+
+The fourth enforcing static-analysis layer: a declared effect registry
+(tools/leaklint/effects.py) plus a per-function CFG ownership walk
+(tools/leaklint/checkers.py) proving every acquired resource — KV
+pages, allocator refs, adapter pins, prefix pins, staged export
+buckets, resume-journal entries — is released or ownership-transferred
+on every path, including every exception edge. See
+docs/static-analysis.md for the layer catalog and rule reference.
+"""
+
+from tools.leaklint.core import RULES, run_lint, run_lint_parallel
+
+__all__ = ["RULES", "run_lint", "run_lint_parallel"]

@@ -1,0 +1,286 @@
+"""Per-function control-flow graphs for the ownership walk.
+
+One node per simple statement (or branch/loop header); edges carry a
+label the walker interprets:
+
+- ``("n", None)`` — normal fall-through.
+- ``("t"/"f", refine)`` — branch taken/not-taken; ``refine`` is
+  ``(varname, none_branch)`` when the test is a recognizable None/truth
+  check, so the walker can kill a maybe-None obligation on the branch
+  where the acquire returned nothing.
+- ``("x", None)`` — exception edge. Added only from explicit ``raise``
+  statements, ``assert``s, and calls in the registry's declared
+  ``RAISING_CALLS`` set: giving *every* call an exception edge would
+  flag cleanup no real fault path needs (nothing guards against
+  MemoryError), which is exactly the noise that kills a lint layer.
+- ``("loop", None)`` — a back edge to a loop header (``continue`` or
+  body fall-through); the walker treats a still-held obligation
+  acquired inside the loop as leaked there (the next iteration rebinds
+  the name over a live resource).
+
+Two pseudo-targets: ``CFG.EXIT`` (return / fall-off) and ``CFG.RAISE``
+(exception leaving the function). ``try/finally`` routes returns and
+uncaught exceptions through the finally body via a synthetic join node
+that fans back out to only the exit kinds actually routed through it —
+an over-approximation (a path through finally may continue to an exit
+another path owned), but one that merges, never drops, discharge
+obligations.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+__all__ = ["CFG", "Node", "build_cfg", "refine_of"]
+
+EXIT = -1    # normal exit (return / fall off the end)
+RAISE = -2   # exceptional exit
+
+_BROAD_HANDLERS = ("Exception", "BaseException")
+
+
+class Node:
+    __slots__ = ("i", "stmt", "succ", "tag")
+
+    def __init__(self, i: int, stmt: Optional[ast.AST], tag: str = ""):
+        self.i = i
+        self.stmt = stmt
+        self.succ: List[Tuple[int, Tuple[str, Optional[tuple]]]] = []
+        self.tag = tag  # "" | "branch" | "loop" | "assert" | "join"
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0) or 0
+
+
+class CFG:
+    EXIT = EXIT
+    RAISE = RAISE
+
+    def __init__(self):
+        self.nodes: List[Node] = []
+        self.entry: int = EXIT
+
+
+def refine_of(test: ast.AST):
+    """Branch-refinement atoms for a condition: a tuple of
+    ``(edge_label, varname, is_none)`` saying that on the ``edge_label``
+    ("t"/"f") side of the branch, ``varname`` is known None/falsy
+    (``is_none=True`` — a maybe-None acquire acquired nothing) or known
+    non-None (``is_none=False``). Compound tests decompose one-sidedly:
+    every conjunct of an ``and`` is known true on the taken edge, every
+    disjunct of an ``or`` known false on the not-taken edge. Returns
+    None when nothing is recognizable."""
+    atoms = _refine_atoms(test)
+    return tuple(atoms) or None
+
+
+def _refine_atoms(test: ast.AST):
+    if isinstance(test, ast.Name):
+        # `if x:` — falsy on the f edge
+        return [("f", test.id, True), ("t", test.id, False)]
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return [(("f" if e == "t" else "t"), v, k)
+                for e, v, k in _refine_atoms(test.operand)]
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.left, ast.Name) \
+            and isinstance(test.comparators[0], ast.Constant) \
+            and test.comparators[0].value is None:
+        if isinstance(test.ops[0], (ast.Is, ast.Eq)):
+            # `if x is None:`
+            return [("t", test.left.id, True), ("f", test.left.id, False)]
+        if isinstance(test.ops[0], (ast.IsNot, ast.NotEq)):
+            return [("f", test.left.id, True), ("t", test.left.id, False)]
+    if isinstance(test, ast.BoolOp):
+        # and: all operands true on the t edge; or: all false on the f
+        # edge. The opposite edge proves nothing about any operand.
+        keep = "t" if isinstance(test.op, ast.And) else "f"
+        out = []
+        for operand in test.values:
+            out.extend(a for a in _refine_atoms(operand) if a[0] == keep)
+        return out
+    return []
+
+
+def _is_true_const(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and test.value is True
+
+
+class _Builder:
+    def __init__(self, can_raise):
+        self.cfg = CFG()
+        self.can_raise = can_raise  # stmt -> bool (declared raising call?)
+
+    def node(self, stmt, tag="") -> Node:
+        n = Node(len(self.cfg.nodes), stmt, tag)
+        self.cfg.nodes.append(n)
+        return n
+
+    # ctx keys:
+    #   exc      -> (handler_entries, broad, outer_ctx_for_handlers) | None
+    #   on_exc   -> target id for an uncaught exception (RAISE or a
+    #               finally entry)
+    #   on_return-> target id for `return` (EXIT or a finally entry)
+    #   brk/cont -> loop targets (possibly routed through a finally)
+    #   fin      -> the innermost finally's pending-kind recorder (set)
+    def build(self, fn: ast.AST) -> CFG:
+        ctx = {"exc": None, "on_exc": RAISE, "on_return": EXIT,
+               "brk": None, "cont": None, "fin": None}
+        self.cfg.entry = self.seq(fn.body, EXIT, ctx)
+        return self.cfg
+
+    def seq(self, stmts, follow: int, ctx) -> int:
+        entry = follow
+        for stmt in reversed(stmts):
+            entry = self.one(stmt, entry, ctx)
+        return entry
+
+    def _exc_edges(self, n: Node, ctx) -> None:
+        """Wire the exception successors for a raising statement."""
+        exc = ctx["exc"]
+        if exc is not None:
+            handler_entries, broad = exc
+            for h in handler_entries:
+                n.succ.append((h, ("x", None)))
+            if not broad:
+                self._record_fin(ctx, "x")
+                n.succ.append((ctx["on_exc"], ("x", None)))
+        else:
+            self._record_fin(ctx, "x")
+            n.succ.append((ctx["on_exc"], ("x", None)))
+
+    @staticmethod
+    def _record_fin(ctx, kind: str) -> None:
+        if ctx["fin"] is not None:
+            ctx["fin"].add(kind)
+
+    def one(self, stmt, follow: int, ctx) -> int:
+        if isinstance(stmt, ast.If):
+            n = self.node(stmt, "branch")
+            ref = refine_of(stmt.test)
+            then_e = self.seq(stmt.body, follow, ctx)
+            else_e = self.seq(stmt.orelse, follow, ctx)
+            n.succ.append((then_e, ("t", ref)))
+            n.succ.append((else_e, ("f", ref)))
+            return n.i
+
+        if isinstance(stmt, ast.While):
+            n = self.node(stmt, "loop")
+            ref = refine_of(stmt.test)
+            body_ctx = dict(ctx, brk=follow, cont=n.i)
+            body_e = self.seq(stmt.body, n.i, body_ctx)
+            n.succ.append((body_e, ("t", ref)))
+            if not _is_true_const(stmt.test):
+                else_e = self.seq(stmt.orelse, follow, ctx)
+                n.succ.append((else_e, ("f", ref)))
+            return n.i
+
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            n = self.node(stmt, "loop")
+            body_ctx = dict(ctx, brk=follow, cont=n.i)
+            body_e = self.seq(stmt.body, n.i, body_ctx)
+            else_e = self.seq(stmt.orelse, follow, ctx)
+            n.succ.append((body_e, ("t", None)))
+            n.succ.append((else_e, ("f", None)))
+            return n.i
+
+        if isinstance(stmt, ast.Break):
+            n = self.node(stmt)
+            n.succ.append((ctx["brk"] if ctx["brk"] is not None else follow,
+                           ("n", None)))
+            return n.i
+
+        if isinstance(stmt, ast.Continue):
+            n = self.node(stmt)
+            n.succ.append((ctx["cont"] if ctx["cont"] is not None else follow,
+                           ("loop", None)))
+            return n.i
+
+        if isinstance(stmt, ast.Return):
+            n = self.node(stmt)
+            self._record_fin(ctx, "return")
+            n.succ.append((ctx["on_return"], ("n", None)))
+            return n.i
+
+        if isinstance(stmt, ast.Raise):
+            n = self.node(stmt)
+            self._exc_edges(n, ctx)
+            return n.i
+
+        if isinstance(stmt, ast.Assert):
+            n = self.node(stmt, "assert")
+            ref = refine_of(stmt.test)
+            # the surviving edge is the test-true branch
+            n.succ.append((follow, ("t", ref)))
+            self._exc_edges(n, ctx)
+            return n.i
+
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, follow, ctx)
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            n = self.node(stmt)
+            body_e = self.seq(stmt.body, follow, ctx)
+            n.succ.append((body_e, ("n", None)))
+            return n.i
+
+        # simple statement (incl. nested def/class, which the walker
+        # treats as one opaque mention of everything it reads)
+        n = self.node(stmt)
+        n.succ.append((follow, ("n", None)))
+        if self.can_raise(stmt):
+            self._exc_edges(n, ctx)
+        return n.i
+
+    def _try(self, stmt: ast.Try, follow: int, ctx) -> int:
+        if stmt.finalbody:
+            # join node fans finally's completion back out to the exit
+            # kinds that were actually routed through it
+            join = self.node(None, "join")
+            pending: set = set()
+            fin_entry = self.seq(stmt.finalbody, join.i, ctx)
+            inner = dict(ctx, on_exc=fin_entry, on_return=fin_entry,
+                         fin=pending)
+            if ctx["brk"] is not None:
+                inner["brk"] = fin_entry  # over-approx: break runs finally
+            if ctx["cont"] is not None:
+                inner["cont"] = fin_entry
+            body_exit = fin_entry
+        else:
+            join = None
+            pending = set()
+            inner = ctx
+            body_exit = follow
+
+        broad = any(
+            h.type is None or (isinstance(h.type, ast.Name)
+                               and h.type.id in _BROAD_HANDLERS)
+            or (isinstance(h.type, ast.Attribute)
+                and h.type.attr in _BROAD_HANDLERS)
+            for h in stmt.handlers)
+        handler_entries = [self.seq(h.body, body_exit, inner)
+                           for h in stmt.handlers]
+
+        body_ctx = dict(inner, exc=(handler_entries, broad)) \
+            if stmt.handlers else inner
+        # else-body runs after a clean try body, before finally
+        post_body = self.seq(stmt.orelse, body_exit, inner) \
+            if stmt.orelse else body_exit
+        entry = self.seq(stmt.body, post_body, body_ctx)
+
+        if join is not None:
+            pending.add("n")  # clean completion always reaches follow
+            join.succ.append((follow, ("n", None)))
+            if "x" in pending:
+                self._exc_edges(join, ctx)
+            if "return" in pending:
+                self._record_fin(ctx, "return")
+                join.succ.append((ctx["on_return"], ("n", None)))
+        return entry
+
+
+def build_cfg(fn: ast.AST, can_raise) -> CFG:
+    """``fn`` is a FunctionDef/AsyncFunctionDef; ``can_raise(stmt)``
+    says whether a simple statement carries a declared raising call."""
+    return _Builder(can_raise).build(fn)

@@ -1,0 +1,524 @@
+"""leaklint checkers: the path-sensitive ownership walk.
+
+For every function in scope (modules under a ``runtime/`` directory —
+the resource managers and their callers), build the CFG
+(tools/leaklint/cfg.py) and push obligation states along every edge.
+An *obligation* is one acquired resource bound to a local name; its
+status moves through:
+
+- ``held``      — acquired, not yet discharged; a leak if it reaches an
+                  exit edge like this.
+- ``escaped``   — the name was mentioned somewhere the walk can't model
+                  (passed to an unregistered call, stored on an object,
+                  interpolated). Deliberately treated as discharged: the
+                  layer's contract is catching the *raise-before-first-
+                  use* shape (every historical leak), not full alias
+                  analysis, and staying quiet on the live tree is what
+                  keeps the gate enforceable.
+- ``released``  — a registered release ran; another release is
+                  ``double-release``.
+- ``moved``     — consuming transfer (queue publication, pool submit);
+                  any later mention is ``transfer-then-use``.
+- ``shared``    — in-place ownership transfer (``_commit_slot``, trie
+                  ``insert``): reads stay legal, a release afterwards is
+                  ``double-release``.
+
+Refcounts fall out of multi-obligation bookkeeping: ``retain(pages)``
+adds a *second* obligation on ``pages``, so two ``free`` calls are
+legal and the third is a ``double-release``.
+
+Exception edges carry the PRE-state of the raising statement (the call
+did not complete), so ``except: retry`` around a declared-raising
+transfer is clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint.core import (
+    Finding,
+    Module,
+    Project,
+    dotted,
+    iter_functions,
+    snippet_at,
+)
+from tools.leaklint.cfg import CFG, Node, build_cfg
+from tools.leaklint.effects import (
+    ACQUIRE_BY_NAME,
+    ACQUIRER_NAMES,
+    RAISING_CALLS,
+    RELEASE_BY_NAME,
+    TRANSFER_BY_NAME,
+    Acquire,
+)
+
+__all__ = ["SCOPE_DIRS", "check_project"]
+
+# Only modules under a runtime/ directory hold ownership logic; scanning
+# transport/metrics/testing would only manufacture escape noise.
+SCOPE_DIRS = ("runtime",)
+
+HELD, ESCAPED, RELEASED, MOVED, SHARED = (
+    "held", "escaped", "released", "moved", "shared")
+
+# obligation tuple layout: (oid, name, resource, maybe_none, status, line)
+OID, NAME, RES, MAYBE, STATUS, LINE = range(6)
+
+# Functions that legitimately still hold obligations at a *normal* exit:
+# the registered acquirers (returning live resources is their contract)
+# and the registered transfer sites (held-at-exit is the bookkeeping
+# they take over). A raise-exit with a held obligation is a leak even
+# in these.
+_EXIT_EXEMPT = ACQUIRER_NAMES | frozenset(TRANSFER_BY_NAME)
+
+# names whose presence in a function makes it worth walking at all
+_TRACKED_ACQUIRE_NAMES = frozenset(
+    a.name for a in ACQUIRE_BY_NAME.values() if a.tracked)
+
+_STATE_BUDGET = 40000  # per-function state-visit cap (explosion guard)
+
+
+def _callee(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _hint_ok(spec, call: ast.Call) -> bool:
+    hint = getattr(spec, "recv_hint", None)
+    if not hint:
+        return True
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    recv = dotted(call.func.value) or ""
+    return hint in recv
+
+
+def _arg_names(call: ast.Call) -> List[str]:
+    """Base Name ids mentioned anywhere in the call's arguments (so
+    ``free([cow[0]])`` matches the obligation bound to ``cow``)."""
+    out: List[str] = []
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        for n in ast.walk(a):
+            if isinstance(n, ast.Name) and n.id not in out:
+                out.append(n.id)
+    return out
+
+
+def _arg_name_node_ids(call: ast.Call) -> Set[int]:
+    out: Set[int] = set()
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        for n in ast.walk(a):
+            if isinstance(n, ast.Name):
+                out.add(id(n))
+    return out
+
+
+class _FunctionWalk:
+    def __init__(self, module: Module, qualname: str, fn: ast.AST):
+        self.module = module
+        self.qualname = qualname
+        self.bare_name = qualname.rsplit(".", 1)[-1]
+        self.fn = fn
+        self.findings: List[Finding] = []
+        self._emitted: Set[tuple] = set()
+
+    # ------------------------------------------------------------------
+    # findings
+    # ------------------------------------------------------------------
+
+    def _emit(self, rule: str, line: int, message: str, key: tuple) -> None:
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        self.findings.append(Finding(
+            rule, self.module.relpath, line, message, self.qualname,
+            snippet_at(self.module, line)))
+
+    # ------------------------------------------------------------------
+    # state ops (state = sorted tuple of obligation tuples)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _with(ob: tuple, **kw) -> tuple:
+        lst = list(ob)
+        for k, v in kw.items():
+            lst[{"maybe_none": MAYBE, "status": STATUS}[k]] = v
+        return tuple(lst)
+
+    def _mention(self, name: str, st: List[tuple], node: Node) -> None:
+        for i, ob in enumerate(st):
+            if ob[NAME] != name:
+                continue
+            if ob[STATUS] == HELD:
+                st[i] = self._with(ob, status=ESCAPED)
+            elif ob[STATUS] == MOVED:
+                self._emit(
+                    "transfer-then-use", node.line,
+                    f"{ob[RES]} bound to {name!r} was transferred "
+                    f"(line {ob[LINE]} acquire; consuming transfer on an "
+                    "earlier line of this path) and is touched again here",
+                    ("transfer-then-use", ob[OID], node.i))
+
+    def _release(self, call: ast.Call, st: List[tuple], node: Node,
+                 consumed: Set[int]) -> None:
+        consumed |= _arg_name_node_ids(call)
+        for nm in _arg_names(call):
+            # SHARED is releasable: a non-consuming transfer (insert,
+            # _commit_slot) gives the receiver its OWN reference — the
+            # caller's remaining one may still be dropped exactly once
+            live = [i for i, ob in enumerate(st)
+                    if ob[NAME] == nm
+                    and ob[STATUS] in (HELD, ESCAPED, SHARED)]
+            if live:
+                # prefer discharging a still-held obligation
+                order = {HELD: 0, ESCAPED: 1, SHARED: 2}
+                live.sort(key=lambda i: order[st[i][STATUS]])
+                i = live[0]
+                st[i] = self._with(st[i], status=RELEASED)
+                continue
+            done = [ob for ob in st if ob[NAME] == nm
+                    and ob[STATUS] in (RELEASED, MOVED)]
+            if done:
+                ob = done[0]
+                self._emit(
+                    "double-release", node.line,
+                    f"{ob[RES]} bound to {nm!r} is already "
+                    f"{'released' if ob[STATUS] == RELEASED else 'transferred'}"
+                    " on this path; this release is a double free",
+                    ("double-release", ob[OID], node.i))
+
+    def _transfer(self, spec, call: ast.Call, st: List[tuple], node: Node,
+                  consumed: Set[int]) -> None:
+        consumed |= _arg_name_node_ids(call)
+        target = MOVED if spec.consuming else SHARED
+        for nm in _arg_names(call):
+            for i, ob in enumerate(st):
+                if ob[NAME] != nm:
+                    continue
+                if ob[STATUS] in (HELD, ESCAPED):
+                    st[i] = self._with(ob, status=target)
+                elif ob[STATUS] == MOVED:
+                    self._emit(
+                        "transfer-then-use", node.line,
+                        f"{ob[RES]} bound to {nm!r} was already handed off "
+                        "by a consuming transfer on this path; transferring "
+                        "it again races the new owner",
+                        ("transfer-then-use", ob[OID], node.i))
+
+    def _acquire_arg(self, spec: Acquire, call: ast.Call, st: List[tuple],
+                     node: Node, consumed: Set[int], seq: List[int]) -> None:
+        """retain/pin: the obligation lands on the argument names."""
+        consumed |= _arg_name_node_ids(call)
+        for nm in _arg_names(call):
+            st.append(self._new_ob(node, seq, nm, spec.resource, False))
+
+    def _new_ob(self, node: Node, seq: List[int], name: str, resource: str,
+                maybe_none: bool) -> tuple:
+        oid = node.i * 16 + seq[0]
+        seq[0] += 1
+        return (oid, name, resource, maybe_none, HELD, node.line)
+
+    def _rebind(self, name: str, st: List[tuple], node: Node) -> None:
+        keep = []
+        for ob in st:
+            if ob[NAME] != name:
+                keep.append(ob)
+                continue
+            if ob[STATUS] == HELD:
+                self._emit(
+                    "leak-on-path", ob[LINE],
+                    f"{ob[RES]} acquired at line {ob[LINE]} is still held "
+                    f"when {name!r} is rebound at line {node.line} — the "
+                    "old resource becomes unreachable",
+                    ("leak-on-path", ob[OID]))
+        st[:] = keep
+
+    # ------------------------------------------------------------------
+    # expression scanning (pass A: registered calls; pass B: mentions)
+    # ------------------------------------------------------------------
+
+    def _process(self, exprs: Sequence[Optional[ast.AST]], st: List[tuple],
+                 node: Node, seq: List[int], escape: str = "all") -> None:
+        """``escape``: "all" (every name mention discharges), "callargs"
+        (only names nested inside call arguments — branch tests, so
+        ``if pages is None`` doesn't discharge before refinement), or
+        "none" (raise statements: naming a resource in the exception
+        message is not a discharge)."""
+        exprs = [e for e in exprs if e is not None]
+        consumed: Set[int] = set()
+        in_call_args: Set[int] = set()
+        for e in exprs:
+            for sub in ast.walk(e):
+                if not isinstance(sub, ast.Call):
+                    continue
+                in_call_args |= _arg_name_node_ids(sub)
+                name = _callee(sub)
+                if name in RELEASE_BY_NAME and _hint_ok(
+                        RELEASE_BY_NAME[name], sub):
+                    self._release(sub, st, node, consumed)
+                elif name in TRANSFER_BY_NAME and _hint_ok(
+                        TRANSFER_BY_NAME[name], sub):
+                    self._transfer(TRANSFER_BY_NAME[name], sub, st, node,
+                                   consumed)
+                elif name in ACQUIRE_BY_NAME:
+                    spec = ACQUIRE_BY_NAME[name]
+                    if spec.tracked and spec.binds == "arg" \
+                            and _hint_ok(spec, sub):
+                        self._acquire_arg(spec, sub, st, node, consumed, seq)
+        if escape == "none":
+            return
+        for e in exprs:
+            for sub in ast.walk(e):
+                if isinstance(sub, ast.Name) and id(sub) not in consumed:
+                    if escape == "all" or id(sub) in in_call_args:
+                        self._mention(sub.id, st, node)
+
+    def _acquire_result_spec(self, value: ast.AST) -> Optional[Acquire]:
+        if not isinstance(value, ast.Call):
+            return None
+        spec = ACQUIRE_BY_NAME.get(_callee(value) or "")
+        if spec and spec.tracked and spec.binds == "result" \
+                and _hint_ok(spec, value):
+            return spec
+        return None
+
+    # ------------------------------------------------------------------
+    # statement application
+    # ------------------------------------------------------------------
+
+    def _apply(self, node: Node, state: Tuple[tuple, ...]) -> Tuple[tuple, ...]:
+        stmt = node.stmt
+        st = list(state)
+        seq = [0]
+        if stmt is None:  # finally join
+            return state
+
+        if node.tag in ("branch", "assert") or (
+                node.tag == "loop" and isinstance(stmt, ast.While)):
+            test = stmt.test
+            self._process([test], st, node, seq, escape="callargs")
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._process([stmt.iter], st, node, seq)
+            self._rebind_target(stmt.target, st, node)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._process([item.context_expr], st, node, seq)
+                if item.optional_vars is not None:
+                    self._rebind_target(item.optional_vars, st, node)
+        elif isinstance(stmt, ast.Raise):
+            self._process([stmt.exc, stmt.cause], st, node, seq,
+                          escape="none")
+        elif isinstance(stmt, ast.Return):
+            self._apply_return(stmt, st, node, seq)
+        elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            self._apply_assign(stmt, st, node, seq)
+        elif isinstance(stmt, ast.AugAssign):
+            self._process([stmt.value], st, node, seq)
+            if isinstance(stmt.target, ast.Name):
+                self._mention(stmt.target.id, st, node)
+        else:
+            self._process([stmt], st, node, seq)
+
+        return tuple(sorted(st))
+
+    def _rebind_target(self, target: ast.AST, st: List[tuple],
+                       node: Node) -> None:
+        if isinstance(target, ast.Name):
+            self._rebind(target.id, st, node)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._rebind_target(elt, st, node)
+        elif isinstance(target, ast.Starred):
+            self._rebind_target(target.value, st, node)
+        # attribute/subscript targets store onto an object — out of scope
+
+    def _apply_assign(self, stmt, st: List[tuple], node: Node,
+                      seq: List[int]) -> None:
+        value = stmt.value
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        if value is None:  # bare annotation
+            return
+        spec = self._acquire_result_spec(value)
+        if spec is None:
+            self._process([value], st, node, seq)
+            for t in targets:
+                self._rebind_target(t, st, node)
+            return
+
+        # acquire-assignment: scan the call's arguments, then bind
+        self._process([value], st, node, seq)
+        tgt = targets[0]
+        if isinstance(tgt, ast.Name):
+            self._rebind(tgt.id, st, node)
+            st.append(self._new_ob(node, seq, tgt.id, spec.resource,
+                                   spec.maybe_none))
+        elif isinstance(tgt, (ast.Tuple, ast.List)) and spec.elements:
+            for idx, elt in enumerate(tgt.elts):
+                if not isinstance(elt, ast.Name):
+                    continue
+                self._rebind(elt.id, st, node)
+                if idx in spec.elements:
+                    res, maybe = spec.elements[idx]
+                    st.append(self._new_ob(node, seq, elt.id, res, maybe))
+        else:
+            self._rebind_target(tgt, st, node)
+            # stored straight onto an object/subscript: out of scope
+
+    def _apply_return(self, stmt: ast.Return, st: List[tuple], node: Node,
+                      seq: List[int]) -> None:
+        v = stmt.value
+        if v is None:
+            return
+        spec = self._acquire_result_spec(v)
+        if spec is not None:
+            self._process([v], st, node, seq)
+            if self.bare_name not in ACQUIRER_NAMES:
+                self._emit(
+                    "unregistered-acquirer", node.line,
+                    f"{self.bare_name}() returns a live {spec.resource} "
+                    f"from {spec.name}() but is not a registered acquire "
+                    "site (tools/leaklint/effects.py) — callers' "
+                    "obligations are invisible to the analysis",
+                    ("unregistered-acquirer", node.i))
+            return
+        names: List[str] = []
+        if isinstance(v, ast.Name):
+            names = [v.id]
+        elif isinstance(v, ast.Tuple) and all(
+                isinstance(e, ast.Name) for e in v.elts):
+            names = [e.id for e in v.elts]
+        if not names:
+            self._process([v], st, node, seq)
+            return
+        keep = []
+        for ob in st:
+            if ob[NAME] in names and ob[STATUS] == HELD:
+                if self.bare_name not in ACQUIRER_NAMES:
+                    self._emit(
+                        "unregistered-acquirer", node.line,
+                        f"{self.bare_name}() returns {ob[NAME]!r} holding a "
+                        f"live {ob[RES]} (acquired line {ob[LINE]}) but is "
+                        "not a registered acquire site "
+                        "(tools/leaklint/effects.py)",
+                        ("unregistered-acquirer", ob[OID]))
+                continue  # ownership handed to the caller either way
+            if ob[NAME] in names and ob[STATUS] == MOVED:
+                self._emit(
+                    "transfer-then-use", node.line,
+                    f"{ob[RES]} bound to {ob[NAME]!r} was handed off by a "
+                    "consuming transfer on this path but is returned here",
+                    ("transfer-then-use", ob[OID], node.i))
+            keep.append(ob)
+        st[:] = keep
+
+    # ------------------------------------------------------------------
+    # exits and refinement
+    # ------------------------------------------------------------------
+
+    def _check_exit(self, state, is_raise: bool, node: Node) -> None:
+        for ob in state:
+            if ob[STATUS] != HELD:
+                continue
+            if not is_raise and self.bare_name in _EXIT_EXEMPT:
+                continue
+            how = "the exception path leaving" if is_raise \
+                else "the return path leaving"
+            self._emit(
+                "leak-on-path", ob[LINE],
+                f"{ob[RES]} bound to {ob[NAME]!r} (acquired line "
+                f"{ob[LINE]}) reaches neither a release nor a transfer on "
+                f"{how} line {node.line}",
+                ("leak-on-path", ob[OID]))
+
+    @staticmethod
+    def _refine(state, ref, label):
+        """Apply the branch's refinement atoms (cfg.refine_of): on the
+        edge where a maybe-None acquire is known None, its obligation
+        dies (nothing was acquired); where it is known non-None, the
+        maybe flag clears so later exits report it."""
+        facts = {var: is_none for edge, var, is_none in ref
+                 if edge == label}
+        if not facts:
+            return state
+        out = []
+        for ob in state:
+            if ob[NAME] in facts and ob[MAYBE] and ob[STATUS] == HELD:
+                if facts[ob[NAME]]:
+                    continue  # the acquire returned None: nothing held
+                ob = ob[:MAYBE] + (False,) + ob[MAYBE + 1:]
+            out.append(ob)
+        return tuple(out)
+
+    # ------------------------------------------------------------------
+    # driver
+    # ------------------------------------------------------------------
+
+    def _can_raise(self, stmt: ast.AST) -> bool:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call) and _callee(sub) in RAISING_CALLS:
+                return True
+        return False
+
+    def run(self) -> List[Finding]:
+        present = set()
+        for sub in ast.walk(self.fn):
+            if isinstance(sub, ast.Attribute):
+                present.add(sub.attr)
+            elif isinstance(sub, ast.Name):
+                present.add(sub.id)
+        if not (present & _TRACKED_ACQUIRE_NAMES):
+            return []
+
+        cfg = build_cfg(self.fn, self._can_raise)
+        if cfg.entry in (CFG.EXIT, CFG.RAISE):
+            return []
+        stack = [(cfg.entry, ())]
+        seen: Set[tuple] = set()
+        steps = 0
+        while stack:
+            nid, state = stack.pop()
+            if (nid, state) in seen:
+                continue
+            seen.add((nid, state))
+            steps += 1
+            if steps > _STATE_BUDGET:
+                break
+            node = cfg.nodes[nid]
+            post = self._apply(node, state)
+            for tgt, (kind, ref) in node.succ:
+                prop = state if kind == "x" else post
+                if ref is not None and kind in ("t", "f"):
+                    prop = self._refine(prop, ref, kind)
+                if tgt == CFG.EXIT:
+                    self._check_exit(prop, False, node)
+                elif tgt == CFG.RAISE:
+                    self._check_exit(prop, True, node)
+                else:
+                    stack.append((tgt, prop))
+        return self.findings
+
+
+def in_scope(module: Module) -> bool:
+    return any(part in SCOPE_DIRS for part in module.parts[:-1])
+
+
+def check_project(project: Project,
+                  rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in project.modules:
+        if not in_scope(module):
+            continue
+        for qualname, fn in iter_functions(module.tree):
+            findings.extend(_FunctionWalk(module, qualname, fn).run())
+    if rules is not None:
+        active = set(rules)
+        findings = [f for f in findings if f.rule in active]
+    return findings

@@ -1,0 +1,181 @@
+"""leaklint effect registry: the declared acquire/release/transfer map.
+
+Every host-side resource the serving runtime hands out — KV pool pages,
+allocator refcounts, adapter pins, radix prefix pins, staged export
+buckets, resume-journal entries, retry-budget spends — is acquired at a
+small number of named sites and must be discharged at an equally small
+number of release/transfer sites. This registry DECLARES that map; the
+CFG walk (tools/leaklint/checkers.py) enforces it per function, and the
+dynamic sweep (seldon_core_tpu/testing/faults.py ``LeakSweep``) injects
+a failure at every registered boundary and asserts the counters return
+to baseline.
+
+Matching is by callee attribute name (the last component of the dotted
+call chain): ``self._allocator.alloc(...)``, ``alloc(...)`` and
+``pool.alloc(...)`` all match the ``alloc`` entry. That is deliberate —
+the runtime's resource managers are the only things exposing these
+verbs, and a fixture tree reconstructing a historical leak matches the
+same way the live tree does.
+
+Entries with ``tracked=False`` are registered for the dynamic sweep and
+the docs only — their obligation has no static release site (a retry-
+budget spend is *meant* to be consumed), so the path walk does not
+track them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "Acquire", "Release", "Transfer",
+    "ACQUIRES", "RELEASES", "TRANSFERS",
+    "ACQUIRE_BY_NAME", "RELEASE_BY_NAME", "TRANSFER_BY_NAME",
+    "ACQUIRER_NAMES", "RAISING_CALLS",
+]
+
+
+@dataclass(frozen=True)
+class Acquire:
+    """One acquire site. ``binds`` says where the obligation lands:
+    ``"result"`` (the returned value must be discharged) or ``"arg"``
+    (the call adds a reference/pin to its argument — ``retain``/``pin``).
+    ``maybe_none`` acquires can return None (pool exhausted); the walk
+    kills the obligation on the ``if x is None`` branch. ``elements``
+    maps tuple-result indices to (resource, maybe_none) for unpacking
+    assignments (``k0, pages, cow = cache.match_and_pin(...)``)."""
+
+    name: str
+    resource: str
+    binds: str = "result"          # "result" | "arg"
+    maybe_none: bool = False
+    elements: Optional[Dict[int, Tuple[str, bool]]] = None
+    tracked: bool = True
+    raises: bool = False           # the call itself has a declared raise path
+    # substring the dotted receiver must contain, for generic verbs that
+    # collide with unrelated APIs (`record` vs the flight recorder,
+    # `discard` vs set.discard)
+    recv_hint: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Release:
+    """A discharge site: one reference dropped per call. Applies to every
+    obligation-holding name in the call's arguments (``free(pages)``,
+    ``free([cow[0]])``, ``unpin(aid)``, ``discard(jid)``)."""
+
+    name: str
+    resources: Tuple[str, ...] = ()
+    recv_hint: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Transfer:
+    """An ownership-transfer site. ``consuming=True`` means the value
+    crosses a thread/queue boundary — touching it afterwards is the
+    donation-analog ``transfer-then-use``. ``consuming=False`` transfers
+    bookkeeping ownership in-place (``_commit_slot``, trie ``insert``):
+    later reads are legal, later releases are not."""
+
+    name: str
+    resources: Tuple[str, ...] = ()
+    consuming: bool = True
+    raises: bool = False
+    recv_hint: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# The declared map (docs/static-analysis.md "leaklint" has the prose
+# version; keep the two in sync).
+# ---------------------------------------------------------------------------
+
+ACQUIRES: Tuple[Acquire, ...] = (
+    # PageAllocator.alloc: n pages at refcount 1, all-or-nothing, None on
+    # exhaustion. Discharged by `free` or by ownership transfer (slot
+    # commit, trie insert, handoff publication).
+    Acquire("alloc", "kv-pages", maybe_none=True),
+    # ContinuousBatcher._alloc_pages: alloc with radix-eviction relief —
+    # same contract as alloc (registered so its *callers* are tracked and
+    # its own `return alloc(...)` body is a legal registered acquirer).
+    Acquire("_alloc_pages", "kv-pages", maybe_none=True),
+    # PageAllocator.retain: +1 ref on already-allocated pages (the trie
+    # pinning matched pages into a slot). The obligation lands on the
+    # ARGUMENT: each retained page needs one more `free`.
+    Acquire("retain", "page-ref", binds="arg"),
+    # AdapterRegistry.resolve_and_pin: name -> pinned pool row, raises on
+    # unknown adapter. Discharged by `unpin` / `_unpin_request`.
+    Acquire("resolve_and_pin", "adapter-pin", raises=True),
+    # AdapterRegistry.pin: +1 pin on a resolved row (the argument).
+    Acquire("pin", "adapter-pin", binds="arg"),
+    # RadixPrefixCache.match_and_pin -> (k0, pages, cow): the shared
+    # full-block pages are allocator-retained for the caller, and the COW
+    # source page (cow[0], when cow is not None) carries its own pin.
+    Acquire("match_and_pin", "prefix-pins",
+            elements={1: ("prefix-pins", False), 2: ("cow-pin", True)}),
+    # Dense KV export staging (disagg handoff): the returned bucket owns
+    # device buffers until published through the TransferQueue.
+    Acquire("export_pages", "export-bucket"),
+    Acquire("_export_pages", "export-bucket"),
+    # ResumeJournal.record: one in-flight fleet generation's recovery
+    # entry; discharged by `discard` (the dispatch loop's finally). The
+    # receiver hint keeps the flight recorder's `record()` out of scope.
+    Acquire("record", "journal-entry", recv_hint="journal"),
+    # RetryBudget.take / try_spend: a budget spend is consumed by design —
+    # no static release site. Registered for the dynamic sweep (a raise at
+    # the spend boundary must still unwind the journal) and the docs.
+    Acquire("take", "retry-token", tracked=False),
+    Acquire("try_spend", "retry-token", tracked=False),
+)
+
+RELEASES: Tuple[Release, ...] = (
+    # PageAllocator.free: the ONE uniform decrement for every page release
+    # path (slot teardown, trie eviction, COW-pin drop, shed).
+    Release("free", ("kv-pages", "page-ref", "prefix-pins", "cow-pin")),
+    # AdapterRegistry.unpin / the batcher's pre-commit funnel.
+    Release("unpin", ("adapter-pin",)),
+    Release("_unpin_request", ("adapter-pin",)),
+    # ResumeJournal.discard: the entry's lifetime ends with the dispatch.
+    # Hinted so `set.discard` elsewhere in the runtime is out of scope.
+    Release("discard", ("journal-entry",), recv_hint="journal"),
+)
+
+TRANSFERS: Tuple[Transfer, ...] = (
+    # TransferQueue.put: publication — the handoff now belongs to the
+    # decode side's consume loop. Touching it afterwards races the
+    # consumer (the host-object analog of use-after-donate).
+    Transfer("put", ("export-bucket",), consuming=True),
+    # PrefillWorkerPool.submit: the request (and its decode-side pages)
+    # belongs to the worker until the handoff comes back. submit raises
+    # on a mid-rebalance pool swap, so the retry path is a declared
+    # exception edge (the obligation survives a failed submit).
+    Transfer("submit", ("kv-pages",), consuming=True, raises=True),
+    # ContinuousBatcher._commit_slot: queue-entry ownership (pages +
+    # adapter pin) moves onto the slot; _release_slot discharges it at
+    # the end of the slot's life. In-place: later reads are fine.
+    Transfer("_commit_slot", ("kv-pages", "adapter-pin", "prefix-pins"),
+             consuming=False),
+    # RadixPrefixCache.insert: page ownership transfers node-by-node; the
+    # caller still reads the returned consumed-set against its own lists.
+    Transfer("insert", ("kv-pages",), consuming=False),
+)
+
+ACQUIRE_BY_NAME: Dict[str, Acquire] = {a.name: a for a in ACQUIRES}
+RELEASE_BY_NAME: Dict[str, Release] = {r.name: r for r in RELEASES}
+TRANSFER_BY_NAME: Dict[str, Transfer] = {t.name: t for t in TRANSFERS}
+
+# Functions allowed to RETURN a tracked resource: the registered acquire
+# verbs themselves. Anything else returning a live obligation is an
+# `unregistered-acquirer` — the rule that keeps this registry honest as
+# the tree grows (a new helper that hands out pages must be declared
+# here, which also enrolls it in the dynamic sweep).
+ACQUIRER_NAMES = frozenset(a.name for a in ACQUIRES)
+
+# Calls with a declared exception edge. The walk adds exception edges
+# only from explicit `raise` statements and these names — giving every
+# call an exception edge would drown the tree in paths no real fault
+# takes (and real cleanup cannot guard against MemoryError anyway).
+RAISING_CALLS = frozenset(
+    [a.name for a in ACQUIRES if a.raises]
+    + [t.name for t in TRANSFERS if t.raises]
+)
