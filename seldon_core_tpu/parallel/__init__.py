@@ -4,5 +4,20 @@ from seldon_core_tpu.parallel.sharding import (
     shard_apply,
     shard_params,
 )
+from seldon_core_tpu.parallel.topology import (
+    DECLARED_AXES,
+    Topology,
+    get_topology,
+    set_topology,
+)
 
-__all__ = ["DEFAULT_LOGICAL_RULES", "make_mesh", "shard_apply", "shard_params"]
+__all__ = [
+    "DECLARED_AXES",
+    "DEFAULT_LOGICAL_RULES",
+    "Topology",
+    "get_topology",
+    "make_mesh",
+    "set_topology",
+    "shard_apply",
+    "shard_params",
+]

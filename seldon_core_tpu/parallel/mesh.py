@@ -23,10 +23,14 @@ def make_mesh(
     """Build a Mesh with the given {axis_name: size}. Sizes of -1 are inferred
     from the device count (at most one -1). Axis order is preserved; ICI-heavy
     axes ('model', 'seq') should come last so neighboring devices serve them."""
-    import jax
     from jax.sharding import Mesh
 
-    devices = list(devices if devices is not None else jax.devices())
+    if devices is None:
+        from seldon_core_tpu.parallel.topology import get_topology
+
+        devices = list(get_topology().devices)
+    else:
+        devices = list(devices)
     n = len(devices)
     sizes = dict(axes)
     unknown = [k for k, v in sizes.items() if v == -1]
@@ -60,6 +64,8 @@ class DisaggregatedMesh:
     def __init__(self, prefill_devices: Sequence, decode_devices: Sequence):
         self.prefill_devices = list(prefill_devices)
         self.decode_devices = list(decode_devices)
+        self.prefill_topology = None  # set by attach_topology
+        self.decode_topology = None
         if not self.prefill_devices or not self.decode_devices:
             raise ValueError(
                 f"disaggregated mesh needs >=1 device per role, got "
@@ -74,6 +80,15 @@ class DisaggregatedMesh:
                 "interference disaggregation exists to remove")
         self.prefill = serving_mesh(devices=self.prefill_devices)
         self.decode = serving_mesh(devices=self.decode_devices)
+
+    def attach_topology(self, topo) -> "DisaggregatedMesh":
+        """Give each slice a Topology view of its own devices
+        (parallel/topology.py), so a slice can build further meshes —
+        e.g. tensor parallelism WITHIN the prefill or decode slice —
+        without re-deriving the device world."""
+        self.prefill_topology = topo.sub_topology(self.prefill_devices)
+        self.decode_topology = topo.sub_topology(self.decode_devices)
+        return self
 
     def __repr__(self) -> str:
         return (f"DisaggregatedMesh(prefill={len(self.prefill_devices)}, "
@@ -95,8 +110,6 @@ def disaggregated_mesh(
     ICI/DCN exactly once (parallel/multihost.py
     ``partition_for_disaggregation`` refines the split along physical
     slice boundaries when the platform exposes them)."""
-    import jax
-
     if not isinstance(prefill_devices, int) and not isinstance(
             decode_devices, int):
         return DisaggregatedMesh(prefill_devices, decode_devices)
@@ -104,7 +117,14 @@ def disaggregated_mesh(
     from seldon_core_tpu.parallel.multihost import (
         partition_for_disaggregation)
 
-    devices = list(devices if devices is not None else jax.devices())
+    if devices is None:
+        # the injected process topology, not a fresh jax.devices() — the
+        # split must agree with every other consumer's world view
+        from seldon_core_tpu.parallel.topology import get_topology
+
+        devices = list(get_topology().devices)
+    else:
+        devices = list(devices)
     if not isinstance(prefill_devices, int):
         pre = list(prefill_devices)
         taken = set(map(id, pre))

@@ -88,16 +88,19 @@ def partition_for_disaggregation(devices: Sequence, prefill_count: int):
     otherwise (CPU test mesh, single-slice platforms, ragged counts) the
     split is a plain contiguous tail — device enumeration is slice-major
     on real pods, so the tail is still the "farthest" granule."""
+    from seldon_core_tpu.parallel.topology import physical_slice_map
+
     devices = list(devices)
     n = int(prefill_count)
     if not (0 < n < len(devices)):
         raise ValueError(
             f"prefill_count={n} must leave >=1 decode device out of "
             f"{len(devices)}")
-    if all(hasattr(d, "slice_index") for d in devices):
-        by_slice: Dict[int, list] = {}
-        for d in devices:
-            by_slice.setdefault(d.slice_index, []).append(d)
+    # the declared slice map, not an inline slice_index probe: when it is
+    # None the platform exposes no physical slices and the tail split
+    # below is the declared single-granule behavior, not an accident
+    by_slice = physical_slice_map(devices)
+    if by_slice is not None:
         sizes = {len(v) for v in by_slice.values()}
         if len(by_slice) > 1 and len(sizes) == 1:
             per_slice = sizes.pop()
@@ -125,13 +128,19 @@ def hybrid_mesh(
 
     Sizes of -1 are inferred: at most one per group (ici from per-slice
     device count, dcn from slice count)."""
-    import jax
     from jax.experimental import mesh_utils
     from jax.sharding import Mesh
 
     from seldon_core_tpu.parallel.mesh import make_mesh
+    from seldon_core_tpu.parallel.topology import (
+        get_topology,
+        physical_slice_map,
+    )
 
-    devices = list(devices if devices is not None else jax.devices())
+    if devices is None:
+        devices = list(get_topology().devices)
+    else:
+        devices = list(devices)
     dcn_axes = dict(dcn_axes or {})
     if -1 in dcn_axes.values():
         raise ValueError("dcn axis sizes must be explicit (slice count is not inferable)")
@@ -165,19 +174,20 @@ def hybrid_mesh(
     axis_names = list(dcn_axes.keys()) + list(ici.keys())
     mesh_shape = [1] * len(dcn_axes) + list(ici.values())
     dcn_shape = list(dcn_axes.values()) + [1] * len(ici)
-    if all(hasattr(d, "slice_index") for d in devices):
+    if physical_slice_map(devices) is not None:
         # real multi-slice platform: let mesh_utils group by slice; layout
         # errors here are real errors and must propagate
         mesh_devices = mesh_utils.create_hybrid_device_mesh(
             mesh_shape, dcn_shape, devices=devices, allow_split_physical_axes=True
         )
     else:
-        # Devices without a slice_index attribute (CPU mesh in tests,
-        # single-slice platforms): group contiguously — device enumeration
-        # is slice-major on real pods, so granule = contiguous block.
+        # Declared single-granule fallback (physical_slice_map returned
+        # None: CPU mesh in tests, single-slice platforms): group
+        # contiguously — device enumeration is slice-major on real pods,
+        # so granule = contiguous block.
         import numpy as np
 
-        logger.debug("no slice_index on devices; contiguous hybrid grouping")
+        logger.debug("no physical slice map; contiguous hybrid grouping")
         mesh_devices = np.array(devices).reshape(
             *dcn_axes.values(), *ici.values()
         )

@@ -1054,20 +1054,19 @@ class ContinuousBatcher:
         anchoring the decode role on it means no serving-path array ever
         needs explicit placement — only the prefill workers commit copies
         to their own devices."""
-        import jax
-
-        from seldon_core_tpu.parallel.mesh import disaggregated_mesh
+        from seldon_core_tpu.parallel.topology import get_topology
         from seldon_core_tpu.runtime.disagg import (HandoffReceiver,
                                                     PrefillWorkerPool,
                                                     TransferQueue)
 
         server = self.server
+        topo = getattr(server, "topology", None) or get_topology()
         mesh = disagg_mesh or getattr(server, "disagg_mesh", None)
         if mesh is None:
-            mesh = disaggregated_mesh(
+            mesh = topo.disaggregated(
                 getattr(server, "prefill_devices", 0) or 1,
                 getattr(server, "decode_devices", 0) or 0)
-        default = jax.devices()[0]
+        default = topo.default_device
         if default not in mesh.decode_devices:
             raise ValueError(
                 "the decode slice must contain the process default device "
@@ -1119,19 +1118,17 @@ class ContinuousBatcher:
         process default device — the slot pool lives on it)."""
         if self._remote is None:
             return False
-        import jax
-
-        from seldon_core_tpu.parallel.mesh import disaggregated_mesh
+        from seldon_core_tpu.parallel.topology import get_topology
         from seldon_core_tpu.runtime.disagg import PrefillWorkerPool
 
+        topo = getattr(self.server, "topology", None) or get_topology()
         n_pre = int(prefill_devices)
-        world = jax.devices()
-        if n_pre < 1 or n_pre >= len(world):
+        if n_pre < 1 or n_pre >= topo.device_count:
             return False
         if n_pre == len(self.disagg_mesh.prefill_devices):
             return False
-        mesh = disaggregated_mesh(n_pre, 0)
-        default = world[0]
+        mesh = topo.disaggregated(n_pre, 0)
+        default = topo.default_device
         if default not in mesh.decode_devices:
             return False
         old = self._remote

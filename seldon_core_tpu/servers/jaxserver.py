@@ -41,6 +41,7 @@ class JAXServer(SeldonComponent):
         model_uri: str = "",
         model: Optional[str] = None,
         mesh: Optional[Any] = None,
+        topology: Optional[Any] = None,
         param_sharding_rules: Optional[Any] = None,
         batch_buckets: Optional[Sequence[int]] = None,
         strict_sharding: bool = False,
@@ -53,6 +54,9 @@ class JAXServer(SeldonComponent):
         self.model_uri = model_uri
         self.model_name = model
         self.mesh = mesh
+        # Injected device-world view (parallel/topology.py); None = adopt
+        # the process topology at load() instead of re-deriving it here.
+        self.topology = topology
         self.param_sharding_rules = param_sharding_rules
         self.strict_sharding = strict_sharding
         # Spec-reachable sharding: `tensor_parallel` arrives as a typed unit
@@ -95,16 +99,18 @@ class JAXServer(SeldonComponent):
         self._module = module
 
         if self.mesh is None and self.tensor_parallel > 1:
-            from seldon_core_tpu.parallel.mesh import serving_mesh
+            from seldon_core_tpu.parallel.topology import get_topology
 
-            n = len(jax.devices())
+            self.topology = self.topology or get_topology()
+            n = self.topology.device_count
             if n % self.tensor_parallel:
                 raise SeldonError(
                     f"tensor_parallel={self.tensor_parallel} does not divide "
                     f"{n} available devices",
                     status_code=500,
                 )
-            self.mesh = serving_mesh(model_parallel=self.tensor_parallel)
+            self.mesh = self.topology.serving_mesh(
+                model_parallel=self.tensor_parallel)
 
         params = self._load_params(path)
         param_dtype = self._config.get("param_dtype", self.param_dtype)

@@ -254,6 +254,7 @@ class LLMServer(SeldonComponent):
         len_buckets: Optional[Sequence[int]] = None,
         batch_buckets: Optional[Sequence[int]] = None,
         mesh: Optional[Any] = None,
+        topology: Optional[Any] = None,
         tensor_parallel: int = 0,
         sequence_parallel: int = 0,
         quantize: str = "",
@@ -303,6 +304,11 @@ class LLMServer(SeldonComponent):
         self.len_buckets = tuple(len_buckets or DEFAULT_LEN_BUCKETS)
         self.batch_buckets = tuple(batch_buckets or DEFAULT_BATCH_BUCKETS)
         self.mesh = mesh
+        # The injected device-world view (parallel/topology.py). None =
+        # adopt the process topology at load(); tests and virtual-mesh
+        # harnesses pass their own so the server never re-derives
+        # jax.devices() itself.
+        self.topology = topology
         # Spec-reachable sharding (typed unit parameters, like JAXServer's
         # tensor_parallel): builds a ('data', 'seq', 'model') mesh at load.
         self.tensor_parallel = int(tensor_parallel)
@@ -494,6 +500,14 @@ class LLMServer(SeldonComponent):
 
         from seldon_core_tpu.models import get_model
         from seldon_core_tpu.models.transformer import normalize_kv_cache_dtype
+        from seldon_core_tpu.parallel.topology import get_topology
+
+        # Resolve the device-world view ONCE; everything below (mesh
+        # construction, disagg splits, the batcher's placement defaults)
+        # consumes it instead of re-deriving jax.devices().
+        # racelint: allow-unguarded-shared-state(load()-time config normalization: runs once, before any serving thread or batcher loop exists — nothing can interleave with it)
+        self.topology = self.topology or get_topology()
+        topo = self.topology
 
         # Validate dtype knobs HERE, with a clear ValueError, instead of
         # letting an unknown string explode later inside a jitted cast or
@@ -588,11 +602,11 @@ class LLMServer(SeldonComponent):
                     "with tensor/sequence parallelism or an explicit mesh: "
                     "the batcher's slot pool is single-device per slice — "
                     "shard WITHIN a slice is a follow-up")
-            if self.disagg_mesh is None and len(jax.devices()) < 2:
+            if self.disagg_mesh is None and topo.device_count < 2:
                 raise ValueError(
                     "disaggregation='remote_prefill' needs >= 2 devices "
                     "(one per slice); this process sees "
-                    f"{len(jax.devices())}")
+                    f"{topo.device_count}")
         if self.handoff_transport not in ("", "device", "network"):
             raise ValueError(
                 f"unknown handoff_transport {self.handoff_transport!r}: "
@@ -647,18 +661,16 @@ class LLMServer(SeldonComponent):
             params = _cast_params(params, self.param_dtype, self._cfg.dtype)
 
         if self.mesh is None and (self.tensor_parallel > 1 or self.sequence_parallel > 1):
-            from seldon_core_tpu.parallel.mesh import make_mesh
-
             tp = max(self.tensor_parallel, 1)
             sp = max(self.sequence_parallel, 1)
-            n = len(jax.devices())
+            n = topo.device_count
             if n % (tp * sp):
                 raise SeldonError(
                     f"tensor_parallel={tp} * sequence_parallel={sp} does not "
                     f"divide {n} available devices",
                     status_code=500,
                 )
-            self.mesh = make_mesh({"data": -1, "seq": sp, "model": tp})
+            self.mesh = topo.mesh({"data": -1, "seq": sp, "model": tp})
 
         # quantize BEFORE sharding: shard_params understands QuantizedTensor
         # leaves (q under the weight's logical spec, scale under the channel

@@ -1,0 +1,644 @@
+"""shardlint self-tests: every rule proven red against a minimal
+reconstruction of the discipline violation it exists to catch — a stray
+``jax.devices()``/``Mesh(...)`` outside parallel/, a typo'd mesh axis, a
+provably-overlapping disagg slice pair, an implicit ``devices[0]`` /
+``process_index == 0`` / ``slice_index`` host assumption — plus the
+suppression / baseline mechanics the CI gate relies on, the virtual-mesh
+conformance harness, and the PR 20 burn-down regressions (servers
+consume an injected Topology instead of re-deriving the device world).
+
+The pure-lint tests are stdlib-only synthetic trees under tmp_path, like
+tests/test_leaklint.py; the conformance and burn-down tests compile tiny
+models on the virtual 8-device CPU mesh from conftest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.graftlint.core import save_baseline
+from tools.shardlint import RULES, run_lint, run_lint_parallel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "shardlint", "baseline.json")
+
+# every fixture tree declares ITS OWN registries — the linter reads the
+# scanned tree's parallel/topology.py, not the repo's
+FIXTURE_TOPOLOGY = """
+    DECLARED_AXES = {
+        "data": "batch-parallel",
+        "model": "tensor-parallel",
+        "seq": "sequence-parallel",
+    }
+    SINGLE_HOST_GUARDS = {
+        "detect_world": "the one declared derivation site",
+    }
+    SLICE_CONTRACTS = {
+        "disaggregated_mesh": "validates prefill/decode overlap at runtime",
+    }
+"""
+
+REDERIVE = """
+    import jax
+    from jax.sharding import Mesh
+
+    def build():
+        devs = jax.devices()
+        return Mesh(devs, ("data",))
+"""
+
+TYPO_AXIS = """
+    from jax.sharding import PartitionSpec as P
+
+    def cache_spec():
+        return P("data", "modle")
+"""
+
+OVERLAP_SLICE = """
+    def split(devs):
+        return DisaggregatedMesh(devs[:2], devs[1:])
+"""
+
+HOST_ASSUMPTION = """
+    def pick(devices, topo):
+        lead = devices[0]
+        if topo.process_index == 0:
+            return lead
+        return [d for d in devices if hasattr(d, "slice_index")]
+"""
+
+
+def write_tree(root, files, topology=FIXTURE_TOPOLOGY):
+    files = dict(files)
+    files.setdefault("parallel/topology.py", topology)
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(root)
+
+
+def lint(path, baseline=None, rules=None):
+    return run_lint([path], baseline_path=baseline, rules=rules)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.shardlint", *args],
+        capture_output=True, text=True, cwd=cwd)
+
+
+# ---------------------------------------------------------------------------
+# mesh-rederivation
+# ---------------------------------------------------------------------------
+
+def test_world_derivation_outside_parallel_fires(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"runtime/engine.py": REDERIVE})
+    reported, _, _ = lint(root)
+    hits = [f for f in reported if f.rule == "mesh-rederivation"]
+    assert len(hits) == 2  # jax.devices() AND Mesh(...)
+    assert any("jax.devices()" in f.message for f in hits)
+    assert any("Mesh construction" in f.message for f in hits)
+
+
+def test_same_code_inside_parallel_is_the_declared_site(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"parallel/world.py": REDERIVE})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_mesh_utils_import_outside_parallel_fires(tmp_path):
+    src = """
+        from jax.experimental import mesh_utils
+
+        def grid(n):
+            return mesh_utils.create_device_mesh((n,))
+    """
+    root = write_tree(tmp_path / "pkg", {"servers/grid.py": src})
+    reported, _, _ = lint(root)
+    assert "mesh-rederivation" in rules_of(reported)
+
+
+def test_topology_consumer_is_clean(tmp_path):
+    src = """
+        def build(topo):
+            return topo.mesh({"data": -1, "model": 2})
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/engine.py": src})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+# ---------------------------------------------------------------------------
+# axis-name-discipline
+# ---------------------------------------------------------------------------
+
+def test_typoed_axis_in_partition_spec_fires(tmp_path):
+    """The motivating bug: P("modle") silently REPLICATES instead of
+    sharding — here it goes red against the declared registry."""
+    root = write_tree(tmp_path / "pkg", {"servers/spec.py": TYPO_AXIS})
+    reported, _, _ = lint(root)
+    hits = [f for f in reported if f.rule == "axis-name-discipline"]
+    assert len(hits) == 1  # the declared "data" in the same spec is quiet
+    assert "'modle'" in hits[0].message
+
+
+def test_declared_axes_are_quiet_everywhere(tmp_path):
+    src = """
+        from jax.sharding import PartitionSpec as P
+        import jax
+
+        def specs(topo):
+            kv = P("data", "seq", ("model",), None)
+            mesh = topo.mesh({"data": -1, "seq": 1, "model": 2})
+            out = jax.lax.psum(1, "model")
+            return kv, mesh, out
+    """
+    root = write_tree(tmp_path / "pkg", {"servers/spec.py": src})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_collective_and_axis_name_kwarg_literals_checked(tmp_path):
+    src = """
+        import jax
+
+        def reduce(x, blocks):
+            y = jax.lax.psum(x, "modle")
+            return ring_attention(y, blocks, axis_name="sqe")
+    """
+    root = write_tree(tmp_path / "pkg", {"ops/ring.py": src})
+    reported, _, _ = lint(root)
+    names = {f.message.split("'")[1] for f in reported
+             if f.rule == "axis-name-discipline"}
+    assert names == {"modle", "sqe"}
+
+
+def test_mesh_dict_keys_checked(tmp_path):
+    src = """
+        def build(topo):
+            return topo.mesh({"data": -1, "modell": 2})
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/engine.py": src})
+    reported, _, _ = lint(root)
+    assert "axis-name-discipline" in rules_of(reported)
+
+
+def test_single_file_scan_falls_back_to_repo_registry(tmp_path):
+    """Scanning a lone file (no parallel/topology.py in the tree) checks
+    against the repo's own DECLARED_AXES."""
+    good = tmp_path / "good.py"
+    good.write_text('from jax.sharding import PartitionSpec as P\n'
+                    'S = P("data", "model")\n')
+    bad = tmp_path / "bad.py"
+    bad.write_text('from jax.sharding import PartitionSpec as P\n'
+                   'S = P("bogus")\n')
+    reported, _, _ = run_lint([str(good)])
+    assert rules_of(reported) == []
+    reported, _, _ = run_lint([str(bad)])
+    assert "axis-name-discipline" in rules_of(reported)
+
+
+# ---------------------------------------------------------------------------
+# slice-disjointness
+# ---------------------------------------------------------------------------
+
+def test_provable_overlap_fires_even_with_contract(tmp_path):
+    """devs[:2] and devs[1:] share device 1 at every world size — red
+    even when the callee would raise at runtime (a certain overlap is a
+    bug; the contract just turns it into a crash)."""
+    src = OVERLAP_SLICE.replace("DisaggregatedMesh", "disaggregated_mesh")
+    root = write_tree(tmp_path / "pkg", {"runtime/disagg.py": src})
+    reported, _, _ = lint(root)
+    hits = [f for f in reported if f.rule == "slice-disjointness"]
+    assert hits and "PROVABLY overlapping" in hits[0].message
+
+
+def test_disjoint_constant_slices_are_clean(tmp_path):
+    src = """
+        def split(devs):
+            return DisaggregatedMesh(devs[:2], devs[2:])
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/disagg.py": src})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_complementary_tail_head_split_is_clean(tmp_path):
+    src = """
+        def split(devs, n):
+            return DisaggregatedMesh(devs[:-n], devs[-n:])
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/disagg.py": src})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_opaque_sets_need_a_declared_contract(tmp_path):
+    bad = """
+        def split(pre, dec):
+            return DisaggregatedMesh(pre, dec)
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/disagg.py": bad})
+    reported, _, _ = lint(root)
+    hits = [f for f in reported if f.rule == "slice-disjointness"]
+    assert hits and "SLICE_CONTRACTS" in hits[0].message
+
+    # same call through the CONTRACTED callee: covered
+    ok = bad.replace("DisaggregatedMesh", "disaggregated_mesh")
+    root = write_tree(tmp_path / "pkg2", {"runtime/disagg.py": ok})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_integer_counts_are_the_librarys_problem(tmp_path):
+    src = """
+        def split(topo):
+            return topo.disaggregated(1, 0)
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/disagg.py": src})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+# ---------------------------------------------------------------------------
+# host-assumption
+# ---------------------------------------------------------------------------
+
+def test_implicit_host_assumptions_fire(tmp_path):
+    root = write_tree(tmp_path / "pkg",
+                      {"controlplane/host.py": HOST_ASSUMPTION})
+    reported, _, _ = lint(root)
+    hits = [f.message for f in reported if f.rule == "host-assumption"]
+    assert len(hits) == 3
+    assert any("devices[k]" in m for m in hits)
+    assert any("process_index" in m for m in hits)
+    assert any("slice_index" in m for m in hits)
+
+
+def test_declared_guard_function_is_waived(tmp_path):
+    src = """
+        def detect_world(devices):
+            return devices[0]
+    """
+    root = write_tree(tmp_path / "pkg", {"controlplane/host.py": src})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_lexical_topology_guard_is_waived(tmp_path):
+    src = """
+        def pick(devices, topo):
+            if topo.single_host:
+                return devices[0]
+            if topo.is_primary_process:
+                return devices[1]
+            return None
+    """
+    root = write_tree(tmp_path / "pkg", {"controlplane/host.py": src})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == []
+
+
+def test_jax_devices_zero_outside_parallel_reports_once(tmp_path):
+    """jax.devices()[0] outside parallel/ is ONE finding (the call, as
+    mesh-rederivation) — the [0] symptom isn't double-billed."""
+    src = """
+        import jax
+
+        def lead():
+            return jax.devices()[0]
+    """
+    root = write_tree(tmp_path / "pkg", {"servers/lead.py": src})
+    reported, _, _ = lint(root)
+    assert rules_of(reported) == ["mesh-rederivation"]
+
+
+def test_device_list_indexing_inside_parallel_still_needs_a_guard(tmp_path):
+    src = """
+        def lead(devices):
+            return devices[0]
+    """
+    root = write_tree(tmp_path / "pkg", {"parallel/lead.py": src})
+    reported, _, _ = lint(root)
+    assert "host-assumption" in rules_of(reported)
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason_silences(tmp_path):
+    src = REDERIVE.replace(
+        "        devs = jax.devices()",
+        "        # shardlint: allow-mesh-rederivation(fixture: platform probe, no world derived)\n"
+        "        devs = jax.devices()\n"
+        "        # shardlint: allow-mesh-rederivation(fixture: test-only mesh)")
+    root = write_tree(tmp_path / "pkg", {"runtime/engine.py": src})
+    reported, _, suppressed = lint(root)
+    assert rules_of(reported) == []
+    assert len(suppressed) == 2
+
+
+def test_suppression_with_empty_reason_is_a_finding(tmp_path):
+    src = TYPO_AXIS.replace(
+        'return P("data", "modle")',
+        'return P("data", "modle")  # shardlint: allow-axis-name-discipline()')
+    root = write_tree(tmp_path / "pkg", {"servers/spec.py": src})
+    reported, _, _ = lint(root)
+    assert "bad-suppression" in rules_of(reported)
+    assert "axis-name-discipline" in rules_of(reported)  # NOT silenced
+
+
+def test_unknown_rule_suppression_is_flagged(tmp_path):
+    src = TYPO_AXIS.replace(
+        'return P("data", "modle")',
+        'return P("data", "modle")  # shardlint: allow-made-up-rule(nope)')
+    root = write_tree(tmp_path / "pkg", {"servers/spec.py": src})
+    reported, _, _ = lint(root)
+    assert "bad-suppression" in rules_of(reported)
+
+
+def test_other_tools_tags_do_not_silence_shardlint(tmp_path):
+    """Cross-tool tag isolation: racelint/leaklint/graftlint comments
+    answer to their own layers only."""
+    src = TYPO_AXIS.replace(
+        'return P("data", "modle")',
+        'return P("data", "modle")  '
+        '# racelint: allow-axis-name-discipline(wrong tool)  '
+        '# leaklint: allow-axis-name-discipline(wrong tool)')
+    root = write_tree(tmp_path / "pkg", {"servers/spec.py": src})
+    reported, _, _ = lint(root)
+    assert "axis-name-discipline" in rules_of(reported)
+
+
+def test_shardlint_tag_does_not_silence_leaklint(tmp_path):
+    from tools.leaklint import run_lint as leak_lint
+
+    src = """
+        class Batcher:
+            def _admit(self, req):
+                # shardlint: allow-leak-on-path(wrong tool)
+                aid = self._adapters.resolve_and_pin(req.adapter)
+                slot = self.find_slot()
+                if slot is None:
+                    return False
+                self._commit_slot(slot, aid)
+                return True
+    """
+    root = write_tree(tmp_path / "pkg", {"runtime/adm.py": src})
+    reported, _, _ = leak_lint([root])
+    assert "leak-on-path" in rules_of(reported)
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+def test_baseline_absorbs_then_dies_with_the_code(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"servers/spec.py": TYPO_AXIS})
+    reported, _, _ = lint(root)
+    findings = [f for f in reported if f.rule in RULES]
+    assert findings
+    bpath = str(tmp_path / "baseline.json")
+    save_baseline(bpath, findings)
+    data = json.loads(open(bpath).read())
+    for e in data["entries"]:
+        e["reason"] = "grandfathered for the mechanics test"
+    with open(bpath, "w") as f:
+        json.dump(data, f)
+
+    reported2, absorbed, _ = lint(root, baseline=bpath)
+    assert rules_of(reported2) == []
+    assert len(absorbed) == len(findings)
+
+    # touch the fingerprinted line: the entry dies, the finding resurfaces
+    mutated = TYPO_AXIS.replace('P("data", "modle")', 'P("seq", "modle")')
+    write_tree(tmp_path / "pkg", {"servers/spec.py": mutated})
+    reported3, _, _ = lint(root, baseline=bpath)
+    assert "axis-name-discipline" in rules_of(reported3)
+
+
+def test_baseline_without_reason_is_rejected(tmp_path):
+    root = write_tree(tmp_path / "pkg", {"servers/spec.py": TYPO_AXIS})
+    reported, _, _ = lint(root)
+    bpath = str(tmp_path / "baseline.json")
+    save_baseline(bpath, [f for f in reported if f.rule in RULES])
+    data = json.loads(open(bpath).read())
+    data["entries"][0]["reason"] = "  "
+    with open(bpath, "w") as f:
+        json.dump(data, f)
+    with pytest.raises(ValueError, match="no reason"):
+        lint(root, baseline=bpath)
+
+
+def test_real_tree_has_zero_unsuppressed_findings():
+    """The gate itself: the shipped tree + shipped (empty) baseline lint
+    clean. The PR 20 burn-down fixed every real finding — the only live
+    suppressions are the ops/ Pallas platform probes, each with a
+    reviewable reason."""
+    reported, absorbed, suppressed = run_lint(
+        [os.path.join(REPO, "seldon_core_tpu")],
+        baseline_path=BASELINE if os.path.exists(BASELINE) else None)
+    assert reported == [], "\n".join(f.render() for f in reported)
+    assert absorbed == []  # nothing grandfathered — keep it that way
+    assert all(f.rule == "mesh-rederivation" for f in suppressed), \
+        "only the Pallas platform probes carry suppressions today"
+
+
+def test_real_baseline_count_only_decreases():
+    """The ratchet: the shardlint baseline shipped EMPTY. Growing it
+    means shipping a known sharding-discipline hole; fix it or suppress
+    it inline with a reason a reviewer can judge."""
+    with open(BASELINE) as f:
+        data = json.load(f)
+    assert len(data.get("entries", [])) <= 0
+
+
+# ---------------------------------------------------------------------------
+# burn-down regressions: servers consume the injected Topology
+# ---------------------------------------------------------------------------
+
+def test_migrated_modules_never_touch_the_world_directly():
+    """batcher/llmserver/jaxserver passed the burn-down: zero
+    mesh-rederivation findings WITHOUT suppressions in any of them."""
+    targets = [
+        os.path.join(REPO, "seldon_core_tpu", "runtime", "batcher.py"),
+        os.path.join(REPO, "seldon_core_tpu", "servers", "llmserver.py"),
+        os.path.join(REPO, "seldon_core_tpu", "servers", "jaxserver.py"),
+    ]
+    reported, _, suppressed = run_lint(targets,
+                                       rules=["mesh-rederivation"])
+    assert reported == [], "\n".join(f.render() for f in reported)
+    assert suppressed == []
+
+
+def test_topology_registry_shape():
+    """DECLARED_AXES is the single source of axis truth: the serving
+    axes exist, and Topology.mesh rejects an undeclared axis with a
+    message naming the registry."""
+    from seldon_core_tpu.parallel import DECLARED_AXES, Topology
+
+    assert {"data", "model", "seq"} <= set(DECLARED_AXES)
+    topo = Topology.detect()
+    assert topo.device_count == 8  # conftest virtual mesh
+    with pytest.raises(ValueError, match="DECLARED_AXES"):
+        topo.mesh({"data": -1, "modle": 2})
+
+
+def test_llmserver_builds_its_mesh_from_the_injected_topology():
+    """The server's world view is the Topology it was handed — a
+    4-device sub-topology yields a mesh over exactly those 4 devices,
+    not the process's 8 (the partition_for_disaggregation pre-work:
+    each disagg slice gets a sub-mesh view)."""
+    from seldon_core_tpu.parallel import Topology
+    from seldon_core_tpu.servers.llmserver import LLMServer
+
+    topo = Topology.detect()
+    sub = topo.sub_topology(topo.devices[:4])
+    s = LLMServer(model="llama-tiny", init_random=True, max_new_tokens=2,
+                  len_buckets=(16,), batch_buckets=(1,), seed=7,
+                  tensor_parallel=2, topology=sub)
+    s.load()
+    assert s.topology is sub
+    assert set(s.mesh.devices.flat) == set(sub.devices)
+    assert dict(s.mesh.shape) == {"data": 2, "seq": 1, "model": 2}
+
+
+def test_disaggregated_meshes_carry_sub_topology_views():
+    from seldon_core_tpu.parallel import Topology
+
+    topo = Topology.detect()
+    dm = topo.disaggregated(prefill_devices=2)
+    assert dm.prefill_topology is not None
+    assert set(dm.prefill_topology.devices) == set(dm.prefill_devices)
+    assert set(dm.decode_topology.devices) == set(dm.decode_devices)
+    assert not (set(dm.prefill_topology.devices)
+                & set(dm.decode_topology.devices))
+
+
+# ---------------------------------------------------------------------------
+# virtual-mesh conformance harness
+# ---------------------------------------------------------------------------
+
+def test_conformance_compare_goes_red_on_spec_drift():
+    """The harness's own red path: a declared spec the compiled program
+    doesn't carry must be reported, with the diff naming both sides."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from seldon_core_tpu.parallel import Topology
+    from tools.shardlint.conformance import _compare
+
+    mesh = Topology.detect().mesh({"data": -1, "model": 2})
+    declared = [NamedSharding(mesh, P("model"))]
+    compiled = [NamedSharding(mesh, P())]
+    mismatches = []
+    _compare(declared, compiled, [1], ["w"], "4x2", "predict", mismatches)
+    assert len(mismatches) == 1
+    assert mismatches[0]["declared"] != mismatches[0]["compiled"]
+
+    mismatches = []
+    _compare(declared, [NamedSharding(mesh, P("model"))], [1], ["w"],
+             "4x2", "predict", mismatches)
+    assert mismatches == []
+
+
+def test_conformance_4x2():
+    """Tier-1 cell: compiled shardings match the declared specs at the
+    4x2 (data x model) shape, both cells."""
+    from tools.shardlint.conformance import run_conformance
+
+    report, mismatches = run_conformance(["4x2"])
+    assert mismatches == [], json.dumps(mismatches, indent=2)
+    assert report["4x2"]["leaves_checked"]["predict"] > 0
+    assert report["4x2"]["leaves_checked"]["decode"] > 0
+
+
+@pytest.mark.slow  # tier-1 budget: CI's multi-chip dryrun step runs these
+def test_conformance_2x4_and_1x8():
+    from tools.shardlint.conformance import run_conformance
+
+    report, mismatches = run_conformance(["2x4", "1x8"])
+    assert mismatches == [], json.dumps(mismatches, indent=2)
+    assert set(report) == {"2x4", "1x8"}
+
+
+# ---------------------------------------------------------------------------
+# CLI + parallel runner
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes_and_json(tmp_path):
+    """The acceptance contract: non-zero on EACH mutated fixture class —
+    rederivation, typo'd axis, overlapping slice, host assumption,
+    empty-reason suppression — and 0 on a clean tree."""
+    bad = write_tree(tmp_path / "bad", {
+        "runtime/engine.py": REDERIVE,
+        "servers/spec.py": TYPO_AXIS,
+        "runtime/disagg.py": OVERLAP_SLICE,
+        "controlplane/host.py": HOST_ASSUMPTION,
+        "runtime/supp.py": """
+            def f(topo):
+                return topo.mesh({"data": -1, "oops": 2})  # shardlint: allow-axis-name-discipline()
+        """,
+    })
+    ok = write_tree(tmp_path / "ok", {"runtime/c.py": "X = 1\n"})
+
+    r = cli(bad, "--no-baseline", "--format", "json")
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    seen = {f["rule"] for f in payload["findings"]}
+    assert set(RULES) | {"bad-suppression"} <= seen
+
+    # each rule's gate bites solo too
+    for rule in RULES:
+        assert cli(bad, "--no-baseline", "--rules", rule).returncode == 1, rule
+
+    assert cli(ok, "--no-baseline").returncode == 0
+    assert cli(str(tmp_path / "missing")).returncode == 2
+    assert cli(bad, "--rules", "not-a-rule").returncode == 2
+
+
+def test_cli_real_tree_is_the_gate():
+    r = cli("seldon_core_tpu/")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.slow  # tier-1 870s budget: runs in CI's unfiltered shardlint proofs step
+def test_parallel_matches_serial(tmp_path):
+    root = write_tree(tmp_path / "pkg", {
+        "runtime/engine.py": REDERIVE,
+        "servers/spec.py": TYPO_AXIS,
+        "controlplane/host.py": HOST_ASSUMPTION,
+        "runtime/supp.py": """
+            def f(topo):
+                return topo.mesh({"data": -1, "oops": 2})  # shardlint: allow-axis-name-discipline()
+        """,
+    })
+    serial = run_lint([root])
+    parallel = run_lint_parallel([root], None, None, jobs=4)
+    for s, p in zip(serial, parallel):
+        assert [(f.rule, f.path, f.line) for f in s] == \
+            [(f.rule, f.path, f.line) for f in p]
+    # meta findings (the empty-reason suppression) appear exactly once
+    assert sum(1 for f in parallel[0] if f.rule == "bad-suppression") == 1
+
+
+def test_rules_filter(tmp_path):
+    root = write_tree(tmp_path / "pkg", {
+        "runtime/engine.py": REDERIVE,
+        "servers/spec.py": TYPO_AXIS,
+    })
+    reported, _, _ = lint(root, rules=["mesh-rederivation"])
+    assert set(rules_of(reported)) == {"mesh-rederivation"}
+    reported, _, _ = lint(root, rules=["axis-name-discipline"])
+    assert set(rules_of(reported)) == {"axis-name-discipline"}

@@ -1,0 +1,16 @@
+"""shardlint — mesh/sharding-discipline analysis.
+
+The fifth enforcing static-analysis layer: four AST rules
+(tools/shardlint/checkers.py) anchored on the first-class Topology
+registries (seldon_core_tpu/parallel/topology.py) — mesh-rederivation,
+axis-name-discipline, slice-disjointness, host-assumption — plus a
+virtual-mesh conformance harness (tools/shardlint/conformance.py) that
+lowers the sharded serving contracts under 1x8 / 2x4 / 4x2 device
+meshes and asserts the compiled in/out shardings match the declared
+specs. See docs/static-analysis.md for the layer catalog and rule
+reference.
+"""
+
+from tools.shardlint.core import RULES, run_lint, run_lint_parallel
+
+__all__ = ["RULES", "run_lint", "run_lint_parallel"]

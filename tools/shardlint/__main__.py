@@ -1,0 +1,117 @@
+"""shardlint CLI.
+
+    python -m tools.shardlint [paths...]
+        [--baseline FILE | --no-baseline] [--update-baseline]
+        [--rules r1,r2] [--jobs N] [--format text|json] [--verbose]
+
+Exit codes: 0 clean, 1 findings, 2 usage/configuration error — the same
+contract as the other four layers (docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.graftlint.core import load_baseline, save_baseline
+from tools.shardlint.core import RULES, run_lint, run_lint_parallel
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.shardlint",
+        description="mesh/sharding-discipline analysis "
+                    "(docs/static-analysis.md)")
+    parser.add_argument("paths", nargs="*", default=["seldon_core_tpu"],
+                        help="files or directories to scan "
+                             "(default: seldon_core_tpu)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline JSON (default: {DEFAULT_BASELINE} "
+                             "when it exists)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline: report every finding")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="write the current findings to the baseline "
+                             "file (reasons must then be filled in by hand)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated subset of: " + ", ".join(RULES))
+    parser.add_argument("--jobs", type=int, default=1, metavar="N",
+                        help="run rule groups in N worker processes "
+                             "(CI uses this to keep five lint layers "
+                             "inside the old wall time)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--verbose", action="store_true",
+                        help="also list suppressed/baselined findings")
+    args = parser.parse_args(argv)
+
+    paths = args.paths or ["seldon_core_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"shardlint: path does not exist: {p}", file=sys.stderr)
+            return 2
+
+    baseline_path = None
+    if not args.no_baseline:
+        baseline_path = args.baseline or (
+            DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
+        if args.baseline and not os.path.exists(args.baseline) \
+                and not args.update_baseline:
+            print(f"shardlint: baseline not found: {args.baseline}",
+                  file=sys.stderr)
+            return 2
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    live_baseline = baseline_path if (
+        baseline_path and os.path.exists(baseline_path)) else None
+    try:
+        if args.jobs > 1:
+            reported, absorbed, suppressed = run_lint_parallel(
+                paths, live_baseline, rules, args.jobs)
+        else:
+            reported, absorbed, suppressed = run_lint(
+                paths, baseline_path=live_baseline, rules=rules)
+    except ValueError as e:
+        print(f"shardlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        # regenerate from the FULL set (reported + still-absorbed) so live
+        # grandfathered entries and their hand-written reasons survive
+        target = args.baseline or DEFAULT_BASELINE
+        keep = {}
+        if live_baseline:
+            keep = load_baseline(live_baseline)
+        entries = [f for f in reported if f.rule in RULES] + absorbed
+        save_baseline(target, entries, keep_reasons=keep)
+        fresh = sum(1 for f in entries if keep.get(f.fingerprint()) is None)
+        print(f"shardlint: wrote {len(entries)} finding(s) to {target} "
+              f"({fresh} new — fill in each new entry's reason before "
+              "committing)")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in reported],
+            "baselined": len(absorbed),
+            "suppressed": len(suppressed),
+        }, indent=2))
+    else:
+        for f in reported:
+            print(f.render())
+        if args.verbose:
+            for f in suppressed:
+                print(f"[suppressed] {f.render()}")
+            for f in absorbed:
+                print(f"[baselined]  {f.render()}")
+        print(f"shardlint: {len(reported)} finding(s)"
+              f" ({len(suppressed)} suppressed, {len(absorbed)} baselined)",
+              file=sys.stderr)
+    return 1 if reported else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

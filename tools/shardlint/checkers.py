@@ -1,0 +1,558 @@
+"""shardlint checkers: mesh/sharding-discipline rules over the AST.
+
+Four rules, all anchored on the declared registries in
+``seldon_core_tpu/parallel/topology.py`` (which this module reads with
+``ast`` — a fixture tree carries its own ``parallel/topology.py`` and is
+checked against ITS registries, not the repo's):
+
+- **mesh-rederivation** — the device world is derived once, in
+  ``parallel/``. Any ``jax.devices()`` / ``jax.local_devices()`` /
+  ``jax.device_count()`` / ``jax.process_index()`` call, ``Mesh(...)``
+  construction, or ``mesh_utils`` import/use outside ``parallel/`` is a
+  finding: two derivation sites can disagree, and code holding only a
+  slice view must not be able to see the whole world.
+- **axis-name-discipline** — every mesh axis literal (``PartitionSpec``
+  / ``P`` args, collective ``axis_name``s, ``make_mesh``-style axis
+  dict keys, ``Mesh`` axis tuples) must be declared in
+  ``DECLARED_AXES``. A typo'd axis name silently replicates instead of
+  sharding; here it fails the lint gate instead.
+- **slice-disjointness** — prefill/decode device sets flowing into a
+  disaggregated-mesh constructor are proven non-overlapping when both
+  are constant slices of the same sequence; a PROVABLE overlap is
+  always a finding, and a statically-opaque pair is a finding unless
+  the callee declares a runtime disjointness contract in
+  ``SLICE_CONTRACTS``.
+- **host-assumption** — ``devices[0]``-style constant indexing,
+  ``process_index == 0`` gating, and ``slice_index`` probes are only
+  legal inside functions declared in ``SINGLE_HOST_GUARDS`` or under an
+  ``if``/``while`` test on a topology predicate (``single_host`` /
+  ``is_primary_process``). Outside ``parallel/``, a ``jax.devices()[0]``
+  is reported once, as mesh-rederivation (the call is the disease; the
+  ``[0]`` is a symptom).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import FrozenSet, List, Optional, Sequence, Tuple
+
+from tools.graftlint.core import (
+    Finding,
+    Module,
+    Project,
+    dotted,
+    iter_functions,
+    make_finding,
+)
+
+RULES = (
+    "mesh-rederivation",
+    "axis-name-discipline",
+    "slice-disjointness",
+    "host-assumption",
+)
+
+TOPOLOGY_SUFFIX = "parallel/topology.py"
+
+# device-world derivation calls banned outside parallel/
+WORLD_CALLS = frozenset({
+    "jax.devices",
+    "jax.local_devices",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.process_index",
+    "jax.process_count",
+})
+
+# collectives whose string args name mesh axes
+COLLECTIVE_FNS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter",
+    "all_gather", "all_to_all", "ppermute", "pshuffle", "pbroadcast",
+    "axis_index", "axis_size",
+})
+
+# callables taking a {axis_name: size} dict as a positional arg
+MESH_DICT_FNS = frozenset({"make_mesh", "hybrid_mesh", "mesh"})
+
+# disaggregated prefill/decode constructors examined by slice-disjointness
+DISAGG_FNS = frozenset({
+    "DisaggregatedMesh", "disaggregated_mesh", "disaggregated",
+})
+
+# topology predicates whose if/while tests declare a host assumption
+GUARD_PREDICATES = frozenset({"single_host", "is_primary_process"})
+
+
+@dataclass(frozen=True)
+class TopologyRegistry:
+    """The declared registries, parsed statically from the scanned
+    tree's ``parallel/topology.py`` (repo fallback for single-file
+    scans). ``source`` names where they came from ("" = nowhere)."""
+
+    axes: FrozenSet[str]
+    guards: FrozenSet[str]
+    contracts: FrozenSet[str]
+    source: str
+
+
+def _registry_from_tree(tree: ast.Module):
+    axes, guards, contracts = set(), set(), set()
+    buckets = {
+        "DECLARED_AXES": axes,
+        "SINGLE_HOST_GUARDS": guards,
+        "SLICE_CONTRACTS": contracts,
+    }
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                           ast.Name):
+            targets, value = [node.target.id], node.value
+        else:
+            continue
+        if not isinstance(value, ast.Dict):
+            continue
+        keys = {k.value for k in value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+        for t in targets:
+            if t in buckets:
+                buckets[t] |= keys
+    return axes, guards, contracts
+
+
+def load_registry(project: Project) -> TopologyRegistry:
+    axes, guards, contracts = set(), set(), set()
+    source = ""
+    for mod in project.modules:
+        if mod.relpath.endswith(TOPOLOGY_SUFFIX):
+            a, g, c = _registry_from_tree(mod.tree)
+            axes |= a
+            guards |= g
+            contracts |= c
+            source = source or mod.relpath
+    if not source:
+        # single-file scans: fall back to the repo's own registry so
+        # `python -m tools.shardlint some/file.py` still knows the axes
+        repo = os.path.normpath(os.path.join(
+            os.path.dirname(__file__), "..", "..",
+            "seldon_core_tpu", "parallel", "topology.py"))
+        if os.path.exists(repo):
+            try:
+                with open(repo, "r", encoding="utf-8") as f:
+                    a, g, c = _registry_from_tree(ast.parse(f.read()))
+            except (SyntaxError, OSError):
+                pass
+            else:
+                axes, guards, contracts = a, g, c
+                source = "<repo topology.py>"
+    return TopologyRegistry(frozenset(axes), frozenset(guards),
+                            frozenset(contracts), source)
+
+
+# ----------------------------------------------------------------------
+# shared helpers
+# ----------------------------------------------------------------------
+
+def _in_parallel(module: Module) -> bool:
+    return "parallel" in module.parts[:-1]
+
+
+def _is_topology_module(module: Module) -> bool:
+    return module.relpath.endswith(TOPOLOGY_SUFFIX)
+
+
+def _func_index(module: Module):
+    return iter_functions(module.tree)
+
+
+def _enclosing(funcs, node: ast.AST) -> str:
+    line = getattr(node, "lineno", 0) or 0
+    best, best_span = "", None
+    for q, f in funcs:
+        end = getattr(f, "end_lineno", f.lineno) or f.lineno
+        if f.lineno <= line <= end:
+            span = end - f.lineno
+            if best_span is None or span < best_span:
+                best, best_span = q, span
+    return best
+
+
+def _final(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _callee_name(call: ast.Call) -> str:
+    return _final(dotted(call.func))
+
+
+# ----------------------------------------------------------------------
+# mesh-rederivation
+# ----------------------------------------------------------------------
+
+def check_mesh_rederivation(project: Project,
+                            registry: TopologyRegistry) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules:
+        if _in_parallel(mod):
+            continue
+        funcs = _func_index(mod)
+        mesh_ctors = set()  # local names bound to jax.sharding.Mesh
+        seen = set()
+
+        def report(node, api: str, what: str):
+            key = (getattr(node, "lineno", 0), api)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(make_finding(
+                mod, "mesh-rederivation", node,
+                f"{what}: device/mesh facts are derived once in parallel/ "
+                f"and consumed via the injected Topology "
+                f"(parallel/topology.py) — {api} re-derives them here",
+                _enclosing(funcs, node)))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module == "jax.sharding":
+                    for alias in node.names:
+                        if alias.name == "Mesh":
+                            mesh_ctors.add(alias.asname or alias.name)
+                if node.module in ("jax.experimental",) and any(
+                        a.name == "mesh_utils" for a in node.names):
+                    report(node, "mesh_utils",
+                           "mesh_utils import outside parallel/")
+                if node.module and node.module.startswith(
+                        "jax.experimental.mesh_utils"):
+                    report(node, "mesh_utils",
+                           "mesh_utils import outside parallel/")
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.startswith("jax.experimental.mesh_utils"):
+                        report(node, "mesh_utils",
+                               "mesh_utils import outside parallel/")
+            elif isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d in WORLD_CALLS:
+                    report(node, f"{d}()",
+                           "device-world call outside parallel/")
+                elif d and (d == "jax.sharding.Mesh" or d in mesh_ctors):
+                    report(node, "Mesh(...)",
+                           "Mesh construction outside parallel/")
+                elif d and (d.startswith("mesh_utils.")
+                            or ".mesh_utils." in d):
+                    report(node, d, "mesh_utils use outside parallel/")
+    return findings
+
+
+# ----------------------------------------------------------------------
+# axis-name-discipline
+# ----------------------------------------------------------------------
+
+def _str_literals(node: ast.AST):
+    """Yield (str, node) for a Constant str or a tuple/list of them."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                yield elt.value, elt
+
+
+def check_axis_names(project: Project,
+                     registry: TopologyRegistry) -> List[Finding]:
+    findings: List[Finding] = []
+    declared = registry.axes
+    where = registry.source or "parallel/topology.py (NOT FOUND in scan)"
+    for mod in project.modules:
+        if _is_topology_module(mod):
+            continue
+        funcs = _func_index(mod)
+        # names bound to jax.sharding.PartitionSpec (incl. `as P`)
+        spec_ctors = {"PartitionSpec"}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "jax.sharding":
+                for alias in node.names:
+                    if alias.name == "PartitionSpec":
+                        spec_ctors.add(alias.asname or alias.name)
+
+        def check(name: str, node: ast.AST, via: str):
+            if name in declared:
+                return
+            findings.append(make_finding(
+                mod, "axis-name-discipline", node,
+                f"axis name {name!r} (via {via}) is not declared in "
+                f"DECLARED_AXES ({where}) — known axes: "
+                f"{', '.join(sorted(declared)) or 'none'}",
+                _enclosing(funcs, node)))
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _callee_name(node)
+            # PartitionSpec("data", ...) / P(None, ("data", "model"))
+            if callee in spec_ctors:
+                for arg in node.args:
+                    for name, n in _str_literals(arg):
+                        check(name, n, f"{callee}(...)")
+            # collective positional axis args: psum(x, "model")
+            elif callee in COLLECTIVE_FNS:
+                for arg in node.args:
+                    for name, n in _str_literals(arg):
+                        check(name, n, f"{callee}(...)")
+            # {axis: size} dicts: make_mesh({"data": -1}), topo.mesh({...})
+            if callee in MESH_DICT_FNS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Dict):
+                        for k in arg.keys:
+                            if isinstance(k, ast.Constant) and \
+                                    isinstance(k.value, str):
+                                check(k.value, k, f"{callee}({{...}})")
+            # Mesh(devices, ("data", "model")) axis tuples
+            if callee == "Mesh" and len(node.args) >= 2:
+                for name, n in _str_literals(node.args[1]):
+                    check(name, n, "Mesh(..., axis_names)")
+            # axis_name=/axis_names= kwargs on ANY call (shard_map,
+            # collectives, ring_attention-style kernels)
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis_names"):
+                    for name, n in _str_literals(kw.value):
+                        check(name, n, f"{callee or '?'}({kw.arg}=)")
+    return findings
+
+
+# ----------------------------------------------------------------------
+# slice-disjointness
+# ----------------------------------------------------------------------
+
+def _const_slice(node: ast.AST):
+    """(base_dump, lower, upper) for a step-less ``base[l:u]`` where each
+    bound is None, an int constant, or the marker string 'var' (paired
+    with the bound's ast dump for complement matching); None otherwise."""
+    if not isinstance(node, ast.Subscript) or \
+            not isinstance(node.slice, ast.Slice):
+        return None
+    sl = node.slice
+    if sl.step is not None:
+        return None
+
+    def bound(x):
+        if x is None:
+            return None, None
+        if isinstance(x, ast.Constant) and isinstance(x.value, int):
+            return x.value, ast.dump(x)
+        if isinstance(x, ast.UnaryOp) and isinstance(x.op, ast.USub) and \
+                isinstance(x.operand, ast.Constant) and \
+                isinstance(x.operand.value, int):
+            return -x.operand.value, ast.dump(x)
+        return "var", ast.dump(x)
+
+    lo, lo_dump = bound(sl.lower)
+    hi, hi_dump = bound(sl.upper)
+    return ast.dump(node.value), (lo, lo_dump), (hi, hi_dump)
+
+
+def _classify_pair(a, b) -> str:
+    """'disjoint' | 'overlap' | 'unknown' for two constant slices.
+
+    Complementary split — ``x[L:]`` vs ``x[:U]`` with L and U the same
+    expression — is disjoint by construction. Integer-bound pairs are
+    decided by evaluating both slices over every length 1..256: slice
+    arithmetic with negative indices is linear in len, so if the verdict
+    is the same at every sampled length it holds for all of them."""
+    if a is None or b is None or a[0] != b[0]:
+        return "unknown"
+    (alo, alo_d), (ahi, ahi_d) = a[1], a[2]
+    (blo, blo_d), (bhi, bhi_d) = b[1], b[2]
+    for (lo, lo_d, o_hi, o_hi_d) in (
+            (alo, alo_d, bhi, bhi_d), (blo, blo_d, ahi, ahi_d)):
+        if lo_d is not None and o_hi_d is not None and lo_d == o_hi_d \
+                and ahi_d != alo_d:
+            # a = x[E:] vs b = x[:E] (in either order)
+            if (lo == alo and ahi is None and blo is None) or \
+                    (lo == blo and bhi is None and alo is None):
+                return "disjoint"
+    bounds = (alo, ahi, blo, bhi)
+    if any(v == "var" for v in bounds):
+        return "unknown"
+    verdicts = set()
+    for length in range(1, 257):
+        idx = list(range(length))
+        sa = set(idx[slice(alo, ahi)])
+        sb = set(idx[slice(blo, bhi)])
+        if not sa or not sb:
+            continue  # degenerate length: no evidence either way
+        verdicts.add(bool(sa & sb))
+    if verdicts == {True}:
+        return "overlap"
+    if verdicts == {False}:
+        return "disjoint"
+    return "unknown"
+
+
+def check_slice_disjointness(project: Project,
+                             registry: TopologyRegistry) -> List[Finding]:
+    findings: List[Finding] = []
+    contracts = {_final(c) for c in registry.contracts}
+    for mod in project.modules:
+        funcs = _func_index(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _callee_name(node)
+            if callee not in DISAGG_FNS:
+                continue
+            args = list(node.args[:2])
+            by_kw = {kw.arg: kw.value for kw in node.keywords}
+            while len(args) < 2:
+                args.append(None)
+            if args[0] is None:
+                args[0] = by_kw.get("prefill_devices")
+            if args[1] is None:
+                args[1] = by_kw.get("decode_devices")
+            pre, dec = args
+            if pre is None or dec is None:
+                continue
+            # int counts: the library computes the split — nothing to prove
+            if any(isinstance(x, ast.Constant) and isinstance(x.value, int)
+                   for x in (pre, dec)):
+                continue
+            verdict = _classify_pair(_const_slice(pre), _const_slice(dec))
+            if verdict == "overlap":
+                findings.append(make_finding(
+                    mod, "slice-disjointness", node,
+                    f"prefill/decode device sets passed to {callee} are "
+                    "PROVABLY overlapping constant slices of the same "
+                    "sequence — a shared device re-couples the prefill "
+                    "burst to decode latency",
+                    _enclosing(funcs, node)))
+            elif verdict == "unknown" and callee not in contracts:
+                findings.append(make_finding(
+                    mod, "slice-disjointness", node,
+                    f"prefill/decode device sets passed to {callee} are "
+                    "not statically disjoint and the callee declares no "
+                    "runtime disjointness contract in SLICE_CONTRACTS "
+                    "(parallel/topology.py)",
+                    _enclosing(funcs, node)))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# host-assumption
+# ----------------------------------------------------------------------
+
+def _guarded_lines(tree: ast.Module) -> set:
+    """Lines lexically under an if/while whose test consults a topology
+    predicate (single_host / is_primary_process) — there the host
+    assumption is declared, not implicit."""
+    guarded = set()
+
+    def mentions(test: ast.AST) -> bool:
+        for n in ast.walk(test):
+            d = dotted(n)
+            if d and _final(d) in GUARD_PREDICATES:
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.While)) and mentions(node.test):
+            for child in node.body:
+                for n in ast.walk(child):
+                    ln = getattr(n, "lineno", None)
+                    if ln:
+                        guarded.add(ln)
+    return guarded
+
+
+def check_host_assumption(project: Project,
+                          registry: TopologyRegistry) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules:
+        funcs = _func_index(mod)
+        guarded = _guarded_lines(mod.tree)
+        seen = set()
+
+        def report(node, what: str):
+            fn = _enclosing(funcs, node)
+            if fn in registry.guards:
+                return
+            line = getattr(node, "lineno", 0)
+            if line in guarded:
+                return
+            key = (line, what)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(make_finding(
+                mod, "host-assumption", node,
+                f"{what} outside a declared single-host guard "
+                "(SINGLE_HOST_GUARDS in parallel/topology.py, or an "
+                "if/while on topology.single_host / is_primary_process) "
+                "— use Topology.default_device / is_primary_process / "
+                "physical_slice_map instead",
+                fn))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, int):
+                base = node.value
+                if isinstance(base, ast.Call):
+                    d = dotted(base.func)
+                    if d and _final(d) in ("devices", "local_devices"):
+                        # outside parallel/, the jax.devices() call itself
+                        # is already a mesh-rederivation finding
+                        if not (d in WORLD_CALLS and not _in_parallel(mod)):
+                            report(node, "constant indexing of a device "
+                                         "list (devices()[k])")
+                else:
+                    d = dotted(base)
+                    if d and (_final(d) in ("devices", "local_devices")):
+                        report(node, "constant indexing of a device list "
+                                     "(devices[k])")
+            elif isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                names = []
+                for s in sides:
+                    d = dotted(s.func) if isinstance(s, ast.Call) \
+                        else dotted(s)
+                    names.append(_final(d) if d else "")
+                has_pi = "process_index" in names
+                has_const = any(isinstance(s, ast.Constant) and
+                                isinstance(s.value, int) for s in sides)
+                if has_pi and has_const:
+                    report(node, "process_index compared to a constant")
+            elif isinstance(node, ast.Attribute) and \
+                    node.attr == "slice_index":
+                report(node, "slice_index probe")
+            elif isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d == "hasattr" and len(node.args) == 2 and \
+                        isinstance(node.args[1], ast.Constant) and \
+                        node.args[1].value == "slice_index":
+                    report(node, "slice_index probe (hasattr)")
+    return findings
+
+
+# ----------------------------------------------------------------------
+# dispatch
+# ----------------------------------------------------------------------
+
+CHECKERS = {
+    "mesh-rederivation": check_mesh_rederivation,
+    "axis-name-discipline": check_axis_names,
+    "slice-disjointness": check_slice_disjointness,
+    "host-assumption": check_host_assumption,
+}
+
+
+def check_project(project: Project,
+                  rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    registry = load_registry(project)
+    findings: List[Finding] = []
+    for rule in rules or RULES:
+        findings.extend(CHECKERS[rule](project, registry))
+    return findings

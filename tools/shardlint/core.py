@@ -1,0 +1,75 @@
+"""shardlint runner: suppressions, baseline, and rule dispatch.
+
+Fifth enforcing lint layer (after graftlint / hlolint / racelint /
+leaklint), built on the same shared machinery (tools/graftlint/core.py):
+identical Finding fingerprinting, shrink-only baseline with mandatory
+reasons, one-line suppressions answering to the ``shardlint`` tag only:
+
+    dev = jax.devices()[0]  # shardlint: allow-mesh-rederivation(reason...)
+
+The static half lives in tools/shardlint/checkers.py (four rules over
+the Topology registries declared in seldon_core_tpu/parallel/
+topology.py); the dynamic half that proves the declared specs actually
+compile is the virtual-mesh conformance harness in
+tools/shardlint/conformance.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from tools.graftlint.core import (
+    Finding,
+    finalize_findings,
+    load_baseline,
+    load_project,
+    parallel_by_rule,
+    save_baseline,
+    suppress_re,
+)
+
+RULES = (
+    "mesh-rederivation",
+    "axis-name-discipline",
+    "slice-disjointness",
+    "host-assumption",
+)
+
+META_RULES = ("bad-suppression", "parse-error")
+
+SUPPRESS_RE = suppress_re("shardlint")
+
+__all__ = ["RULES", "run_lint", "run_lint_parallel", "load_baseline",
+           "save_baseline"]
+
+
+def run_lint(paths: Sequence[str], baseline_path: Optional[str] = None,
+             rules: Optional[Sequence[str]] = None, meta: bool = True):
+    """Returns (reported, absorbed, suppressed); ``reported`` non-empty
+    fails the gate. Same contract as the other four layers."""
+    from tools.shardlint.checkers import check_project
+
+    project = load_project(paths, suppress=SUPPRESS_RE, known_rules=RULES,
+                           tool="shardlint")
+    findings: List[Finding] = list(project.errors) if meta else []
+    active = set(rules or RULES)
+    unknown = active - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+    findings.extend(check_project(project, rules=sorted(active)))
+    return finalize_findings(project, findings, RULES, baseline_path)
+
+
+def _parallel_worker(args):
+    paths, baseline_path, rule_group, meta = args
+    return run_lint(paths, baseline_path=baseline_path, rules=rule_group,
+                    meta=meta)
+
+
+def run_lint_parallel(paths: Sequence[str], baseline_path: Optional[str],
+                      rules: Optional[Sequence[str]], jobs: int):
+    """--jobs: rule groups across worker processes via the shared
+    graftlint-core scheme (whole-tree walk per group, rule-scoped
+    baseline fingerprints, meta findings from exactly one group)."""
+    return parallel_by_rule(_parallel_worker, paths, baseline_path, rules,
+                            jobs, RULES, run_lint)
